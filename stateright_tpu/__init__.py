@@ -1,0 +1,50 @@
+"""stateright_tpu — a TPU-native explicit-state model checker for distributed systems.
+
+A brand-new framework with the capabilities of the Rust `stateright` library
+(reference: /root/reference, v0.30.2):
+
+- A general-purpose explicit-state model checker (BFS / DFS / on-demand / random
+  simulation) with safety, reachability, and liveness properties
+  (ref: src/checker.rs, src/checker/{bfs,dfs,on_demand,simulation}.rs).
+- An actor framework whose systems can be both model-checked (`ActorModel`) and
+  executed for real over UDP (`spawn`) (ref: src/actor.rs, src/actor/*).
+- Consistency semantics testers (linearizability, sequential consistency) that run
+  inside the checker as auxiliary history state (ref: src/semantics/*).
+- An interactive Explorer web UI for browsing the state graph
+  (ref: src/checker/explorer.rs, ui/).
+
+Unlike the reference's thread/work-stealing design, the performance path here is
+TPU-first: frontier states are expanded as batched successor kernels under `jit`,
+fingerprint dedup is a device-resident hash set over HBM, and multi-chip runs shard
+the frontier by fingerprint with ICI all-to-all exchange (see `stateright_tpu.tensor`).
+"""
+
+from .core.model import Model, Property, Expectation
+from .core.fingerprint import fingerprint, fingerprint_bytes, stable_encode
+from .core.path import Path
+from .core.visitor import CheckerVisitor, PathRecorder, StateRecorder
+from .core.report import Reporter, WriteReporter, ReportData
+from .core.discovery import HasDiscoveries
+from .checker.builder import CheckerBuilder
+from .checker.base import Checker
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Model",
+    "Property",
+    "Expectation",
+    "fingerprint",
+    "fingerprint_bytes",
+    "stable_encode",
+    "Path",
+    "CheckerVisitor",
+    "PathRecorder",
+    "StateRecorder",
+    "Reporter",
+    "WriteReporter",
+    "ReportData",
+    "HasDiscoveries",
+    "CheckerBuilder",
+    "Checker",
+]

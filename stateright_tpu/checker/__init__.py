@@ -1,0 +1,1 @@
+"""Checker runtimes: BFS, DFS, simulation, on-demand, and the TPU frontier checker."""

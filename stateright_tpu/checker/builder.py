@@ -1,0 +1,101 @@
+"""Checker configuration builder (ref: src/checker.rs:65-288).
+
+Instantiated via `Model.checker()`; fluent config then one of the `spawn_*`
+methods. Beyond the reference's strategies (bfs/dfs/on_demand/simulation), this
+builder adds `spawn_tpu()` — the batched device frontier checker — behind the
+same `Checker` interface, the plug-in boundary BASELINE.json requires.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from ..core.discovery import HasDiscoveries
+from ..core.visitor import as_visitor
+
+
+class CheckerBuilder:
+    def __init__(self, model):
+        self.model = model
+        self.symmetry_fn_: Optional[Callable] = None
+        self.target_state_count_: Optional[int] = None
+        self.target_max_depth_: Optional[int] = None
+        self.thread_count_: int = 1
+        self.visitor_ = None
+        self.finish_when_: HasDiscoveries = HasDiscoveries.ALL
+        self.timeout_: Optional[float] = None
+
+    # -- config (fluent; ref: src/checker.rs:219-287) --------------------------
+
+    def symmetry(self) -> "CheckerBuilder":
+        """Enable symmetry reduction via the state's `representative()` method
+        (ref: src/checker.rs:222-227)."""
+        return self.symmetry_fn(lambda state: state.representative())
+
+    def symmetry_fn(self, representative: Callable) -> "CheckerBuilder":
+        self.symmetry_fn_ = representative
+        return self
+
+    def finish_when(self, has_discoveries: HasDiscoveries) -> "CheckerBuilder":
+        self.finish_when_ = has_discoveries
+        return self
+
+    def target_state_count(self, count: int) -> "CheckerBuilder":
+        self.target_state_count_ = count if count > 0 else None
+        return self
+
+    def target_max_depth(self, depth: int) -> "CheckerBuilder":
+        self.target_max_depth_ = depth if depth > 0 else None
+        return self
+
+    def threads(self, thread_count: int) -> "CheckerBuilder":
+        self.thread_count_ = max(1, thread_count)
+        return self
+
+    def visitor(self, visitor) -> "CheckerBuilder":
+        self.visitor_ = as_visitor(visitor)
+        return self
+
+    def timeout(self, seconds: float) -> "CheckerBuilder":
+        self.timeout_ = seconds
+        return self
+
+    @property
+    def close_at(self) -> Optional[float]:
+        return None if self.timeout_ is None else time.monotonic() + self.timeout_
+
+    # -- spawn (ref: src/checker.rs:144-217) -----------------------------------
+
+    def spawn_bfs(self):
+        from .bfs import BfsChecker
+
+        return BfsChecker(self)
+
+    def spawn_dfs(self):
+        from .dfs import DfsChecker
+
+        return DfsChecker(self)
+
+    def spawn_simulation(self, seed: int = 0, chooser=None):
+        from .simulation import SimulationChecker, UniformChooser
+
+        return SimulationChecker(self, seed, chooser or UniformChooser())
+
+    def spawn_on_demand(self):
+        from .on_demand import OnDemandChecker
+
+        return OnDemandChecker(self)
+
+    def serve(self, address: str = "localhost:3000", block: bool = False):
+        """Start the Explorer web service (ref: src/checker.rs:144-151)."""
+        from ..explorer.server import serve
+
+        return serve(self, address, block=block)
+
+    def spawn_tpu(self, **kwargs):
+        """Spawn the batched device (TPU) frontier checker. The model must be a
+        `stateright_tpu.tensor.TensorModel` or provide one via `tensor_model()`."""
+        from .tpu import TpuChecker
+
+        return TpuChecker(self, **kwargs)

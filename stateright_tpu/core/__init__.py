@@ -1,0 +1,1 @@
+"""Core abstractions: Model, Property, fingerprinting, paths, visitors, reporting."""

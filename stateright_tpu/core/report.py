@@ -1,0 +1,78 @@
+"""Progress reporting (ref: src/report.rs).
+
+`WriteReporter` prints periodic "Checking. states=... unique=... sec=..." lines
+and a final summary including discovered property paths, matching the reference's
+report stream that bench.sh greps (ref: src/report.rs:50-98, bench.sh:17-27).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Optional, TextIO
+
+
+@dataclass
+class ReportData:
+    """Snapshot of checker progress (ref: src/report.rs:10-21)."""
+
+    total_states: int
+    unique_states: int
+    max_depth: int
+    duration: float  # seconds
+    done: bool
+
+
+class Reporter:
+    """Receives progress snapshots (ref: src/report.rs:35-48)."""
+
+    def delay(self) -> float:
+        return 1.0  # ref: src/report.rs:46 — 1s default
+
+    def report_checking(self, data: ReportData) -> None:
+        raise NotImplementedError
+
+    def report_discoveries(self, model, discoveries: dict) -> None:
+        raise NotImplementedError
+
+
+class WriteReporter(Reporter):
+    """Writes progress to a stream (ref: src/report.rs:50-98)."""
+
+    def __init__(self, stream: Optional[TextIO] = None):
+        import sys
+
+        self.stream = stream if stream is not None else sys.stdout
+
+    def report_checking(self, data: ReportData) -> None:
+        # Line formats match the reference exactly (ref: src/report.rs:65-82);
+        # bench harnesses grep the `sec=` field of the Done line.
+        if data.done:
+            self.stream.write(
+                f"Done. states={data.total_states}, unique={data.unique_states}, "
+                f"depth={data.max_depth}, sec={data.duration:.6g}\n"
+            )
+        else:
+            self.stream.write(
+                f"Checking. states={data.total_states}, "
+                f"unique={data.unique_states}, depth={data.max_depth}\n"
+            )
+        self.stream.flush()
+
+    def report_discoveries(self, model, discoveries: dict) -> None:
+        # ref: src/report.rs:84-97
+        for name, (classification, path) in sorted(discoveries.items()):
+            self.stream.write(f'Discovered "{name}" {classification} {path}')
+            self.stream.write(f"Fingerprint path: {path.encode()}\n")
+        self.stream.flush()
+
+
+class _NullReporter(Reporter):
+    def report_checking(self, data: ReportData) -> None:
+        pass
+
+    def report_discoveries(self, model, discoveries: dict) -> None:
+        pass
+
+
+NULL_REPORTER = _NullReporter()

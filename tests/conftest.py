@@ -1,0 +1,16 @@
+"""Test configuration.
+
+Device-layer tests run on a virtual 8-device CPU mesh so multi-chip sharding is
+exercised without TPU hardware (the driver separately dry-run-compiles the
+multi-chip path via __graft_entry__.dryrun_multichip). These env vars must be
+set before jax is first imported anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,77 @@
+"""BFS checker semantics (ref: src/checker/bfs.rs:411-489 tests)."""
+
+import pytest
+
+from stateright_tpu import StateRecorder
+from stateright_tpu.fixtures import Guess, LinearEquation, Panicker
+
+
+def test_visits_states_in_bfs_order():
+    # ref: src/checker/bfs.rs:417-442
+    recorder = StateRecorder()
+    LinearEquation(a=2, b=10, c=14).checker().visitor(recorder).spawn_bfs().join()
+    assert recorder.states == [
+        (0, 0),  # distance 0
+        (1, 0), (0, 1),  # distance 1
+        (2, 0), (1, 1), (0, 2),  # distance 2
+        (3, 0), (2, 1),  # distance 3
+    ]
+
+
+def test_can_complete_by_enumerating_all_states():
+    # ref: src/checker/bfs.rs:444-453 — full 256*256 enumeration
+    checker = LinearEquation(a=2, b=4, c=7).checker().spawn_bfs().join()
+    assert checker.is_done()
+    checker.assert_no_discovery("solvable")
+    assert checker.unique_state_count() == 256 * 256
+
+
+def test_can_complete_by_eliminating_properties():
+    # ref: src/checker/bfs.rs:455-476
+    checker = LinearEquation(a=2, b=10, c=14).checker().spawn_bfs().join()
+    checker.assert_properties()
+    assert checker.unique_state_count() == 12
+
+    # BFS finds the shortest example...
+    assert checker.discovery("solvable").actions() == [
+        Guess.INCREASE_X, Guess.INCREASE_X, Guess.INCREASE_Y,
+    ]
+    # ...but other solutions also validate: (2*0 + 10*27) % 256 == 14.
+    checker.assert_discovery("solvable", [Guess.INCREASE_Y] * 27)
+
+
+def test_handles_panics_gracefully():
+    # ref: src/checker/bfs.rs:478-488 — a panicking model must shut down all
+    # threads, and join() surfaces the panic.
+    with pytest.raises(RuntimeError, match="reached panic state"):
+        Panicker().checker().threads(2).spawn_bfs().join()
+
+
+def test_multithreaded_bfs_matches_single_threaded_counts():
+    single = LinearEquation(a=2, b=4, c=7).checker().spawn_bfs().join()
+    multi = LinearEquation(a=2, b=4, c=7).checker().threads(4).spawn_bfs().join()
+    assert multi.unique_state_count() == single.unique_state_count() == 65536
+
+
+def test_target_max_depth_limits_exploration():
+    checker = (
+        LinearEquation(a=2, b=4, c=7)
+        .checker()
+        .target_max_depth(3)
+        .spawn_bfs()
+        .join()
+    )
+    # depths 1..3 evaluated; states at depth 3 are not expanded.
+    assert checker.max_depth() == 3
+    assert checker.unique_state_count() == 1 + 2 + 3  # BFS layers of the grid
+
+
+def test_target_state_count_stops_early():
+    checker = (
+        LinearEquation(a=2, b=4, c=7)
+        .checker()
+        .target_state_count(100)
+        .spawn_bfs()
+        .join()
+    )
+    assert 100 <= checker.state_count() < 65536 * 2

@@ -1,0 +1,30 @@
+"""DFS checker semantics (ref: src/checker/dfs.rs:404-585 tests)."""
+
+import pytest
+
+from stateright_tpu.fixtures import Guess, LinearEquation, Panicker
+
+
+def test_can_complete_by_enumerating_all_states():
+    checker = LinearEquation(a=2, b=4, c=7).checker().spawn_dfs().join()
+    assert checker.is_done()
+    checker.assert_no_discovery("solvable")
+    assert checker.unique_state_count() == 256 * 256
+
+
+def test_can_complete_by_eliminating_properties():
+    # Single-threaded DFS explores the IncreaseY branch first (successors are
+    # popped LIFO), finding the all-Y solution at depth 28 having generated one
+    # X-sibling per level: 28 + 27 = 55 states (ref: src/checker.rs:748-758
+    # pins the same counts for the reference's DFS).
+    checker = LinearEquation(a=2, b=10, c=14).checker().spawn_dfs().join()
+    checker.assert_properties()
+    assert checker.discovery("solvable").actions() == [Guess.INCREASE_Y] * 27
+    assert checker.state_count() == 55
+    assert checker.unique_state_count() == 55
+
+
+def test_handles_panics_gracefully():
+    # ref: src/checker/dfs.rs:575-585
+    with pytest.raises(RuntimeError, match="reached panic state"):
+        Panicker().checker().threads(2).spawn_dfs().join()

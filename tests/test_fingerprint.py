@@ -1,0 +1,66 @@
+"""Stable-encoding and fingerprint tests (ref contract: src/lib.rs:340-387 —
+fingerprints must be stable across runs/threads; unordered collections must
+hash independently of iteration order, ref: src/util.rs)."""
+
+import subprocess
+import sys
+
+from stateright_tpu import fingerprint, stable_encode
+
+
+def test_fingerprint_nonzero_and_deterministic():
+    fp = fingerprint((0, 0))
+    assert fp != 0
+    assert fp == fingerprint((0, 0))
+    assert fingerprint((0, 1)) != fp
+
+
+def test_set_encoding_is_order_independent():
+    # Build sets with different insertion orders.
+    s1 = set()
+    for x in [3, 1, 2, 9, 7]:
+        s1.add(x)
+    s2 = set()
+    for x in [7, 9, 2, 1, 3]:
+        s2.add(x)
+    assert stable_encode(s1) == stable_encode(s2)
+    assert fingerprint(frozenset([1, 2])) == fingerprint(frozenset([2, 1]))
+
+
+def test_dict_encoding_is_order_independent():
+    d1 = {"a": 1, "b": 2}
+    d2 = {"b": 2, "a": 1}
+    assert stable_encode(d1) == stable_encode(d2)
+
+
+def test_distinct_types_encode_distinctly():
+    assert stable_encode(1) != stable_encode("1")
+    assert stable_encode(True) != stable_encode(1)
+    assert stable_encode(None) != stable_encode(0)
+
+
+def test_nested_structures():
+    v1 = (1, frozenset([(2, 3), (4, 5)]), {"k": [1, 2]})
+    v2 = (1, frozenset([(4, 5), (2, 3)]), {"k": [1, 2]})
+    assert fingerprint(v1) == fingerprint(v2)
+
+
+def test_stable_across_processes():
+    # The reason Python's hash() can't be used: PYTHONHASHSEED. Our fingerprint
+    # must agree between separate interpreter processes.
+    code = (
+        "from stateright_tpu import fingerprint;"
+        "print(fingerprint(('x', frozenset([1, 2, 3]), 42)))"
+    )
+    outs = {
+        subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            check=True,
+            cwd="/root/repo",
+        ).stdout.strip()
+        for _ in range(2)
+    }
+    assert len(outs) == 1
+    assert int(outs.pop()) == fingerprint(("x", frozenset([1, 2, 3]), 42))

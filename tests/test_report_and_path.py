@@ -1,0 +1,66 @@
+"""Report format and Path reconstruction (ref: src/checker.rs:683-800,
+src/report.rs)."""
+
+import io
+import re
+
+from stateright_tpu import Path, WriteReporter, fingerprint
+from stateright_tpu.fixtures import BinaryClock, Guess, LinearEquation
+
+
+def test_can_build_path_from_fingerprints():
+    # ref: src/checker.rs:690-707
+    model = LinearEquation(a=2, b=10, c=14)
+    fps = [
+        fingerprint((0, 0)),
+        fingerprint((0, 1)),
+        fingerprint((1, 1)),
+        fingerprint((2, 1)),
+    ]
+    path = Path.from_fingerprints(model, fps)
+    assert path.last_state() == (2, 1)
+    assert path.last_state() == Path.final_state(model, fps)
+    assert path.fingerprints() == fps
+    assert path.encode() == "/".join(str(fp) for fp in fps)
+
+
+def test_from_actions_roundtrip():
+    model = LinearEquation(a=2, b=10, c=14)
+    path = Path.from_actions(
+        model, (0, 0), [Guess.INCREASE_X, Guess.INCREASE_X, Guess.INCREASE_Y]
+    )
+    assert path is not None
+    assert path.last_state() == (2, 1)
+
+
+def test_nondeterministic_model_detected():
+    import pytest
+
+    model = LinearEquation(a=2, b=10, c=14)
+    with pytest.raises(RuntimeError, match="nondeterministic"):
+        Path.from_fingerprints(model, [12345])  # bogus fingerprint
+
+
+def test_report_format_matches_reference():
+    # ref: src/checker.rs:709-800 — format parity modulo exact hash values
+    # (our fingerprints are blake2b, not ahash, so the fp digits differ).
+    stream = io.StringIO()
+    (
+        LinearEquation(a=2, b=10, c=14)
+        .checker()
+        .spawn_bfs()
+        .report(WriteReporter(stream))
+    )
+    out = stream.getvalue()
+    assert re.search(
+        r"Done\. states=\d+, unique=12, depth=4, sec=", out
+    ), out
+    assert 'Discovered "solvable" example Path[3]:\n' in out
+    assert "- IncreaseX\n- IncreaseX\n- IncreaseY\n" in out
+    assert re.search(r"Fingerprint path: \d+(/\d+){3}\n", out), out
+
+
+def test_binary_clock_properties():
+    checker = BinaryClock().checker().spawn_bfs().join()
+    checker.assert_properties()
+    assert checker.unique_state_count() == 2

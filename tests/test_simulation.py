@@ -1,0 +1,36 @@
+"""Simulation checker tests (ref: src/checker/simulation.rs:444-462)."""
+
+from stateright_tpu.checker.simulation import UniformChooser
+from stateright_tpu.fixtures import Guess, LinearEquation
+
+
+def test_can_complete_by_eliminating_properties():
+    checker = (
+        LinearEquation(a=2, b=10, c=14)
+        .checker()
+        .spawn_simulation(0, UniformChooser())
+        .join()
+    )
+    checker.assert_properties()
+    # Any valid solution validates: (2*2 + 10*1) % 256 == 14.
+    checker.assert_discovery(
+        "solvable", [Guess.INCREASE_X, Guess.INCREASE_Y, Guess.INCREASE_X]
+    )
+
+
+def test_same_seed_is_reproducible():
+    d1 = (
+        LinearEquation(a=2, b=10, c=14)
+        .checker()
+        .spawn_simulation(7, UniformChooser())
+        .join()
+        .discovery("solvable")
+    )
+    d2 = (
+        LinearEquation(a=2, b=10, c=14)
+        .checker()
+        .spawn_simulation(7, UniformChooser())
+        .join()
+        .discovery("solvable")
+    )
+    assert d1.actions() == d2.actions()
