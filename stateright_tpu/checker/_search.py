@@ -1,0 +1,97 @@
+"""Shared search-loop machinery for the host checkers.
+
+BFS and DFS are semantic twins differing only in queue discipline and dedup
+bookkeeping (the reference keeps two near-identical files and defers the lift
+until DPOR, ref: src/checker/bfs.rs:17-18); here the worker shutdown protocol
+and the property/ebits evaluation — the parts that MUST stay in lockstep — live
+in one place.
+"""
+
+from __future__ import annotations
+
+from ..core.model import Expectation
+
+
+class WorkerLoopMixin:
+    """The per-thread job loop (ref: src/checker/bfs.rs:103-160 and the
+    identical src/checker/dfs.rs:106-164).
+
+    Hosts must provide: _broker, _lock, _properties, _discoveries,
+    _finish_when, _target_state_count, _state_count, and _check_block.
+    """
+
+    def _worker(self) -> None:
+        broker = self._broker
+        panic = None
+        try:
+            from collections import deque
+
+            pending = deque()
+            while True:
+                if not pending:
+                    pending = broker.pop()
+                    if not pending:
+                        return
+                self._check_block(pending, self.BLOCK_SIZE)
+                if broker.deadline_passed():
+                    return
+                with self._lock:
+                    discovered = set(self._discoveries)
+                if self._finish_when.matches(self._properties, discovered):
+                    return
+                if (
+                    self._target_state_count is not None
+                    and self._target_state_count <= self._state_count
+                ):
+                    return
+                if len(pending) > 1:
+                    broker.split_and_push(pending)
+        except BaseException as e:  # noqa: BLE001 — propagate via join()
+            panic = e
+        finally:
+            # Any exit — early finish or panic — closes the market so peers
+            # stop too (the reference does this in JobBroker::drop).
+            broker.thread_exited(panic=panic)
+
+
+def evaluate_properties(model, properties, state, discoveries, lock, token, ebits):
+    """Evaluate every undiscovered property on `state`
+    (ref: src/checker/bfs.rs:230-280 == dfs.rs:234-281 == simulation.rs:305-352).
+
+    `token` is what a discovery records (BFS: the state's fingerprint; DFS and
+    simulation: the full fingerprint path). Returns
+    ``(is_awaiting_discoveries, ebits)`` where `ebits` has the indices of
+    `eventually` properties observed on this state removed.
+    """
+    is_awaiting = False
+    for i, prop in enumerate(properties):
+        if prop.name in discoveries:
+            continue
+        if prop.expectation == Expectation.ALWAYS:
+            if not prop.condition(model, state):
+                with lock:
+                    discoveries.setdefault(prop.name, token)
+            else:
+                is_awaiting = True
+        elif prop.expectation == Expectation.SOMETIMES:
+            if prop.condition(model, state):
+                with lock:
+                    discoveries.setdefault(prop.name, token)
+            else:
+                is_awaiting = True
+        else:
+            # EVENTUALLY discoveries are only identified at terminal states; a
+            # satisfying state merely clears the path's pending bit.
+            is_awaiting = True
+            if prop.condition(model, state):
+                ebits = ebits - {i}
+    return is_awaiting, ebits
+
+
+def record_terminal_ebits(properties, ebits, discoveries, lock, token) -> None:
+    """At a terminal state, every still-set eventually bit is a counterexample
+    (ref: src/checker/bfs.rs:326-333)."""
+    for i, prop in enumerate(properties):
+        if i in ebits:
+            with lock:
+                discoveries.setdefault(prop.name, token)

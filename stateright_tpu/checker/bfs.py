@@ -20,13 +20,14 @@ from typing import Optional
 from ..core.fingerprint import Fingerprint, fingerprint
 from ..core.model import Expectation
 from ..core.path import Path
+from ._search import WorkerLoopMixin, evaluate_properties, record_terminal_ebits
 from .base import Checker
 from .job_market import JobBroker
 
-BLOCK_SIZE = 1500  # states per block before re-sync (ref: src/checker/bfs.rs:130)
 
+class BfsChecker(WorkerLoopMixin, Checker):
+    BLOCK_SIZE = 1500  # states per block before re-sync (ref: src/checker/bfs.rs:130)
 
-class BfsChecker(Checker):
     def __init__(self, options):
         super().__init__(options.model)
         model = options.model
@@ -64,39 +65,6 @@ class BfsChecker(Checker):
             th.start()
             self._threads.append(th)
 
-    # -- worker loop (ref: src/checker/bfs.rs:103-160) -------------------------
-
-    def _worker(self) -> None:
-        broker = self._broker
-        panic = None
-        try:
-            pending = deque()
-            while True:
-                if not pending:
-                    pending = broker.pop()
-                    if not pending:
-                        return
-                self._check_block(pending, BLOCK_SIZE)
-                if broker.deadline_passed():
-                    return
-                with self._lock:
-                    discovered = set(self._discoveries)
-                if self._finish_when.matches(self._properties, discovered):
-                    return
-                if (
-                    self._target_state_count is not None
-                    and self._target_state_count <= self._state_count
-                ):
-                    return
-                if len(pending) > 1:
-                    broker.split_and_push(pending)
-        except BaseException as e:  # noqa: BLE001 — propagate via join()
-            panic = e
-        finally:
-            # Any exit — early finish or panic — closes the market so peers
-            # stop too (reference does this in JobBroker::drop).
-            broker.thread_exited(panic=panic)
-
     def _check_block(self, pending: deque, max_count: int) -> None:
         """The hot loop (ref: src/checker/bfs.rs:177-335). Each popped state:
         depth bookkeeping, visitor, property evaluation, expansion with dedup."""
@@ -115,26 +83,9 @@ class BfsChecker(Checker):
             if self._visitor is not None:
                 self._visitor.visit(model, self._reconstruct_path(state_fp))
 
-            is_awaiting_discoveries = False
-            for i, prop in enumerate(properties):
-                if prop.name in self._discoveries:
-                    continue
-                if prop.expectation == Expectation.ALWAYS:
-                    if not prop.condition(model, state):
-                        with self._lock:
-                            self._discoveries.setdefault(prop.name, state_fp)
-                    else:
-                        is_awaiting_discoveries = True
-                elif prop.expectation == Expectation.SOMETIMES:
-                    if prop.condition(model, state):
-                        with self._lock:
-                            self._discoveries.setdefault(prop.name, state_fp)
-                    else:
-                        is_awaiting_discoveries = True
-                else:  # EVENTUALLY: only discoverable at terminal states
-                    is_awaiting_discoveries = True
-                    if prop.condition(model, state):
-                        ebits = ebits - {i}
+            is_awaiting_discoveries, ebits = evaluate_properties(
+                model, properties, state, self._discoveries, self._lock, state_fp, ebits
+            )
             if not is_awaiting_discoveries:
                 return
 
@@ -162,10 +113,9 @@ class BfsChecker(Checker):
                 is_terminal = False
                 pending.appendleft((next_state, next_fp, ebits, depth + 1))
             if is_terminal:
-                for i, prop in enumerate(properties):
-                    if i in ebits:
-                        with self._lock:
-                            self._discoveries.setdefault(prop.name, state_fp)
+                record_terminal_ebits(
+                    properties, ebits, self._discoveries, self._lock, state_fp
+                )
 
     # -- Checker interface -----------------------------------------------------
 

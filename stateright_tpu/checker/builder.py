@@ -83,19 +83,31 @@ class CheckerBuilder:
         return SimulationChecker(self, seed, chooser or UniformChooser())
 
     def spawn_on_demand(self):
-        from .on_demand import OnDemandChecker
-
+        try:
+            from .on_demand import OnDemandChecker
+        except ImportError as e:
+            raise NotImplementedError(
+                "the on-demand checker has not landed yet in this build"
+            ) from e
         return OnDemandChecker(self)
 
     def serve(self, address: str = "localhost:3000", block: bool = False):
         """Start the Explorer web service (ref: src/checker.rs:144-151)."""
-        from ..explorer.server import serve
-
+        try:
+            from ..explorer.server import serve
+        except ImportError as e:
+            raise NotImplementedError(
+                "the Explorer web service has not landed yet in this build"
+            ) from e
         return serve(self, address, block=block)
 
     def spawn_tpu(self, **kwargs):
         """Spawn the batched device (TPU) frontier checker. The model must be a
         `stateright_tpu.tensor.TensorModel` or provide one via `tensor_model()`."""
-        from .tpu import TpuChecker
-
+        try:
+            from .tpu import TpuChecker
+        except ImportError as e:
+            raise NotImplementedError(
+                "the TPU frontier checker has not landed yet in this build"
+            ) from e
         return TpuChecker(self, **kwargs)

@@ -17,13 +17,14 @@ from collections import deque
 from ..core.fingerprint import Fingerprint, fingerprint
 from ..core.model import Expectation
 from ..core.path import Path
+from ._search import WorkerLoopMixin, evaluate_properties, record_terminal_ebits
 from .base import Checker
 from .job_market import JobBroker
 
-BLOCK_SIZE = 1500  # ref: src/checker/dfs.rs:133
 
+class DfsChecker(WorkerLoopMixin, Checker):
+    BLOCK_SIZE = 1500  # ref: src/checker/dfs.rs:133
 
-class DfsChecker(Checker):
     def __init__(self, options):
         super().__init__(options.model)
         model = options.model
@@ -64,35 +65,6 @@ class DfsChecker(Checker):
             th.start()
             self._threads.append(th)
 
-    def _worker(self) -> None:
-        broker = self._broker
-        panic = None
-        try:
-            pending = deque()
-            while True:
-                if not pending:
-                    pending = broker.pop()
-                    if not pending:
-                        return
-                self._check_block(pending, BLOCK_SIZE)
-                if broker.deadline_passed():
-                    return
-                with self._lock:
-                    discovered = set(self._discoveries)
-                if self._finish_when.matches(self._properties, discovered):
-                    return
-                if (
-                    self._target_state_count is not None
-                    and self._target_state_count <= self._state_count
-                ):
-                    return
-                if len(pending) > 1:
-                    broker.split_and_push(pending)
-        except BaseException as e:  # noqa: BLE001 — propagate via join()
-            panic = e
-        finally:
-            broker.thread_exited(panic=panic)
-
     def _check_block(self, pending: deque, max_count: int) -> None:
         """The hot loop (ref: src/checker/dfs.rs:182-358)."""
         model = self._model
@@ -113,26 +85,15 @@ class DfsChecker(Checker):
                     model, Path.from_fingerprints(model, fingerprints)
                 )
 
-            is_awaiting_discoveries = False
-            for i, prop in enumerate(properties):
-                if prop.name in self._discoveries:
-                    continue
-                if prop.expectation == Expectation.ALWAYS:
-                    if not prop.condition(model, state):
-                        with self._lock:
-                            self._discoveries.setdefault(prop.name, list(fingerprints))
-                    else:
-                        is_awaiting_discoveries = True
-                elif prop.expectation == Expectation.SOMETIMES:
-                    if prop.condition(model, state):
-                        with self._lock:
-                            self._discoveries.setdefault(prop.name, list(fingerprints))
-                    else:
-                        is_awaiting_discoveries = True
-                else:  # EVENTUALLY
-                    is_awaiting_discoveries = True
-                    if prop.condition(model, state):
-                        ebits = ebits - {i}
+            is_awaiting_discoveries, ebits = evaluate_properties(
+                model,
+                properties,
+                state,
+                self._discoveries,
+                self._lock,
+                list(fingerprints),
+                ebits,
+            )
             if not is_awaiting_discoveries:
                 return
 
@@ -169,10 +130,9 @@ class DfsChecker(Checker):
                     (next_state, fingerprints + [next_fp], ebits, depth + 1)
                 )
             if is_terminal:
-                for i, prop in enumerate(properties):
-                    if i in ebits:
-                        with self._lock:
-                            self._discoveries.setdefault(prop.name, list(fingerprints))
+                record_terminal_ebits(
+                    properties, ebits, self._discoveries, self._lock, list(fingerprints)
+                )
 
     # -- Checker interface -----------------------------------------------------
 

@@ -22,6 +22,7 @@ import time
 from ..core.fingerprint import Fingerprint, fingerprint
 from ..core.model import Expectation
 from ..core.path import Path
+from ._search import evaluate_properties, record_terminal_ebits
 from .base import Checker
 
 
@@ -164,30 +165,15 @@ class SimulationChecker(Checker):
                     model, Path.from_fingerprints(model, fingerprint_path)
                 )
 
-            is_awaiting_discoveries = False
-            for i, prop in enumerate(properties):
-                if prop.name in self._discoveries:
-                    continue
-                if prop.expectation == Expectation.ALWAYS:
-                    if not prop.condition(model, state):
-                        with self._lock:
-                            self._discoveries.setdefault(
-                                prop.name, list(fingerprint_path)
-                            )
-                    else:
-                        is_awaiting_discoveries = True
-                elif prop.expectation == Expectation.SOMETIMES:
-                    if prop.condition(model, state):
-                        with self._lock:
-                            self._discoveries.setdefault(
-                                prop.name, list(fingerprint_path)
-                            )
-                    else:
-                        is_awaiting_discoveries = True
-                else:  # EVENTUALLY
-                    is_awaiting_discoveries = True
-                    if prop.condition(model, state):
-                        ebits = ebits - {i}
+            is_awaiting_discoveries, ebits = evaluate_properties(
+                model,
+                properties,
+                state,
+                self._discoveries,
+                self._lock,
+                list(fingerprint_path),
+                ebits,
+            )
             if not is_awaiting_discoveries:
                 break
 
@@ -210,10 +196,9 @@ class SimulationChecker(Checker):
         # Check the eventually properties at the end of the walk; the reference
         # reaches this on every break — loop, boundary, or terminal
         # (ref: src/checker/simulation.rs:390-397).
-        for i, prop in enumerate(properties):
-            if i in ebits:
-                with self._lock:
-                    self._discoveries.setdefault(prop.name, list(fingerprint_path))
+        record_terminal_ebits(
+            properties, ebits, self._discoveries, self._lock, list(fingerprint_path)
+        )
 
     # -- Checker interface -----------------------------------------------------
 

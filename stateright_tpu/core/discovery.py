@@ -7,7 +7,7 @@ space, given the set of discovered property names so far.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import FrozenSet, Iterable
+from typing import ClassVar, FrozenSet, Iterable
 
 from .model import Expectation, Property
 
@@ -27,10 +27,11 @@ class HasDiscoveries:
     kind: str
     names: FrozenSet[str] = field(default_factory=frozenset)
 
-    ALL: "HasDiscoveries" = None  # type: ignore  # filled in below
-    ANY: "HasDiscoveries" = None  # type: ignore
-    ANY_FAILURES: "HasDiscoveries" = None  # type: ignore
-    ALL_FAILURES: "HasDiscoveries" = None  # type: ignore
+    # Sentinels, filled in below the class definition.
+    ALL: ClassVar["HasDiscoveries"]
+    ANY: ClassVar["HasDiscoveries"]
+    ANY_FAILURES: ClassVar["HasDiscoveries"]
+    ALL_FAILURES: ClassVar["HasDiscoveries"]
 
     @staticmethod
     def all_of(names: Iterable[str]) -> "HasDiscoveries":

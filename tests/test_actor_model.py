@@ -1,0 +1,427 @@
+"""ActorModel semantics tests mirroring the reference's golden assertions
+(ref: src/actor/model.rs:765-1600)."""
+
+from stateright_tpu import Expectation, StateRecorder, PathRecorder
+from stateright_tpu.actor import (
+    Actor,
+    ActorModel,
+    ActorModelState,
+    Deliver,
+    DropEnv,
+    Envelope,
+    Id,
+    LossyNetwork,
+    Network,
+    Out,
+    model_timeout,
+)
+from stateright_tpu.actor.test_util import Ping, PingPongCfg, Pong
+
+
+def test_visits_expected_states():
+    # ref: src/actor/model.rs:774-892 — exact 14-state space of lossy
+    # duplicating ping-pong with max_nat=1.
+    def snap(states, envelopes, last_msg):
+        return ActorModelState(
+            actor_states=tuple(states),
+            network=Network.new_unordered_duplicating_with_last_msg(
+                envelopes, last_msg
+            ),
+            timers_set=(frozenset(), frozenset()),
+            random_choices=({}, {}),
+            crashed=(False, False),
+            history=(0, 0),
+        )
+
+    e = lambda s, d, m: Envelope(Id(s), Id(d), m)
+
+    recorder = StateRecorder()
+    checker = (
+        PingPongCfg(maintains_history=False, max_nat=1)
+        .into_model()
+        .with_lossy_network(LossyNetwork.YES)
+        .checker()
+        .visitor(recorder)
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.unique_state_count() == 14
+    assert len(recorder.states) == 14
+
+    expected = [
+        # Lossless progressions.
+        snap([0, 0], [e(0, 1, Ping(0))], None),
+        snap([0, 1], [e(0, 1, Ping(0)), e(1, 0, Pong(0))], e(0, 1, Ping(0))),
+        snap(
+            [1, 1],
+            [e(0, 1, Ping(0)), e(1, 0, Pong(0)), e(0, 1, Ping(1))],
+            e(1, 0, Pong(0)),
+        ),
+        # Loss from state (0, 0).
+        snap([0, 0], [], None),
+        # Loss from state (0, 1).
+        snap([0, 1], [e(1, 0, Pong(0))], e(0, 1, Ping(0))),
+        snap([0, 1], [e(0, 1, Ping(0))], e(0, 1, Ping(0))),
+        snap([0, 1], [], e(0, 1, Ping(0))),
+        # Loss from state (1, 1).
+        snap([1, 1], [e(1, 0, Pong(0)), e(0, 1, Ping(1))], e(1, 0, Pong(0))),
+        snap([1, 1], [e(0, 1, Ping(0)), e(0, 1, Ping(1))], e(1, 0, Pong(0))),
+        snap([1, 1], [e(0, 1, Ping(0)), e(1, 0, Pong(0))], e(1, 0, Pong(0))),
+        snap([1, 1], [e(0, 1, Ping(1))], e(1, 0, Pong(0))),
+        snap([1, 1], [e(1, 0, Pong(0))], e(1, 0, Pong(0))),
+        snap([1, 1], [e(0, 1, Ping(0))], e(1, 0, Pong(0))),
+        snap([1, 1], [], e(1, 0, Pong(0))),
+    ]
+    for exp in expected:
+        assert exp in recorder.states, f"missing state {exp!r}"
+    assert len(expected) == 14
+
+
+def test_no_op_depends_on_network():
+    # ref: src/actor/model.rs:894-967
+    class Client(Actor):
+        def __init__(self, server):
+            self.server = server
+
+        def on_start(self, id, out):
+            out.send(self.server, "Ignored")
+            out.send(self.server, "Interesting")
+            return "Awaiting an interesting message."
+
+        def on_msg(self, id, state, src, msg, out):
+            if msg == "Interesting":
+                return "Got an interesting message."
+            return None
+
+    class Server(Actor):
+        def on_start(self, id, out):
+            return "Awaiting an interesting message."
+
+        def on_msg(self, id, state, src, msg, out):
+            if msg == "Interesting":
+                return "Got an interesting message."
+            return None
+
+    def build(network):
+        return (
+            ActorModel.new(None, None)
+            .actor(Client(Id(1)))
+            .actor(Server())
+            .with_lossy_network(LossyNetwork.NO)
+            .with_init_network(network)
+            .property(Expectation.ALWAYS, "Check everything", lambda m, s: True)
+        )
+
+    assert (
+        build(Network.new_unordered_duplicating()).checker().spawn_bfs().join()
+        .unique_state_count()
+        == 2
+    )
+    assert (
+        build(Network.new_unordered_nonduplicating()).checker().spawn_bfs().join()
+        .unique_state_count()
+        == 2
+    )
+    # Ordered networks must pop the flow head even when delivery is a no-op.
+    assert (
+        build(Network.new_ordered()).checker().spawn_bfs().join()
+        .unique_state_count()
+        == 3
+    )
+
+
+def test_maintains_fixed_delta_despite_lossy_duplicating_network():
+    # ref: src/actor/model.rs:969-982 — the 4,094-state golden.
+    checker = (
+        PingPongCfg(max_nat=5, maintains_history=False)
+        .into_model()
+        .with_lossy_network(LossyNetwork.YES)
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.unique_state_count() == 4094
+    checker.assert_no_discovery("delta within 1")
+
+
+def test_may_never_reach_max_on_lossy_network():
+    # ref: src/actor/model.rs:984-1006
+    checker = (
+        PingPongCfg(max_nat=5, maintains_history=False)
+        .into_model()
+        .with_lossy_network(LossyNetwork.YES)
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.unique_state_count() == 4094
+    checker.assert_discovery(
+        "must reach max", [DropEnv(Envelope(Id(0), Id(1), Ping(0)))]
+    )
+
+
+def test_eventually_reaches_max_on_perfect_delivery_network():
+    # ref: src/actor/model.rs:1008-1022 — the 11-state golden.
+    checker = (
+        PingPongCfg(max_nat=5, maintains_history=False)
+        .into_model()
+        .with_init_network(Network.new_unordered_nonduplicating())
+        .with_lossy_network(LossyNetwork.NO)
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.unique_state_count() == 11
+    checker.assert_no_discovery("must reach max")
+
+
+def test_can_reach_max():
+    # ref: src/actor/model.rs:1024-1044
+    checker = (
+        PingPongCfg(max_nat=5, maintains_history=False)
+        .into_model()
+        .with_lossy_network(LossyNetwork.NO)
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.unique_state_count() == 11
+    assert checker.discovery("can reach max").last_state().actor_states == (4, 5)
+
+
+def test_might_never_reach_beyond_max():
+    # ref: src/actor/model.rs:1046-1073 — falsifiable liveness via the boundary.
+    checker = (
+        PingPongCfg(max_nat=5, maintains_history=False)
+        .into_model()
+        .with_init_network(Network.new_unordered_nonduplicating())
+        .with_lossy_network(LossyNetwork.NO)
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.unique_state_count() == 11
+    assert checker.discovery("must exceed max").last_state().actor_states == (5, 5)
+
+
+def test_handles_undeliverable_messages():
+    # ref: src/actor/model.rs:1076-1092
+    class Noop(Actor):
+        def on_start(self, id, out):
+            return ()
+
+    checker = (
+        ActorModel.new(None, None)
+        .actor(Noop())
+        .property(Expectation.ALWAYS, "unused", lambda m, s: True)
+        .with_init_network(
+            Network.new_unordered_duplicating([Envelope(Id(0), Id(99), ())])
+        )
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    assert checker.unique_state_count() == 1
+
+
+def test_handles_ordered_network_flag():
+    # ref: src/actor/model.rs:1094-1159
+    class OrderedNetworkActor(Actor):
+        def on_start(self, id, out):
+            if id == 0:
+                out.send(Id(1), 2)
+                out.send(Id(1), 1)
+            return ()
+
+        def on_msg(self, id, state, src, msg, out):
+            return state + (msg,)
+
+    def build(network):
+        return (
+            ActorModel.new(None, None)
+            .add_actors([OrderedNetworkActor(), OrderedNetworkActor()])
+            .property(Expectation.ALWAYS, "any", lambda m, s: True)
+            .with_init_network(network)
+        )
+
+    recorder = StateRecorder()
+    build(Network.new_ordered()).checker().visitor(recorder).spawn_bfs().join()
+    received = {s.actor_states[1] for s in recorder.states}
+    assert received == {(), (2,), (2, 1)}
+
+    recorder = StateRecorder()
+    build(Network.new_unordered_nonduplicating()).checker().visitor(
+        recorder
+    ).spawn_bfs().join()
+    received = {s.actor_states[1] for s in recorder.states}
+    assert received == {(), (1,), (2,), (1, 2), (2, 1)}
+
+
+def test_unordered_network_semantics():
+    # ref: src/actor/model.rs:1161-1274 — the duplicating-network regression:
+    # "drop" on a duplicating network means "never deliver again".
+    class A(Actor):
+        def on_start(self, id, out):
+            if id == 0:
+                out.send(Id(1), "m")
+                out.send(Id(1), "m")
+            return 0
+
+        def on_msg(self, id, state, src, msg, out):
+            return state + 1
+
+    def action_sequences(lossy, network):
+        recorder = PathRecorder()
+        (
+            ActorModel.new(None, None)
+            .add_actors([A(), A()])
+            .with_init_network(network)
+            .with_lossy_network(lossy)
+            .property(Expectation.ALWAYS, "force visiting all states", lambda m, s: True)
+            .with_within_boundary(lambda cfg, s: s.actor_states[1] < 4)
+            .checker()
+            .visitor(recorder)
+            .spawn_dfs()
+            .join()
+        )
+        return {tuple(p.actions()) for p in recorder.paths}
+
+    deliver = Deliver(Id(0), Id(1), "m")
+    drop = DropEnv(Envelope(Id(0), Id(1), "m"))
+
+    # Ordered: both messages deliverable/droppable, no third.
+    ordered_lossless = action_sequences(LossyNetwork.NO, Network.new_ordered())
+    assert (deliver, deliver) in ordered_lossless
+    assert (deliver, deliver, deliver) not in ordered_lossless
+    ordered_lossy = action_sequences(LossyNetwork.YES, Network.new_ordered())
+    assert (deliver, deliver) in ordered_lossy
+    assert (deliver, drop) in ordered_lossy
+    assert (drop, drop) in ordered_lossy
+
+    # Unordered duplicating: unlimited redelivery; drop ends delivery.
+    ud_lossless = action_sequences(
+        LossyNetwork.NO, Network.new_unordered_duplicating()
+    )
+    assert (deliver, deliver, deliver) in ud_lossless
+    ud_lossy = action_sequences(LossyNetwork.YES, Network.new_unordered_duplicating())
+    assert (deliver, deliver, deliver) in ud_lossy
+    assert (deliver, deliver, drop) in ud_lossy
+    assert (deliver, drop) in ud_lossy
+    assert (drop,) in ud_lossy
+    assert (drop, deliver) not in ud_lossy  # drop means "never deliver again"
+
+    # Unordered nonduplicating: exactly two copies.
+    und_lossless = action_sequences(
+        LossyNetwork.NO, Network.new_unordered_nonduplicating()
+    )
+    assert (deliver, deliver) in und_lossless
+    und_lossy = action_sequences(
+        LossyNetwork.YES, Network.new_unordered_nonduplicating()
+    )
+    assert (deliver, drop) in und_lossy
+    assert (drop, drop) in und_lossy
+
+
+def test_timer_semantics():
+    # ref: src/actor/model.rs:1276-1330 (resets_timer and timer behavior)
+    class TimerActor(Actor):
+        def on_start(self, id, out):
+            out.set_timer("t", model_timeout())
+            return 0
+
+        def on_timeout(self, id, state, timer, out):
+            if state < 2:
+                out.set_timer("t", model_timeout())
+                return state + 1
+            return None  # state 2: nothing — timer fires and is consumed
+
+    checker = (
+        ActorModel.new(None, None)
+        .actor(TimerActor())
+        .property(Expectation.ALWAYS, "any", lambda m, s: True)
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    # States: (0, timer set) -> (1, set) -> (2, set) -> (2, unset).
+    assert checker.unique_state_count() == 4
+
+
+def test_crash_semantics():
+    # ref: src/actor/model.rs:1332-1431 — crash cancels timers, blocks delivery.
+    class CrashableActor(Actor):
+        def on_start(self, id, out):
+            out.set_timer("tick", model_timeout())
+            if id == 0:
+                out.send(Id(1), "hello")
+            return 0
+
+        def on_msg(self, id, state, src, msg, out):
+            return state + 1
+
+        def on_timeout(self, id, state, timer, out):
+            return None
+
+    from stateright_tpu.actor import Crash, Timeout
+
+    model = (
+        ActorModel.new(None, None)
+        .add_actors([CrashableActor(), CrashableActor()])
+        .with_init_network(Network.new_unordered_nonduplicating())
+        .with_max_crashes(1)
+        .property(Expectation.ALWAYS, "any", lambda m, s: True)
+    )
+    init = model.init_states()[0]
+
+    # Crash actions are enumerated while the budget lasts.
+    actions: list = []
+    model.actions(init, actions)
+    assert Crash(Id(0)) in actions and Crash(Id(1)) in actions
+
+    # Crashing cancels timers and marks the actor dead.
+    crashed_state = model.next_state(init, Crash(Id(1)))
+    assert crashed_state.crashed[1]
+    assert crashed_state.timers_set[1] == frozenset()
+
+    # Delivery to a crashed actor is ignored (ref: src/actor/model.rs:332-337).
+    assert model.next_state(crashed_state, Deliver(Id(0), Id(1), "hello")) is None
+    # The crashed actor's timers are gone, so only actor 0's timeout remains.
+    actions = []
+    model.actions(crashed_state, actions)
+    assert Timeout(Id(1), "tick") not in actions
+    assert Timeout(Id(0), "tick") in actions
+    # Crash budget exhausted: no further Crash actions.
+    assert not any(isinstance(a, Crash) for a in actions)
+
+    # NOTE (reference parity): states differing only in `crashed` share a
+    # fingerprint — crash states merge with no-op-timeout states during dedup,
+    # exactly as in the reference whose Hash impl also excludes `crashed`
+    # (ref: src/actor/model_state.rs:134-145).
+
+
+def test_choose_random_creates_branches():
+    # ref: src/actor.rs choose_random / on_random + SelectRandom actions.
+    class RandomActor(Actor):
+        def on_start(self, id, out):
+            out.choose_random("coin", ["heads", "tails"])
+            return "undecided"
+
+        def on_random(self, id, state, random, out):
+            return random
+
+    recorder = StateRecorder()
+    checker = (
+        ActorModel.new(None, None)
+        .actor(RandomActor())
+        .property(Expectation.ALWAYS, "any", lambda m, s: True)
+        .checker()
+        .visitor(recorder)
+        .spawn_bfs()
+        .join()
+    )
+    finals = {s.actor_states[0] for s in recorder.states}
+    assert finals == {"undecided", "heads", "tails"}
+    # random_choices are excluded from the fingerprint, so "undecided with
+    # choices pending" and "undecided after a choice" do not double-count...
+    # (both reachable states differ in actor state only).
+    assert checker.unique_state_count() == 3
