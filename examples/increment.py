@@ -1,0 +1,34 @@
+#!/usr/bin/env python
+"""Data-race increment example CLI (ref: examples/increment.rs:203-258)."""
+
+from _cli import argv_int, argv_str, argv_subcommand, report, thread_count
+
+from stateright_tpu.examples.increment import IncrementSys
+
+
+def main():
+    cmd = argv_subcommand()
+    if cmd == "check":
+        n = argv_int(2, 3)
+        print(f"Model checking increment with {n} threads.")
+        report(IncrementSys(n).checker().threads(thread_count()).spawn_dfs())
+    elif cmd == "check-sym":
+        n = argv_int(2, 3)
+        print(f"Model checking increment with {n} threads using symmetry reduction.")
+        report(
+            IncrementSys(n).checker().threads(thread_count()).symmetry().spawn_dfs()
+        )
+    elif cmd == "explore":
+        n = argv_int(2, 3)
+        address = argv_str(3, "localhost:3000")
+        print(f"Exploring the state space of increment with {n} threads on {address}.")
+        IncrementSys(n).checker().serve(address, block=True)
+    else:
+        print("USAGE:")
+        print("  ./increment.py check [THREAD_COUNT]")
+        print("  ./increment.py check-sym [THREAD_COUNT]")
+        print("  ./increment.py explore [THREAD_COUNT] [ADDRESS]")
+
+
+if __name__ == "__main__":
+    main()

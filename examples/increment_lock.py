@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""Lock-fixed increment example CLI (ref: examples/increment_lock.rs)."""
+
+from _cli import argv_int, argv_str, argv_subcommand, report, thread_count
+
+from stateright_tpu.examples.increment import IncrementLockSys
+
+
+def main():
+    cmd = argv_subcommand()
+    if cmd == "check":
+        n = argv_int(2, 3)
+        print(f"Model checking increment_lock with {n} threads.")
+        report(IncrementLockSys(n).checker().threads(thread_count()).spawn_dfs())
+    elif cmd == "check-sym":
+        n = argv_int(2, 3)
+        print(
+            f"Model checking increment_lock with {n} threads using symmetry reduction."
+        )
+        report(
+            IncrementLockSys(n)
+            .checker()
+            .threads(thread_count())
+            .symmetry()
+            .spawn_dfs()
+        )
+    elif cmd == "explore":
+        n = argv_int(2, 3)
+        address = argv_str(3, "localhost:3000")
+        print(
+            f"Exploring the state space of increment_lock with {n} threads on {address}."
+        )
+        IncrementLockSys(n).checker().serve(address, block=True)
+    else:
+        print("USAGE:")
+        print("  ./increment_lock.py check [THREAD_COUNT]")
+        print("  ./increment_lock.py check-sym [THREAD_COUNT]")
+        print("  ./increment_lock.py explore [THREAD_COUNT] [ADDRESS]")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+#!/usr/bin/env python
+"""External-input modeling example CLI (ref: examples/interaction.rs:17-68)."""
+
+from _cli import argv_str, argv_subcommand, report, thread_count
+
+from stateright_tpu.examples.interaction import build_model
+
+
+def main():
+    cmd = argv_subcommand()
+    if cmd == "check":
+        # target_max_depth bounds the loosely-bounded space
+        # (ref: examples/interaction.rs:43).
+        checker = (
+            build_model()
+            .checker()
+            .threads(thread_count())
+            .target_max_depth(30)
+            .spawn_bfs()
+        )
+        report(checker)
+        checker.assert_properties()
+    elif cmd == "explore":
+        address = argv_str(2, "0.0.0.0:3000")
+        build_model().checker().target_max_depth(30).serve(address, block=True)
+    else:
+        print("USAGE:")
+        print("  ./interaction.py check")
+        print("  ./interaction.py explore")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""LWW-register CRDT example CLI (ref: examples/lww-register.rs:188-262)."""
+
+from _cli import argv_int, argv_str, argv_subcommand, report
+
+from stateright_tpu.examples.lww_register import build_model
+
+
+def main():
+    cmd = argv_subcommand()
+    if cmd == "check":
+        client_count = argv_int(2, 2)
+        depth = argv_int(3, 8)
+        report(
+            build_model(client_count)
+            .checker()
+            .target_max_depth(depth)
+            .spawn_dfs()
+        )
+    elif cmd == "explore":
+        client_count = argv_int(2, 2)
+        address = argv_str(3, "localhost:3000")
+        print(
+            f"Exploring state space for last-writer-wins register with "
+            f"{client_count} clients on {address}."
+        )
+        build_model(client_count).checker().serve(address, block=True)
+    elif cmd == "spawn":
+        from stateright_tpu.actor import Id
+        from stateright_tpu.actor.spawn import spawn
+        from stateright_tpu.examples.lww_register import LwwActor
+
+        port = 3000
+        from stateright_tpu.examples.lww_register import LwwRegister
+
+        ids = [Id.from_addr("127.0.0.1", port + i) for i in range(3)]
+        print("  A server that implements a last-writer-wins register.")
+        spawn(
+            [
+                (ids[i], LwwActor([pid for pid in ids if pid != ids[i]]))
+                for i in range(3)
+            ],
+            msg_types=[LwwRegister],
+        )
+    else:
+        print("USAGE:")
+        print("  ./lww_register.py check [CLIENT_COUNT] [DEPTH]")
+        print("  ./lww_register.py explore [CLIENT_COUNT] [ADDRESS]")
+        print("  ./lww_register.py spawn")
+
+
+if __name__ == "__main__":
+    main()

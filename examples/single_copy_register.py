@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Single-copy register example CLI
+(ref: examples/single-copy-register.rs:139-231)."""
+
+from _cli import (
+    argv_int,
+    argv_network,
+    argv_str,
+    argv_subcommand,
+    network_names,
+    report,
+    thread_count,
+)
+
+from stateright_tpu.examples.single_copy_register import SingleCopyModelCfg
+
+
+def main():
+    cmd = argv_subcommand()
+    if cmd == "check":
+        client_count = argv_int(2, 2)
+        network = argv_network(3)
+        print(f"Model checking a single-copy register with {client_count} clients.")
+        report(
+            SingleCopyModelCfg(
+                client_count=client_count, server_count=1, network=network
+            )
+            .into_model()
+            .checker()
+            .threads(thread_count())
+            .spawn_dfs()
+        )
+    elif cmd == "explore":
+        client_count = argv_int(2, 2)
+        address = argv_str(3, "localhost:3000")
+        network = argv_network(4)
+        print(
+            f"Exploring state space for single-copy register with "
+            f"{client_count} clients on {address}."
+        )
+        SingleCopyModelCfg(
+            client_count=client_count, server_count=1, network=network
+        ).into_model().checker().serve(address, block=True)
+    elif cmd == "spawn":
+        from stateright_tpu.actor import Id
+        from stateright_tpu.actor.spawn import spawn
+        from stateright_tpu.examples.single_copy_register import SingleCopyActor
+
+        port = 3000
+        print("  A server that implements a single-copy register.")
+        print(f"  Interact via UDP JSON, e.g. nc -u localhost {port}")
+        from stateright_tpu.actor.register import Get, GetOk, Put, PutOk
+
+        spawn(
+            [(Id.from_addr("127.0.0.1", port), SingleCopyActor())],
+            msg_types=[Put, Get, PutOk, GetOk],
+        )
+    else:
+        print("USAGE:")
+        print("  ./single_copy_register.py check [CLIENT_COUNT]")
+        print("  ./single_copy_register.py explore [CLIENT_COUNT] [ADDRESS] [NETWORK]")
+        print("  ./single_copy_register.py spawn")
+        print(f"NETWORK: {network_names()}")
+
+
+if __name__ == "__main__":
+    main()

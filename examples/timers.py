@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""Timer-driven pinger example CLI (ref: examples/timers.rs:119-165)."""
+
+from _cli import (
+    argv_network,
+    argv_str,
+    argv_subcommand,
+    network_names,
+    report,
+    thread_count,
+)
+
+from stateright_tpu.examples.timers import PingerModelCfg
+
+
+def main():
+    cmd = argv_subcommand()
+    if cmd == "check":
+        network = argv_network(2)
+        print("Model checking Pingers")
+        report(
+            PingerModelCfg(server_count=3, network=network)
+            .into_model()
+            .checker()
+            .threads(thread_count())
+            .spawn_dfs()
+        )
+    elif cmd == "explore":
+        address = argv_str(2, "localhost:3000")
+        network = argv_network(3)
+        print(f"Exploring state space for Pingers on {address}.")
+        PingerModelCfg(server_count=3, network=network).into_model().checker().serve(
+            address, block=True
+        )
+    else:
+        print("USAGE:")
+        print("  ./timers.py check [NETWORK]")
+        print("  ./timers.py explore [ADDRESS] [NETWORK]")
+        print(f"NETWORK: {network_names()}")
+
+
+if __name__ == "__main__":
+    main()

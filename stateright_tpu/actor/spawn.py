@@ -1,0 +1,204 @@
+"""Real-world actor execution over UDP (ref: src/actor/spawn.rs).
+
+One thread per actor; each binds the UDP socket encoded in its `Id`, JSON-serdes
+messages, and multiplexes socket reads with a timer wheel (`next_interrupts`:
+interrupt → deadline) via socket timeouts, mirroring the reference's event loop
+(ref: src/actor/spawn.rs:64-154). Model-checked `choose_random` commands become
+delayed interrupts resolved with a real RNG (ref: src/actor/spawn.rs:163-232).
+
+Message serde: by default messages are encoded as JSON with a `{"__type__":
+ClassName, ...fields}` convention for dataclasses (plus native JSON scalars /
+lists). Pass a `msg_types` registry (class list) for decoding, or override
+`serialize`/`deserialize` entirely — the reference likewise takes explicit
+serde functions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+import socket
+import threading
+import time
+from typing import Callable, Iterable, Optional, Tuple
+
+from . import Actor, CancelTimer, ChooseRandom, Id, Out, Send, SetTimer
+
+_MAX_DATAGRAM = 65_507
+
+
+def _encode_value(v):
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        return {
+            "__type__": type(v).__name__,
+            **{
+                f.name: _encode_value(getattr(v, f.name))
+                for f in dataclasses.fields(v)
+            },
+        }
+    if isinstance(v, (list, tuple)):
+        return [_encode_value(x) for x in v]
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    raise TypeError(f"cannot JSON-encode message part {v!r}; pass custom serde")
+
+
+def make_json_serde(msg_types: Iterable[type] = ()):
+    """Default JSON codec: dataclasses tagged by class name. Decoding tuples
+    is lossy (JSON arrays decode as lists); dataclass fields that were tuples
+    are restored as tuples."""
+    registry = {t.__name__: t for t in msg_types}
+
+    def serialize(msg) -> bytes:
+        return json.dumps(_encode_value(msg)).encode("utf-8")
+
+    def _decode(v):
+        if isinstance(v, dict) and "__type__" in v:
+            cls = registry.get(v["__type__"])
+            if cls is None:
+                raise ValueError(f"unknown message type {v['__type__']!r}")
+            kwargs = {}
+            for f in dataclasses.fields(cls):
+                if f.name in v:
+                    val = _decode(v[f.name])
+                    if isinstance(val, list):
+                        val = tuple(val)
+                    kwargs[f.name] = val
+            return cls(**kwargs)
+        if isinstance(v, list):
+            return [_decode(x) for x in v]
+        return v
+
+    def deserialize(data: bytes):
+        return _decode(json.loads(data.decode("utf-8")))
+
+    return serialize, deserialize
+
+
+class _ActorRuntime(threading.Thread):
+    def __init__(self, id: Id, actor: Actor, serialize, deserialize, stop_event):
+        super().__init__(name=f"actor-{int(id)}", daemon=True)
+        self.id = Id(id)
+        self.actor = actor
+        self.serialize = serialize
+        self.deserialize = deserialize
+        self.stop_event = stop_event
+        self.rng = random.Random()
+        # interrupt key -> (deadline, payload); keys are ("timer", timer) or
+        # ("random", key) (ref: src/actor/spawn.rs:156-160).
+        self.next_interrupts: dict = {}
+        ip, port = self.id.to_addr()
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind((ip, port))
+
+    def _on_command(self, cmd) -> None:
+        """ref: src/actor/spawn.rs:163-232"""
+        if isinstance(cmd, Send):
+            ip, port = Id(cmd.dst).to_addr()
+            try:
+                self.sock.sendto(self.serialize(cmd.msg), (ip, port))
+            except OSError:
+                pass  # unreachable peers are dropped datagrams, like UDP itself
+        elif isinstance(cmd, SetTimer):
+            lo, hi = cmd.duration
+            delay = self.rng.uniform(lo, hi) if hi > lo else lo
+            self.next_interrupts[("timer", cmd.timer)] = (
+                time.monotonic() + delay,
+                cmd.timer,
+            )
+        elif isinstance(cmd, CancelTimer):
+            self.next_interrupts.pop(("timer", cmd.timer), None)
+        elif isinstance(cmd, ChooseRandom):
+            if not cmd.choices:
+                self.next_interrupts.pop(("random", cmd.key), None)
+            else:
+                # Random choices become near-immediate interrupts resolved
+                # with a real RNG.
+                self.next_interrupts[("random", cmd.key)] = (
+                    time.monotonic() + self.rng.uniform(0.0, 0.01),
+                    self.rng.choice(cmd.choices),
+                )
+
+    def run(self) -> None:
+        out = Out()
+        state = self.actor.on_start(self.id, out)
+        for cmd in out:
+            self._on_command(cmd)
+
+        while not self.stop_event.is_set():
+            # Wait until the next interrupt (or a message arrives).
+            timeout = 0.5
+            if self.next_interrupts:
+                nearest = min(d for d, _ in self.next_interrupts.values())
+                timeout = max(0.0, min(timeout, nearest - time.monotonic()))
+            self.sock.settimeout(timeout if timeout > 0 else 0.000001)
+            out = Out()
+            try:
+                data, addr = self.sock.recvfrom(_MAX_DATAGRAM)
+                try:
+                    msg = self.deserialize(data)
+                except Exception:
+                    continue  # malformed datagrams are ignored
+                src = Id.from_addr(addr[0], addr[1])
+                next_state = self.actor.on_msg(self.id, state, src, msg, out)
+                if next_state is not None:
+                    state = next_state
+            except socket.timeout:
+                now = time.monotonic()
+                due = [
+                    (k, payload)
+                    for k, (deadline, payload) in self.next_interrupts.items()
+                    if deadline <= now
+                ]
+                for key, payload in due:
+                    del self.next_interrupts[key]
+                    if key[0] == "timer":
+                        next_state = self.actor.on_timeout(
+                            self.id, state, payload, out
+                        )
+                    else:
+                        next_state = self.actor.on_random(
+                            self.id, state, payload, out
+                        )
+                    if next_state is not None:
+                        state = next_state
+            except OSError:
+                break
+            for cmd in out:
+                self._on_command(cmd)
+        self.sock.close()
+
+
+def spawn(
+    actors: Iterable[Tuple[Id, Actor]],
+    serialize: Optional[Callable] = None,
+    deserialize: Optional[Callable] = None,
+    msg_types: Iterable[type] = (),
+    block: bool = True,
+):
+    """Run actors for real over UDP (ref: src/actor/spawn.rs:64-154).
+
+    Each (id, actor) pair gets a thread bound to the socket address encoded in
+    its id. With `block=True` (default) this joins forever (ctrl-C to stop);
+    otherwise returns (threads, stop_event) for the caller to manage.
+    """
+    if serialize is None or deserialize is None:
+        default_ser, default_de = make_json_serde(msg_types)
+        serialize = serialize or default_ser
+        deserialize = deserialize or default_de
+    stop_event = threading.Event()
+    threads = [
+        _ActorRuntime(id, actor, serialize, deserialize, stop_event)
+        for id, actor in actors
+    ]
+    for t in threads:
+        t.start()
+    if not block:
+        return threads, stop_event
+    try:
+        while any(t.is_alive() for t in threads):
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        stop_event.set()
+    return threads, stop_event

@@ -1,0 +1,6 @@
+"""The reference's example workloads, rebuilt on this framework
+(ref: /root/reference/examples/*.rs).
+
+Each module exposes the model (importable for tests and benchmarks); the thin
+CLI wrappers live in the repo-level examples/ directory.
+"""

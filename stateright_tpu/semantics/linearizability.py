@@ -1,0 +1,185 @@
+"""Linearizability tester (ref: src/semantics/linearizability.rs).
+
+Captures a potentially concurrent history and decides whether a total order
+exists that (a) respects each thread's own order, (b) respects *real-time*
+order — an operation invoked after another completed must be serialized after
+it — and (c) is valid per the `SequentialSpec`.
+
+Real-time order is tracked exactly as the reference does: upon invocation, the
+tester records the index of the last completed operation of every other thread
+(ref: src/semantics/linearizability.rs:7-12, 114-126); the backtracking
+`serialize` rejects interleavings that would place an operation before any of
+those prerequisites (ref: :193-280).
+
+Testers are immutable: recorders return new testers, so a tester can serve as
+an `ActorModel` history (auxiliary state hashed into the fingerprint).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from . import ConsistencyTester, SequentialSpec
+
+
+class LinearizabilityTester(ConsistencyTester):
+    __slots__ = (
+        "init_ref_obj",
+        "history_by_thread",
+        "in_flight_by_thread",
+        "is_valid_history",
+    )
+
+    def __init__(
+        self,
+        init_ref_obj: SequentialSpec,
+        history_by_thread: Optional[dict] = None,
+        in_flight_by_thread: Optional[dict] = None,
+        is_valid_history: bool = True,
+    ):
+        self.init_ref_obj = init_ref_obj
+        # {tid: tuple of (last_completed, op, ret)}, last_completed is a tuple
+        # of sorted (peer_tid, last_index) pairs.
+        self.history_by_thread = history_by_thread or {}
+        # {tid: (last_completed, op)}
+        self.in_flight_by_thread = in_flight_by_thread or {}
+        self.is_valid_history = is_valid_history
+
+    def __len__(self) -> int:
+        return len(self.in_flight_by_thread) + sum(
+            len(h) for h in self.history_by_thread.values()
+        )
+
+    # -- recording (ref: src/semantics/linearizability.rs:102-157) -------------
+
+    def on_invoke(self, thread_id, op) -> "LinearizabilityTester":
+        if not self.is_valid_history or thread_id in self.in_flight_by_thread:
+            # Double-invocation invalidates the history permanently.
+            return self._invalidated()
+        last_completed = tuple(
+            sorted(
+                (tid, len(hist) - 1)
+                for tid, hist in self.history_by_thread.items()
+                if tid != thread_id and hist
+            )
+        )
+        in_flight = dict(self.in_flight_by_thread)
+        in_flight[thread_id] = (last_completed, op)
+        history = dict(self.history_by_thread)
+        history.setdefault(thread_id, ())
+        return LinearizabilityTester(self.init_ref_obj, history, in_flight, True)
+
+    def on_return(self, thread_id, ret) -> "LinearizabilityTester":
+        if not self.is_valid_history or thread_id not in self.in_flight_by_thread:
+            return self._invalidated()
+        in_flight = dict(self.in_flight_by_thread)
+        last_completed, op = in_flight.pop(thread_id)
+        history = dict(self.history_by_thread)
+        history[thread_id] = history.get(thread_id, ()) + ((last_completed, op, ret),)
+        return LinearizabilityTester(self.init_ref_obj, history, in_flight, True)
+
+    def _invalidated(self) -> "LinearizabilityTester":
+        return LinearizabilityTester(
+            self.init_ref_obj,
+            self.history_by_thread,
+            self.in_flight_by_thread,
+            False,
+        )
+
+    def is_consistent(self) -> bool:
+        return self.serialized_history() is not None
+
+    # -- serialization search (ref: src/semantics/linearizability.rs:175-280) --
+
+    def serialized_history(self) -> Optional[list]:
+        """A valid total order of (op, ret) pairs, or None. In-flight ops may
+        appear (they might have taken effect) or not (they might not have)."""
+        if not self.is_valid_history:
+            return None
+        remaining = {
+            tid: tuple(enumerate(hist))
+            for tid, hist in self.history_by_thread.items()
+        }
+        return _serialize([], self.init_ref_obj, remaining, self.in_flight_by_thread)
+
+    # -- identity (the tester lives inside checker states) ---------------------
+
+    def _key(self):
+        return (
+            self.init_ref_obj,
+            frozenset(self.history_by_thread.items()),
+            frozenset(self.in_flight_by_thread.items()),
+            self.is_valid_history,
+        )
+
+    def __stable_encode__(self):
+        return (
+            type(self).__name__,
+            self.init_ref_obj,
+            self.history_by_thread,
+            self.in_flight_by_thread,
+            self.is_valid_history,
+        )
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, type(self)) and self._key() == other._key()
+
+    def __hash__(self) -> int:
+        return hash(self._key())
+
+    def __repr__(self) -> str:
+        return (
+            f"{type(self).__name__}(history={self.history_by_thread!r}, "
+            f"in_flight={self.in_flight_by_thread!r}, valid={self.is_valid_history})"
+        )
+
+
+def _violates_real_time(last_completed, remaining) -> bool:
+    """An op cannot serialize before its prerequisites: every peer op up to the
+    recorded index must already be consumed (ref: linearizability.rs:221-233)."""
+    for peer_id, min_peer_time in last_completed:
+        ops = remaining.get(peer_id)
+        if ops:
+            next_peer_time = ops[0][0]
+            if next_peer_time <= min_peer_time:
+                return True
+    return False
+
+
+def _serialize(valid_history, ref_obj, remaining, in_flight) -> Optional[list]:
+    if all(not h for h in remaining.values()):
+        # In-flight ops need not take effect (ref: linearizability.rs:203-208).
+        return valid_history
+
+    for thread_id in remaining:
+        history = remaining[thread_id]
+        if not history:
+            # Case 1: only a possibly-in-flight op remains for this thread.
+            if thread_id not in in_flight:
+                continue
+            last_completed, op = in_flight[thread_id]
+            if _violates_real_time(last_completed, remaining):
+                continue
+            ret, next_obj = ref_obj.invoke(op)
+            next_in_flight = {t: v for t, v in in_flight.items() if t != thread_id}
+            result = _serialize(
+                valid_history + [(op, ret)], next_obj, remaining, next_in_flight
+            )
+            if result is not None:
+                return result
+        else:
+            # Case 2: consume the thread's next completed op.
+            (_idx, (last_completed, op, ret)) = history[0]
+            next_remaining = dict(remaining)
+            next_remaining[thread_id] = history[1:]
+            if _violates_real_time(last_completed, next_remaining):
+                continue
+            next_obj = ref_obj.is_valid_step(op, ret)
+            if next_obj is None:
+                continue
+            result = _serialize(
+                valid_history + [(op, ret)], next_obj, next_remaining, in_flight
+            )
+            if result is not None:
+                return result
+    return None

@@ -1,0 +1,144 @@
+"""Sequential-consistency tester (ref: src/semantics/sequential_consistency.rs).
+
+Like `LinearizabilityTester` but without real-time constraints: a total order
+need only respect each thread's own operation order plus the spec's semantics,
+so e.g. a thread may observe stale state relative to another thread's completed
+operation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from . import ConsistencyTester, SequentialSpec
+
+
+class SequentialConsistencyTester(ConsistencyTester):
+    __slots__ = (
+        "init_ref_obj",
+        "history_by_thread",
+        "in_flight_by_thread",
+        "is_valid_history",
+    )
+
+    def __init__(
+        self,
+        init_ref_obj: SequentialSpec,
+        history_by_thread: Optional[dict] = None,
+        in_flight_by_thread: Optional[dict] = None,
+        is_valid_history: bool = True,
+    ):
+        self.init_ref_obj = init_ref_obj
+        self.history_by_thread = history_by_thread or {}  # {tid: ((op, ret), ...)}
+        self.in_flight_by_thread = in_flight_by_thread or {}  # {tid: op}
+        self.is_valid_history = is_valid_history
+
+    def __len__(self) -> int:
+        return len(self.in_flight_by_thread) + sum(
+            len(h) for h in self.history_by_thread.values()
+        )
+
+    # -- recording (ref: sequential_consistency.rs:97-143) ---------------------
+
+    def on_invoke(self, thread_id, op) -> "SequentialConsistencyTester":
+        if not self.is_valid_history or thread_id in self.in_flight_by_thread:
+            return self._invalidated()
+        in_flight = dict(self.in_flight_by_thread)
+        in_flight[thread_id] = op
+        history = dict(self.history_by_thread)
+        history.setdefault(thread_id, ())
+        return SequentialConsistencyTester(self.init_ref_obj, history, in_flight, True)
+
+    def on_return(self, thread_id, ret) -> "SequentialConsistencyTester":
+        if not self.is_valid_history or thread_id not in self.in_flight_by_thread:
+            return self._invalidated()
+        in_flight = dict(self.in_flight_by_thread)
+        op = in_flight.pop(thread_id)
+        history = dict(self.history_by_thread)
+        history[thread_id] = history.get(thread_id, ()) + ((op, ret),)
+        return SequentialConsistencyTester(self.init_ref_obj, history, in_flight, True)
+
+    def _invalidated(self) -> "SequentialConsistencyTester":
+        return SequentialConsistencyTester(
+            self.init_ref_obj,
+            self.history_by_thread,
+            self.in_flight_by_thread,
+            False,
+        )
+
+    def is_consistent(self) -> bool:
+        return self.serialized_history() is not None
+
+    # -- serialization search (ref: sequential_consistency.rs:152-238) ---------
+
+    def serialized_history(self) -> Optional[list]:
+        if not self.is_valid_history:
+            return None
+        return _serialize(
+            [],
+            self.init_ref_obj,
+            dict(self.history_by_thread),
+            self.in_flight_by_thread,
+        )
+
+    # -- identity --------------------------------------------------------------
+
+    def _key(self):
+        return (
+            self.init_ref_obj,
+            frozenset(self.history_by_thread.items()),
+            frozenset(self.in_flight_by_thread.items()),
+            self.is_valid_history,
+        )
+
+    def __stable_encode__(self):
+        return (
+            type(self).__name__,
+            self.init_ref_obj,
+            self.history_by_thread,
+            self.in_flight_by_thread,
+            self.is_valid_history,
+        )
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, type(self)) and self._key() == other._key()
+
+    def __hash__(self) -> int:
+        return hash(self._key())
+
+    def __repr__(self) -> str:
+        return (
+            f"SequentialConsistencyTester(history={self.history_by_thread!r}, "
+            f"in_flight={self.in_flight_by_thread!r}, valid={self.is_valid_history})"
+        )
+
+
+def _serialize(valid_history, ref_obj, remaining, in_flight) -> Optional[list]:
+    if all(not h for h in remaining.values()):
+        return valid_history
+    for thread_id in remaining:
+        history = remaining[thread_id]
+        if not history:
+            if thread_id not in in_flight:
+                continue
+            op = in_flight[thread_id]
+            ret, next_obj = ref_obj.invoke(op)
+            next_in_flight = {t: v for t, v in in_flight.items() if t != thread_id}
+            result = _serialize(
+                valid_history + [(op, ret)], next_obj, remaining, next_in_flight
+            )
+            if result is not None:
+                return result
+        else:
+            op, ret = history[0]
+            next_obj = ref_obj.is_valid_step(op, ret)
+            if next_obj is None:
+                continue
+            next_remaining = dict(remaining)
+            next_remaining[thread_id] = history[1:]
+            result = _serialize(
+                valid_history + [(op, ret)], next_obj, next_remaining, in_flight
+            )
+            if result is not None:
+                return result
+    return None

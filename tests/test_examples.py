@@ -1,0 +1,227 @@
+"""Example-workload golden tests: exact unique-state counts are the
+cross-implementation correctness oracle against the Rust reference
+(SURVEY.md §4 takeaway (b))."""
+
+import pytest
+
+from stateright_tpu.actor import Deliver, Id, Network
+from stateright_tpu.actor.register import Get, GetOk, Internal, Put, PutOk
+from stateright_tpu.examples.abd import AbdModelCfg, AckQuery, AckRecord, Query, Record
+from stateright_tpu.examples.increment import IncrementLockSys, IncrementSys
+from stateright_tpu.examples.interaction import build_model as interaction_model
+from stateright_tpu.examples.lww_register import build_model as lww_model
+from stateright_tpu.examples.paxos import (
+    Accept,
+    Accepted,
+    Decided,
+    PaxosModelCfg,
+    Prepare,
+    Prepared,
+)
+from stateright_tpu.examples.single_copy_register import SingleCopyModelCfg
+from stateright_tpu.examples.two_phase_commit import TwoPhaseSys
+
+
+def test_2pc_goldens():
+    # ref: examples/2pc.rs:149-170 — 288 @ 3 RMs (BFS), 8,832 @ 5 (DFS),
+    # 665 @ 5 with symmetry.
+    checker = TwoPhaseSys(3).checker().spawn_bfs().join()
+    assert checker.unique_state_count() == 288
+    checker.assert_properties()
+
+    checker = TwoPhaseSys(5).checker().spawn_dfs().join()
+    assert checker.unique_state_count() == 8832
+    checker.assert_properties()
+
+    checker = TwoPhaseSys(5).checker().symmetry().spawn_dfs().join()
+    assert checker.unique_state_count() == 665
+    checker.assert_properties()
+
+
+def test_increment_goldens():
+    # ref: examples/increment.rs:32-105 — the full space is 13 states with 2
+    # threads, 8 with symmetry reduction. The checker early-exits once "fin"'s
+    # counterexample is found (reference-parity behavior), so full enumeration
+    # needs an additional undiscoverable property.
+    from stateright_tpu import Property
+
+    class FullIncrement(IncrementSys):
+        def properties(self):
+            return super().properties() + [
+                Property.sometimes("unreachable", lambda m, s: False)
+            ]
+
+    checker = IncrementSys(2).checker().spawn_dfs().join()
+    assert checker.discovery("fin") is not None  # data race found
+
+    checker = FullIncrement(2).checker().spawn_dfs().join()
+    assert checker.unique_state_count() == 13
+    checker = FullIncrement(2).checker().symmetry().spawn_dfs().join()
+    assert checker.unique_state_count() == 8
+
+
+def test_increment_lock_fixes_race():
+    checker = IncrementLockSys(2).checker().spawn_dfs().join()
+    checker.assert_properties()  # fin + mutex both hold
+
+    sym = IncrementLockSys(2).checker().symmetry().spawn_dfs().join()
+    sym.assert_properties()
+    assert sym.unique_state_count() <= checker.unique_state_count()
+
+
+def test_single_copy_register_goldens():
+    # ref: examples/single-copy-register.rs:91-137
+    checker = (
+        SingleCopyModelCfg(
+            client_count=2,
+            server_count=1,
+            network=Network.new_unordered_nonduplicating(),
+        )
+        .into_model()
+        .checker()
+        .spawn_dfs()
+        .join()
+    )
+    checker.assert_properties()
+    checker.assert_discovery(
+        "value chosen",
+        [
+            Deliver(Id(2), Id(0), Put(2, "B")),
+            Deliver(Id(0), Id(2), PutOk(2)),
+            Deliver(Id(2), Id(0), Get(4)),
+        ],
+    )
+    assert checker.unique_state_count() == 93
+
+    # More than one server: not linearizable.
+    checker = (
+        SingleCopyModelCfg(
+            client_count=2,
+            server_count=2,
+            network=Network.new_unordered_nonduplicating(),
+        )
+        .into_model()
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    checker.assert_discovery(
+        "linearizable",
+        [
+            Deliver(Id(3), Id(1), Put(3, "B")),
+            Deliver(Id(1), Id(3), PutOk(3)),
+            Deliver(Id(3), Id(0), Get(6)),
+            Deliver(Id(0), Id(3), GetOk(6, "\x00")),
+        ],
+    )
+    checker.assert_discovery(
+        "value chosen",
+        [
+            Deliver(Id(3), Id(1), Put(3, "B")),
+            Deliver(Id(1), Id(3), PutOk(3)),
+            Deliver(Id(2), Id(0), Put(2, "A")),
+            Deliver(Id(3), Id(0), Get(6)),
+        ],
+    )
+    # The reference pins 20 here, but that number is visit-order dependent:
+    # the checker early-exits once BOTH discoveries are found, and how many
+    # states are visited first depends on action enumeration order (Rust
+    # fixed-seed HashMap order vs our insertion order). Both witness traces
+    # above validate by re-execution, which is the order-independent oracle.
+    assert 10 <= checker.unique_state_count() <= 60
+
+
+def test_abd_goldens():
+    # ref: examples/linearizable-register.rs:252-305 — 544 unique states with
+    # 2 clients / 2 servers; the documented witness trace validates.
+    checker = (
+        AbdModelCfg(
+            client_count=2,
+            server_count=2,
+            network=Network.new_unordered_nonduplicating(),
+        )
+        .into_model()
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    checker.assert_properties()
+    checker.assert_discovery(
+        "value chosen",
+        [
+            Deliver(Id(3), Id(1), Put(3, "B")),
+            Deliver(Id(1), Id(0), Internal(Query(3))),
+            Deliver(Id(0), Id(1), Internal(AckQuery(3, (0, Id(0)), "\x00"))),
+            Deliver(Id(1), Id(0), Internal(Record(3, (1, Id(1)), "B"))),
+            Deliver(Id(0), Id(1), Internal(AckRecord(3))),
+            Deliver(Id(1), Id(3), PutOk(3)),
+            Deliver(Id(3), Id(0), Get(6)),
+            Deliver(Id(0), Id(1), Internal(Query(6))),
+            Deliver(Id(1), Id(0), Internal(AckQuery(6, (1, Id(1)), "B"))),
+            Deliver(Id(0), Id(1), Internal(Record(6, (1, Id(1)), "B"))),
+            Deliver(Id(1), Id(0), Internal(AckRecord(6))),
+        ],
+    )
+    assert checker.unique_state_count() == 544
+
+
+@pytest.mark.slow
+def test_paxos_golden():
+    # ref: examples/paxos.rs:300-352 — THE headline golden: 16,668 unique
+    # states with 2 clients / 3 servers, linearizability holding throughout.
+    checker = (
+        PaxosModelCfg(
+            client_count=2,
+            server_count=3,
+            network=Network.new_unordered_nonduplicating(),
+        )
+        .into_model()
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    checker.assert_properties()
+    checker.assert_discovery(
+        "value chosen",
+        [
+            Deliver(Id(4), Id(1), Put(4, "B")),
+            Deliver(Id(1), Id(0), Internal(Prepare((1, Id(1))))),
+            Deliver(Id(0), Id(1), Internal(Prepared((1, Id(1)), None))),
+            Deliver(Id(1), Id(2), Internal(Accept((1, Id(1)), (4, Id(4), "B")))),
+            Deliver(Id(2), Id(1), Internal(Accepted((1, Id(1))))),
+            Deliver(Id(1), Id(4), PutOk(4)),
+            Deliver(Id(1), Id(2), Internal(Decided((1, Id(1)), (4, Id(4), "B")))),
+            Deliver(Id(4), Id(2), Get(8)),
+        ],
+    )
+    assert checker.unique_state_count() == 16668
+
+
+def test_lww_register_is_eventually_consistent():
+    checker = lww_model(2).checker().target_max_depth(6).spawn_dfs().join()
+    checker.assert_properties()
+    assert checker.unique_state_count() > 10
+
+
+def test_timers_model_checks():
+    from stateright_tpu.examples.timers import PingerModelCfg
+
+    checker = (
+        PingerModelCfg(server_count=2, network=Network.new_unordered_nonduplicating())
+        .into_model()
+        .checker()
+        .target_max_depth(6)
+        .spawn_dfs()
+        .join()
+    )
+    checker.assert_properties()
+    assert checker.unique_state_count() > 1
+
+
+def test_interaction_success_reachable():
+    checker = (
+        interaction_model().checker().target_max_depth(12).spawn_bfs().join()
+    )
+    # Within the bounded depth the client can observe success; the eventually
+    # property must not produce a counterexample.
+    assert checker.discovery("success") is None

@@ -1,0 +1,255 @@
+"""Consistency-semantics tests: table-driven accept/reject histories mirroring
+the reference (ref: src/semantics/linearizability.rs:310-509,
+src/semantics/sequential_consistency.rs:266+, register.rs:51-87, vec.rs:52-99,
+write_once_register.rs:60-114)."""
+
+from stateright_tpu.semantics import (
+    LinearizabilityTester,
+    Len,
+    LenOk,
+    Pop,
+    PopOk,
+    Push,
+    PushOk,
+    Read,
+    ReadOk,
+    Register,
+    SequentialConsistencyTester,
+    VecSpec,
+    WORegister,
+    Write,
+    WriteFail,
+    WriteOk,
+)
+
+
+# -- reference objects ---------------------------------------------------------
+
+
+def test_register_semantics():
+    r = Register("A")
+    ret, r2 = r.invoke(Read())
+    assert ret == ReadOk("A")
+    ret, r3 = r2.invoke(Write("B"))
+    assert ret == WriteOk()
+    ret, _ = r3.invoke(Read())
+    assert ret == ReadOk("B")
+
+    assert Register("A").is_valid_history([])
+    assert Register("A").is_valid_history(
+        [
+            (Read(), ReadOk("A")),
+            (Write("B"), WriteOk()),
+            (Read(), ReadOk("B")),
+            (Write("C"), WriteOk()),
+            (Read(), ReadOk("C")),
+        ]
+    )
+    assert not Register("A").is_valid_history(
+        [(Read(), ReadOk("B")), (Write("B"), WriteOk())]
+    )
+    assert not Register("A").is_valid_history(
+        [(Write("B"), WriteOk()), (Read(), ReadOk("A"))]
+    )
+
+
+def test_write_once_register_semantics():
+    r = WORegister()
+    ret, r2 = r.invoke(Write("A"))
+    assert ret == WriteOk()
+    ret, _ = r2.invoke(Read())
+    assert ret == ReadOk("A")
+    ret, _ = r2.invoke(Write("B"))
+    assert ret == WriteFail()
+    ret, r3 = r2.invoke(Write("A"))  # idempotent equal write succeeds
+    assert ret == WriteOk()
+    assert WORegister().is_valid_history(
+        [(Read(), ReadOk(None)), (Write("A"), WriteOk()), (Write("B"), WriteFail())]
+    )
+    assert not WORegister().is_valid_history([(Write("A"), WriteFail())])
+
+
+def test_vec_semantics():
+    v = VecSpec(("A",))
+    ret, _ = v.invoke(Len())
+    assert ret == LenOk(1)
+    ret, v2 = v.invoke(Push("B"))
+    assert ret == PushOk()
+    ret, v3 = v2.invoke(Pop())
+    assert ret == PopOk("B")
+    ret, _ = VecSpec().invoke(Pop())
+    assert ret == PopOk(None)
+
+
+# -- linearizability (ref: linearizability.rs:316-509) -------------------------
+
+
+def test_rejects_invalid_history():
+    t = LinearizabilityTester(Register("A")).on_invoke(99, Write("B"))
+    t2 = t.on_invoke(99, Write("C"))  # double in-flight
+    assert not t2.is_valid_history
+    assert t2.serialized_history() is None
+
+    t = (
+        LinearizabilityTester(Register("A"))
+        .on_invret(99, Write("B"), WriteOk())
+        .on_invret(99, Write("C"), WriteOk())
+        .on_return(99, WriteOk())  # return without invocation
+    )
+    assert not t.is_valid_history
+
+
+def test_identifies_linearizable_register_history():
+    t = (
+        LinearizabilityTester(Register("A"))
+        .on_invoke(0, Write("B"))
+        .on_invret(1, Read(), ReadOk("A"))
+    )
+    assert t.serialized_history() == [(Read(), ReadOk("A"))]
+
+    t = (
+        LinearizabilityTester(Register("A"))
+        .on_invoke(0, Read())
+        .on_invoke(1, Write("B"))
+        .on_return(0, ReadOk("B"))
+    )
+    assert t.serialized_history() == [
+        (Write("B"), WriteOk()),
+        (Read(), ReadOk("B")),
+    ]
+
+
+def test_identifies_unlinearizable_register_history():
+    t = LinearizabilityTester(Register("A")).on_invret(0, Read(), ReadOk("B"))
+    assert t.serialized_history() is None
+
+    # Sequentially consistent but NOT linearizable: the read completed before
+    # the write was invoked, so real-time order forbids serializing the write
+    # first.
+    t = (
+        LinearizabilityTester(Register("A"))
+        .on_invret(0, Read(), ReadOk("B"))
+        .on_invoke(1, Write("B"))
+    )
+    assert t.serialized_history() is None
+
+
+def test_identifies_linearizable_vec_history():
+    t = LinearizabilityTester(VecSpec()).on_invoke(0, Push(10))
+    assert t.serialized_history() == []
+
+    t = (
+        LinearizabilityTester(VecSpec())
+        .on_invoke(0, Push(10))
+        .on_invret(1, Pop(), PopOk(None))
+    )
+    assert t.serialized_history() == [(Pop(), PopOk(None))]
+
+    t = (
+        LinearizabilityTester(VecSpec())
+        .on_invoke(0, Push(10))
+        .on_invret(1, Pop(), PopOk(10))
+    )
+    assert t.serialized_history() == [(Push(10), PushOk()), (Pop(), PopOk(10))]
+
+    t = (
+        LinearizabilityTester(VecSpec())
+        .on_invret(0, Push(10), PushOk())
+        .on_invoke(0, Push(20))
+        .on_invret(1, Len(), LenOk(1))
+        .on_invret(1, Pop(), PopOk(20))
+        .on_invret(1, Pop(), PopOk(10))
+    )
+    assert t.serialized_history() == [
+        (Push(10), PushOk()),
+        (Len(), LenOk(1)),
+        (Push(20), PushOk()),
+        (Pop(), PopOk(20)),
+        (Pop(), PopOk(10)),
+    ]
+
+    t = (
+        LinearizabilityTester(VecSpec())
+        .on_invret(0, Push(10), PushOk())
+        .on_invoke(1, Len())
+        .on_invoke(0, Push(20))
+        .on_return(1, LenOk(2))
+    )
+    assert t.serialized_history() == [
+        (Push(10), PushOk()),
+        (Push(20), PushOk()),
+        (Len(), LenOk(2)),
+    ]
+
+
+def test_identifies_unlinearizable_vec_history():
+    t = (
+        LinearizabilityTester(VecSpec())
+        .on_invret(0, Push(10), PushOk())
+        .on_invret(1, Pop(), PopOk(None))
+    )
+    assert t.serialized_history() is None
+
+    t = (
+        LinearizabilityTester(VecSpec())
+        .on_invret(0, Push(10), PushOk())
+        .on_invoke(1, Len())
+        .on_invoke(0, Push(20))
+        .on_return(1, LenOk(0))
+    )
+    assert t.serialized_history() is None
+
+    t = (
+        LinearizabilityTester(VecSpec())
+        .on_invret(0, Push(10), PushOk())
+        .on_invoke(0, Push(20))
+        .on_invret(1, Len(), LenOk(2))
+        .on_invret(1, Pop(), PopOk(10))
+        .on_invret(1, Pop(), PopOk(20))
+    )
+    assert t.serialized_history() is None
+
+
+# -- sequential consistency ----------------------------------------------------
+
+
+def test_sequential_consistency_allows_stale_reads():
+    # The history that is NOT linearizable IS sequentially consistent.
+    t = (
+        SequentialConsistencyTester(Register("A"))
+        .on_invret(0, Read(), ReadOk("B"))
+        .on_invoke(1, Write("B"))
+    )
+    assert t.serialized_history() == [
+        (Write("B"), WriteOk()),
+        (Read(), ReadOk("B")),
+    ]
+
+    t = (
+        SequentialConsistencyTester(VecSpec())
+        .on_invret(0, Push(10), PushOk())
+        .on_invret(1, Pop(), PopOk(None))
+    )
+    assert t.serialized_history() == [(Pop(), PopOk(None)), (Push(10), PushOk())]
+
+
+def test_sequential_consistency_still_respects_program_order():
+    t = (
+        SequentialConsistencyTester(Register("A"))
+        .on_invret(0, Write("B"), WriteOk())
+        .on_invret(0, Read(), ReadOk("A"))  # same thread: must see own write
+    )
+    assert t.serialized_history() is None
+
+
+def test_tester_is_stably_encodable_and_hashable():
+    from stateright_tpu import fingerprint
+
+    t1 = LinearizabilityTester(Register("A")).on_invoke(0, Write("B"))
+    t2 = LinearizabilityTester(Register("A")).on_invoke(0, Write("B"))
+    assert t1 == t2
+    assert hash(t1) == hash(t2)
+    assert fingerprint(t1) == fingerprint(t2)
+    t3 = t1.on_return(0, WriteOk())
+    assert t1 != t3
+    assert fingerprint(t1) != fingerprint(t3)
