@@ -1,0 +1,122 @@
+"""The TPU frontier checker behind the standard `Checker` interface — the
+plug-in boundary BASELINE.json requires: `TensorModel.checker().spawn_tpu()`
+gives the same handle API (counts, discoveries, join, report, assertions) as
+the host checkers, with the search executed as batched device kernels.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..core.path import Path
+from .base import Checker
+
+
+class TpuChecker(Checker):
+    def __init__(
+        self,
+        options,
+        batch_size: int = 1024,
+        table_log2: int = 20,
+        resident: bool = None,
+    ):
+        from ..tensor.frontier import FrontierSearch
+        from ..tensor.model import TensorModel
+        from ..tensor.resident import ResidentSearch
+
+        model = options.model
+        if not isinstance(model, TensorModel):
+            raise TypeError(
+                "spawn_tpu() requires a stateright_tpu.tensor.TensorModel; "
+                f"got {type(model).__name__}. Host Models run on spawn_bfs/"
+                "spawn_dfs; tensor encodings of the bundled workloads live in "
+                "stateright_tpu.tensor.models."
+            )
+        if options.symmetry_fn_ is not None:
+            raise NotImplementedError(
+                "symmetry reduction on the device checker lands with the "
+                "tensor canonicalization kernel; use spawn_dfs for now"
+            )
+        super().__init__(model)
+        # The resident engine runs the whole search in one device dispatch —
+        # the default. The host-orchestrated engine supports live progress,
+        # target_max_depth, and timeout (a device loop can't be interrupted),
+        # and is the fallback for those options.
+        if resident is None:
+            resident = options.target_max_depth_ is None and options.timeout_ is None
+        self._search = (
+            ResidentSearch(model, batch_size, table_log2)
+            if resident
+            else FrontierSearch(model, batch_size, table_log2)
+        )
+        self._options = options
+        self._result = None
+        self._discovery_paths = None
+        self._live = {"states": 0, "unique": 0, "depth": 0}
+        self._panic: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        def progress(states, unique, depth):
+            self._live["states"] = states
+            self._live["unique"] = unique
+            self._live["depth"] = depth
+
+        from ..tensor.frontier import FrontierSearch
+
+        kwargs = dict(
+            finish_when=self._options.finish_when_,
+            target_state_count=self._options.target_state_count_,
+            target_max_depth=self._options.target_max_depth_,
+            timeout=self._options.timeout_,
+        )
+        if isinstance(self._search, FrontierSearch):
+            kwargs["progress"] = progress
+        try:
+            self._result = self._search.run(**kwargs)
+        except BaseException as e:  # noqa: BLE001 — surfaced by join()
+            self._panic = e
+
+    # -- Checker interface -----------------------------------------------------
+
+    def state_count(self) -> int:
+        r = self._result
+        return r.state_count if r is not None else self._live["states"]
+
+    def unique_state_count(self) -> int:
+        r = self._result
+        return r.unique_state_count if r is not None else self._live["unique"]
+
+    def max_depth(self) -> int:
+        r = self._result
+        return r.max_depth if r is not None else self._live["depth"]
+
+    def discoveries(self) -> dict[str, Path]:
+        if self._result is None:
+            return {}
+        if self._discovery_paths is None:
+            # Reconstruction dumps the device table; results are immutable
+            # once the search thread finishes, so build the paths once.
+            self._discovery_paths = {
+                name: self._search.reconstruct_path(fp)
+                for name, fp in self._result.discoveries.items()
+            }
+        return dict(self._discovery_paths)
+
+    def join(self) -> "TpuChecker":
+        self._thread.join()
+        if self._panic is not None:
+            raise self._panic
+        return self
+
+    def is_done(self) -> bool:
+        return not self._thread.is_alive()
+
+    def assert_discovery(self, name, actions) -> None:
+        raise NotImplementedError(
+            "assert_discovery validates action lists by host re-execution; "
+            "compare discovery(name).actions() against expectations instead "
+            "for tensor models"
+        )

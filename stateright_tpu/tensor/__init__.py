@@ -1,0 +1,41 @@
+"""The TPU-native checker core: batched frontier expansion on device.
+
+This is the performance path that replaces the reference's thread/work-stealing
+design (src/checker/bfs.rs + src/job_market.rs) with frontier-synchronous
+batched BFS (SURVEY.md §7):
+
+- a state is a fixed-width row of uint32 lanes; a model defines one vectorized
+  transition kernel `expand(states) -> (successors, valid_mask)` with the
+  action dimension enumerated statically — one `jit` call expands thousands of
+  states per step instead of one thread expanding one state at a time;
+- fingerprints are 64-bit mixes computed on device; the visited set is a
+  device-resident open-addressing hash table in HBM whose insert kernel also
+  stores parent fingerprints for TLC-style path reconstruction
+  (mirroring the parent pointers at src/checker/bfs.rs:301-315);
+- property predicates are vectorized masks; eventually-bits ride along as a
+  per-state bitmask lane (src/checker.rs:580-587 semantics preserved);
+- multi-chip runs shard the table by fingerprint ownership and exchange
+  successors with all_to_all collectives (stateright_tpu.tensor.sharding),
+  replacing the job market's work stealing.
+
+Importing this package enables 64-bit array types (needed for on-device u64
+fingerprints; TPUs emulate 64-bit integer ops).
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from .model import TensorModel, TensorProperty  # noqa: E402
+from .fingerprint import device_fingerprint  # noqa: E402
+from .hashtable import HashTable  # noqa: E402
+from .frontier import FrontierSearch, SearchResult  # noqa: E402
+
+__all__ = [
+    "TensorModel",
+    "TensorProperty",
+    "device_fingerprint",
+    "HashTable",
+    "FrontierSearch",
+    "SearchResult",
+]

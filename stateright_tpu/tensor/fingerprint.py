@@ -1,0 +1,37 @@
+"""On-device 64-bit state fingerprinting.
+
+The host fingerprint (blake2b over a canonical encoding,
+stateright_tpu.core.fingerprint) identifies Python states; device states are
+uint32 lane rows, identified by a splitmix64-style multiply-xor fold computed
+entirely on device. The two fingerprint domains never need to agree — parity of
+unique-state counts only requires each encoding to be injective per model
+(SURVEY.md §7 "hard parts") — but both honor the same contracts as the
+reference's `Fingerprint` (ref: src/lib.rs:340-387): stable across
+runs/processes/chips, and nonzero (0 is the empty-slot/no-parent sentinel).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# splitmix64 constants (public domain PRNG finalizer).
+_GOLDEN = jnp.uint64(0x9E3779B97F4A7C15)
+_MIX1 = jnp.uint64(0xBF58476D1CE4E5B9)
+_MIX2 = jnp.uint64(0x94D049BB133111EB)
+
+
+def _mix64(h: jnp.ndarray) -> jnp.ndarray:
+    h = (h ^ (h >> jnp.uint64(30))) * _MIX1
+    h = (h ^ (h >> jnp.uint64(27))) * _MIX2
+    return h ^ (h >> jnp.uint64(31))
+
+
+def device_fingerprint(states: jnp.ndarray) -> jnp.ndarray:
+    """uint32[B, L] -> uint64[B], avoiding both sentinels: 0 (empty slot /
+    no parent) and 2^64-1 (the engines' invalid-lane sort key)."""
+    h = jnp.full(states.shape[0], jnp.uint64(0x5851F42D4C957F2D))
+    lanes = states.astype(jnp.uint64)
+    for i in range(states.shape[1]):  # static, small
+        h = _mix64(h ^ (lanes[:, i] + _GOLDEN * jnp.uint64(i + 1)))
+    h = jnp.where(h == 0, jnp.uint64(1), h)
+    return jnp.where(h == jnp.uint64(0xFFFFFFFFFFFFFFFF), jnp.uint64(2), h)

@@ -1,0 +1,394 @@
+"""Frontier-synchronous batched BFS on device — the TPU replacement for the
+reference's hot loop (`check_block`, src/checker/bfs.rs:177-335).
+
+One jitted step fuses, for a batch of up to `batch_size` frontier states:
+property-mask evaluation, successor expansion (`TensorModel.expand`), boundary
+masking, on-device fingerprinting, intra-batch dedup (sort + neighbor compare),
+and visited-set insertion with parent tracking. The host orchestrates the
+frontier queue, eventually-bit bookkeeping, discovery recording, and early
+exit — exactly the split SURVEY.md §7 prescribes (host keeps the user-facing
+API and path reconstruction; the device owns the hot loop).
+
+Search semantics match the host BFS checker bit-for-bit where observable:
+state/unique counts, boundary handling, depth cutoffs, eventually-bit false
+negatives at revisits, early exit once every property has a discovery.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.discovery import HasDiscoveries
+from ..core.model import Expectation
+from ..core.path import Path
+from .fingerprint import device_fingerprint
+from .hashtable import HashTable
+from .model import TensorModel
+
+_MAX_U64 = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def seed_init(model: "TensorModel"):
+    """Boundary-filter and fingerprint-dedup the initial states on host.
+
+    Returns (states uint32[n0, L], fps uint64[n0], n_raw) where n_raw is the
+    PRE-dedup in-boundary count — the host checkers seed state_count with the
+    raw init list length (ref: src/checker/bfs.rs:54), so count parity
+    requires it.
+    """
+    init = np.asarray(model.init_states(), dtype=np.uint32)
+    in_bounds = np.asarray(model.within_boundary(jnp.asarray(init)))
+    init = init[in_bounds]
+    n_raw = len(init)
+    init_fps = np.asarray(device_fingerprint(jnp.asarray(init)))
+    _, first_pos = np.unique(init_fps, return_index=True)
+    keep = np.sort(first_pos)
+    return init[keep], init_fps[keep], n_raw
+
+
+def expand_insert(model: "TensorModel", keys, parents, states, fps, active):
+    """The traced core of one frontier step, shared by the host-orchestrated
+    and device-resident engines: expand, boundary-mask, fingerprint, intra-
+    batch dedup (sort + neighbor compare), visited-set insert with parent
+    tracking, and compaction of the newly-discovered states to the front.
+
+    Returns (keys, parents, out_states, out_fps, src_rows, new_count,
+    gen_count, has_succ, overflow); `src_rows[i] // max_actions` is the input
+    row that produced compacted output row i.
+    """
+    from .hashtable import _insert_impl
+
+    K = states.shape[0]
+    A = model.max_actions
+    succs, valid = model.expand(states)
+    valid = valid & active[:, None]
+    flat = succs.reshape(K * A, model.lanes)
+    validf = valid.reshape(-1) & model.within_boundary(flat)
+    # Generated-state count is pre-dedup, post-boundary (ref: bfs.rs:288-291).
+    gen_count = validf.sum()
+    # Terminality counts deduped successors too, but not boundary-excluded
+    # ones (ref: bfs.rs:287-333).
+    has_succ = validf.reshape(K, A).any(axis=1)
+
+    sfps = device_fingerprint(flat)
+    sort_key = jnp.where(validf, sfps, _MAX_U64)
+    order = jnp.argsort(sort_key)
+    so_fps = sort_key[order]
+    uniq = so_fps != jnp.roll(so_fps, 1)
+    uniq = uniq.at[0].set(True) & (so_fps != _MAX_U64)
+    parent_rep = jnp.repeat(fps, A)[order]
+    keys, parents, is_new, overflow = _insert_impl(
+        keys, parents, so_fps, parent_rep, uniq
+    )
+
+    rank = jnp.argsort(~is_new, stable=True)
+    src_rows = order[rank]
+    out_states = flat[src_rows]
+    out_fps = so_fps[rank]
+    new_count = is_new.sum()
+    return (
+        keys,
+        parents,
+        out_states,
+        out_fps,
+        src_rows.astype(jnp.int32),
+        new_count,
+        gen_count,
+        has_succ,
+        overflow,
+    )
+
+
+def reconstruct_path(model: TensorModel, parent_map: dict, fp: int) -> Path:
+    """Walk device parent pointers, then re-execute the tensor model to
+    recover decoded states and action labels (the TLC fingerprint-stack
+    technique, ref: src/checker/bfs.rs:380-409)."""
+    chain: list[int] = []
+    cur = fp
+    while cur:
+        chain.append(cur)
+        cur = parent_map.get(cur, 0)
+    chain.reverse()
+
+    init = np.asarray(model.init_states(), dtype=np.uint32)
+    init_fps = np.asarray(device_fingerprint(jnp.asarray(init)))
+    rows = np.nonzero(init_fps == np.uint64(chain[0]))[0]
+    if len(rows) == 0:
+        raise RuntimeError(
+            "failed to reconstruct init state from device fingerprint; "
+            "the tensor model may be nondeterministic"
+        )
+    cur_row = init[rows[0]]
+    pairs = []
+    for next_fp in chain[1:]:
+        succs, valid = model.expand(jnp.asarray(cur_row[None]))
+        succs = np.asarray(succs)[0]
+        valid = np.asarray(valid)[0]
+        sfps = np.asarray(device_fingerprint(jnp.asarray(succs)))
+        hits = np.nonzero(valid & (sfps == np.uint64(next_fp)))[0]
+        if len(hits) == 0:
+            raise RuntimeError(
+                "failed to reconstruct a step from device fingerprints; "
+                "the tensor model may be nondeterministic"
+            )
+        a = int(hits[0])
+        pairs.append((model.decode(cur_row), model.action_label(cur_row, a)))
+        cur_row = succs[a]
+    pairs.append((model.decode(cur_row), None))
+    return Path(pairs)
+
+
+@dataclass
+class SearchResult:
+    state_count: int
+    unique_state_count: int
+    max_depth: int
+    discoveries: dict  # name -> device fingerprint
+    complete: bool  # queue exhausted (vs early exit)
+    duration: float
+    steps: int = 0
+
+
+@dataclass
+class _Chunk:
+    states: np.ndarray  # uint32[n, L]
+    fps: np.ndarray  # uint64[n]
+    ebits: np.ndarray  # bool[n, P]
+    depth: int
+
+
+class FrontierSearch:
+    def __init__(
+        self,
+        model: TensorModel,
+        batch_size: int = 1024,
+        table_log2: int = 20,
+    ):
+        self.model = model
+        self.batch_size = batch_size
+        self.table = HashTable(table_log2)
+        self.properties = model.properties()
+        self._step = self._build_step()
+
+    # -- the fused device step -------------------------------------------------
+
+    def _build_step(self):
+        model = self.model
+        K = self.batch_size
+        A = model.max_actions
+        props = self.properties
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def step(keys, parents, states, fps, active):
+            # Property masks on the input states (ref: bfs.rs:230-280).
+            prop_masks = (
+                jnp.stack([p.condition(model, states) for p in props])
+                if props
+                else jnp.zeros((0, K), dtype=bool)
+            )
+            return (
+                *expand_insert(model, keys, parents, states, fps, active),
+                prop_masks,
+            )
+
+        return step
+
+    # -- host orchestration ----------------------------------------------------
+
+    def run(
+        self,
+        finish_when: HasDiscoveries = HasDiscoveries.ALL,
+        target_state_count: Optional[int] = None,
+        target_max_depth: Optional[int] = None,
+        timeout: Optional[float] = None,
+        progress: Optional[callable] = None,
+    ) -> SearchResult:
+        model = self.model
+        K = self.batch_size
+        A = model.max_actions
+        P = len(self.properties)
+        start = time.monotonic()
+        props = self.properties
+        prop_is = {
+            "always": [i for i, p in enumerate(props) if p.expectation == Expectation.ALWAYS],
+            "sometimes": [i for i, p in enumerate(props) if p.expectation == Expectation.SOMETIMES],
+            "eventually": [i for i, p in enumerate(props) if p.expectation == Expectation.EVENTUALLY],
+        }
+
+        discoveries: dict = {}
+        steps = 0
+
+        # Seed: boundary-filter init states, dedup, insert with parent 0.
+        init, init_fps, n_raw = seed_init(model)
+        n0 = len(init)
+        state_count = n_raw  # host checkers count pre-dedup (bfs.rs:54)
+        unique_count = 0
+        max_depth = 0
+
+        # Insert init states (chunked to batch size).
+        for lo in range(0, n0, K):
+            sl = slice(lo, min(lo + K, n0))
+            fps_pad = np.zeros(K, dtype=np.uint64)
+            n = sl.stop - sl.start
+            fps_pad[:n] = init_fps[sl]
+            res = self.table.insert(
+                jnp.asarray(fps_pad),
+                jnp.zeros(K, dtype=jnp.uint64),
+                jnp.asarray(np.arange(K) < n),
+            )
+            if bool(res.overflow):
+                raise RuntimeError("hash table full; raise table_log2")
+            unique_count += int(np.asarray(res.is_new).sum())
+
+        ebits0 = np.zeros((n0, P), dtype=bool)
+        for i in prop_is["eventually"]:
+            ebits0[:, i] = True
+        queue: deque = deque()
+        queue.append(_Chunk(init, init_fps, ebits0, depth=1))
+
+        complete = True
+        while queue:
+            if timeout is not None and time.monotonic() - start > timeout:
+                complete = False
+                break
+            chunk = queue.popleft()
+            # Coalesce same-depth chunks so narrow frontiers still fill the
+            # batch (depths in the queue are monotonically nondecreasing).
+            while queue and queue[0].depth == chunk.depth:
+                nxt = queue.popleft()
+                chunk = _Chunk(
+                    np.concatenate([chunk.states, nxt.states]),
+                    np.concatenate([chunk.fps, nxt.fps]),
+                    np.concatenate([chunk.ebits, nxt.ebits]),
+                    chunk.depth,
+                )
+            max_depth = max(max_depth, chunk.depth)
+            if target_max_depth is not None and chunk.depth >= target_max_depth:
+                # Not expanded, not evaluated (ref: bfs.rs:219-224).
+                continue
+            n = len(chunk.states)
+            for lo in range(0, n, K):
+                hi = min(lo + K, n)
+                m = hi - lo
+                st = np.zeros((K, model.lanes), dtype=np.uint32)
+                st[:m] = chunk.states[lo:hi]
+                fp = np.zeros(K, dtype=np.uint64)
+                fp[:m] = chunk.fps[lo:hi]
+                active = np.arange(K) < m
+
+                (
+                    keys,
+                    parents,
+                    out_states,
+                    out_fps,
+                    src_rows,
+                    new_count,
+                    gen_count,
+                    has_succ,
+                    overflow,
+                    prop_masks,
+                ) = self._step(
+                    self.table.keys,
+                    self.table.parents,
+                    jnp.asarray(st),
+                    jnp.asarray(fp),
+                    jnp.asarray(active),
+                )
+                self.table.keys, self.table.parents = keys, parents
+                steps += 1
+                if bool(overflow):
+                    raise RuntimeError("hash table full; raise table_log2")
+
+                prop_masks = np.asarray(prop_masks)
+                ebits = chunk.ebits[lo:hi]
+
+                # Discoveries (ref: bfs.rs:230-280).
+                for i in prop_is["always"]:
+                    if props[i].name in discoveries:
+                        continue
+                    viol = active[:m] & ~prop_masks[i][:m]
+                    if viol.any():
+                        discoveries[props[i].name] = int(fp[np.argmax(viol)])
+                for i in prop_is["sometimes"]:
+                    if props[i].name in discoveries:
+                        continue
+                    sat = active[:m] & prop_masks[i][:m]
+                    if sat.any():
+                        discoveries[props[i].name] = int(fp[np.argmax(sat)])
+                if prop_is["eventually"]:
+                    for i in prop_is["eventually"]:
+                        # Clear pending bits where observed; successors
+                        # inherit the cleared bits below.
+                        ebits[:, i] &= ~prop_masks[i][:m]
+                    # Terminal states with pending eventually bits are
+                    # counterexamples (ref: bfs.rs:326-333).
+                    term = ~np.asarray(has_succ)[:m]
+                    for i in prop_is["eventually"]:
+                        if props[i].name in discoveries:
+                            continue
+                        bad = term & ebits[:, i]
+                        if bad.any():
+                            discoveries[props[i].name] = int(fp[np.argmax(bad)])
+
+                # Early exit when every property is discovered
+                # (ref: bfs.rs:278-280) or finish_when matches.
+                if props and len(discoveries) == len(props):
+                    complete = False
+                    queue.clear()
+                    break
+                if finish_when.matches(props, set(discoveries)):
+                    complete = False
+                    queue.clear()
+                    break
+
+                state_count += int(gen_count)
+                nc = int(new_count)
+                unique_count += nc
+                if nc:
+                    out_states = np.asarray(out_states[:nc])
+                    out_fps = np.asarray(out_fps[:nc])
+                    parent_rows = np.asarray(src_rows[:nc]) // A
+                    child_ebits = (
+                        ebits[parent_rows]
+                        if P
+                        else np.zeros((nc, 0), dtype=bool)
+                    )
+                    queue.append(
+                        _Chunk(out_states, out_fps, child_ebits, chunk.depth + 1)
+                    )
+                if (
+                    target_state_count is not None
+                    and state_count >= target_state_count
+                ):
+                    complete = False
+                    queue.clear()
+                    break
+                if progress is not None:
+                    progress(state_count, unique_count, max_depth)
+            else:
+                continue
+            break
+
+        return SearchResult(
+            state_count=state_count,
+            unique_state_count=unique_count,
+            max_depth=max_depth,
+            discoveries=discoveries,
+            complete=complete and not queue,
+            duration=time.monotonic() - start,
+            steps=steps,
+        )
+
+    # -- path reconstruction ---------------------------------------------------
+
+    def reconstruct_path(self, fp: int) -> Path:
+        return reconstruct_path(self.model, self.table.dump(), fp)

@@ -1,0 +1,98 @@
+"""Device-resident visited set: an open-addressing hash table over HBM.
+
+Replaces the reference's sharded concurrent `DashMap<Fingerprint,
+Option<Fingerprint>>` (ref: src/checker/bfs.rs:29-30): keys are nonzero uint64
+fingerprints, values are parent fingerprints for path reconstruction.
+
+The batched insert-if-absent kernel resolves intra-batch slot races with a
+scatter-max claim: every still-probing lane proposes its fingerprint for its
+current (free) slot, the maximum proposal wins the slot, losers advance to the
+next probe position. Linear-probing lookups stay correct because slots are
+claimed only when observed free along the probe chain and are never emptied.
+
+The caller must pre-deduplicate fingerprints within a batch (two lanes with the
+same fp would both observe a "hit" or both claim — FrontierSearch sorts and
+masks duplicates before inserting).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+MAX_PROBES = 128
+
+
+class InsertResult(NamedTuple):
+    keys: jnp.ndarray  # uint64[S]
+    parents: jnp.ndarray  # uint64[S]
+    is_new: jnp.ndarray  # bool[B] — inserted by this call
+    overflow: jnp.ndarray  # bool — some lane exhausted MAX_PROBES
+
+
+class HashTable:
+    """Host-side handle; the arrays live on device."""
+
+    def __init__(self, log2_size: int):
+        self.log2_size = log2_size
+        self.size = 1 << log2_size
+        self.keys = jnp.zeros(self.size, dtype=jnp.uint64)
+        self.parents = jnp.zeros(self.size, dtype=jnp.uint64)
+
+    def insert(self, fps, parent_fps, active) -> InsertResult:
+        res = _insert(self.keys, self.parents, fps, parent_fps, active)
+        self.keys, self.parents = res.keys, res.parents
+        return res
+
+    def dump(self) -> dict:
+        """Host dict {fingerprint: parent_fingerprint (0 = init)} — used once
+        per search for path reconstruction."""
+        import numpy as np
+
+        keys = np.asarray(self.keys)
+        parents = np.asarray(self.parents)
+        nz = keys != 0
+        return dict(zip(keys[nz].tolist(), parents[nz].tolist()))
+
+
+def _insert_impl(keys, parents, fps, parent_fps, active) -> InsertResult:
+    size = keys.shape[0]
+    mask = jnp.uint64(size - 1)
+    idx = (fps & mask).astype(jnp.int64)
+
+    def cond(carry):
+        _keys, _parents, _idx, done, _is_new, probes = carry
+        return (~jnp.all(done)) & (probes < MAX_PROBES)
+
+    def body(carry):
+        keys, parents, idx, done, is_new, probes = carry
+        cur = keys[idx]
+        hit = cur == fps
+        free = cur == 0
+        attempt = (~done) & free
+        # Scatter-max claim: duplicate target slots resolve deterministically
+        # to the largest proposing fingerprint; done lanes propose 0 (no-op).
+        proposal = jnp.where(attempt, fps, jnp.uint64(0))
+        keys = keys.at[idx].max(proposal)
+        claimed = attempt & (keys[idx] == fps)
+        # Record the parent for claimed slots (claimed slots are unique per
+        # lane, so a plain dropped-out-of-bounds scatter is race-free).
+        pidx = jnp.where(claimed, idx, size)
+        parents = parents.at[pidx].set(parent_fps, mode="drop")
+        done = done | hit | claimed
+        is_new = is_new | claimed
+        idx = jnp.where(done, idx, (idx + 1) & jnp.int64(size - 1))
+        return keys, parents, idx, done, is_new, probes + 1
+
+    done0 = ~active
+    is_new0 = jnp.zeros_like(active)
+    keys, parents, idx, done, is_new, _probes = jax.lax.while_loop(
+        cond, body, (keys, parents, idx, done0, is_new0, jnp.int32(0))
+    )
+    return InsertResult(keys, parents, is_new, ~jnp.all(done))
+
+
+_insert = partial(jax.jit, donate_argnums=(0, 1))(_insert_impl)

@@ -1,0 +1,93 @@
+"""The tensor model contract: the device analogue of `Model`.
+
+Where the host `Model` (ref: src/lib.rs:152-257) yields per-state Python
+actions, a `TensorModel` defines one batched transition kernel with a STATIC
+maximum action fan-out: `expand` maps `[B, lanes] -> ([B, A, lanes], [B, A])`,
+where invalid/ignored action slots are masked out. Wasted lanes are fine — the
+reference wastes a whole thread on one state at a time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+
+from ..core.model import Expectation
+
+
+@dataclass(frozen=True)
+class TensorProperty:
+    """A vectorized property: `fn(model, states[B, L]) -> bool[B]`."""
+
+    expectation: Expectation
+    name: str
+    condition: Callable
+
+    @staticmethod
+    def always(name, condition) -> "TensorProperty":
+        return TensorProperty(Expectation.ALWAYS, name, condition)
+
+    @staticmethod
+    def sometimes(name, condition) -> "TensorProperty":
+        return TensorProperty(Expectation.SOMETIMES, name, condition)
+
+    @staticmethod
+    def eventually(name, condition) -> "TensorProperty":
+        return TensorProperty(Expectation.EVENTUALLY, name, condition)
+
+
+class TensorModel:
+    """A transition system over fixed-width uint32 state rows.
+
+    Required: `lanes`, `max_actions`, `init_states()`, `expand(states)`.
+    Optional: `properties()`, `within_boundary(states)`, `decode(row)`,
+    `action_label(row, action_index)` for human-readable paths.
+    """
+
+    lanes: int
+    max_actions: int
+
+    def init_states(self) -> jnp.ndarray:
+        """Initial states as uint32[N0, lanes]."""
+        raise NotImplementedError
+
+    def expand(self, states: jnp.ndarray):
+        """Batched successor generation.
+
+        Args:  states: uint32[B, lanes]
+        Returns: (successors uint32[B, max_actions, lanes],
+                  valid bool[B, max_actions])
+        """
+        raise NotImplementedError
+
+    def properties(self) -> list[TensorProperty]:
+        return []
+
+    def within_boundary(self, states: jnp.ndarray) -> jnp.ndarray:
+        """bool[B]; states outside are not expanded (ref: src/lib.rs:245)."""
+        return jnp.ones(states.shape[0], dtype=bool)
+
+    # -- host-side display / parity hooks --------------------------------------
+
+    def decode(self, row) -> Any:
+        """Decode one state row (numpy/int tuple) to a human-readable value."""
+        return tuple(int(x) for x in row)
+
+    def action_label(self, row, action_index: int) -> Any:
+        """Label for taking action slot `action_index` in the state `row`."""
+        return action_index
+
+    def property_by_name(self, name: str) -> TensorProperty:
+        for p in self.properties():
+            if p.name == name:
+                return p
+        raise KeyError(f"no property named {name!r}")
+
+    def checker(self):
+        """Fluent checker config, like `Model.checker()` — `spawn_tpu()` is
+        the natural spawn for tensor models."""
+        from ..checker.builder import CheckerBuilder
+
+        return CheckerBuilder(self)

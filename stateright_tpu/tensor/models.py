@@ -1,0 +1,199 @@
+"""Tensor-state encodings of the canonical workloads, built TPU-first: static
+action fan-out, branchless lane updates via `where`, everything batched.
+
+These pair with the host models for count-parity testing (the "exact unique
+state counts as cross-implementation oracle" strategy, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from .model import TensorModel, TensorProperty
+
+
+@dataclass
+class TensorLinearEquation(TensorModel):
+    """a*x + b*y == c (mod 256) — the canonical checker workload
+    (ref: src/test_util.rs:140-192). Lanes: [x, y]; actions: IncreaseX,
+    IncreaseY. Full space 256*256 = 65,536 states."""
+
+    a: int
+    b: int
+    c: int
+    lanes = 2
+    max_actions = 2
+
+    def init_states(self):
+        return jnp.zeros((1, 2), dtype=jnp.uint32)
+
+    def expand(self, states):
+        x, y = states[:, 0], states[:, 1]
+        inc_x = jnp.stack([(x + 1) % 256, y], axis=1)
+        inc_y = jnp.stack([x, (y + 1) % 256], axis=1)
+        succs = jnp.stack([inc_x, inc_y], axis=1).astype(jnp.uint32)
+        valid = jnp.ones((states.shape[0], 2), dtype=bool)
+        return succs, valid
+
+    def properties(self):
+        def solvable(model, states):
+            x, y = states[:, 0], states[:, 1]
+            return (model.a * x + model.b * y) % 256 == model.c % 256
+
+        return [TensorProperty.sometimes("solvable", solvable)]
+
+    def decode(self, row):
+        return (int(row[0]), int(row[1]))
+
+    def action_label(self, row, action_index):
+        return ["IncreaseX", "IncreaseY"][action_index]
+
+
+# -- 2PC ----------------------------------------------------------------------
+
+# RM states (2 bits each, packed one per lane for simplicity).
+_WORKING, _PREPARED, _COMMITTED, _ABORTED = 0, 1, 2, 3
+_TM_INIT, _TM_COMMITTED, _TM_ABORTED = 0, 1, 2
+
+
+@dataclass
+class TensorTwoPhaseSys(TensorModel):
+    """Two-phase commit (ref: examples/2pc.rs:59-147), tensor-encoded.
+
+    Lanes: [rm_state[0..N], tm_state, tm_prepared_bitmask, msgs_bitmask]
+    where msgs bit i = "Prepared{rm=i}" in flight, bit N = Commit,
+    bit N+1 = Abort.
+
+    Actions (static slots): 0 = TmCommit, 1 = TmAbort, then per RM:
+    [TmRcvPrepared, RmPrepare, RmChooseToAbort, RmRcvCommit, RmRcvAbort].
+    """
+
+    rm_count: int
+
+    def __post_init__(self):
+        self.lanes = self.rm_count + 3
+        self.max_actions = 2 + 5 * self.rm_count
+
+    def init_states(self):
+        return jnp.zeros((1, self.lanes), dtype=jnp.uint32)
+
+    def expand(self, states):
+        n = self.rm_count
+        B = states.shape[0]
+        rm = states[:, :n]  # [B, n]
+        tm = states[:, n]
+        prepared_mask = states[:, n + 1]
+        msgs = states[:, n + 2]
+        commit_bit = jnp.uint32(1 << n)
+        abort_bit = jnp.uint32(1 << (n + 1))
+
+        all_prepared = prepared_mask == jnp.uint32((1 << n) - 1)
+        tm_init = tm == _TM_INIT
+
+        succ_list = []
+        valid_list = []
+
+        def assemble(rm_new, tm_new, prep_new, msgs_new):
+            return jnp.concatenate(
+                [
+                    rm_new.astype(jnp.uint32),
+                    tm_new.astype(jnp.uint32)[:, None],
+                    prep_new.astype(jnp.uint32)[:, None],
+                    msgs_new.astype(jnp.uint32)[:, None],
+                ],
+                axis=1,
+            )
+
+        # TmCommit (ref: 2pc.rs:73-75, 104-107)
+        succ_list.append(
+            assemble(rm, jnp.full(B, _TM_COMMITTED), prepared_mask, msgs | commit_bit)
+        )
+        valid_list.append(tm_init & all_prepared)
+        # TmAbort (ref: 2pc.rs:76-78, 108-111)
+        succ_list.append(
+            assemble(rm, jnp.full(B, _TM_ABORTED), prepared_mask, msgs | abort_bit)
+        )
+        valid_list.append(tm_init)
+
+        for i in range(n):
+            rm_bit = jnp.uint32(1 << i)
+            rm_i = rm[:, i]
+            one_hot = jnp.arange(n) == i  # [n]
+
+            def set_rm(value):
+                return jnp.where(one_hot[None, :], jnp.uint32(value), rm)
+
+            # TmRcvPrepared(i) (ref: 2pc.rs:80-82, 101-103)
+            succ_list.append(assemble(rm, tm, prepared_mask | rm_bit, msgs))
+            valid_list.append(tm_init & ((msgs & rm_bit) != 0))
+            # RmPrepare(i) (ref: 2pc.rs:83-85, 112-115)
+            succ_list.append(
+                assemble(set_rm(_PREPARED), tm, prepared_mask, msgs | rm_bit)
+            )
+            valid_list.append(rm_i == _WORKING)
+            # RmChooseToAbort(i) (ref: 2pc.rs:86-88, 116-118)
+            succ_list.append(assemble(set_rm(_ABORTED), tm, prepared_mask, msgs))
+            valid_list.append(rm_i == _WORKING)
+            # RmRcvCommitMsg(i) (ref: 2pc.rs:89-91, 119-121)
+            succ_list.append(assemble(set_rm(_COMMITTED), tm, prepared_mask, msgs))
+            valid_list.append((msgs & commit_bit) != 0)
+            # RmRcvAbortMsg(i) (ref: 2pc.rs:92-94, 122-124)
+            succ_list.append(assemble(set_rm(_ABORTED), tm, prepared_mask, msgs))
+            valid_list.append((msgs & abort_bit) != 0)
+
+        succs = jnp.stack(succ_list, axis=1)  # [B, A, L]
+        valid = jnp.stack(valid_list, axis=1)  # [B, A]
+        return succs, valid
+
+    def properties(self):
+        n = self.rm_count
+
+        def rm_all(states, value):
+            return jnp.all(states[:, :n] == jnp.uint32(value), axis=1)
+
+        return [
+            TensorProperty.sometimes(
+                "abort agreement", lambda m, s: rm_all(s, _ABORTED)
+            ),
+            TensorProperty.sometimes(
+                "commit agreement", lambda m, s: rm_all(s, _COMMITTED)
+            ),
+            TensorProperty.always(
+                "consistent",
+                lambda m, s: ~(
+                    jnp.any(s[:, :n] == jnp.uint32(_ABORTED), axis=1)
+                    & jnp.any(s[:, :n] == jnp.uint32(_COMMITTED), axis=1)
+                ),
+            ),
+        ]
+
+    def decode(self, row):
+        n = self.rm_count
+        names = {0: "working", 1: "prepared", 2: "committed", 3: "aborted"}
+        tm_names = {0: "init", 1: "committed", 2: "aborted"}
+        msgs = int(row[n + 2])
+        msg_set = {f"prepared({i})" for i in range(n) if msgs & (1 << i)}
+        if msgs & (1 << n):
+            msg_set.add("commit")
+        if msgs & (1 << (n + 1)):
+            msg_set.add("abort")
+        return (
+            tuple(names[int(x)] for x in row[:n]),
+            tm_names[int(row[n])],
+            int(row[n + 1]),
+            frozenset(msg_set),
+        )
+
+    def action_label(self, row, action_index):
+        if action_index == 0:
+            return "tm_commit"
+        if action_index == 1:
+            return "tm_abort"
+        i, kind = divmod(action_index - 2, 5)
+        return (
+            ["tm_rcv_prepared", "rm_prepare", "rm_choose_abort",
+             "rm_rcv_commit", "rm_rcv_abort"][kind],
+            i,
+        )

@@ -1,0 +1,391 @@
+"""Fully device-resident frontier search: the ENTIRE breadth-first check runs
+as one `lax.while_loop` inside one `jit` dispatch.
+
+Motivation: the host-orchestrated loop (frontier.py) pays a host↔device round
+trip per step — fatal when the device is reached over a network tunnel and
+merely wasteful otherwise. Here the frontier queue itself lives in HBM as a
+ring buffer; each loop iteration pops a batch, expands it with the model
+kernel, fingerprints + dedups + inserts into the visited table, evaluates
+property masks, and appends fresh states to the queue tail — no host
+involvement until the search finishes.
+
+Capacity argument: every unique state is enqueued exactly once, so a queue with
+as many rows as the hash table has slots can never overflow before the table
+does.
+
+Early-exit parity with the reference checkers: the loop stops when every
+property has a discovery (src/checker/bfs.rs:278-280), when the configured
+`HasDiscoveries` policy matches (encoded as required/any bitmask pairs), when
+`target_state_count` is reached, or when the queue drains.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.discovery import HasDiscoveries
+from ..core.model import Expectation
+from .frontier import SearchResult, expand_insert, reconstruct_path, seed_init
+from .hashtable import _insert_impl
+from .model import TensorModel
+
+
+def _finish_masks(finish_when: HasDiscoveries, props) -> tuple[int, int]:
+    """Encode a HasDiscoveries policy as (required_mask, any_mask):
+    stop when (discovered & required) == required != 0, or
+    (discovered & any_mask) != 0."""
+    name_bit = {p.name: 1 << i for i, p in enumerate(props)}
+    failure_bits = sum(
+        1 << i
+        for i, p in enumerate(props)
+        if p.expectation in (Expectation.ALWAYS, Expectation.EVENTUALLY)
+    )
+    all_bits = (1 << len(props)) - 1
+    k = finish_when.kind
+    if k == "all":
+        return all_bits, 0
+    if k == "any":
+        return 0, all_bits
+    if k == "any_failures":
+        return 0, failure_bits
+    if k == "all_failures":
+        return failure_bits, 0
+    if k == "all_of":
+        return sum(name_bit[n] for n in finish_when.names), 0
+    if k == "any_of":
+        return 0, sum(name_bit[n] for n in finish_when.names)
+    raise ValueError(f"unknown HasDiscoveries kind {k!r}")
+
+
+class _Carry(NamedTuple):
+    keys: jnp.ndarray  # uint64[S]
+    parents: jnp.ndarray  # uint64[S]
+    q_states: jnp.ndarray  # uint32[Q, L]
+    q_fps: jnp.ndarray  # uint64[Q]
+    q_ebits: jnp.ndarray  # uint32[Q]
+    q_depth: jnp.ndarray  # uint32[Q]
+    head: jnp.ndarray  # int64
+    tail: jnp.ndarray  # int64
+    state_count: jnp.ndarray  # int64
+    unique_count: jnp.ndarray  # int64
+    max_depth: jnp.ndarray  # uint32
+    discovered: jnp.ndarray  # uint32 bitmask
+    disc_fps: jnp.ndarray  # uint64[P]
+    stop: jnp.ndarray  # bool
+    overflow: jnp.ndarray  # bool
+    steps: jnp.ndarray  # int64
+
+
+class ResidentSearch:
+    """One-dispatch whole-search engine for a `TensorModel`."""
+
+    def __init__(
+        self,
+        model: TensorModel,
+        batch_size: int = 2048,
+        table_log2: int = 20,
+    ):
+        self.model = model
+        self.batch_size = batch_size
+        self.table_log2 = table_log2
+        self.props = model.properties()
+        self._kernel = self._build()
+        self._last_tables = None
+        self._parent_map = None
+
+    def _build(self):
+        model = self.model
+        K = self.batch_size
+        A = model.max_actions
+        L = model.lanes
+        S = 1 << self.table_log2
+        Q = S  # see capacity argument in the module docstring
+        props = self.props
+        P = len(props)
+        always_i = [i for i, p in enumerate(props) if p.expectation == Expectation.ALWAYS]
+        sometimes_i = [i for i, p in enumerate(props) if p.expectation == Expectation.SOMETIMES]
+        eventually_i = [i for i, p in enumerate(props) if p.expectation == Expectation.EVENTUALLY]
+        ebits0 = np.uint32(sum(1 << i for i in eventually_i))
+        all_bits = jnp.uint32((1 << P) - 1)
+
+        def body(c: _Carry) -> _Carry:
+            # -- pop a batch from the queue ------------------------------------
+            avail = c.tail - c.head
+            take = jnp.minimum(avail, K)
+            pos = (c.head + jnp.arange(K, dtype=jnp.int64)) % Q
+            active = jnp.arange(K) < take
+            states = c.q_states[pos]
+            fps = c.q_fps[pos]
+            ebits = c.q_ebits[pos]
+            depth = c.q_depth[pos]
+            head = c.head + take
+
+            max_depth = jnp.maximum(
+                c.max_depth, jnp.max(jnp.where(active, depth, 0))
+            )
+
+            # -- property evaluation (ref: bfs.rs:230-280) ---------------------
+            discovered = c.discovered
+            disc_fps = c.disc_fps
+            if P:
+                masks = jnp.stack([p.condition(model, states) for p in props])
+                for i in always_i:
+                    hit = active & ~masks[i]
+                    discovered, disc_fps = _record(
+                        discovered, disc_fps, i, hit, fps
+                    )
+                for i in sometimes_i:
+                    hit = active & masks[i]
+                    discovered, disc_fps = _record(
+                        discovered, disc_fps, i, hit, fps
+                    )
+                for i in eventually_i:
+                    ebits = jnp.where(
+                        masks[i], ebits & jnp.uint32(~(1 << i) & 0xFFFFFFFF), ebits
+                    )
+
+            # -- expand + fingerprint + dedup + insert (shared core) -----------
+            (
+                keys,
+                parents,
+                out_states,
+                out_fps,
+                src_rows,
+                new_count,
+                gen,
+                has_succ,
+                ovf,
+            ) = expand_insert(model, c.keys, c.parents, states, fps, active)
+
+            # -- eventually counterexamples at terminal states -----------------
+            if eventually_i:
+                term = active & ~has_succ
+                for i in eventually_i:
+                    bad = term & ((ebits >> jnp.uint32(i)) & 1).astype(bool)
+                    discovered, disc_fps = _record(
+                        discovered, disc_fps, i, bad, fps
+                    )
+
+            # -- append new states to the queue tail ---------------------------
+            new_count = new_count.astype(jnp.int64)
+            slot = jnp.arange(K * A, dtype=jnp.int64)
+            qpos = jnp.where(slot < new_count, (c.tail + slot) % Q, Q)
+            q_states = c.q_states.at[qpos].set(out_states, mode="drop")
+            q_fps = c.q_fps.at[qpos].set(out_fps, mode="drop")
+            child_ebits = ebits[src_rows // A]
+            q_ebits = c.q_ebits.at[qpos].set(child_ebits, mode="drop")
+            child_depth = depth[src_rows // A] + 1
+            q_depth = c.q_depth.at[qpos].set(child_depth, mode="drop")
+            tail = c.tail + new_count
+
+            return _Carry(
+                keys=keys,
+                parents=parents,
+                q_states=q_states,
+                q_fps=q_fps,
+                q_ebits=q_ebits,
+                q_depth=q_depth,
+                head=head,
+                tail=tail,
+                state_count=c.state_count + gen.astype(jnp.int64),
+                unique_count=c.unique_count + new_count,
+                max_depth=max_depth,
+                discovered=discovered,
+                disc_fps=disc_fps,
+                stop=c.stop,
+                overflow=c.overflow | ovf,
+                steps=c.steps + 1,
+            )
+
+        def _record(discovered, disc_fps, i, hit, fps):
+            bit = jnp.uint32(1 << i)
+            already = (discovered & bit) != 0
+            any_hit = jnp.any(hit)
+            first = jnp.argmax(hit)
+            record = (~already) & any_hit
+            disc_fps = disc_fps.at[i].set(
+                jnp.where(record, fps[first], disc_fps[i])
+            )
+            discovered = jnp.where(record, discovered | bit, discovered)
+            return discovered, disc_fps
+
+        @partial(jax.jit, static_argnums=(5, 6, 9), donate_argnums=(0, 1))
+        def search(
+            keys,
+            parents,
+            init_states,  # uint32[K, L] padded
+            init_fps,  # uint64[K]
+            init_active,  # bool[K]
+            required_mask: int,
+            any_mask: int,
+            target_state_count,  # int64 scalar (0 = none)
+            n_raw_seed,  # int64: pre-dedup init count (host count parity)
+            max_steps: int,
+        ):
+            # Seed the table and queue with the (pre-deduped) init batch.
+            keys, parents, is_new, ovf = _insert_impl(
+                keys, parents, init_fps, jnp.zeros(K, dtype=jnp.uint64), init_active
+            )
+            n0 = init_active.sum().astype(jnp.int64)
+            q_states = jnp.zeros((Q, L), dtype=jnp.uint32)
+            q_fps = jnp.zeros(Q, dtype=jnp.uint64)
+            q_ebits = jnp.zeros(Q, dtype=jnp.uint32)
+            q_depth = jnp.zeros(Q, dtype=jnp.uint32)
+            slot = jnp.arange(K, dtype=jnp.int64)
+            qpos = jnp.where(slot < n0, slot, Q)
+            q_states = q_states.at[qpos].set(init_states, mode="drop")
+            q_fps = q_fps.at[qpos].set(init_fps, mode="drop")
+            q_ebits = q_ebits.at[qpos].set(jnp.uint32(ebits0), mode="drop")
+            q_depth = q_depth.at[qpos].set(jnp.uint32(1), mode="drop")
+
+            req = jnp.uint32(required_mask)
+            anym = jnp.uint32(any_mask)
+
+            def cond(c: _Carry):
+                drained = c.head >= c.tail
+                all_found = (P > 0) & (c.discovered == all_bits)
+                policy = ((req != 0) & ((c.discovered & req) == req)) | (
+                    (c.discovered & anym) != 0
+                )
+                count_hit = (target_state_count > 0) & (
+                    c.state_count >= target_state_count
+                )
+                return (
+                    (~drained)
+                    & (~all_found)
+                    & (~policy)
+                    & (~count_hit)
+                    & (~c.overflow)
+                    & (c.steps < max_steps)
+                )
+
+            carry = _Carry(
+                keys=keys,
+                parents=parents,
+                q_states=q_states,
+                q_fps=q_fps,
+                q_ebits=q_ebits,
+                q_depth=q_depth,
+                head=jnp.int64(0),
+                tail=n0,
+                state_count=n_raw_seed,
+                unique_count=is_new.sum().astype(jnp.int64),
+                max_depth=jnp.uint32(0),
+                discovered=jnp.uint32(0),
+                disc_fps=jnp.zeros(max(P, 1), dtype=jnp.uint64),
+                stop=jnp.bool_(False),
+                overflow=ovf,
+                steps=jnp.int64(0),
+            )
+            carry = jax.lax.while_loop(cond, body, carry)
+            return carry
+
+        return search
+
+    # -- host entry ------------------------------------------------------------
+
+    def run(
+        self,
+        finish_when: HasDiscoveries = HasDiscoveries.ALL,
+        target_state_count: Optional[int] = None,
+        target_max_depth: Optional[int] = None,
+        timeout: Optional[float] = None,
+        max_steps: int = 1 << 31,
+    ) -> SearchResult:
+        if target_max_depth is not None:
+            raise NotImplementedError(
+                "target_max_depth on the resident engine lands with the "
+                "depth-masked body; use the host-orchestrated FrontierSearch "
+                "(TpuChecker(resident=False)) meanwhile"
+            )
+        del timeout  # device loops can't be interrupted; bound via max_steps
+        model = self.model
+        K = self.batch_size
+        start = time.monotonic()
+        self._parent_map = None  # invalidate any prior reconstruction cache
+
+        init, init_fps, n_raw = seed_init(model)
+        if len(init) > K:
+            raise ValueError("more init states than batch_size; raise batch_size")
+        n0 = len(init)
+
+        # Vacuously-true finish policies (e.g. ALL with zero properties) stop
+        # before exploring anything, matching the host checkers' immediate
+        # is_awaiting_discoveries early-out (ref: bfs.rs:278-280).
+        if finish_when.matches(self.props, set()) or not self.props:
+            self._last_tables = (
+                jnp.zeros(1 << self.table_log2, dtype=jnp.uint64),
+                jnp.zeros(1 << self.table_log2, dtype=jnp.uint64),
+            )
+            return SearchResult(
+                state_count=n_raw,
+                unique_state_count=n0,
+                max_depth=1 if n0 else 0,
+                discoveries={},
+                complete=False,
+                duration=time.monotonic() - start,
+                steps=0,
+            )
+
+        st = np.zeros((K, model.lanes), dtype=np.uint32)
+        st[:n0] = init
+        fp = np.zeros(K, dtype=np.uint64)
+        fp[:n0] = init_fps
+        active = np.arange(K) < n0
+
+        required_mask, any_mask = _finish_masks(finish_when, self.props)
+        keys = jnp.zeros(1 << self.table_log2, dtype=jnp.uint64)
+        parents = jnp.zeros(1 << self.table_log2, dtype=jnp.uint64)
+        carry = self._kernel(
+            keys,
+            parents,
+            jnp.asarray(st),
+            jnp.asarray(fp),
+            jnp.asarray(active),
+            required_mask,
+            any_mask,
+            jnp.int64(target_state_count or 0),
+            jnp.int64(n_raw),
+            max_steps,
+        )
+        carry = jax.block_until_ready(carry)
+        if bool(carry.overflow):
+            raise RuntimeError("hash table full; raise table_log2")
+        self._last_tables = (carry.keys, carry.parents)
+
+        discovered = int(carry.discovered)
+        disc_fps = np.asarray(carry.disc_fps)
+        discoveries = {
+            p.name: int(disc_fps[i])
+            for i, p in enumerate(self.props)
+            if discovered & (1 << i)
+        }
+        return SearchResult(
+            state_count=int(carry.state_count),
+            unique_state_count=int(carry.unique_count),
+            max_depth=int(carry.max_depth),
+            discoveries=discoveries,
+            complete=bool(carry.head >= carry.tail),
+            duration=time.monotonic() - start,
+            steps=int(carry.steps),
+        )
+
+    def reconstruct_path(self, fp: int):
+        """TLC-style reconstruction from the final table contents (the logic
+        is shared with the host-orchestrated engine)."""
+        if self._parent_map is None:
+            keys, parents = self._last_tables
+            keys = np.asarray(keys)
+            parents = np.asarray(parents)
+            nz = keys != 0
+            self._parent_map = dict(
+                zip(keys[nz].tolist(), parents[nz].tolist())
+            )
+        return reconstruct_path(self.model, self._parent_map, fp)

@@ -14,3 +14,13 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# Something in this image's site config re-registers the experimental 'axon'
+# TPU platform and overrides JAX_PLATFORMS; pin the config explicitly so the
+# test suite always runs on the virtual CPU mesh.
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # host-only test environments
+    pass

@@ -1,0 +1,183 @@
+"""Device frontier-checker tests: count parity against the host checkers and
+the reference goldens, on the CPU backend (conftest pins jax to cpu)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stateright_tpu.core.discovery import HasDiscoveries
+from stateright_tpu.tensor import (
+    FrontierSearch,
+    HashTable,
+    TensorModel,
+    TensorProperty,
+    device_fingerprint,
+)
+from stateright_tpu.tensor.models import TensorLinearEquation, TensorTwoPhaseSys
+
+
+def test_device_fingerprint_basics():
+    states = jnp.asarray(np.arange(12, dtype=np.uint32).reshape(6, 2))
+    fps = np.asarray(device_fingerprint(states))
+    assert len(set(fps.tolist())) == 6  # distinct inputs -> distinct fps
+    assert (fps != 0).all()
+    fps2 = np.asarray(device_fingerprint(states))
+    assert (fps == fps2).all()  # deterministic
+
+
+def test_hashtable_insert_and_dedup():
+    ht = HashTable(8)
+    fps = jnp.asarray(np.array([5, 9, 13, 5 + (1 << 8)], dtype=np.uint64))
+    parents = jnp.asarray(np.array([0, 0, 5, 9], dtype=np.uint64))
+    active = jnp.ones(4, dtype=bool)
+    res = ht.insert(fps, parents, active)
+    assert np.asarray(res.is_new).sum() == 4  # incl. colliding 5 and 5+256
+    res = ht.insert(fps, parents, active)
+    assert np.asarray(res.is_new).sum() == 0  # all duplicates
+    dump = ht.dump()
+    assert dump[13] == 5 and dump[5 + (1 << 8)] == 9
+
+
+def test_hashtable_overflow_detected():
+    ht = HashTable(2)  # 4 slots
+    fps = jnp.asarray(np.arange(1, 9, dtype=np.uint64))
+    res = ht.insert(fps, jnp.zeros(8, dtype=jnp.uint64), jnp.ones(8, dtype=bool))
+    assert bool(res.overflow)
+
+
+def test_linear_equation_full_enumeration():
+    # ref golden: 65,536 states (src/checker/bfs.rs:444-453).
+    r = FrontierSearch(TensorLinearEquation(2, 4, 7), 512, 18).run()
+    assert r.unique_state_count == 65536
+    assert r.state_count == 1 + 2 * 65536
+    assert r.discoveries == {}
+    assert r.complete
+
+
+def test_linear_equation_finds_shortest_example():
+    fs = FrontierSearch(TensorLinearEquation(2, 10, 14), 512, 18)
+    r = fs.run()
+    assert "solvable" in r.discoveries
+    path = fs.reconstruct_path(r.discoveries["solvable"])
+    # BFS shortest: same as the host/reference discovery
+    # (ref: src/checker/bfs.rs:455-476).
+    assert path.actions() == ["IncreaseX", "IncreaseX", "IncreaseY"]
+    assert path.last_state() == (2, 1)
+
+
+def test_2pc_parity_with_host_checker():
+    # Device checker vs reference goldens AND host checker totals.
+    r = FrontierSearch(TensorTwoPhaseSys(3), 512, 16).run()
+    assert r.unique_state_count == 288
+    assert r.state_count == 1146  # matches host BFS/DFS generated count
+    assert set(r.discoveries) == {"abort agreement", "commit agreement"}
+
+    r = FrontierSearch(TensorTwoPhaseSys(4), 1024, 18).run()
+    from stateright_tpu.examples.two_phase_commit import TwoPhaseSys
+
+    host = TwoPhaseSys(4).checker().spawn_bfs().join()
+    assert r.unique_state_count == host.unique_state_count()
+    assert r.state_count == host.state_count()
+
+
+def test_2pc_5_golden():
+    r = FrontierSearch(TensorTwoPhaseSys(5), 2048, 20).run()
+    assert r.unique_state_count == 8832  # ref: examples/2pc.rs:158-159
+
+
+class CounterModel(TensorModel):
+    """0..max counter; terminal at max. For eventually-property semantics."""
+
+    lanes = 1
+    max_actions = 1
+
+    def __init__(self, max_value, odd_target=True):
+        self.max_value = max_value
+
+    def init_states(self):
+        return jnp.zeros((1, 1), dtype=jnp.uint32)
+
+    def expand(self, states):
+        succ = (states + 1)[:, None, :]
+        valid = (states[:, 0] < self.max_value)[:, None]
+        return succ.astype(jnp.uint32), valid
+
+    def properties(self):
+        return [
+            TensorProperty.eventually(
+                "reaches odd", lambda m, s: s[:, 0] % 2 == 1
+            ),
+            TensorProperty.eventually(
+                "exceeds max", lambda m, s: s[:, 0] > m.max_value
+            ),
+        ]
+
+    def decode(self, row):
+        return int(row[0])
+
+
+def test_eventually_semantics_on_device():
+    # A 0->1->...->4 chain: "reaches odd" is satisfied en route (no
+    # counterexample); "exceeds max" is impossible and the terminal state
+    # yields the counterexample.
+    fs = FrontierSearch(CounterModel(4), 16, 10)
+    r = fs.run()
+    assert "reaches odd" not in r.discoveries
+    assert "exceeds max" in r.discoveries
+    path = fs.reconstruct_path(r.discoveries["exceeds max"])
+    assert path.states() == [0, 1, 2, 3, 4]
+
+
+def test_eventually_semantics_on_resident_engine():
+    from stateright_tpu.tensor.resident import ResidentSearch
+
+    rs = ResidentSearch(CounterModel(4), 16, 10)
+    r = rs.run()
+    assert "reaches odd" not in r.discoveries
+    assert "exceeds max" in r.discoveries
+    assert rs.reconstruct_path(r.discoveries["exceeds max"]).states() == [
+        0, 1, 2, 3, 4,
+    ]
+
+
+def test_resident_matches_host_on_2pc4():
+    from stateright_tpu.examples.two_phase_commit import TwoPhaseSys
+    from stateright_tpu.tensor.resident import ResidentSearch
+
+    r = ResidentSearch(TensorTwoPhaseSys(4), 1024, 18).run()
+    host = TwoPhaseSys(4).checker().spawn_bfs().join()
+    assert r.unique_state_count == host.unique_state_count()
+    assert r.state_count == host.state_count()
+
+
+def test_tpu_checker_interface():
+    checker = TensorTwoPhaseSys(3).checker().spawn_tpu(
+        batch_size=512, table_log2=16
+    ).join()
+    assert checker.unique_state_count() == 288
+    checker.assert_properties()
+    assert checker.discovery("commit agreement") is not None
+    assert checker.discovery_classification("consistent") == "counterexample"
+
+
+def test_tpu_checker_target_state_count():
+    checker = (
+        TensorLinearEquation(2, 4, 7)
+        .checker()
+        .target_state_count(1000)
+        .spawn_tpu(batch_size=256, table_log2=18)
+        .join()
+    )
+    assert 1000 <= checker.state_count() < 140000
+
+
+def test_tpu_checker_finish_when():
+    checker = (
+        TensorTwoPhaseSys(3)
+        .checker()
+        .finish_when(HasDiscoveries.ANY)
+        .spawn_tpu(batch_size=512, table_log2=16)
+        .join()
+    )
+    assert len(checker.discoveries()) >= 1
+    assert checker.unique_state_count() < 288  # stopped early
