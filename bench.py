@@ -1,0 +1,60 @@
+"""Benchmark: device frontier checker vs host BFS on the 2PC-4 workload
+(the BASELINE.json metric config: "states/sec/chip, 2PC-4").
+
+Runs the whole-search resident engine (one device dispatch) on the current
+default JAX backend (the TPU chip under the driver; CPU elsewhere), measures
+generated-states/sec after a compile warm-up, and compares against the
+host-Python multithread-free BFS checker on the same model. The reference
+publishes no absolute numbers (BASELINE.md), so `vs_baseline` is the ratio
+against the locally-measured host BFS states/sec.
+
+Prints exactly one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def main() -> None:
+    from stateright_tpu.examples.two_phase_commit import TwoPhaseSys
+    from stateright_tpu.tensor.models import TensorTwoPhaseSys
+    from stateright_tpu.tensor.resident import ResidentSearch
+
+    rm = 4
+
+    # -- host BFS baseline (pure Python, same model family) --------------------
+    t0 = time.monotonic()
+    host = TwoPhaseSys(rm).checker().spawn_bfs().join()
+    host_dur = time.monotonic() - t0
+    host_sps = host.state_count() / host_dur
+
+    # -- device resident search ------------------------------------------------
+    search = ResidentSearch(TensorTwoPhaseSys(rm), batch_size=1024, table_log2=16)
+    search.run()  # compile + warm-up dispatch
+    best = None
+    for _ in range(3):
+        r = search.run()
+        if best is None or r.duration < best.duration:
+            best = r
+    assert best.unique_state_count == host.unique_state_count(), (
+        best.unique_state_count,
+        host.unique_state_count(),
+    )
+    sps = best.state_count / best.duration
+
+    print(
+        json.dumps(
+            {
+                "metric": f"2pc-{rm} generated states/sec (device, whole search)",
+                "value": round(sps, 1),
+                "unit": "states/sec",
+                "vs_baseline": round(sps / host_sps, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

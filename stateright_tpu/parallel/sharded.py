@@ -1,0 +1,514 @@
+"""Multi-chip frontier search: fingerprint-sharded visited set + ICI
+all-to-all successor exchange.
+
+This is the TPU-native replacement for the reference's work-stealing job
+market (ref: src/job_market.rs:149-176): instead of idle threads stealing
+slices of a shared deque, every chip owns the fingerprint range
+`owner(fp) == axis_index` and each expansion step ends with one
+`lax.all_to_all` that routes every generated successor to its owner chip.
+Termination detection replaces the market's `open_count` quiescence protocol
+(ref: src/job_market.rs:109-127) with a `psum` of per-chip queue occupancy;
+discovery early-exit (`HasDiscoveries`, ref: src/has_discoveries.rs:5-42)
+becomes an all-gather + OR of per-chip discovery bitmasks. The whole search —
+queue pop, property masks, expansion, shuffle, dedup, hash-table insert —
+runs as ONE `lax.while_loop` inside ONE `shard_map`-over-`Mesh` dispatch, so
+multi-host meshes ride ICI/DCN with zero host round-trips mid-search.
+
+Sharding invariants:
+- `owner(fp) = (fp >> 32) % n_chips` uses the HIGH fingerprint bits while the
+  per-chip table slot uses the LOW bits (`fp & (slots-1)`), so sharding does
+  not skew table occupancy.
+- Each unique state is inserted/enqueued on exactly one chip, so per-chip
+  `state_count`/`unique_count` sum to the global totals, and the per-chip
+  queue can never hold more rows than the per-chip table has slots (the same
+  capacity argument as the single-chip resident engine).
+- The all-to-all send buffer reserves `dest_capacity` rows per destination;
+  the sound default (batch_size * max_actions) can never overflow because one
+  step generates at most that many successors in total.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..core.discovery import HasDiscoveries
+from ..core.model import Expectation
+from ..tensor.fingerprint import device_fingerprint
+from ..tensor.frontier import (
+    SearchResult,
+    reconstruct_path,
+    record_discovery as _record_impl,
+    seed_init,
+)
+from ..tensor.hashtable import _insert_impl
+from ..tensor.model import TensorModel
+from ..tensor.resident import _finish_masks
+
+_MAX_U64 = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "d") -> Mesh:
+    """A 1-D device mesh over the first `n_devices` visible devices."""
+    devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices but only {len(devices)} "
+                "are visible (set --xla_force_host_platform_device_count "
+                "for virtual CPU meshes)"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis,))
+
+
+class _Carry(NamedTuple):
+    keys: jnp.ndarray  # uint64[S]      per-chip table shard
+    parents: jnp.ndarray  # uint64[S]
+    q_states: jnp.ndarray  # uint32[Q, L]  per-chip frontier ring buffer
+    q_fps: jnp.ndarray  # uint64[Q]
+    q_ebits: jnp.ndarray  # uint32[Q]
+    q_depth: jnp.ndarray  # uint32[Q]
+    head: jnp.ndarray  # int64
+    tail: jnp.ndarray  # int64
+    state_count: jnp.ndarray  # int64 (local; host sums shards)
+    unique_count: jnp.ndarray  # int64 (local)
+    max_depth: jnp.ndarray  # uint32 (local)
+    discovered: jnp.ndarray  # uint32 global OR of discovery bits
+    disc_fps: jnp.ndarray  # uint64[P] locally-witnessed discovery fps
+    cont: jnp.ndarray  # bool global continue flag
+    overflow: jnp.ndarray  # bool (local table/routing overflow)
+    steps: jnp.ndarray  # int64
+
+
+class ShardedSearch:
+    """Whole-search multi-chip engine for a `TensorModel` over a 1-D mesh."""
+
+    def __init__(
+        self,
+        model: TensorModel,
+        mesh: Optional[Mesh] = None,
+        batch_size: int = 1024,
+        table_log2: int = 18,
+        dest_capacity: Optional[int] = None,
+    ):
+        self.model = model
+        self.mesh = mesh if mesh is not None else make_mesh()
+        (self.axis,) = self.mesh.axis_names
+        self.n_chips = self.mesh.devices.size
+        self.batch_size = batch_size
+        self.table_log2 = table_log2
+        # Per-destination all-to-all capacity; default is sound (see module
+        # docstring), smaller values trade bandwidth for an overflow risk
+        # that is detected and surfaced as a RuntimeError.
+        self.dest_capacity = (
+            dest_capacity
+            if dest_capacity is not None
+            else batch_size * model.max_actions
+        )
+        self.props = model.properties()
+        self._kernel = self._build()
+        self._last_tables = None
+        self._parent_map = None
+
+    def _build(self):
+        model = self.model
+        mesh = self.mesh
+        ax = self.axis
+        N = self.n_chips
+        K = self.batch_size
+        A = model.max_actions
+        L = model.lanes
+        S = 1 << self.table_log2
+        Q = S
+        C = self.dest_capacity
+        props = self.props
+        P_ = len(props)
+        always_i = [i for i, p in enumerate(props) if p.expectation == Expectation.ALWAYS]
+        sometimes_i = [i for i, p in enumerate(props) if p.expectation == Expectation.SOMETIMES]
+        eventually_i = [i for i, p in enumerate(props) if p.expectation == Expectation.EVENTUALLY]
+        ebits0 = np.uint32(sum(1 << i for i in eventually_i))
+        all_bits = jnp.uint32((1 << P_) - 1)
+
+        def owner_of(fps):
+            return ((fps >> jnp.uint64(32)) % jnp.uint64(N)).astype(jnp.int32)
+
+        _record = _record_impl
+
+        def per_chip(
+            init_states,  # uint32[K, L] replicated
+            init_fps,  # uint64[K] replicated
+            init_active,  # bool[K] replicated
+            target_state_count,  # int64 replicated
+            n_raw_seed,  # int64 replicated
+            required_mask,  # uint32 replicated
+            any_mask,  # uint32 replicated
+            max_steps,  # int64 replicated
+        ):
+            me = jax.lax.axis_index(ax)
+
+            # -- seed: each chip keeps only the init states it owns ------------
+            mine = init_active & (owner_of(init_fps) == me)
+            keys = jnp.zeros(S, dtype=jnp.uint64)
+            parents = jnp.zeros(S, dtype=jnp.uint64)
+            keys, parents, is_new, ovf0 = _insert_impl(
+                keys, parents, init_fps, jnp.zeros(K, dtype=jnp.uint64), mine
+            )
+            order0 = jnp.argsort(~mine, stable=True)
+            n0 = mine.sum().astype(jnp.int64)
+            slot = jnp.arange(K, dtype=jnp.int64)
+            qpos = jnp.where(slot < n0, slot, Q)
+            q_states = (
+                jnp.zeros((Q, L), dtype=jnp.uint32)
+                .at[qpos].set(init_states[order0], mode="drop")
+            )
+            q_fps = (
+                jnp.zeros(Q, dtype=jnp.uint64)
+                .at[qpos].set(init_fps[order0], mode="drop")
+            )
+            q_ebits = (
+                jnp.zeros(Q, dtype=jnp.uint32)
+                .at[qpos].set(jnp.uint32(ebits0), mode="drop")
+            )
+            q_depth = (
+                jnp.zeros(Q, dtype=jnp.uint32)
+                .at[qpos].set(jnp.uint32(1), mode="drop")
+            )
+
+            def body(c: _Carry) -> _Carry:
+                # -- pop a local batch -----------------------------------------
+                avail = c.tail - c.head
+                take = jnp.minimum(avail, K)
+                pos = (c.head + jnp.arange(K, dtype=jnp.int64)) % Q
+                active = jnp.arange(K) < take
+                states = c.q_states[pos]
+                fps = c.q_fps[pos]
+                ebits = c.q_ebits[pos]
+                depth = c.q_depth[pos]
+                head = c.head + take
+                max_depth = jnp.maximum(
+                    c.max_depth, jnp.max(jnp.where(active, depth, 0))
+                )
+
+                # -- property masks on popped states (bfs.rs:230-280) ----------
+                discovered = c.discovered
+                disc_fps = c.disc_fps
+                if P_:
+                    masks = jnp.stack([p.condition(model, states) for p in props])
+                    for i in always_i:
+                        discovered, disc_fps = _record(
+                            discovered, disc_fps, i, active & ~masks[i], fps
+                        )
+                    for i in sometimes_i:
+                        discovered, disc_fps = _record(
+                            discovered, disc_fps, i, active & masks[i], fps
+                        )
+                    for i in eventually_i:
+                        ebits = jnp.where(
+                            masks[i],
+                            ebits & jnp.uint32(~(1 << i) & 0xFFFFFFFF),
+                            ebits,
+                        )
+
+                # -- expand locally --------------------------------------------
+                succs, valid = model.expand(states)
+                valid = valid & active[:, None]
+                flat = succs.reshape(K * A, L)
+                validf = valid.reshape(-1) & model.within_boundary(flat)
+                gen = validf.sum().astype(jnp.int64)
+                has_succ = validf.reshape(K, A).any(axis=1)
+
+                # -- eventually counterexamples at terminal states --------------
+                if eventually_i:
+                    term = active & ~has_succ
+                    for i in eventually_i:
+                        bad = term & ((ebits >> jnp.uint32(i)) & 1).astype(bool)
+                        discovered, disc_fps = _record(
+                            discovered, disc_fps, i, bad, fps
+                        )
+
+                # -- route successors to owner chips ---------------------------
+                sfps = device_fingerprint(flat)
+                owner = jnp.where(validf, owner_of(sfps), N)
+                route = jnp.argsort(owner)
+                o_s = owner[route]
+                seg_start = jnp.searchsorted(o_s, o_s, side="left")
+                idx_in_seg = jnp.arange(K * A) - seg_start
+                live = o_s < N
+                route_ovf = jnp.any(live & (idx_in_seg >= C))
+                dest = jnp.where(
+                    live & (idx_in_seg < C), o_s * C + idx_in_seg, N * C
+                )
+                parent_rep = jnp.repeat(fps, A)[route]
+                ebits_rep = jnp.repeat(ebits, A)[route]
+                depth_rep = jnp.repeat(depth + 1, A)[route]
+
+                def scatter(zero, vals):
+                    return zero.at[dest].set(vals, mode="drop")
+
+                s_states = scatter(
+                    jnp.zeros((N * C, L), dtype=jnp.uint32), flat[route]
+                )
+                s_fps = scatter(jnp.zeros(N * C, dtype=jnp.uint64), sfps[route])
+                s_parent = scatter(jnp.zeros(N * C, dtype=jnp.uint64), parent_rep)
+                s_ebits = scatter(jnp.zeros(N * C, dtype=jnp.uint32), ebits_rep)
+                s_depth = scatter(jnp.zeros(N * C, dtype=jnp.uint32), depth_rep)
+                s_valid = scatter(jnp.zeros(N * C, dtype=bool), live)
+
+                def shuffle(x):
+                    return jax.lax.all_to_all(
+                        x.reshape(N, C, *x.shape[1:]), ax, 0, 0
+                    ).reshape(N * C, *x.shape[1:])
+
+                r_states = shuffle(s_states)
+                r_fps = shuffle(s_fps)
+                r_parent = shuffle(s_parent)
+                r_ebits = shuffle(s_ebits)
+                r_depth = shuffle(s_depth)
+                r_valid = shuffle(s_valid)
+
+                # -- dedup received batch + insert into the local shard --------
+                sort_key = jnp.where(r_valid, r_fps, _MAX_U64)
+                order = jnp.argsort(sort_key)
+                so = sort_key[order]
+                uniq = so != jnp.roll(so, 1)
+                uniq = uniq.at[0].set(True) & (so != _MAX_U64)
+                keys2, parents2, is_new, ins_ovf = _insert_impl(
+                    c.keys, c.parents, so, r_parent[order], uniq
+                )
+                rank = jnp.argsort(~is_new, stable=True)
+                sel = order[rank]
+                new_count = is_new.sum().astype(jnp.int64)
+
+                # -- append fresh states to the local queue --------------------
+                slot = jnp.arange(N * C, dtype=jnp.int64)
+                qpos = jnp.where(slot < new_count, (c.tail + slot) % Q, Q)
+                q_states = c.q_states.at[qpos].set(r_states[sel], mode="drop")
+                q_fps = c.q_fps.at[qpos].set(so[rank], mode="drop")
+                q_ebits = c.q_ebits.at[qpos].set(r_ebits[sel], mode="drop")
+                q_depth = c.q_depth.at[qpos].set(r_depth[sel], mode="drop")
+                tail = c.tail + new_count
+
+                state_count = c.state_count + gen
+                unique_count = c.unique_count + new_count
+                overflow = c.overflow | route_ovf | ins_ovf
+
+                # -- global sync: discovery OR, termination, early exit ---------
+                gathered = jax.lax.all_gather(discovered, ax)
+                discovered = gathered[0]
+                for i in range(1, N):  # static unroll: global OR of bitmasks
+                    discovered = discovered | gathered[i]
+                g_pending = jax.lax.psum(tail - head, ax)
+                g_states = jax.lax.psum(state_count, ax)
+                g_overflow = jax.lax.psum(overflow.astype(jnp.int32), ax) > 0
+                all_found = (P_ > 0) & (discovered == all_bits)
+                policy = (
+                    (required_mask != 0)
+                    & ((discovered & required_mask) == required_mask)
+                ) | ((discovered & any_mask) != 0)
+                count_hit = (target_state_count > 0) & (
+                    g_states >= target_state_count
+                )
+                steps = c.steps + 1
+                cont = (
+                    (g_pending > 0)
+                    & ~all_found
+                    & ~policy
+                    & ~count_hit
+                    & ~g_overflow
+                    & (steps < max_steps)
+                )
+
+                return _Carry(
+                    keys=keys2,
+                    parents=parents2,
+                    q_states=q_states,
+                    q_fps=q_fps,
+                    q_ebits=q_ebits,
+                    q_depth=q_depth,
+                    head=head,
+                    tail=tail,
+                    state_count=state_count,
+                    unique_count=unique_count,
+                    max_depth=max_depth,
+                    discovered=discovered,
+                    disc_fps=disc_fps,
+                    cont=cont,
+                    overflow=overflow,
+                    steps=steps,
+                )
+
+            # Every chip holds the same replicated init batch; count the
+            # raw seed once (chip 0) so shard sums match the host totals.
+            state_count0 = jnp.where(me == 0, n_raw_seed, jnp.int64(0))
+            # Stop conditions that can already hold at seed time (empty init
+            # set, target_state_count <= seed count, max_steps == 0, seed
+            # overflow) must prevent the first expansion step, matching the
+            # resident engine's check-cond-before-first-body semantics.
+            cont0 = (
+                (jax.lax.psum(n0, ax) > 0)
+                & ~(
+                    (target_state_count > 0)
+                    & (jax.lax.psum(state_count0, ax) >= target_state_count)
+                )
+                & ~(jax.lax.psum(ovf0.astype(jnp.int32), ax) > 0)
+                & (max_steps > 0)
+            )
+            carry = _Carry(
+                keys=keys,
+                parents=parents,
+                q_states=q_states,
+                q_fps=q_fps,
+                q_ebits=q_ebits,
+                q_depth=q_depth,
+                head=jnp.int64(0),
+                tail=n0,
+                state_count=state_count0,
+                unique_count=is_new.sum().astype(jnp.int64),
+                max_depth=jnp.uint32(0),
+                discovered=jnp.uint32(0),
+                disc_fps=jnp.zeros(max(P_, 1), dtype=jnp.uint64),
+                cont=cont0,
+                overflow=ovf0,
+                steps=jnp.int64(0),
+            )
+            carry = jax.lax.while_loop(lambda c: c.cont, body, carry)
+
+            def shard(x):
+                return x.reshape(1, *jnp.shape(x))
+
+            return (
+                shard(carry.keys),
+                shard(carry.parents),
+                shard(carry.state_count),
+                shard(carry.unique_count),
+                shard(carry.max_depth),
+                shard(carry.discovered),
+                shard(carry.disc_fps),
+                shard(carry.head >= carry.tail),
+                shard(carry.overflow),
+                shard(carry.steps),
+            )
+
+        sharded = jax.shard_map(
+            per_chip,
+            mesh=mesh,
+            in_specs=(P(), P(), P(), P(), P(), P(), P(), P()),
+            out_specs=P(ax),
+            check_vma=False,
+        )
+        return jax.jit(sharded)
+
+    # -- host entry ------------------------------------------------------------
+
+    def run(
+        self,
+        finish_when: HasDiscoveries = HasDiscoveries.ALL,
+        target_state_count: Optional[int] = None,
+        target_max_depth: Optional[int] = None,
+        timeout: Optional[float] = None,
+        max_steps: int = 1 << 31,
+    ) -> SearchResult:
+        if target_max_depth is not None:
+            raise NotImplementedError(
+                "target_max_depth is not supported on the sharded engine yet; "
+                "use the single-chip checkers for depth-bounded runs"
+            )
+        del timeout  # device loops can't be interrupted; bound via max_steps
+        model = self.model
+        K = self.batch_size
+        start = time.monotonic()
+        self._parent_map = None
+
+        init, init_fps, n_raw = seed_init(model)
+        if len(init) > K:
+            raise ValueError("more init states than batch_size; raise batch_size")
+        n0 = len(init)
+
+        if finish_when.matches(self.props, set()) or not self.props:
+            # Vacuous finish policy: stop before exploring (bfs.rs:278-280).
+            n_shards = self.n_chips
+            self._last_tables = (
+                np.zeros((n_shards, 1 << self.table_log2), dtype=np.uint64),
+                np.zeros((n_shards, 1 << self.table_log2), dtype=np.uint64),
+            )
+            return SearchResult(
+                state_count=n_raw,
+                unique_state_count=n0,
+                max_depth=1 if n0 else 0,
+                discoveries={},
+                complete=False,
+                duration=time.monotonic() - start,
+                steps=0,
+            )
+
+        st = np.zeros((K, model.lanes), dtype=np.uint32)
+        st[:n0] = init
+        fp = np.zeros(K, dtype=np.uint64)
+        fp[:n0] = init_fps
+        active = np.arange(K) < n0
+
+        required_mask, any_mask = _finish_masks(finish_when, self.props)
+        (
+            keys,
+            parents,
+            state_counts,
+            unique_counts,
+            max_depths,
+            discovered,
+            disc_fps,
+            drained,
+            overflow,
+            steps,
+        ) = jax.block_until_ready(
+            self._kernel(
+                jnp.asarray(st),
+                jnp.asarray(fp),
+                jnp.asarray(active),
+                jnp.int64(target_state_count or 0),
+                jnp.int64(n_raw),
+                jnp.uint32(required_mask),
+                jnp.uint32(any_mask),
+                jnp.int64(max_steps),
+            )
+        )
+        if bool(np.asarray(overflow).any()):
+            raise RuntimeError(
+                "sharded search overflow: raise table_log2 or dest_capacity"
+            )
+        self._last_tables = (np.asarray(keys), np.asarray(parents))
+
+        # discovered is globally OR-synced, identical on every shard.
+        disc_mask = int(np.asarray(discovered)[0])
+        disc_fps = np.asarray(disc_fps)  # [N, P]
+        discoveries = {}
+        for i, p in enumerate(self.props):
+            if disc_mask & (1 << i):
+                witnesses = disc_fps[:, i]
+                witnesses = witnesses[witnesses != 0]
+                discoveries[p.name] = int(witnesses[0])
+        return SearchResult(
+            state_count=int(np.asarray(state_counts).sum()),
+            unique_state_count=int(np.asarray(unique_counts).sum()),
+            max_depth=int(np.asarray(max_depths).max()),
+            discoveries=discoveries,
+            complete=bool(np.asarray(drained).all()),
+            duration=time.monotonic() - start,
+            steps=int(np.asarray(steps).max()),
+        )
+
+    def reconstruct_path(self, fp: int):
+        """Union the per-chip parent maps, then reconstruct as usual."""
+        if self._parent_map is None:
+            keys, parents = self._last_tables
+            keys = keys.reshape(-1)
+            parents = parents.reshape(-1)
+            nz = keys != 0
+            self._parent_map = dict(zip(keys[nz].tolist(), parents[nz].tolist()))
+        return reconstruct_path(self.model, self._parent_map, fp)

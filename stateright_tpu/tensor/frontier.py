@@ -108,6 +108,22 @@ def expand_insert(model: "TensorModel", keys, parents, states, fps, active):
     )
 
 
+def record_discovery(discovered, disc_fps, i, hit, fps):
+    """First-witness discovery recording for property bit `i` inside a traced
+    search body (shared by the resident and sharded engines). Keeps the first
+    hit only; cross-batch/cross-chip races are tolerated exactly as the
+    reference tolerates discovery-insertion races (ref: src/checker/bfs.rs:243).
+    """
+    bit = jnp.uint32(1 << i)
+    already = (discovered & bit) != 0
+    any_hit = jnp.any(hit)
+    first = jnp.argmax(hit)
+    record = (~already) & any_hit
+    disc_fps = disc_fps.at[i].set(jnp.where(record, fps[first], disc_fps[i]))
+    discovered = jnp.where(record, discovered | bit, discovered)
+    return discovered, disc_fps
+
+
 def reconstruct_path(model: TensorModel, parent_map: dict, fp: int) -> Path:
     """Walk device parent pointers, then re-execute the tensor model to
     recover decoded states and action labels (the TLC fingerprint-stack

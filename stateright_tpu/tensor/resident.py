@@ -32,7 +32,13 @@ import jax.numpy as jnp
 
 from ..core.discovery import HasDiscoveries
 from ..core.model import Expectation
-from .frontier import SearchResult, expand_insert, reconstruct_path, seed_init
+from .frontier import (
+    SearchResult,
+    expand_insert,
+    reconstruct_path,
+    record_discovery as _record,
+    seed_init,
+)
 from .hashtable import _insert_impl
 from .model import TensorModel
 
@@ -203,18 +209,6 @@ class ResidentSearch:
                 overflow=c.overflow | ovf,
                 steps=c.steps + 1,
             )
-
-        def _record(discovered, disc_fps, i, hit, fps):
-            bit = jnp.uint32(1 << i)
-            already = (discovered & bit) != 0
-            any_hit = jnp.any(hit)
-            first = jnp.argmax(hit)
-            record = (~already) & any_hit
-            disc_fps = disc_fps.at[i].set(
-                jnp.where(record, fps[first], disc_fps[i])
-            )
-            discovered = jnp.where(record, discovered | bit, discovered)
-            return discovered, disc_fps
 
         @partial(jax.jit, static_argnums=(5, 6, 9), donate_argnums=(0, 1))
         def search(
