@@ -1,0 +1,124 @@
+"""On-demand checker + Explorer tests.
+
+Mirrors the reference strategy: the on-demand checker is driven through its
+control-flow surface (check_fingerprint / run_to_completion,
+ref: src/checker/on_demand.rs), and the Explorer endpoints are tested as pure
+view functions without a socket (ref: src/checker/explorer.rs:322-597), plus
+one live-HTTP smoke test.
+"""
+
+import json
+import time
+import urllib.request
+
+from stateright_tpu.core.fingerprint import fingerprint
+from stateright_tpu.explorer.server import serve, states_view, status_view
+from stateright_tpu.fixtures import BinaryClock, LinearEquation
+from stateright_tpu.examples.two_phase_commit import TwoPhaseSys
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_on_demand_is_lazy_then_completes():
+    checker = LinearEquation(2, 10, 14).checker().spawn_on_demand()
+    # Lazy: nothing beyond the init state is generated until asked.
+    assert checker.unique_state_count() == 1
+    init_fp = fingerprint((0, 0))
+    checker.check_fingerprint(init_fp)
+    assert _wait(lambda: checker.unique_state_count() == 3)
+    # Unknown fingerprints are ignored.
+    checker.check_fingerprint(123456789)
+    checker.run_to_completion()
+    checker.join()
+    assert checker.discovery("solvable") is not None
+
+
+def test_on_demand_join_runs_to_completion():
+    checker = TwoPhaseSys(3).checker().spawn_on_demand().join()
+    assert checker.unique_state_count() == 288  # ref: examples/2pc.rs:153-154
+    checker.assert_properties()
+
+
+def test_on_demand_expand_single_step_counts():
+    checker = BinaryClock().checker().spawn_on_demand()
+    # Two init states (0 and 1); expanding one generates its single successor.
+    assert checker.unique_state_count() == 2
+    checker.check_fingerprint(fingerprint(0))
+    _wait(lambda: checker.state_count() > 2)
+    assert checker.unique_state_count() == 2  # successor (1) already known
+    checker.join()
+
+
+def test_status_view_shape():
+    checker = TwoPhaseSys(3).checker().spawn_on_demand().join()
+    view = status_view(checker)
+    assert view["model"] == "TwoPhaseSys"
+    assert view["unique_state_count"] == 288
+    assert view["done"]
+    by_name = {p["name"]: p for p in view["properties"]}
+    assert by_name["commit agreement"]["discovery"] is not None
+    assert by_name["commit agreement"]["classification"] == "example"
+    assert by_name["consistent"]["discovery"] is None
+
+
+def test_states_view_init_and_next_steps():
+    model = TwoPhaseSys(3)
+    init_views = states_view(model, [])
+    assert len(init_views) == 1
+    assert init_views[0]["action"] is None
+    fp = int(init_views[0]["fingerprint"])
+
+    next_views = states_view(model, [fp])
+    # From the 2PC init state: TmAbort + per-RM Prepare/ChooseToAbort.
+    actions = [v["action"] for v in next_views]
+    assert any("abort" in a.lower() for a in actions)
+    assert all(not v["ignored"] for v in next_views)
+    # Property verdicts ride along on each next state.
+    assert {p["name"] for p in next_views[0]["properties"]} == {
+        "abort agreement", "commit agreement", "consistent",
+    }
+
+
+def test_states_view_404_on_bogus_path():
+    import pytest
+
+    with pytest.raises(KeyError):
+        states_view(TwoPhaseSys(3), [42])
+
+
+def test_explorer_http_roundtrip():
+    server = TwoPhaseSys(3).checker().serve("localhost:0")
+    try:
+        base = f"http://{server.address}"
+
+        def get(path):
+            with urllib.request.urlopen(base + path, timeout=5) as r:
+                return json.loads(r.read())
+
+        status = get("/.status")
+        assert status["model"] == "TwoPhaseSys"
+
+        init = get("/.states")
+        fp = init[0]["fingerprint"]
+        nxt = get(f"/.states/{fp}")
+        assert len(nxt) >= 2
+
+        with urllib.request.urlopen(base + "/", timeout=5) as r:
+            assert b"stateright_tpu explorer" in r.read()
+
+        req = urllib.request.Request(
+            base + "/.runtocompletion", method="POST", data=b""
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert json.loads(r.read())["ok"]
+        assert _wait(lambda: get("/.status")["done"], timeout=10)
+        assert get("/.status")["unique_state_count"] == 288
+    finally:
+        server.shutdown()
