@@ -1,0 +1,166 @@
+"""Ordered-reliable-link and utility-type tests.
+
+The ORL is proved by model checking, the reference's own strategy
+(ref: src/actor/ordered_reliable_link.rs:215-325): under a lossy duplicating
+network, delivery to the wrapped actor must stay an in-order duplicate-free
+prefix, and full delivery must be reachable.
+"""
+
+from dataclasses import dataclass
+
+import pytest
+
+from stateright_tpu.actor import Actor, ActorModel, Id, Network, Out
+from stateright_tpu.actor.ordered_reliable_link import (
+    Ack,
+    ActorWrapper,
+    Deliver,
+    Resend,
+    StateWrapper,
+)
+from stateright_tpu.core.fingerprint import fingerprint
+from stateright_tpu.core.model import Expectation
+from stateright_tpu.utils import DenseNatMap, HashableMap, HashableSet, VectorClock
+
+MSGS = ("a", "b")
+
+
+@dataclass
+class Sender(Actor):
+    msgs: tuple
+
+    def on_start(self, id: Id, out: Out):
+        for m in self.msgs:
+            out.send(Id(1), m)
+        return "sender"
+
+
+class Recv(Actor):
+    def on_start(self, id: Id, out: Out):
+        return ()
+
+    def on_msg(self, id: Id, state, src: Id, msg, out: Out):
+        return state + (msg,)
+
+
+def _orl_model(lossy: bool) -> ActorModel:
+    def received(state):
+        return state.actor_states[1].wrapped
+
+    return (
+        ActorModel.new()
+        .actor(ActorWrapper(Sender(MSGS)))
+        .actor(ActorWrapper(Recv()))
+        .with_init_network(Network.new_unordered_duplicating())
+        .with_lossy_network(lossy)
+        .property(
+            Expectation.ALWAYS,
+            "delivered in order without dups",
+            lambda m, s: received(s) == MSGS[: len(received(s))],
+        )
+        .property(
+            Expectation.SOMETIMES,
+            "fully delivered",
+            lambda m, s: received(s) == MSGS,
+        )
+    )
+
+
+def test_orl_guarantees_under_lossy_duplicating_network():
+    checker = _orl_model(lossy=True).checker().spawn_bfs().join()
+    checker.assert_properties()
+
+
+def test_orl_guarantees_under_lossless_network():
+    checker = _orl_model(lossy=False).checker().spawn_bfs().join()
+    checker.assert_properties()
+
+
+def test_orl_acks_shrink_pending():
+    w = ActorWrapper(Sender(MSGS))
+    out = Out()
+    state = w.on_start(Id(0), out)
+    assert [k for k, _ in state.pending_ack] == [(Id(1), 1), (Id(1), 2)]
+    # Ack for seq 1 removes it; a duplicate ack is a no-op (None).
+    state2 = w.on_msg(Id(0), state, Id(1), Ack(1), Out())
+    assert [k for k, _ in state2.pending_ack] == [(Id(1), 2)]
+    assert w.on_msg(Id(0), state2, Id(1), Ack(1), Out()) is None
+
+
+def test_orl_receiver_dedups_and_always_acks():
+    w = ActorWrapper(Recv())
+    out = Out()
+    state = w.on_start(Id(1), out)
+    out = Out()
+    state = w.on_msg(Id(1), state, Id(0), Deliver(1, "a"), out)
+    assert state.wrapped == ("a",)
+    # Redelivery: dropped (None) but still acked.
+    out = Out()
+    assert w.on_msg(Id(1), state, Id(0), Deliver(1, "a"), out) is None
+    assert any(isinstance(c.msg, Ack) for c in out.commands)
+    # Out-of-order (seq 3 before 2): dropped.
+    assert w.on_msg(Id(1), state, Id(0), Deliver(3, "c"), Out()) is None
+
+
+def test_orl_resend_retransmits_pending():
+    w = ActorWrapper(Sender(MSGS))
+    state = w.on_start(Id(0), Out())
+    out = Out()
+    assert w.on_timeout(Id(0), state, Resend(), out) is None
+    from stateright_tpu.actor import Send
+
+    sends = [
+        c.msg
+        for c in out.commands
+        if isinstance(c, Send) and isinstance(c.msg, Deliver)
+    ]
+    assert sends == [Deliver(1, "a"), Deliver(2, "b")]
+
+
+# -- utils ---------------------------------------------------------------------
+
+
+def test_hashable_set_order_insensitive():
+    a = HashableSet([1, 2, 3])
+    b = HashableSet([3, 1, 2, 2])
+    assert a == b and hash(a) == hash(b)
+    assert fingerprint(a) == fingerprint(b)
+    assert 2 in a and 9 not in a
+    assert len(a.add(4)) == 4 and len(a.remove(1)) == 2
+
+
+def test_hashable_map_order_insensitive():
+    a = HashableMap({"x": 1, "y": 2})
+    b = HashableMap([("y", 2), ("x", 1)])
+    assert a == b and hash(a) == hash(b)
+    assert fingerprint(a) == fingerprint(b)
+    assert a["x"] == 1 and a.get("z") is None
+    assert a.set("z", 3)["z"] == 3
+    assert "x" not in a.remove("x")
+    with pytest.raises(KeyError):
+        a["z"]
+
+
+def test_dense_nat_map():
+    m = DenseNatMap(["s0", "s1"])
+    assert m[Id(1)] == "s1"
+    assert m.insert(Id(2), "s2").values() == ("s0", "s1", "s2")
+    with pytest.raises(IndexError):
+        m.insert(Id(5), "gap")
+    with pytest.raises(IndexError):
+        DenseNatMap.from_iter_keyed([(Id(0), "a"), (Id(2), "c")])
+
+
+def test_vector_clock_partial_order():
+    z = VectorClock()
+    a = z.incremented(0)  # [1]
+    b = z.incremented(1)  # [0, 1]
+    assert a.partial_cmp(b) is None  # incomparable
+    assert z < a and z < b
+    ab = a.merge_max(b)
+    assert a <= ab and b <= ab
+    assert ab == VectorClock([1, 1])
+    assert ab.incremented(0) > ab
+    # Canonical form drops trailing zeros so fingerprints agree.
+    assert VectorClock([1, 0, 0]) == VectorClock([1])
+    assert fingerprint(VectorClock([1, 0])) == fingerprint(VectorClock([1]))
