@@ -40,12 +40,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core.discovery import HasDiscoveries
 from ..core.model import Expectation
-from ..tensor.fingerprint import device_fingerprint
 from ..tensor.frontier import (
     SearchResult,
     reconstruct_path,
     record_discovery as _record_impl,
     seed_init,
+    state_fingerprint,
 )
 from ..tensor.hashtable import _insert_impl
 from ..tensor.model import TensorModel
@@ -234,7 +234,7 @@ class ShardedSearch:
                         )
 
                 # -- route successors to owner chips ---------------------------
-                sfps = device_fingerprint(flat)
+                sfps = state_fingerprint(model, flat)
                 owner = jnp.where(validf, owner_of(sfps), N)
                 route = jnp.argsort(owner)
                 o_s = owner[route]

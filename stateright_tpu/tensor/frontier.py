@@ -37,6 +37,14 @@ from .model import TensorModel
 _MAX_U64 = jnp.uint64(0xFFFFFFFFFFFFFFFF)
 
 
+def state_fingerprint(model: "TensorModel", states: jnp.ndarray) -> jnp.ndarray:
+    """Fingerprint for identity purposes: the canonical (symmetry
+    representative) form when the model defines one, else the state itself."""
+    if model.representative is not None:
+        states = model.representative(states)
+    return device_fingerprint(states)
+
+
 def seed_init(model: "TensorModel"):
     """Boundary-filter and fingerprint-dedup the initial states on host.
 
@@ -49,7 +57,7 @@ def seed_init(model: "TensorModel"):
     in_bounds = np.asarray(model.within_boundary(jnp.asarray(init)))
     init = init[in_bounds]
     n_raw = len(init)
-    init_fps = np.asarray(device_fingerprint(jnp.asarray(init)))
+    init_fps = np.asarray(state_fingerprint(model, jnp.asarray(init)))
     _, first_pos = np.unique(init_fps, return_index=True)
     keep = np.sort(first_pos)
     return init[keep], init_fps[keep], n_raw
@@ -79,7 +87,7 @@ def expand_insert(model: "TensorModel", keys, parents, states, fps, active):
     # ones (ref: bfs.rs:287-333).
     has_succ = validf.reshape(K, A).any(axis=1)
 
-    sfps = device_fingerprint(flat)
+    sfps = state_fingerprint(model, flat)
     sort_key = jnp.where(validf, sfps, _MAX_U64)
     order = jnp.argsort(sort_key)
     so_fps = sort_key[order]
@@ -136,7 +144,7 @@ def reconstruct_path(model: TensorModel, parent_map: dict, fp: int) -> Path:
     chain.reverse()
 
     init = np.asarray(model.init_states(), dtype=np.uint32)
-    init_fps = np.asarray(device_fingerprint(jnp.asarray(init)))
+    init_fps = np.asarray(state_fingerprint(model, jnp.asarray(init)))
     rows = np.nonzero(init_fps == np.uint64(chain[0]))[0]
     if len(rows) == 0:
         raise RuntimeError(
@@ -147,9 +155,9 @@ def reconstruct_path(model: TensorModel, parent_map: dict, fp: int) -> Path:
     pairs = []
     for next_fp in chain[1:]:
         succs, valid = model.expand(jnp.asarray(cur_row[None]))
+        sfps = np.asarray(state_fingerprint(model, succs[0]))
         succs = np.asarray(succs)[0]
         valid = np.asarray(valid)[0]
-        sfps = np.asarray(device_fingerprint(jnp.asarray(succs)))
         hits = np.nonzero(valid & (sfps == np.uint64(next_fp)))[0]
         if len(hits) == 0:
             raise RuntimeError(

@@ -43,11 +43,16 @@ class TensorModel:
 
     Required: `lanes`, `max_actions`, `init_states()`, `expand(states)`.
     Optional: `properties()`, `within_boundary(states)`, `decode(row)`,
-    `action_label(row, action_index)` for human-readable paths.
+    `action_label(row, action_index)` for human-readable paths, and
+    `representative(states) -> states` for symmetry reduction (a batched
+    canonicalization kernel; see `stateright_tpu.tensor.symmetry`). When
+    defined, the engines fingerprint the canonical form but keep searching
+    with the original states (ref: src/checker/dfs.rs:309-334).
     """
 
     lanes: int
     max_actions: int
+    representative = None  # overridden as a method by symmetric models
 
     def init_states(self) -> jnp.ndarray:
         """Initial states as uint32[N0, lanes]."""

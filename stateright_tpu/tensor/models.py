@@ -71,10 +71,13 @@ class TensorTwoPhaseSys(TensorModel):
     """
 
     rm_count: int
+    symmetry: bool = False  # opt-in like the host builder's .symmetry()
 
     def __post_init__(self):
         self.lanes = self.rm_count + 3
         self.max_actions = 2 + 5 * self.rm_count
+        if self.symmetry:
+            self.representative = self._representative
 
     def init_states(self):
         return jnp.zeros((1, self.lanes), dtype=jnp.uint32)
@@ -169,6 +172,45 @@ class TensorTwoPhaseSys(TensorModel):
             ),
         ]
 
+    def _representative(self, states):
+        """Canonicalize under RM permutation by stable-sorting RMs on their
+        FULL per-RM key (state value, prepared bit, in-flight message bit) and
+        permuting the satellite bits to match.
+
+        Using the full key makes this a true orbit invariant, so the reduced
+        count is deterministic and traversal-order-independent: 8,832 → 314 at
+        5 RMs. The reference sorts on the state value alone, which splits
+        orbits on satellite-bit ties and yields the weaker, DFS-order-dependent
+        665 (ref: examples/2pc.rs:163-168); the host checker reproduces that
+        behavior for parity, while the device models take the stronger
+        reduction (cross-validated against host DFS with the same full-key
+        canonicalization)."""
+        from .symmetry import gather_entities, permute_mask_bits, stable_argsort
+
+        n = self.rm_count
+        rm = states[:, :n]
+        prepared_mask = states[:, n + 1]
+        msgs = states[:, n + 2]
+        lanes = jnp.arange(n, dtype=jnp.uint32)
+        prep_bits = (prepared_mask[:, None] >> lanes) & jnp.uint32(1)
+        msg_bits = (msgs[:, None] >> lanes) & jnp.uint32(1)
+        perm = stable_argsort(
+            rm * jnp.uint32(4) + prep_bits * jnp.uint32(2) + msg_bits
+        )
+        rm_new = gather_entities(rm, perm)
+        prep_new = permute_mask_bits(prepared_mask, perm)
+        rm_bits_new = permute_mask_bits(msgs, perm)
+        ctl_bits = msgs & jnp.uint32(0b11 << n)  # commit/abort: not per-RM
+        return jnp.concatenate(
+            [
+                rm_new,
+                states[:, n : n + 1],
+                prep_new[:, None],
+                (rm_bits_new | ctl_bits)[:, None],
+            ],
+            axis=1,
+        ).astype(jnp.uint32)
+
     def decode(self, row):
         n = self.rm_count
         names = {0: "working", 1: "prepared", 2: "committed", 3: "aborted"}
@@ -197,3 +239,105 @@ class TensorTwoPhaseSys(TensorModel):
              "rm_rcv_commit", "rm_rcv_abort"][kind],
             i,
         )
+
+
+# -- increment (shared-memory interleaving / data-race demo) -------------------
+
+
+@dataclass
+class TensorIncrement(TensorModel):
+    """Lost-update race demo (ref: examples/increment.rs:108-202),
+    tensor-encoded. Lanes: [i, t0, pc0, t1, pc1, ...]; one action slot per
+    thread (each thread has at most one enabled step: read at pc=1, write at
+    pc=2). Goldens with 2 threads: 13 states, 8 under symmetry
+    (ref: examples/increment.rs:32-105).
+
+    The "fin" property (ALWAYS sum(pc==3) == i) is violated by the race; an
+    undiscoverable `sometimes` property forces full enumeration when needed,
+    mirroring the host test strategy.
+    """
+
+    thread_count: int
+    symmetry: bool = False
+    full_enumeration: bool = False  # add an unfindable sometimes property
+
+    def __post_init__(self):
+        self.lanes = 1 + 2 * self.thread_count
+        self.max_actions = self.thread_count
+        if self.symmetry:
+            self.representative = self._representative
+
+    def init_states(self):
+        row = [0] + [0, 1] * self.thread_count
+        return jnp.asarray([row], dtype=jnp.uint32)
+
+    def expand(self, states):
+        i = states[:, 0]
+        succ_list, valid_list = [], []
+        for tid in range(self.thread_count):
+            t = states[:, 1 + 2 * tid]
+            pc = states[:, 2 + 2 * tid]
+            is_read = pc == 1
+            is_write = pc == 2
+            # read: t <- i, pc <- 2;  write: i <- t + 1, pc <- 3.
+            new_i = jnp.where(is_write, t + 1, i)
+            new_t = jnp.where(is_read, i, t)
+            new_pc = jnp.where(is_read, 2, jnp.where(is_write, 3, pc))
+            cols = [new_i]
+            for o in range(self.thread_count):
+                if o == tid:
+                    cols += [new_t, new_pc]
+                else:
+                    cols += [states[:, 1 + 2 * o], states[:, 2 + 2 * o]]
+            succ_list.append(jnp.stack(cols, axis=1))
+            valid_list.append(is_read | is_write)
+        succs = jnp.stack(succ_list, axis=1).astype(jnp.uint32)
+        valid = jnp.stack(valid_list, axis=1)
+        return succs, valid
+
+    def _representative(self, states):
+        """Sort per-thread (t, pc) pairs — the device analogue of the host
+        IncrementState.representative (13 → 8 at 2 threads)."""
+        from .symmetry import gather_entities, stable_argsort
+
+        n = self.thread_count
+        t = states[:, 1::2]
+        pc = states[:, 2::2]
+        # Key order matches the host's sorted((t, pc)) tuples.
+        perm = stable_argsort(t * jnp.uint32(8) + pc)
+        t_new = gather_entities(t, perm)
+        pc_new = gather_entities(pc, perm)
+        out = [states[:, 0:1]]
+        for k in range(n):
+            out += [t_new[:, k : k + 1], pc_new[:, k : k + 1]]
+        return jnp.concatenate(out, axis=1).astype(jnp.uint32)
+
+    def properties(self):
+        n = self.thread_count
+
+        def fin(model, states):
+            done = jnp.stack(
+                [states[:, 2 + 2 * t] == 3 for t in range(n)], axis=1
+            ).sum(axis=1)
+            return done == states[:, 0]
+
+        props = [TensorProperty.always("fin", fin)]
+        if self.full_enumeration:
+            props.append(
+                TensorProperty.sometimes(
+                    "unreachable",
+                    lambda m, s: jnp.zeros(s.shape[0], dtype=bool),
+                )
+            )
+        return props
+
+    def decode(self, row):
+        n = self.thread_count
+        return (
+            int(row[0]),
+            tuple((int(row[1 + 2 * t]), int(row[2 + 2 * t])) for t in range(n)),
+        )
+
+    def action_label(self, row, action_index):
+        pc = int(row[2 + 2 * action_index])
+        return ("read" if pc == 1 else "write", action_index)

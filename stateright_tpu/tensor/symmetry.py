@@ -1,0 +1,42 @@
+"""Device-side symmetry reduction: canonicalization kernels.
+
+The reference reduces symmetric state spaces by mapping each state to a
+canonical orbit representative before dedup (Symmetric-Spin,
+ref: src/checker/representative.rs; the plan derivation is a double argsort,
+ref: src/checker/rewrite_plan.rs:81-107). That double-argsort shape is
+*naturally* TPU-friendly: a `TensorModel` opts in by defining
+`representative(states) -> states`, built from the helpers here — one stable
+argsort over per-entity keys plus gathers/bit-permutes — and the engines then
+fingerprint the canonical form while continuing the search with the original
+state (preserving the reference DFS's representative-insert/original-continue
+semantics, ref: src/checker/dfs.rs:309-334).
+
+Count parity: a stable sort keyed on the entity value places equal-key
+entities in original index order, so the induced state partition — and hence
+the unique-state count — is independent of the key order chosen, matching the
+host `RewritePlan.from_values_to_sort` counts (e.g. 2PC-5: 8,832 → 665).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def stable_argsort(keys: jnp.ndarray) -> jnp.ndarray:
+    """Per-row stable argsort: `keys[B, n] -> perm[B, n]` where `perm[b, j]`
+    is the original index of the entity placed at slot j."""
+    return jnp.argsort(keys, axis=1, stable=True)
+
+
+def gather_entities(lanes: jnp.ndarray, perm: jnp.ndarray) -> jnp.ndarray:
+    """Apply a permutation to per-entity lanes: `lanes[B, n][b, perm[b, j]]`."""
+    return jnp.take_along_axis(lanes, perm, axis=1)
+
+
+def permute_mask_bits(mask: jnp.ndarray, perm: jnp.ndarray) -> jnp.ndarray:
+    """Permute the low `n` bits of a per-row bitmask: new bit j = old bit
+    `perm[b, j]`. Bits at positions >= n are dropped (handle separately)."""
+    n = perm.shape[1]
+    bits = (mask[:, None] >> perm.astype(mask.dtype)) & mask.dtype.type(1)
+    weights = (mask.dtype.type(1) << jnp.arange(n, dtype=mask.dtype))[None, :]
+    return (bits * weights).sum(axis=1, dtype=mask.dtype)
