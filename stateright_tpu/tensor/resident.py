@@ -105,6 +105,7 @@ class ResidentSearch:
         self._kernel = self._build()
         self._last_tables = None
         self._parent_map = None
+        self._seed = None
 
     def _build(self):
         model = self.model
@@ -210,10 +211,8 @@ class ResidentSearch:
                 steps=c.steps + 1,
             )
 
-        @partial(jax.jit, static_argnums=(5, 6, 9), donate_argnums=(0, 1))
+        @partial(jax.jit, static_argnums=(3, 4, 7))
         def search(
-            keys,
-            parents,
             init_states,  # uint32[K, L] padded
             init_fps,  # uint64[K]
             init_active,  # bool[K]
@@ -223,6 +222,10 @@ class ResidentSearch:
             n_raw_seed,  # int64: pre-dedup init count (host count parity)
             max_steps: int,
         ):
+            # Tables are allocated in-trace: a fresh search per dispatch, and
+            # no host-side zero-fill round trip over the device tunnel.
+            keys = jnp.zeros(S, dtype=jnp.uint64)
+            parents = jnp.zeros(S, dtype=jnp.uint64)
             # Seed the table and queue with the (pre-deduped) init batch.
             keys, parents, is_new, ovf = _insert_impl(
                 keys, parents, init_fps, jnp.zeros(K, dtype=jnp.uint64), init_active
@@ -279,7 +282,27 @@ class ResidentSearch:
                 steps=jnp.int64(0),
             )
             carry = jax.lax.while_loop(cond, body, carry)
-            return carry
+            # Pack every host-facing scalar into ONE small vector so the host
+            # reads the whole result in a single device transfer (each fetch
+            # over the device tunnel costs a full round trip).
+            summary = jnp.concatenate(
+                [
+                    jnp.stack(
+                        [
+                            carry.state_count.astype(jnp.uint64),
+                            carry.unique_count.astype(jnp.uint64),
+                            carry.max_depth.astype(jnp.uint64),
+                            carry.discovered.astype(jnp.uint64),
+                            carry.head.astype(jnp.uint64),
+                            carry.tail.astype(jnp.uint64),
+                            carry.overflow.astype(jnp.uint64),
+                            carry.steps.astype(jnp.uint64),
+                        ]
+                    ),
+                    carry.disc_fps,
+                ]
+            )
+            return carry.keys, carry.parents, summary
 
         return search
 
@@ -305,18 +328,31 @@ class ResidentSearch:
         start = time.monotonic()
         self._parent_map = None  # invalidate any prior reconstruction cache
 
-        init, init_fps, n_raw = seed_init(model)
-        if len(init) > K:
-            raise ValueError("more init states than batch_size; raise batch_size")
-        n0 = len(init)
+        # seed_init is deterministic per model; cache it (and its padded
+        # device-side form) so repeat runs skip the host<->device round trips.
+        if self._seed is None:
+            init, init_fps, n_raw = seed_init(model)
+            if len(init) > K:
+                raise ValueError(
+                    "more init states than batch_size; raise batch_size"
+                )
+            n0 = len(init)
+            st = np.zeros((K, model.lanes), dtype=np.uint32)
+            st[:n0] = init
+            fp = np.zeros(K, dtype=np.uint64)
+            fp[:n0] = init_fps
+            active = np.arange(K) < n0
+            dev = jax.device_put((st, fp, active))
+            self._seed = (dev, n0, n_raw)
+        dev, n0, n_raw = self._seed
 
         # Vacuously-true finish policies (e.g. ALL with zero properties) stop
         # before exploring anything, matching the host checkers' immediate
         # is_awaiting_discoveries early-out (ref: bfs.rs:278-280).
         if finish_when.matches(self.props, set()) or not self.props:
             self._last_tables = (
-                jnp.zeros(1 << self.table_log2, dtype=jnp.uint64),
-                jnp.zeros(1 << self.table_log2, dtype=jnp.uint64),
+                np.zeros(1 << self.table_log2, dtype=np.uint64),
+                np.zeros(1 << self.table_log2, dtype=np.uint64),
             )
             return SearchResult(
                 state_count=n_raw,
@@ -328,47 +364,45 @@ class ResidentSearch:
                 steps=0,
             )
 
-        st = np.zeros((K, model.lanes), dtype=np.uint32)
-        st[:n0] = init
-        fp = np.zeros(K, dtype=np.uint64)
-        fp[:n0] = init_fps
-        active = np.arange(K) < n0
-
         required_mask, any_mask = _finish_masks(finish_when, self.props)
-        keys = jnp.zeros(1 << self.table_log2, dtype=jnp.uint64)
-        parents = jnp.zeros(1 << self.table_log2, dtype=jnp.uint64)
-        carry = self._kernel(
-            keys,
-            parents,
-            jnp.asarray(st),
-            jnp.asarray(fp),
-            jnp.asarray(active),
+        keys, parents, summary = self._kernel(
+            *dev,
             required_mask,
             any_mask,
             jnp.int64(target_state_count or 0),
             jnp.int64(n_raw),
             max_steps,
         )
-        carry = jax.block_until_ready(carry)
-        if bool(carry.overflow):
+        # ONE device->host transfer for the entire result.
+        summary = np.asarray(summary)
+        (
+            state_count,
+            unique_count,
+            max_depth,
+            discovered,
+            head,
+            tail,
+            overflow,
+            steps,
+        ) = (int(x) for x in summary[:8])
+        if overflow:
             raise RuntimeError("hash table full; raise table_log2")
-        self._last_tables = (carry.keys, carry.parents)
+        self._last_tables = (keys, parents)
 
-        discovered = int(carry.discovered)
-        disc_fps = np.asarray(carry.disc_fps)
+        disc_fps = summary[8:]
         discoveries = {
             p.name: int(disc_fps[i])
             for i, p in enumerate(self.props)
             if discovered & (1 << i)
         }
         return SearchResult(
-            state_count=int(carry.state_count),
-            unique_state_count=int(carry.unique_count),
-            max_depth=int(carry.max_depth),
+            state_count=state_count,
+            unique_state_count=unique_count,
+            max_depth=max_depth,
             discoveries=discoveries,
-            complete=bool(carry.head >= carry.tail),
+            complete=head >= tail,
             duration=time.monotonic() - start,
-            steps=int(carry.steps),
+            steps=steps,
         )
 
     def reconstruct_path(self, fp: int):
