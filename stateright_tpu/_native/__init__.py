@@ -1,0 +1,53 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference implements its whole runtime natively (Rust); here the compute
+path is JAX/XLA on device, and the host-side hot spots that remain CPU-bound
+get C++ implementations compiled on first use with the toolchain baked into
+the image (no pybind11 — plain C ABI + ctypes). Everything has a pure-Python
+fallback, so a missing compiler degrades performance, never correctness.
+
+Shared objects are cached next to the sources in `build/` keyed by source
+mtime, so repeat imports don't pay the compile.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD = os.path.join(_DIR, "build")
+_lock = threading.Lock()
+_cache: dict = {}
+
+
+def _compile(name: str) -> str:
+    src = os.path.join(_DIR, f"{name}.cpp")
+    out = os.path.join(_BUILD, f"{name}.so")
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    os.makedirs(_BUILD, exist_ok=True)
+    tmp = out + ".tmp"
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp, src],
+        check=True,
+        capture_output=True,
+    )
+    os.replace(tmp, out)
+    return out
+
+
+def load(name: str):
+    """ctypes.CDLL for `<name>.cpp`, compiled on demand; None when the
+    toolchain is unavailable (callers fall back to Python)."""
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        try:
+            lib = ctypes.CDLL(_compile(name))
+        except (OSError, subprocess.CalledProcessError, FileNotFoundError):
+            lib = None
+        _cache[name] = lib
+        return lib

@@ -75,7 +75,19 @@ from .vec import VecSpec, Push, Pop, Len, PushOk, PopOk, LenOk  # noqa: E402
 from .linearizability import LinearizabilityTester  # noqa: E402
 from .sequential_consistency import SequentialConsistencyTester  # noqa: E402
 
+
+def clear_serialization_caches() -> None:
+    """Drop the memoized serialization verdicts (they pin tester histories in
+    memory for the process lifetime otherwise). Call between unrelated long
+    checker runs if memory matters."""
+    from . import linearizability, sequential_consistency
+
+    linearizability._serialized_cached.cache_clear()
+    sequential_consistency._serialized_cached.cache_clear()
+
+
 __all__ = [
+    "clear_serialization_caches",
     "SequentialSpec",
     "ConsistencyTester",
     "Register",

@@ -1,0 +1,273 @@
+"""Bridge between the Python consistency testers and the native serializer.
+
+Encodes a tester's history into flat int64 arrays, calls the C++ backtracking
+search (stateright_tpu/_native/serialize.cpp), and decodes the returned
+interleaving back into (op, ret) pairs by replaying it through the Python
+spec. Only the built-in reference objects (Register, WORegister, VecSpec) with
+hashable payloads take this path; anything else returns NOT_SUPPORTED and the
+caller runs the Python search. The native search visits interleavings in the
+same order as the Python one, so results are identical, not merely equivalent.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+from .register import Read, Register, WORegister, Write, WriteFail, WriteOk
+from .vec import Len, Pop, Push, VecSpec
+
+NOT_SUPPORTED = object()  # sentinel: caller must use the Python search
+
+# Below this many ops (completed + in flight) the Python search finishes in
+# ~10us and the ctypes marshalling (~40-100us) would be a net loss; the native
+# search exists for the larger histories where backtracking grows
+# exponentially. Measured crossover on Register histories: python stays
+# 12-17us through 12 easy ops but blows up on contended ones.
+NATIVE_MIN_OPS = 12
+
+_SPEC_REGISTER, _SPEC_WO_REGISTER, _SPEC_VEC = 0, 1, 2
+_OP_WRITE, _OP_READ = 0, 1
+_OP_PUSH, _OP_POP, _OP_LEN = 0, 1, 2
+
+_i64 = ctypes.c_int64
+_i32 = ctypes.c_int32
+_u8 = ctypes.c_uint8
+
+_lib = None
+_lib_loaded = False
+
+
+def _load():
+    global _lib, _lib_loaded
+    if not _lib_loaded:
+        from .. import _native
+
+        _lib = _native.load("serialize")
+        if _lib is not None:
+            _lib.srt_serialize.restype = ctypes.c_int32
+        _lib_loaded = True
+    return _lib
+
+
+class _Interner:
+    """Dense int64 ids for op/ret payloads, in first-seen order."""
+
+    def __init__(self):
+        self.ids: dict = {}
+
+    def __call__(self, value) -> Optional[int]:
+        try:
+            got = self.ids.get(value)
+        except TypeError:  # unhashable payload
+            return None
+        if got is None:
+            got = len(self.ids)
+            self.ids[value] = got
+        return got
+
+
+def _encode_op(op, intern, is_vec: bool):
+    """(kind, val) or None when the op isn't one this spec understands."""
+    if is_vec:
+        if isinstance(op, Push):
+            v = intern(op.value)
+            return None if v is None else (_OP_PUSH, v)
+        if isinstance(op, Pop):
+            return (_OP_POP, 0)
+        if isinstance(op, Len):
+            return (_OP_LEN, 0)
+        return None
+    if isinstance(op, Write):
+        v = intern(op.value)
+        return None if v is None else (_OP_WRITE, v)
+    if isinstance(op, Read):
+        return (_OP_READ, 0)
+    return None
+
+
+def _encode_ret(ret, intern, is_vec: bool):
+    from .register import ReadOk
+    from .vec import LenOk, PopOk, PushOk
+
+    if is_vec:
+        if isinstance(ret, PushOk):
+            return (0, 0)
+        if isinstance(ret, PopOk):
+            v = intern(ret.value)
+            return None if v is None else (1, v)
+        if isinstance(ret, LenOk):
+            return (2, int(ret.length))
+        return None
+    if isinstance(ret, WriteOk):
+        return (0, 0)
+    if isinstance(ret, WriteFail):
+        return (1, 0)
+    if isinstance(ret, ReadOk):
+        v = intern(ret.value)
+        return None if v is None else (2, v)
+    return None
+
+
+def native_serialized_history(
+    init_ref_obj,
+    history_by_thread: dict,
+    in_flight_by_thread: dict,
+    linearizable: bool,
+):
+    """A serialized history list, None (not serializable), or NOT_SUPPORTED."""
+    n_ops = len(in_flight_by_thread) + sum(
+        len(h) for h in history_by_thread.values()
+    )
+    if n_ops < NATIVE_MIN_OPS:
+        return NOT_SUPPORTED
+    lib = _load()
+    if lib is None:
+        return NOT_SUPPORTED
+
+    # Exact types only: a user subclass may override invoke/is_valid_step, so
+    # it must take the Python path like any other custom spec.
+    spec_type = type(init_ref_obj)
+    if spec_type is WORegister:
+        spec_kind, is_vec = _SPEC_WO_REGISTER, False
+    elif spec_type is Register:
+        spec_kind, is_vec = _SPEC_REGISTER, False
+    elif spec_type is VecSpec:
+        spec_kind, is_vec = _SPEC_VEC, True
+    else:
+        return NOT_SUPPORTED
+
+    intern = _Interner()
+    none_id = intern(None)
+
+    if spec_kind == _SPEC_REGISTER:
+        v = intern(init_ref_obj.value)
+        spec_state = [v]
+    elif spec_kind == _SPEC_WO_REGISTER:
+        v = intern(init_ref_obj.value)
+        spec_state = [v, 1 if init_ref_obj.written else 0]
+    else:
+        vals = [intern(x) for x in init_ref_obj.items]
+        if any(x is None for x in vals):
+            return NOT_SUPPORTED
+        spec_state = vals
+        v = 0
+    if v is None:
+        return NOT_SUPPORTED
+
+    # Dense thread ids in the Python dict's iteration order (the search order).
+    tids = list(history_by_thread)
+    tix = {tid: i for i, tid in enumerate(tids)}
+    T = len(tids)
+    if any(tid not in tix for tid in in_flight_by_thread):
+        return NOT_SUPPORTED  # never happens via the recorders
+
+    hist_offset = [0]
+    op_kind, op_val, ret_kind, ret_val = [], [], [], []
+    prereq_offset = [0]
+    prereq_peer, prereq_time = [], []
+    for tid in tids:
+        for entry in history_by_thread[tid]:
+            if linearizable:
+                last_completed, op, ret = entry
+            else:
+                op, ret = entry
+                last_completed = ()
+            eo = _encode_op(op, intern, is_vec)
+            er = _encode_ret(ret, intern, is_vec)
+            if eo is None or er is None:
+                return NOT_SUPPORTED
+            op_kind.append(eo[0])
+            op_val.append(eo[1])
+            ret_kind.append(er[0])
+            ret_val.append(er[1])
+            for peer, min_time in last_completed:
+                prereq_peer.append(tix[peer])
+                prereq_time.append(min_time)
+            prereq_offset.append(len(prereq_peer))
+        hist_offset.append(len(op_kind))
+    N = len(op_kind)
+
+    ifl_present = [0] * T
+    ifl_op_kind = [0] * T
+    ifl_op_val = [0] * T
+    ifl_prereq_offset = [0] * (T + 1)
+    ifl_prereq_peer, ifl_prereq_time = [], []
+    for t, tid in enumerate(tids):
+        if tid in in_flight_by_thread:
+            entry = in_flight_by_thread[tid]
+            if linearizable:
+                last_completed, op = entry
+            else:
+                op, last_completed = entry, ()
+            eo = _encode_op(op, intern, is_vec)
+            if eo is None:
+                return NOT_SUPPORTED
+            ifl_present[t] = 1
+            ifl_op_kind[t], ifl_op_val[t] = eo
+            for peer, min_time in last_completed:
+                ifl_prereq_peer.append(tix[peer])
+                ifl_prereq_time.append(min_time)
+        ifl_prereq_offset[t + 1] = len(ifl_prereq_peer)
+
+    def arr(ctype, values):
+        return (ctype * max(len(values), 1))(*values)
+
+    out_thread = (_i32 * (N + T))()
+    out_ifl = (_u8 * (N + T))()
+    out_len = _i64(0)
+    rc = lib.srt_serialize(
+        _i32(spec_kind),
+        _i32(1 if linearizable else 0),
+        arr(_i64, spec_state),
+        _i64(len(spec_state)),
+        _i64(none_id),
+        _i32(T),
+        arr(_i64, hist_offset),
+        arr(_i32, op_kind),
+        arr(_i64, op_val),
+        arr(_i32, ret_kind),
+        arr(_i64, ret_val),
+        arr(_i64, prereq_offset),
+        arr(_i64, prereq_peer),
+        arr(_i64, prereq_time),
+        arr(_u8, ifl_present),
+        arr(_i32, ifl_op_kind),
+        arr(_i64, ifl_op_val),
+        arr(_i64, ifl_prereq_offset),
+        arr(_i64, ifl_prereq_peer),
+        arr(_i64, ifl_prereq_time),
+        out_thread,
+        out_ifl,
+        ctypes.byref(out_len),
+    )
+    if rc == 0:
+        return None
+    if rc != 1:
+        return NOT_SUPPORTED
+
+    # Decode: replay the chosen interleaving through the Python spec so the
+    # returned (op, ret) pairs are the exact Python objects.
+    pos = {tid: 0 for tid in tids}
+    spec = init_ref_obj
+    out = []
+    for i in range(out_len.value):
+        tid = tids[out_thread[i]]
+        if out_ifl[i]:
+            entry = in_flight_by_thread[tid]
+            op = entry[1] if linearizable else entry
+            ret, spec = spec.invoke(op)
+        else:
+            entry = history_by_thread[tid][pos[tid]]
+            pos[tid] += 1
+            if linearizable:
+                _, op, ret = entry
+            else:
+                op, ret = entry
+            spec = spec.is_valid_step(op, ret)
+            if spec is None:
+                # Native/Python semantics drift — never silently trust the
+                # native result; let the Python search decide.
+                return NOT_SUPPORTED
+        out.append((op, ret))
+    return out

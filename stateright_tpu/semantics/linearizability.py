@@ -17,6 +17,7 @@ an `ActorModel` history (auxiliary state hashed into the fingerprint).
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import Optional
 
 from . import ConsistencyTester, SequentialSpec
@@ -96,6 +97,20 @@ class LinearizabilityTester(ConsistencyTester):
         appear (they might have taken effect) or not (they might not have)."""
         if not self.is_valid_history:
             return None
+        cached = _serialized_cached(self)
+        return None if cached is None else list(cached)
+
+    def _serialized_uncached(self) -> Optional[list]:
+        from ._native_bridge import NOT_SUPPORTED, native_serialized_history
+
+        native = native_serialized_history(
+            self.init_ref_obj,
+            self.history_by_thread,
+            self.in_flight_by_thread,
+            linearizable=True,
+        )
+        if native is not NOT_SUPPORTED:
+            return native
         remaining = {
             tid: tuple(enumerate(hist))
             for tid, hist in self.history_by_thread.items()
@@ -132,6 +147,15 @@ class LinearizabilityTester(ConsistencyTester):
             f"{type(self).__name__}(history={self.history_by_thread!r}, "
             f"in_flight={self.in_flight_by_thread!r}, valid={self.is_valid_history})"
         )
+
+
+@lru_cache(maxsize=1 << 15)
+def _serialized_cached(tester: "LinearizabilityTester"):
+    """Equal testers recur across many checker states (the history is only one
+    component of the state), so the search result is memoized on the immutable
+    tester (SURVEY.md §7: "cache verdicts by history-fingerprint")."""
+    result = tester._serialized_uncached()
+    return None if result is None else tuple(result)
 
 
 def _violates_real_time(last_completed, remaining) -> bool:

@@ -8,6 +8,7 @@ operation.
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import Optional
 
 from . import ConsistencyTester, SequentialSpec
@@ -74,6 +75,20 @@ class SequentialConsistencyTester(ConsistencyTester):
     def serialized_history(self) -> Optional[list]:
         if not self.is_valid_history:
             return None
+        cached = _serialized_cached(self)
+        return None if cached is None else list(cached)
+
+    def _serialized_uncached(self) -> Optional[list]:
+        from ._native_bridge import NOT_SUPPORTED, native_serialized_history
+
+        native = native_serialized_history(
+            self.init_ref_obj,
+            self.history_by_thread,
+            self.in_flight_by_thread,
+            linearizable=False,
+        )
+        if native is not NOT_SUPPORTED:
+            return native
         return _serialize(
             [],
             self.init_ref_obj,
@@ -111,6 +126,14 @@ class SequentialConsistencyTester(ConsistencyTester):
             f"SequentialConsistencyTester(history={self.history_by_thread!r}, "
             f"in_flight={self.in_flight_by_thread!r}, valid={self.is_valid_history})"
         )
+
+
+@lru_cache(maxsize=1 << 15)
+def _serialized_cached(tester: "SequentialConsistencyTester"):
+    """Memoized search result on the immutable tester (equal histories recur
+    across many checker states)."""
+    result = tester._serialized_uncached()
+    return None if result is None else tuple(result)
 
 
 def _serialize(valid_history, ref_obj, remaining, in_flight) -> Optional[list]:

@@ -1,0 +1,172 @@
+"""Differential tests: the native (C++) consistency serializer must return
+exactly what the Python search returns — same verdict AND same serialization
+order — across randomized histories over all three built-in reference objects.
+"""
+
+import random
+
+import pytest
+
+from stateright_tpu.semantics import _native_bridge
+from stateright_tpu.semantics.linearizability import LinearizabilityTester
+from stateright_tpu.semantics.register import (
+    Read,
+    ReadOk,
+    Register,
+    WORegister,
+    Write,
+    WriteOk,
+)
+from stateright_tpu.semantics.sequential_consistency import (
+    SequentialConsistencyTester,
+)
+from stateright_tpu.semantics.vec import Len, Pop, Push, VecSpec
+
+
+@pytest.fixture(autouse=True)
+def _always_native(monkeypatch):
+    """Exercise the native path even on tiny histories (production gates it
+    behind NATIVE_MIN_OPS because marshalling loses below that)."""
+    monkeypatch.setattr(_native_bridge, "NATIVE_MIN_OPS", 0)
+
+
+def _native_only(tester):
+    """The uncached search, asserting the native path actually ran."""
+    result = tester._serialized_uncached()
+    return result
+
+
+def _python_only(tester):
+    """The uncached search with the native path disabled."""
+    real = _native_bridge.native_serialized_history
+    _native_bridge.native_serialized_history = (
+        lambda *a, **k: _native_bridge.NOT_SUPPORTED
+    )
+    try:
+        return tester._serialized_uncached()
+    finally:
+        _native_bridge.native_serialized_history = real
+
+
+def _native_available():
+    from stateright_tpu import _native
+
+    return _native.load("serialize") is not None
+
+
+pytestmark = pytest.mark.skipif(
+    not _native_available(), reason="no C++ toolchain in this environment"
+)
+
+
+def _random_register_history(tester, rng, threads, values, steps):
+    for _ in range(steps):
+        tid = rng.choice(threads)
+        if rng.random() < 0.5:
+            op = Write(rng.choice(values)) if rng.random() < 0.5 else Read()
+            tester = tester.on_invoke(tid, op)
+        else:
+            ret = WriteOk() if rng.random() < 0.5 else ReadOk(rng.choice(values))
+            tester = tester.on_return(tid, ret)
+        # Invalid recorder sequences poison the tester; restart from there.
+        if not tester.is_valid_history:
+            break
+    return tester
+
+
+@pytest.mark.parametrize("tester_cls", [LinearizabilityTester, SequentialConsistencyTester])
+@pytest.mark.parametrize("spec", [Register("A"), WORegister(), Register(None)])
+def test_differential_register(tester_cls, spec):
+    rng = random.Random(12345)
+    agreements = violations = 0
+    for trial in range(400):
+        t = tester_cls(spec)
+        # Valid recorder discipline: invoke/return alternate per thread.
+        pending = {}
+        for _ in range(rng.randrange(2, 9)):
+            tid = rng.randrange(3)
+            if tid in pending:
+                op = pending.pop(tid)
+                if isinstance(op, Write):
+                    ret = WriteOk()
+                else:
+                    ret = ReadOk(rng.choice(["A", "B", None]))
+                t = t.on_return(tid, ret)
+            else:
+                op = Write(rng.choice(["A", "B"])) if rng.random() < 0.6 else Read()
+                t = t.on_invoke(tid, op)
+                pending[tid] = op
+        native = _native_only(t)
+        python = _python_only(t)
+        assert native == python, (trial, t, native, python)
+        if python is None:
+            violations += 1
+        else:
+            agreements += 1
+    assert agreements and violations  # both outcomes exercised
+
+
+@pytest.mark.parametrize("tester_cls", [LinearizabilityTester, SequentialConsistencyTester])
+def test_differential_vec(tester_cls):
+    rng = random.Random(999)
+    both = set()
+    for trial in range(300):
+        t = tester_cls(VecSpec())
+        pending = {}
+        for _ in range(rng.randrange(2, 8)):
+            tid = rng.randrange(2)
+            if tid in pending:
+                op = pending.pop(tid)
+                from stateright_tpu.semantics.vec import LenOk, PopOk, PushOk
+
+                if isinstance(op, Push):
+                    ret = PushOk()
+                elif isinstance(op, Pop):
+                    ret = PopOk(rng.choice(["x", "y", None]))
+                else:
+                    ret = LenOk(rng.randrange(3))
+                t = t.on_return(tid, ret)
+            else:
+                r = rng.random()
+                op = Push(rng.choice(["x", "y"])) if r < 0.5 else (Pop() if r < 0.8 else Len())
+                t = t.on_invoke(tid, op)
+                pending[tid] = op
+        native = _native_only(t)
+        python = _python_only(t)
+        assert native == python, (trial, t, native, python)
+        both.add(python is None)
+    assert both == {True, False}
+
+
+def test_unsupported_spec_falls_back():
+    """A custom SequentialSpec takes the Python path and still works."""
+    from stateright_tpu.semantics import SequentialSpec
+
+    class Counter(SequentialSpec):
+        def __init__(self, n=0):
+            self.n = n
+
+        def invoke(self, op):
+            return self.n + 1, Counter(self.n + 1)
+
+        def __eq__(self, other):
+            return isinstance(other, Counter) and self.n == other.n
+
+        def __hash__(self):
+            return hash(("Counter", self.n))
+
+    t = LinearizabilityTester(Counter())
+    t = t.on_invoke(0, "inc").on_return(0, 1)
+    assert t.serialized_history() == [("inc", 1)]
+
+
+def test_in_flight_ops_optional():
+    """In-flight ops may or may not take effect (ref: linearizability.rs:203-208)."""
+    t = LinearizabilityTester(Register("A"))
+    t = t.on_invoke(0, Write("B"))  # in flight, never returns
+    t = t.on_invoke(1, Read()).on_return(1, ReadOk("B"))
+    assert t.serialized_history() is not None  # write took effect
+    t2 = LinearizabilityTester(Register("A"))
+    t2 = t2.on_invoke(0, Write("B"))
+    t2 = t2.on_invoke(1, Read()).on_return(1, ReadOk("A"))
+    assert t2.serialized_history() is not None  # write did not take effect
