@@ -1,12 +1,14 @@
-"""Benchmark: device frontier checker vs host BFS on the 2PC-4 workload
-(the BASELINE.json metric config: "states/sec/chip, 2PC-4").
+"""Benchmark: device whole-search checker vs host BFS on the Paxos register
+workload (BASELINE.json metric: states/sec/chip on Paxos; golden 16,668
+unique states @ 2 clients, ref: examples/paxos.rs:327,351).
 
-Runs the whole-search resident engine (one device dispatch) on the current
-default JAX backend (the TPU chip under the driver; CPU elsewhere), measures
-generated-states/sec after a compile warm-up, and compares against the
-host-Python multithread-free BFS checker on the same model. The reference
-publishes no absolute numbers (BASELINE.md), so `vs_baseline` is the ratio
-against the locally-measured host BFS states/sec.
+Runs the host multithread-free Python BFS checker on the 2-client / 3-server
+Paxos actor model (linearizability-tested register), then the device-resident
+whole-search engine on the tensor encoding of the SAME system — including the
+on-device linearizability property — asserts exact unique/generated-state
+count parity, and reports generated states/sec with `vs_baseline` = the ratio
+against the locally-measured host BFS (the reference publishes no absolute
+numbers — BASELINE.md).
 
 Prints exactly one JSON line.
 """
@@ -18,20 +20,28 @@ import time
 
 
 def main() -> None:
-    from stateright_tpu.examples.two_phase_commit import TwoPhaseSys
-    from stateright_tpu.tensor.models import TensorTwoPhaseSys
+    from stateright_tpu.examples.paxos import PaxosModelCfg
+    from stateright_tpu.tensor.paxos import TensorPaxos
     from stateright_tpu.tensor.resident import ResidentSearch
 
-    rm = 4
+    clients = 2
 
-    # -- host BFS baseline (pure Python, same model family) --------------------
+    # -- host BFS baseline (pure Python, same model) ---------------------------
     t0 = time.monotonic()
-    host = TwoPhaseSys(rm).checker().spawn_bfs().join()
+    host = (
+        PaxosModelCfg(client_count=clients, server_count=3)
+        .into_model()
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
     host_dur = time.monotonic() - t0
     host_sps = host.state_count() / host_dur
 
     # -- device resident search ------------------------------------------------
-    search = ResidentSearch(TensorTwoPhaseSys(rm), batch_size=1024, table_log2=16)
+    search = ResidentSearch(
+        TensorPaxos(client_count=clients), batch_size=2048, table_log2=16
+    )
     search.run()  # compile + warm-up dispatch
     best = None
     for _ in range(3):
@@ -42,12 +52,13 @@ def main() -> None:
         best.unique_state_count,
         host.unique_state_count(),
     )
+    assert best.state_count == host.state_count()
     sps = best.state_count / best.duration
 
     print(
         json.dumps(
             {
-                "metric": f"2pc-{rm} generated states/sec (device, whole search)",
+                "metric": f"paxos-{clients} generated states/sec (device, whole search, on-device linearizability)",
                 "value": round(sps, 1),
                 "unit": "states/sec",
                 "vs_baseline": round(sps / host_sps, 3),

@@ -84,6 +84,14 @@ class TensorModel:
         """Label for taking action slot `action_index` in the state `row`."""
         return action_index
 
+    def format_action(self, action) -> str:
+        """Display hook used by `Path.format` (tensor paths carry the
+        `action_label` values as their actions)."""
+        return str(action)
+
+    def format_step(self, last_state, action) -> Any:
+        return None
+
     def property_by_name(self, name: str) -> TensorProperty:
         for p in self.properties():
             if p.name == name:

@@ -1,0 +1,69 @@
+"""Count-parity and discovery-parity tests for the device Paxos encoding
+against the host actor model (the exact-unique-state-count oracle strategy,
+SURVEY.md §4; golden 16,668 @ 2 clients, ref: examples/paxos.rs:327,351)."""
+
+import numpy as np
+import pytest
+
+from stateright_tpu.tensor.paxos import TensorPaxos
+
+
+def test_vocab_tables_consistent():
+    m = TensorPaxos(client_count=2)
+    assert m.V == len(m._TYP)
+    # Every Prepared id decodes back to its fields.
+    b, d, la = 3, 1, 7
+    i = m.PREPARED0 + ((b - 1) * 2 + d) * m.NLA + la
+    assert m._TYP[i] == 5 and m._BAL[i] == b and m._LA[i] == la
+    lead = (b - 1) % 3
+    assert m._DST[i] == lead
+    assert m._SRC[i] == d + (d >= lead)
+
+
+def test_expand_first_steps_match_host_shape():
+    m = TensorPaxos(client_count=2)
+    init = np.asarray(m.init_states())
+    succs, valid = m.expand(init)
+    # Two in-flight Puts -> exactly two valid deliveries from the init state.
+    assert int(np.asarray(valid).sum()) == 2
+
+
+@pytest.mark.slow
+def test_paxos2_golden_counts():
+    """Full search parity: 16,668 unique states AND the same generated-state
+    count as the host checker on the identical model."""
+    from stateright_tpu.examples.paxos import PaxosModelCfg
+    from stateright_tpu.tensor.resident import ResidentSearch
+
+    host = (
+        PaxosModelCfg(client_count=2, server_count=3)
+        .into_model()
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    dev = ResidentSearch(TensorPaxos(client_count=2), batch_size=2048, table_log2=16).run()
+    assert dev.unique_state_count == host.unique_state_count() == 16668
+    assert dev.state_count == host.state_count()
+    # Host discovers "value chosen" (sometimes) and never violates
+    # "linearizable"; the device search must agree.
+    assert set(dev.discoveries) == set(
+        p for p in host.discoveries()
+    ) == {"value chosen"}
+
+
+def test_linearizability_mask_spot_checks():
+    """Drive the device search a few steps and compare the linearizability
+    mask against the host tester on identical logical states, via the states
+    the two searches agree on structurally (checked by the golden test); here
+    we at least pin the init state and an immediate successor."""
+    import jax.numpy as jnp
+
+    m = TensorPaxos(client_count=2)
+    lin = m.property_by_name("linearizable")
+    init = m.init_states()
+    assert bool(np.asarray(lin.condition(m, init))[0])  # empty history: OK
+    succs, valid = m.expand(init)
+    rows = np.asarray(succs)[0][np.asarray(valid)[0]]
+    masks = np.asarray(lin.condition(m, jnp.asarray(rows)))
+    assert masks.all()  # one Prepare broadcast deep: still linearizable
