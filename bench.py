@@ -1,71 +1,306 @@
-"""Benchmark: device whole-search checker vs host BFS on the Paxos register
-workload (BASELINE.json metric: states/sec/chip on Paxos; golden 16,668
-unique states @ 2 clients, ref: examples/paxos.rs:327,351).
+"""Benchmark: device whole-search checker vs the compiled CPU baseline on the
+BASELINE.json metric workloads — Paxos-3 (north star) and 2PC-4 — plus the
+reference's 2-client Paxos golden config as the parity anchor.
 
-Runs the host multithread-free Python BFS checker on the 2-client / 3-server
-Paxos actor model (linearizability-tested register), then the device-resident
-whole-search engine on the tensor encoding of the SAME system — including the
-on-device linearizability property — asserts exact unique/generated-state
-count parity, and reports generated states/sec with `vs_baseline` = the ratio
-against the locally-measured host BFS (the reference publishes no absolute
-numbers — BASELINE.md).
+Baseline: this image has no cargo/rustc, so the reference's multithreaded Rust
+`BfsChecker` (the thing BASELINE.md says to measure via bench.sh) is
+approximated by `stateright_tpu/_native/baseline_bfs.cpp` — a C++ port of the
+same search over the same state spaces, validated against the reference's
+golden counts (2pc-3=288, 2pc-5=8,832, paxos-2=16,668 — examples/2pc.rs:153-159,
+examples/paxos.rs:327). It packs states into u32 lanes, so it does *less* work
+per state than the Rust checker's boxed states: a conservative baseline.
 
-Prints exactly one JSON line.
+Robustness contract (VERDICT round 1): exactly ONE JSON line is printed on
+stdout no matter what. The device is probed with a trivial jitted op (with
+retries) before any search kernel compiles; if the device is unusable the line
+carries the CPU baseline number and a `device_error` field instead of dying
+with rc=1 and no output. Count-parity failures are reported in an `error`
+field (never a bare `assert`, which `python -O` would strip).
 """
 
 from __future__ import annotations
 
 import json
+import re
+import subprocess
+import sys
 import time
+import traceback
+
+# Golden counts (generated, unique): reference examples/paxos.rs:327 for
+# paxos-2; 2pc-4 and paxos-3 were computed by the compiled baseline checker
+# and cross-validated against the device engines (BASELINE_MEASURED.md).
+GOLDEN = {
+    ("paxos", 2): (32_971, 16_668),
+    ("paxos", 3): (2_420_477, 1_194_428),
+    ("2pc", 4): (8_258, 1_568),
+}
 
 
-def main() -> None:
-    from stateright_tpu.examples.paxos import PaxosModelCfg
-    from stateright_tpu.tensor.paxos import TensorPaxos
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+# -- compiled CPU baseline -----------------------------------------------------
+
+
+def compile_baseline() -> str | None:
+    try:
+        from stateright_tpu._native import build
+
+        return build("baseline_bfs", exe=True)
+    except Exception as e:  # noqa: BLE001 — baseline is best-effort
+        log(f"baseline compile failed: {e}")
+        return None
+
+
+def run_baseline(exe: str, model: str, n: int, repeats: int = 3):
+    """Best-of-N run of the compiled checker. Returns dict or None; keeps the
+    best run that *succeeded* even if later repeats fail."""
+    best = None
+    for _ in range(repeats):
+        try:
+            proc = subprocess.run(
+                [exe, model, str(n)],
+                check=True,
+                capture_output=True,
+                text=True,
+                timeout=1800,
+            )
+        except Exception as e:  # noqa: BLE001
+            log(f"baseline run {model}-{n} failed: {e}")
+            continue
+        m = re.search(
+            r"states=(\d+) unique=(\d+) depth=(\d+) sec=([\d.]+) threads=(\d+) "
+            r"violations=(\d+)",
+            proc.stdout,
+        )
+        if not m:
+            log(f"baseline output unparseable: {proc.stdout!r}")
+            continue
+        r = {
+            "states": int(m.group(1)),
+            "unique": int(m.group(2)),
+            "depth": int(m.group(3)),
+            "sec": float(m.group(4)),
+            "threads": int(m.group(5)),
+            "violations": int(m.group(6)),
+        }
+        if best is None or r["sec"] < best["sec"]:
+            best = r
+    if best:
+        best["states_per_sec"] = best["states"] / max(best["sec"], 1e-9)
+    return best
+
+
+# -- device ----------------------------------------------------------------
+
+
+_PROBE_SNIPPET = (
+    "import jax, jax.numpy as jnp;"
+    "x = jax.jit(lambda a: a * 2 + 1)(jnp.arange(8));"
+    "x.block_until_ready();"
+    "print('PROBE_OK', jax.devices())"
+)
+
+
+def probe_device(attempts: int = 6, delay: float = 20.0):
+    """Run a trivial jitted op on the default backend in a SUBPROCESS;
+    returns (ok, error).
+
+    The axon TPU tunnel is single-client: while any other process holds the
+    chip, backend init fails with "UNAVAILABLE: TPU backend setup/compile
+    error" (the round-1 bench death). That clears when the holder exits, so
+    the probe retries patiently — and in a fresh subprocess each time, because
+    a failed backend init can be cached for the life of a process, which would
+    make in-process retries (and the real run afterwards) futile.
+    """
+    last = "unknown"
+    for i in range(attempts):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _PROBE_SNIPPET],
+                capture_output=True,
+                text=True,
+                timeout=300,
+            )
+        except Exception as e:  # noqa: BLE001
+            last = f"probe subprocess failed: {e}"
+            log(last)
+            if i + 1 < attempts:
+                time.sleep(delay)
+            continue
+        if proc.returncode == 0 and "PROBE_OK" in proc.stdout:
+            log(f"device probe ok: {proc.stdout.strip()}")
+            return True, ""
+        tail = (proc.stderr or proc.stdout).strip().splitlines()
+        last = tail[-1] if tail else f"rc={proc.returncode}"
+        log(f"device probe attempt {i + 1}/{attempts} failed: {last}")
+        if i + 1 < attempts:
+            time.sleep(delay)
+    return False, last
+
+
+def device_search(model_name: str, n: int, repeats: int = 3):
+    """Run the resident engine; returns (result dict, parity error or None)."""
     from stateright_tpu.tensor.resident import ResidentSearch
 
-    clients = 2
+    if model_name == "paxos":
+        from stateright_tpu.tensor.paxos import TensorPaxos
 
-    # -- host BFS baseline (pure Python, same model) ---------------------------
+        model = TensorPaxos(client_count=n)
+        batch, table_log2 = (2048, 16) if n <= 2 else (8192, 22)
+    else:
+        from stateright_tpu.tensor.models import TensorTwoPhaseSys
+
+        model = TensorTwoPhaseSys(n)
+        batch, table_log2 = 512, 14
+
+    search = ResidentSearch(model, batch_size=batch, table_log2=table_log2)
     t0 = time.monotonic()
-    host = (
-        PaxosModelCfg(client_count=clients, server_count=3)
-        .into_model()
-        .checker()
-        .spawn_bfs()
-        .join()
-    )
-    host_dur = time.monotonic() - t0
-    host_sps = host.state_count() / host_dur
-
-    # -- device resident search ------------------------------------------------
-    search = ResidentSearch(
-        TensorPaxos(client_count=clients), batch_size=2048, table_log2=16
-    )
-    search.run()  # compile + warm-up dispatch
+    first = search.run()  # compile + warm-up
+    compile_s = time.monotonic() - t0
     best = None
-    for _ in range(3):
+    for _ in range(repeats):
         r = search.run()
         if best is None or r.duration < best.duration:
             best = r
-    assert best.unique_state_count == host.unique_state_count(), (
-        best.unique_state_count,
-        host.unique_state_count(),
-    )
-    assert best.state_count == host.state_count()
-    sps = best.state_count / best.duration
-
-    print(
-        json.dumps(
-            {
-                "metric": f"paxos-{clients} generated states/sec (device, whole search, on-device linearizability)",
-                "value": round(sps, 1),
-                "unit": "states/sec",
-                "vs_baseline": round(sps / host_sps, 3),
-            }
+    gen_gold, uniq_gold = GOLDEN[(model_name, n)]
+    err = None
+    if (best.state_count, best.unique_state_count) != (gen_gold, uniq_gold):
+        err = (
+            f"{model_name}-{n} parity failure: device "
+            f"(gen={best.state_count}, unique={best.unique_state_count}) != "
+            f"golden (gen={gen_gold}, unique={uniq_gold})"
         )
+    return {
+        "states": best.state_count,
+        "unique": best.unique_state_count,
+        "sec": round(best.duration, 4),
+        "states_per_sec": best.state_count / max(best.duration, 1e-9),
+        "compile_sec": round(compile_s, 1),
+    }, err
+
+
+# -- main ----------------------------------------------------------------------
+
+
+def main() -> int:
+    detail: dict = {}
+    errors: list[str] = []
+
+    exe = compile_baseline()
+    base = {}
+    if exe:
+        for model, n in (("paxos", 2), ("paxos", 3), ("2pc", 4)):
+            r = run_baseline(exe, model, n)
+            if r:
+                gen_gold, uniq_gold = GOLDEN[(model, n)]
+                if (r["states"], r["unique"]) != (gen_gold, uniq_gold):
+                    errors.append(
+                        f"baseline {model}-{n} golden mismatch: "
+                        f"(gen={r['states']}, unique={r['unique']}) != "
+                        f"(gen={gen_gold}, unique={uniq_gold})"
+                    )
+                if r["violations"]:
+                    errors.append(
+                        f"baseline {model}-{n} reported {r['violations']} "
+                        "property violations (expected none)"
+                    )
+                base[f"{model}-{n}"] = r
+                log(
+                    f"baseline {model}-{n}: {r['states']} states in "
+                    f"{r['sec']}s ({r['states_per_sec']:.0f}/s, "
+                    f"{r['threads']} threads)"
+                )
+    detail["cpu_baseline"] = {
+        k: {
+            "states_per_sec": round(v["states_per_sec"], 1),
+            "sec": v["sec"],
+            "threads": v["threads"],
+        }
+        for k, v in base.items()
+    }
+
+    device_error = None
+    dev: dict = {}
+    ok, probe_err = probe_device()
+    if not ok:
+        device_error = f"device probe failed: {probe_err}"
+    else:
+        # Smallest-to-largest: each validated workload de-risks the next.
+        for model, n in (("2pc", 4), ("paxos", 2), ("paxos", 3)):
+            try:
+                r, perr = device_search(model, n)
+                if perr:
+                    errors.append(perr)
+                dev[f"{model}-{n}"] = r
+                log(
+                    f"device {model}-{n}: {r['states']} states in {r['sec']}s "
+                    f"({r['states_per_sec']:.0f}/s, compile {r['compile_sec']}s)"
+                )
+            except Exception:  # noqa: BLE001
+                device_error = traceback.format_exc(limit=3).strip().splitlines()[-1]
+                log(f"device {model}-{n} failed:\n{traceback.format_exc(limit=5)}")
+                break
+    detail["device"] = {
+        k: {"states_per_sec": round(v["states_per_sec"], 1), "sec": v["sec"]}
+        for k, v in dev.items()
+    }
+
+    # Headline: Paxos-3 (the BASELINE.json north-star workload).
+    headline_dev = dev.get("paxos-3")
+    headline_base = base.get("paxos-3")
+    if headline_dev is not None:
+        value = headline_dev["states_per_sec"]
+        metric = (
+            "paxos-3 generated states/sec (device whole-search, on-device "
+            "linearizability; 1,194,428 unique states)"
+        )
+    elif headline_base is not None:
+        value = headline_base["states_per_sec"]
+        metric = "paxos-3 generated states/sec (CPU baseline only; device unavailable)"
+    else:
+        value = 0.0
+        metric = "paxos-3 states/sec (no engine available)"
+    vs_baseline = (
+        round(value / headline_base["states_per_sec"], 3)
+        if headline_base and value
+        else None
     )
+
+    out = {
+        "metric": metric,
+        "value": round(value, 1),
+        "unit": "states/sec",
+        "vs_baseline": vs_baseline,
+        "detail": detail,
+    }
+    if device_error:
+        out["device_error"] = device_error
+    if errors:
+        out["error"] = "; ".join(errors)
+    print(json.dumps(out), flush=True)
+    return 1 if errors else 0
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        sys.exit(main())
+    except Exception:  # noqa: BLE001 — the one-JSON-line contract is absolute
+        traceback.print_exc()
+        print(
+            json.dumps(
+                {
+                    "metric": "paxos-3 states/sec",
+                    "value": 0.0,
+                    "unit": "states/sec",
+                    "vs_baseline": None,
+                    "error": traceback.format_exc(limit=2)
+                    .strip()
+                    .splitlines()[-1],
+                }
+            ),
+            flush=True,
+        )
+        sys.exit(1)

@@ -23,20 +23,40 @@ _lock = threading.Lock()
 _cache: dict = {}
 
 
-def _compile(name: str) -> str:
+def build(name: str, *, exe: bool = False, timeout: float = 300.0) -> str:
+    """Compile `<name>.cpp` into the build cache (keyed by source mtime) and
+    return the artifact path. `exe=False` builds a shared object for ctypes;
+    `exe=True` builds a standalone optimized executable (used by the bench
+    harness for the CPU baseline checker)."""
     src = os.path.join(_DIR, f"{name}.cpp")
-    out = os.path.join(_BUILD, f"{name}.so")
+    out = os.path.join(_BUILD, name + ("" if exe else ".so"))
     if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
         return out
     os.makedirs(_BUILD, exist_ok=True)
-    tmp = out + ".tmp"
-    subprocess.run(
-        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp, src],
-        check=True,
-        capture_output=True,
+    flags = (
+        ["-O3", "-march=native", "-pthread"]
+        if exe
+        else ["-O2", "-shared", "-fPIC"]
     )
-    os.replace(tmp, out)
+    # Per-process temp name so concurrent compiles can't interleave output;
+    # os.replace makes the publish atomic.
+    tmp = f"{out}.{os.getpid()}.tmp"
+    try:
+        subprocess.run(
+            ["g++", "-std=c++17", *flags, "-o", tmp, src],
+            check=True,
+            capture_output=True,
+            timeout=timeout,
+        )
+        os.replace(tmp, out)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
     return out
+
+
+def _compile(name: str) -> str:
+    return build(name)
 
 
 def load(name: str):

@@ -4,6 +4,13 @@ Instantiated via `Model.checker()`; fluent config then one of the `spawn_*`
 methods. Beyond the reference's strategies (bfs/dfs/on_demand/simulation), this
 builder adds `spawn_tpu()` — the batched device frontier checker — behind the
 same `Checker` interface, the plug-in boundary BASELINE.json requires.
+
+Memory note: consistency-tester properties (linearizability / sequential
+consistency) memoize serialization verdicts in bounded process-global caches
+(2^15 entries each) that retain tester histories after a run completes; a
+long-lived process checking many unrelated models can call
+`stateright_tpu.semantics.clear_serialization_caches()` between runs to
+release them.
 """
 
 from __future__ import annotations

@@ -3,7 +3,7 @@ all-to-all frontier exchange that replaces the reference's work-stealing job
 market (ref: src/job_market.rs) with XLA collectives over ICI/DCN.
 """
 
-from ..tensor import *  # noqa: F401,F403 — enables x64 before any kernel code
+from ..tensor import *  # noqa: F401,F403 — re-export the tensor core surface
 from .sharded import ShardedSearch, make_mesh
 
 __all__ = ["ShardedSearch", "make_mesh"]

@@ -3,21 +3,25 @@ all-to-all successor exchange.
 
 This is the TPU-native replacement for the reference's work-stealing job
 market (ref: src/job_market.rs:149-176): instead of idle threads stealing
-slices of a shared deque, every chip owns the fingerprint range
-`owner(fp) == axis_index` and each expansion step ends with one
+slices of a shared deque, every chip owns a fingerprint range
+(`owner(fp) == axis_index`) and each expansion step ends with one
 `lax.all_to_all` that routes every generated successor to its owner chip.
 Termination detection replaces the market's `open_count` quiescence protocol
 (ref: src/job_market.rs:109-127) with a `psum` of per-chip queue occupancy;
 discovery early-exit (`HasDiscoveries`, ref: src/has_discoveries.rs:5-42)
 becomes an all-gather + OR of per-chip discovery bitmasks. The whole search —
-queue pop, property masks, expansion, shuffle, dedup, hash-table insert —
-runs as ONE `lax.while_loop` inside ONE `shard_map`-over-`Mesh` dispatch, so
-multi-host meshes ride ICI/DCN with zero host round-trips mid-search.
+queue pop, property masks, expansion, shuffle, insert — runs as ONE
+`lax.while_loop` inside ONE `shard_map`-over-`Mesh` dispatch, so multi-host
+meshes ride ICI/DCN with zero host round-trips mid-search.
+
+Everything is 32-bit on device (u32 fingerprint pairs; u32-pair counters) —
+TPUs emulate 64-bit integer ops, so the round-1 u64 design paid emulation tax
+on every hot op.
 
 Sharding invariants:
-- `owner(fp) = (fp >> 32) % n_chips` uses the HIGH fingerprint bits while the
-  per-chip table slot uses the LOW bits (`fp & (slots-1)`), so sharding does
-  not skew table occupancy.
+- `owner(fp) = fp.lo % n_chips` while the per-chip table bucket uses
+  `fp.hi % n_buckets` (tensor/hashtable.py), so sharding does not skew table
+  occupancy even when both are powers of two.
 - Each unique state is inserted/enqueued on exactly one chip, so per-chip
   `state_count`/`unique_count` sum to the global totals, and the per-chip
   queue can never hold more rows than the per-chip table has slots (the same
@@ -25,6 +29,9 @@ Sharding invariants:
 - The all-to-all send buffer reserves `dest_capacity` rows per destination;
   the sound default (batch_size * max_actions) can never overflow because one
   step generates at most that many successors in total.
+- Routing positions come from per-destination cumsums (static unroll over the
+  N destinations), not a sort: the received batch may contain duplicates and
+  the hash-table insert resolves them (phase-3 arena).
 """
 
 from __future__ import annotations
@@ -38,10 +45,15 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..tensor.fingerprint import pack_fp
 from ..core.discovery import HasDiscoveries
 from ..core.model import Expectation
 from ..tensor.frontier import (
     SearchResult,
+    append_new,
+    count_add,
+    count_ge,
+    pop_batch,
     reconstruct_path,
     record_discovery as _record_impl,
     seed_init,
@@ -50,8 +62,6 @@ from ..tensor.frontier import (
 from ..tensor.hashtable import _insert_impl
 from ..tensor.model import TensorModel
 from ..tensor.resident import _finish_masks
-
-_MAX_U64 = jnp.uint64(0xFFFFFFFFFFFFFFFF)
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "d") -> Mesh:
@@ -69,22 +79,27 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "d") -> Mesh:
 
 
 class _Carry(NamedTuple):
-    keys: jnp.ndarray  # uint64[S]      per-chip table shard
-    parents: jnp.ndarray  # uint64[S]
-    q_states: jnp.ndarray  # uint32[Q, L]  per-chip frontier ring buffer
-    q_fps: jnp.ndarray  # uint64[Q]
+    t_lo: jnp.ndarray  # uint32[S]   per-chip table shard
+    t_hi: jnp.ndarray  # uint32[S]
+    p_lo: jnp.ndarray  # uint32[S]
+    p_hi: jnp.ndarray  # uint32[S]
+    q_states: jnp.ndarray  # uint32[Q, L]  per-chip frontier queue
+    q_lo: jnp.ndarray  # uint32[Q]
+    q_hi: jnp.ndarray  # uint32[Q]
     q_ebits: jnp.ndarray  # uint32[Q]
     q_depth: jnp.ndarray  # uint32[Q]
-    head: jnp.ndarray  # int64
-    tail: jnp.ndarray  # int64
-    state_count: jnp.ndarray  # int64 (local; host sums shards)
-    unique_count: jnp.ndarray  # int64 (local)
+    head: jnp.ndarray  # int32
+    tail: jnp.ndarray  # int32
+    gen_lo: jnp.ndarray  # uint32 GLOBAL generated-count pair (identical on all chips)
+    gen_hi: jnp.ndarray  # uint32
+    unique_count: jnp.ndarray  # int32 (local; host sums shards)
     max_depth: jnp.ndarray  # uint32 (local)
     discovered: jnp.ndarray  # uint32 global OR of discovery bits
-    disc_fps: jnp.ndarray  # uint64[P] locally-witnessed discovery fps
+    disc_lo: jnp.ndarray  # uint32[P] locally-witnessed discovery fps
+    disc_hi: jnp.ndarray  # uint32[P]
     cont: jnp.ndarray  # bool global continue flag
     overflow: jnp.ndarray  # bool (local table/routing overflow)
-    steps: jnp.ndarray  # int64
+    steps: jnp.ndarray  # int32
 
 
 class ShardedSearch:
@@ -136,42 +151,47 @@ class ShardedSearch:
         ebits0 = np.uint32(sum(1 << i for i in eventually_i))
         all_bits = jnp.uint32((1 << P_) - 1)
 
-        def owner_of(fps):
-            return ((fps >> jnp.uint64(32)) % jnp.uint64(N)).astype(jnp.int32)
+        def owner_of(lo, _hi):
+            # lo selects the chip; hi selects the in-table bucket — keeping
+            # the two independent avoids occupancy skew (module docstring).
+            return (lo % jnp.uint32(N)).astype(jnp.int32)
 
         _record = _record_impl
 
         def per_chip(
             init_states,  # uint32[K, L] replicated
-            init_fps,  # uint64[K] replicated
+            init_lo,  # uint32[K] replicated
+            init_hi,  # uint32[K] replicated
             init_active,  # bool[K] replicated
-            target_state_count,  # int64 replicated
-            n_raw_seed,  # int64 replicated
+            target_lo,  # uint32 replicated (pair; 0,0 = none)
+            target_hi,
+            seed_lo,  # uint32 replicated: pre-dedup init count pair
+            seed_hi,
             required_mask,  # uint32 replicated
             any_mask,  # uint32 replicated
-            max_steps,  # int64 replicated
+            max_steps,  # int32 replicated
         ):
             me = jax.lax.axis_index(ax)
 
             # -- seed: each chip keeps only the init states it owns ------------
-            mine = init_active & (owner_of(init_fps) == me)
-            keys = jnp.zeros(S, dtype=jnp.uint64)
-            parents = jnp.zeros(S, dtype=jnp.uint64)
-            keys, parents, is_new, ovf0 = _insert_impl(
-                keys, parents, init_fps, jnp.zeros(K, dtype=jnp.uint64), mine
+            mine = init_active & (owner_of(init_lo, init_hi) == me)
+            t_lo = jnp.zeros(S, dtype=jnp.uint32)
+            t_hi = jnp.zeros(S, dtype=jnp.uint32)
+            p_lo = jnp.zeros(S, dtype=jnp.uint32)
+            p_hi = jnp.zeros(S, dtype=jnp.uint32)
+            zero_k = jnp.zeros(K, dtype=jnp.uint32)
+            t_lo, t_hi, p_lo, p_hi, is_new0, ovf0 = _insert_impl(
+                t_lo, t_hi, p_lo, p_hi, init_lo, init_hi, zero_k, zero_k, mine
             )
-            order0 = jnp.argsort(~mine, stable=True)
-            n0 = mine.sum().astype(jnp.int64)
-            slot = jnp.arange(K, dtype=jnp.int64)
-            qpos = jnp.where(slot < n0, slot, Q)
+            n0 = mine.sum().astype(jnp.int32)
+            pos_all = jnp.cumsum(mine.astype(jnp.int32)) - 1
+            qpos = jnp.where(mine, pos_all, Q)
             q_states = (
                 jnp.zeros((Q, L), dtype=jnp.uint32)
-                .at[qpos].set(init_states[order0], mode="drop")
+                .at[qpos].set(init_states, mode="drop")
             )
-            q_fps = (
-                jnp.zeros(Q, dtype=jnp.uint64)
-                .at[qpos].set(init_fps[order0], mode="drop")
-            )
+            q_lo = jnp.zeros(Q, dtype=jnp.uint32).at[qpos].set(init_lo, mode="drop")
+            q_hi = jnp.zeros(Q, dtype=jnp.uint32).at[qpos].set(init_hi, mode="drop")
             q_ebits = (
                 jnp.zeros(Q, dtype=jnp.uint32)
                 .at[qpos].set(jnp.uint32(ebits0), mode="drop")
@@ -182,32 +202,29 @@ class ShardedSearch:
             )
 
             def body(c: _Carry) -> _Carry:
-                # -- pop a local batch -----------------------------------------
-                avail = c.tail - c.head
-                take = jnp.minimum(avail, K)
-                pos = (c.head + jnp.arange(K, dtype=jnp.int64)) % Q
-                active = jnp.arange(K) < take
-                states = c.q_states[pos]
-                fps = c.q_fps[pos]
-                ebits = c.q_ebits[pos]
-                depth = c.q_depth[pos]
-                head = c.head + take
+                # -- pop a local batch (contiguous; queue never wraps) ---------
+                states, lo, hi, ebits, depth, active, head = pop_batch(
+                    c.q_states, c.q_lo, c.q_hi, c.q_ebits, c.q_depth,
+                    c.head, c.tail, K,
+                )
                 max_depth = jnp.maximum(
                     c.max_depth, jnp.max(jnp.where(active, depth, 0))
                 )
 
                 # -- property masks on popped states (bfs.rs:230-280) ----------
                 discovered = c.discovered
-                disc_fps = c.disc_fps
+                disc_lo, disc_hi = c.disc_lo, c.disc_hi
                 if P_:
                     masks = jnp.stack([p.condition(model, states) for p in props])
                     for i in always_i:
-                        discovered, disc_fps = _record(
-                            discovered, disc_fps, i, active & ~masks[i], fps
+                        discovered, disc_lo, disc_hi = _record(
+                            discovered, disc_lo, disc_hi, i,
+                            active & ~masks[i], lo, hi,
                         )
                     for i in sometimes_i:
-                        discovered, disc_fps = _record(
-                            discovered, disc_fps, i, active & masks[i], fps
+                        discovered, disc_lo, disc_hi = _record(
+                            discovered, disc_lo, disc_hi, i,
+                            active & masks[i], lo, hi,
                         )
                     for i in eventually_i:
                         ebits = jnp.where(
@@ -221,7 +238,7 @@ class ShardedSearch:
                 valid = valid & active[:, None]
                 flat = succs.reshape(K * A, L)
                 validf = valid.reshape(-1) & model.within_boundary(flat)
-                gen = validf.sum().astype(jnp.int64)
+                gen = validf.sum().astype(jnp.int32)
                 has_succ = validf.reshape(K, A).any(axis=1)
 
                 # -- eventually counterexamples at terminal states --------------
@@ -229,36 +246,42 @@ class ShardedSearch:
                     term = active & ~has_succ
                     for i in eventually_i:
                         bad = term & ((ebits >> jnp.uint32(i)) & 1).astype(bool)
-                        discovered, disc_fps = _record(
-                            discovered, disc_fps, i, bad, fps
+                        discovered, disc_lo, disc_hi = _record(
+                            discovered, disc_lo, disc_hi, i, bad, lo, hi
                         )
 
-                # -- route successors to owner chips ---------------------------
-                sfps = state_fingerprint(model, flat)
-                owner = jnp.where(validf, owner_of(sfps), N)
-                route = jnp.argsort(owner)
-                o_s = owner[route]
-                seg_start = jnp.searchsorted(o_s, o_s, side="left")
-                idx_in_seg = jnp.arange(K * A) - seg_start
-                live = o_s < N
+                # -- route successors to owner chips (cumsum per destination) --
+                slo, shi = state_fingerprint(model, flat)
+                owner = jnp.where(validf, owner_of(slo, shi), N)
+                idx_in_seg = jnp.zeros(K * A, dtype=jnp.int32)
+                for d in range(N):  # static unroll
+                    sel = owner == d
+                    idx_in_seg = jnp.where(
+                        sel, jnp.cumsum(sel.astype(jnp.int32)) - 1, idx_in_seg
+                    )
+                live = owner < N
                 route_ovf = jnp.any(live & (idx_in_seg >= C))
                 dest = jnp.where(
-                    live & (idx_in_seg < C), o_s * C + idx_in_seg, N * C
+                    live & (idx_in_seg < C), owner * C + idx_in_seg, N * C
                 )
-                parent_rep = jnp.repeat(fps, A)[route]
-                ebits_rep = jnp.repeat(ebits, A)[route]
-                depth_rep = jnp.repeat(depth + 1, A)[route]
+                parent_lo = jnp.repeat(lo, A)
+                parent_hi = jnp.repeat(hi, A)
+                ebits_rep = jnp.repeat(ebits, A)
+                depth_rep = jnp.repeat(depth + 1, A)
 
                 def scatter(zero, vals):
                     return zero.at[dest].set(vals, mode="drop")
 
+                zero_nc = jnp.zeros(N * C, dtype=jnp.uint32)
                 s_states = scatter(
-                    jnp.zeros((N * C, L), dtype=jnp.uint32), flat[route]
+                    jnp.zeros((N * C, L), dtype=jnp.uint32), flat
                 )
-                s_fps = scatter(jnp.zeros(N * C, dtype=jnp.uint64), sfps[route])
-                s_parent = scatter(jnp.zeros(N * C, dtype=jnp.uint64), parent_rep)
-                s_ebits = scatter(jnp.zeros(N * C, dtype=jnp.uint32), ebits_rep)
-                s_depth = scatter(jnp.zeros(N * C, dtype=jnp.uint32), depth_rep)
+                s_lo = scatter(zero_nc, slo)
+                s_hi = scatter(zero_nc, shi)
+                s_plo = scatter(zero_nc, parent_lo)
+                s_phi = scatter(zero_nc, parent_hi)
+                s_ebits = scatter(zero_nc, ebits_rep)
+                s_depth = scatter(zero_nc, depth_rep)
                 s_valid = scatter(jnp.zeros(N * C, dtype=bool), live)
 
                 def shuffle(x):
@@ -267,53 +290,51 @@ class ShardedSearch:
                     ).reshape(N * C, *x.shape[1:])
 
                 r_states = shuffle(s_states)
-                r_fps = shuffle(s_fps)
-                r_parent = shuffle(s_parent)
+                r_lo = shuffle(s_lo)
+                r_hi = shuffle(s_hi)
+                r_plo = shuffle(s_plo)
+                r_phi = shuffle(s_phi)
                 r_ebits = shuffle(s_ebits)
                 r_depth = shuffle(s_depth)
                 r_valid = shuffle(s_valid)
 
-                # -- dedup received batch + insert into the local shard --------
-                sort_key = jnp.where(r_valid, r_fps, _MAX_U64)
-                order = jnp.argsort(sort_key)
-                so = sort_key[order]
-                uniq = so != jnp.roll(so, 1)
-                uniq = uniq.at[0].set(True) & (so != _MAX_U64)
-                keys2, parents2, is_new, ins_ovf = _insert_impl(
-                    c.keys, c.parents, so, r_parent[order], uniq
+                # -- insert into the local shard (handles duplicates) ----------
+                t_lo2, t_hi2, p_lo2, p_hi2, is_new, ins_ovf = _insert_impl(
+                    c.t_lo, c.t_hi, c.p_lo, c.p_hi,
+                    r_lo, r_hi, r_plo, r_phi, r_valid,
                 )
-                rank = jnp.argsort(~is_new, stable=True)
-                sel = order[rank]
-                new_count = is_new.sum().astype(jnp.int64)
+                # -- append fresh states to the local queue (cumsum) -----------
+                q_states, q_lo, q_hi, q_ebits, q_depth, tail = append_new(
+                    c.q_states, c.q_lo, c.q_hi, c.q_ebits, c.q_depth, c.tail,
+                    r_states, r_lo, r_hi, r_ebits, r_depth, is_new,
+                )
+                new_count = tail - c.tail
 
-                # -- append fresh states to the local queue --------------------
-                slot = jnp.arange(N * C, dtype=jnp.int64)
-                qpos = jnp.where(slot < new_count, (c.tail + slot) % Q, Q)
-                q_states = c.q_states.at[qpos].set(r_states[sel], mode="drop")
-                q_fps = c.q_fps.at[qpos].set(so[rank], mode="drop")
-                q_ebits = c.q_ebits.at[qpos].set(r_ebits[sel], mode="drop")
-                q_depth = c.q_depth.at[qpos].set(r_depth[sel], mode="drop")
-                tail = c.tail + new_count
-
-                state_count = c.state_count + gen
                 unique_count = c.unique_count + new_count
-                overflow = c.overflow | route_ovf | ins_ovf
+                # tail > Q - K: see the resident engine's queue-full guard.
+                overflow = (
+                    c.overflow | route_ovf | ins_ovf | (tail > Q - K)
+                )
 
-                # -- global sync: discovery OR, termination, early exit ---------
+                # -- global sync: discovery OR, counters, termination ----------
                 gathered = jax.lax.all_gather(discovered, ax)
                 discovered = gathered[0]
                 for i in range(1, N):  # static unroll: global OR of bitmasks
                     discovered = discovered | gathered[i]
+                g_gen_step = jax.lax.psum(gen, ax)  # < 2^31 per step
+                gen_lo, gen_hi = count_add(
+                    c.gen_lo, c.gen_hi, g_gen_step.astype(jnp.uint32)
+                )
                 g_pending = jax.lax.psum(tail - head, ax)
-                g_states = jax.lax.psum(state_count, ax)
                 g_overflow = jax.lax.psum(overflow.astype(jnp.int32), ax) > 0
                 all_found = (P_ > 0) & (discovered == all_bits)
                 policy = (
                     (required_mask != 0)
                     & ((discovered & required_mask) == required_mask)
                 ) | ((discovered & any_mask) != 0)
-                count_hit = (target_state_count > 0) & (
-                    g_states >= target_state_count
+                have_target = (target_lo | target_hi) != 0
+                count_hit = have_target & count_ge(
+                    gen_lo, gen_hi, target_lo, target_hi
                 )
                 steps = c.steps + 1
                 cont = (
@@ -326,57 +347,63 @@ class ShardedSearch:
                 )
 
                 return _Carry(
-                    keys=keys2,
-                    parents=parents2,
+                    t_lo=t_lo2,
+                    t_hi=t_hi2,
+                    p_lo=p_lo2,
+                    p_hi=p_hi2,
                     q_states=q_states,
-                    q_fps=q_fps,
+                    q_lo=q_lo,
+                    q_hi=q_hi,
                     q_ebits=q_ebits,
                     q_depth=q_depth,
                     head=head,
                     tail=tail,
-                    state_count=state_count,
+                    gen_lo=gen_lo,
+                    gen_hi=gen_hi,
                     unique_count=unique_count,
                     max_depth=max_depth,
                     discovered=discovered,
-                    disc_fps=disc_fps,
+                    disc_lo=disc_lo,
+                    disc_hi=disc_hi,
                     cont=cont,
                     overflow=overflow,
                     steps=steps,
                 )
 
-            # Every chip holds the same replicated init batch; count the
-            # raw seed once (chip 0) so shard sums match the host totals.
-            state_count0 = jnp.where(me == 0, n_raw_seed, jnp.int64(0))
+            # The seed counter pair is global (identical on every chip).
             # Stop conditions that can already hold at seed time (empty init
-            # set, target_state_count <= seed count, max_steps == 0, seed
-            # overflow) must prevent the first expansion step, matching the
-            # resident engine's check-cond-before-first-body semantics.
+            # set, target <= seed count, max_steps == 0, seed overflow) must
+            # prevent the first expansion step, matching the resident
+            # engine's check-cond-before-first-body semantics.
+            have_target0 = (target_lo | target_hi) != 0
             cont0 = (
                 (jax.lax.psum(n0, ax) > 0)
-                & ~(
-                    (target_state_count > 0)
-                    & (jax.lax.psum(state_count0, ax) >= target_state_count)
-                )
+                & ~(have_target0 & count_ge(seed_lo, seed_hi, target_lo, target_hi))
                 & ~(jax.lax.psum(ovf0.astype(jnp.int32), ax) > 0)
                 & (max_steps > 0)
             )
             carry = _Carry(
-                keys=keys,
-                parents=parents,
+                t_lo=t_lo,
+                t_hi=t_hi,
+                p_lo=p_lo,
+                p_hi=p_hi,
                 q_states=q_states,
-                q_fps=q_fps,
+                q_lo=q_lo,
+                q_hi=q_hi,
                 q_ebits=q_ebits,
                 q_depth=q_depth,
-                head=jnp.int64(0),
+                head=jnp.int32(0),
                 tail=n0,
-                state_count=state_count0,
-                unique_count=is_new.sum().astype(jnp.int64),
+                gen_lo=seed_lo,
+                gen_hi=seed_hi,
+                unique_count=is_new0.sum().astype(jnp.int32),
                 max_depth=jnp.uint32(0),
                 discovered=jnp.uint32(0),
-                disc_fps=jnp.zeros(max(P_, 1), dtype=jnp.uint64),
+                disc_lo=jnp.zeros(max(P_, 1), dtype=jnp.uint32),
+                disc_hi=jnp.zeros(max(P_, 1), dtype=jnp.uint32),
                 cont=cont0,
                 overflow=ovf0,
-                steps=jnp.int64(0),
+                steps=jnp.int32(0),
             )
             carry = jax.lax.while_loop(lambda c: c.cont, body, carry)
 
@@ -384,13 +411,17 @@ class ShardedSearch:
                 return x.reshape(1, *jnp.shape(x))
 
             return (
-                shard(carry.keys),
-                shard(carry.parents),
-                shard(carry.state_count),
+                shard(carry.t_lo),
+                shard(carry.t_hi),
+                shard(carry.p_lo),
+                shard(carry.p_hi),
+                shard(carry.gen_lo),
+                shard(carry.gen_hi),
                 shard(carry.unique_count),
                 shard(carry.max_depth),
                 shard(carry.discovered),
-                shard(carry.disc_fps),
+                shard(carry.disc_lo),
+                shard(carry.disc_hi),
                 shard(carry.head >= carry.tail),
                 shard(carry.overflow),
                 shard(carry.steps),
@@ -399,7 +430,7 @@ class ShardedSearch:
         sharded = jax.shard_map(
             per_chip,
             mesh=mesh,
-            in_specs=(P(), P(), P(), P(), P(), P(), P(), P()),
+            in_specs=(P(),) * 11,
             out_specs=P(ax),
             check_vma=False,
         )
@@ -413,7 +444,7 @@ class ShardedSearch:
         target_state_count: Optional[int] = None,
         target_max_depth: Optional[int] = None,
         timeout: Optional[float] = None,
-        max_steps: int = 1 << 31,
+        max_steps: int = 1 << 30,
     ) -> SearchResult:
         if target_max_depth is not None:
             raise NotImplementedError(
@@ -426,18 +457,15 @@ class ShardedSearch:
         start = time.monotonic()
         self._parent_map = None
 
-        init, init_fps, n_raw = seed_init(model)
+        init, init_lo, init_hi, n_raw = seed_init(model)
         if len(init) > K:
             raise ValueError("more init states than batch_size; raise batch_size")
         n0 = len(init)
 
         if finish_when.matches(self.props, set()) or not self.props:
             # Vacuous finish policy: stop before exploring (bfs.rs:278-280).
-            n_shards = self.n_chips
-            self._last_tables = (
-                np.zeros((n_shards, 1 << self.table_log2), dtype=np.uint64),
-                np.zeros((n_shards, 1 << self.table_log2), dtype=np.uint64),
-            )
+            z = np.zeros((self.n_chips, 1 << self.table_log2), dtype=np.uint32)
+            self._last_tables = (z, z, z, z)
             return SearchResult(
                 state_count=n_raw,
                 unique_state_count=n0,
@@ -450,51 +478,69 @@ class ShardedSearch:
 
         st = np.zeros((K, model.lanes), dtype=np.uint32)
         st[:n0] = init
-        fp = np.zeros(K, dtype=np.uint64)
-        fp[:n0] = init_fps
+        lo = np.zeros(K, dtype=np.uint32)
+        lo[:n0] = init_lo
+        hi = np.zeros(K, dtype=np.uint32)
+        hi[:n0] = init_hi
         active = np.arange(K) < n0
 
         required_mask, any_mask = _finish_masks(finish_when, self.props)
+        target = int(target_state_count or 0)
         (
-            keys,
-            parents,
-            state_counts,
+            t_lo,
+            t_hi,
+            p_lo,
+            p_hi,
+            gen_lo,
+            gen_hi,
             unique_counts,
             max_depths,
             discovered,
-            disc_fps,
+            disc_lo,
+            disc_hi,
             drained,
             overflow,
             steps,
         ) = jax.block_until_ready(
             self._kernel(
                 jnp.asarray(st),
-                jnp.asarray(fp),
+                jnp.asarray(lo),
+                jnp.asarray(hi),
                 jnp.asarray(active),
-                jnp.int64(target_state_count or 0),
-                jnp.int64(n_raw),
+                jnp.uint32(target & 0xFFFFFFFF),
+                jnp.uint32(target >> 32),
+                jnp.uint32(n_raw & 0xFFFFFFFF),
+                jnp.uint32(n_raw >> 32),
                 jnp.uint32(required_mask),
                 jnp.uint32(any_mask),
-                jnp.int64(max_steps),
+                jnp.int32(max_steps),
             )
         )
         if bool(np.asarray(overflow).any()):
             raise RuntimeError(
                 "sharded search overflow: raise table_log2 or dest_capacity"
             )
-        self._last_tables = (np.asarray(keys), np.asarray(parents))
+        self._last_tables = (
+            np.asarray(t_lo), np.asarray(t_hi),
+            np.asarray(p_lo), np.asarray(p_hi),
+        )
 
+        # The generated-count pair is globally synced (identical per shard).
+        state_count = int(np.asarray(gen_lo)[0]) | (
+            int(np.asarray(gen_hi)[0]) << 32
+        )
         # discovered is globally OR-synced, identical on every shard.
         disc_mask = int(np.asarray(discovered)[0])
-        disc_fps = np.asarray(disc_fps)  # [N, P]
+        disc_lo = np.asarray(disc_lo)  # [N, P]
+        disc_hi = np.asarray(disc_hi)
         discoveries = {}
         for i, p in enumerate(self.props):
             if disc_mask & (1 << i):
-                witnesses = disc_fps[:, i]
+                witnesses = pack_fp(disc_lo[:, i], disc_hi[:, i])
                 witnesses = witnesses[witnesses != 0]
                 discoveries[p.name] = int(witnesses[0])
         return SearchResult(
-            state_count=int(np.asarray(state_counts).sum()),
+            state_count=state_count,
             unique_state_count=int(np.asarray(unique_counts).sum()),
             max_depth=int(np.asarray(max_depths).max()),
             discoveries=discoveries,
@@ -506,9 +552,11 @@ class ShardedSearch:
     def reconstruct_path(self, fp: int):
         """Union the per-chip parent maps, then reconstruct as usual."""
         if self._parent_map is None:
-            keys, parents = self._last_tables
-            keys = keys.reshape(-1)
-            parents = parents.reshape(-1)
-            nz = keys != 0
-            self._parent_map = dict(zip(keys[nz].tolist(), parents[nz].tolist()))
+            t_lo, t_hi, p_lo, p_hi = (
+                x.reshape(-1) for x in self._last_tables
+            )
+            nz = t_lo != 0
+            keys = pack_fp(t_lo[nz], t_hi[nz])
+            parents = pack_fp(p_lo[nz], p_hi[nz])
+            self._parent_map = dict(zip(keys.tolist(), parents.tolist()))
         return reconstruct_path(self.model, self._parent_map, fp)

@@ -8,33 +8,32 @@ batched BFS (SURVEY.md §7):
   transition kernel `expand(states) -> (successors, valid_mask)` with the
   action dimension enumerated statically — one `jit` call expands thousands of
   states per step instead of one thread expanding one state at a time;
-- fingerprints are 64-bit mixes computed on device; the visited set is a
-  device-resident open-addressing hash table in HBM whose insert kernel also
-  stores parent fingerprints for TLC-style path reconstruction
-  (mirroring the parent pointers at src/checker/bfs.rs:301-315);
+- fingerprints are 64-bit identities carried as PAIRS of uint32 lanes (TPUs
+  have no native 64-bit integer ALU; see tensor/fingerprint.py) computed on
+  device; the visited set is a device-resident bucketed hash table in HBM
+  whose insert kernel also stores parent fingerprints for TLC-style path
+  reconstruction (mirroring the parent pointers at src/checker/bfs.rs:301-315);
 - property predicates are vectorized masks; eventually-bits ride along as a
   per-state bitmask lane (src/checker.rs:580-587 semantics preserved);
 - multi-chip runs shard the table by fingerprint ownership and exchange
-  successors with all_to_all collectives (stateright_tpu.tensor.sharding),
+  successors with all_to_all collectives (stateright_tpu.parallel.sharded),
   replacing the job market's work stealing.
 
-Importing this package enables 64-bit array types (needed for on-device u64
-fingerprints; TPUs emulate 64-bit integer ops).
+Everything is 32-bit on device: no `jax_enable_x64` required (the round-1
+design forced it globally and paid u64 emulation tax in every hot op).
 """
 
-import jax
-
-jax.config.update("jax_enable_x64", True)
-
-from .model import TensorModel, TensorProperty  # noqa: E402
-from .fingerprint import device_fingerprint  # noqa: E402
-from .hashtable import HashTable  # noqa: E402
-from .frontier import FrontierSearch, SearchResult  # noqa: E402
+from .model import TensorModel, TensorProperty
+from .fingerprint import device_fingerprint, pack_fp, unpack_fp
+from .hashtable import HashTable
+from .frontier import FrontierSearch, SearchResult
 
 __all__ = [
     "TensorModel",
     "TensorProperty",
     "device_fingerprint",
+    "pack_fp",
+    "unpack_fp",
     "HashTable",
     "FrontierSearch",
     "SearchResult",

@@ -1,37 +1,63 @@
-"""On-device 64-bit state fingerprinting.
+"""On-device state fingerprinting as a PAIR of uint32 lanes.
 
 The host fingerprint (blake2b over a canonical encoding,
 stateright_tpu.core.fingerprint) identifies Python states; device states are
-uint32 lane rows, identified by a splitmix64-style multiply-xor fold computed
-entirely on device. The two fingerprint domains never need to agree — parity of
-unique-state counts only requires each encoding to be injective per model
-(SURVEY.md §7 "hard parts") — but both honor the same contracts as the
+uint32 lane rows, identified by two independent 32-bit murmur3-style folds
+(= one 64-bit identity). The two fingerprint domains never need to agree —
+parity of unique-state counts only requires each encoding to be injective per
+model (SURVEY.md §7 "hard parts") — but both honor the same contracts as the
 reference's `Fingerprint` (ref: src/lib.rs:340-387): stable across
-runs/processes/chips, and nonzero (0 is the empty-slot/no-parent sentinel).
+runs/processes/chips, and nonzero.
+
+Why a u32 pair instead of one u64: TPUs have no native 64-bit integer ALU —
+XLA emulates u64 arithmetic with 32-bit pairs — so the hot sort/probe/compare
+ops on fingerprints would pay emulation cost on exactly the hardware this
+framework targets. All device code handles (lo, hi) pairs; the host packs
+them into one Python int (`pack_fp`) only at the API boundary (parent maps,
+Explorer URLs, discovery fingerprints).
+
+Sentinel contract: `lo` is forced nonzero, so a (0, *) pair never denotes a
+real state — lo==0 marks empty hash-table slots and "no parent" exactly as
+the reference's NonZeroU64 fingerprint does (ref: src/lib.rs:341).
 """
 
 from __future__ import annotations
 
+import numpy as np
+
 import jax.numpy as jnp
 
-# splitmix64 constants (public domain PRNG finalizer).
-_GOLDEN = jnp.uint64(0x9E3779B97F4A7C15)
-_MIX1 = jnp.uint64(0xBF58476D1CE4E5B9)
-_MIX2 = jnp.uint64(0x94D049BB133111EB)
+# murmur3 fmix32 constants (public domain).
+_M1 = jnp.uint32(0x85EBCA6B)
+_M2 = jnp.uint32(0xC2B2AE35)
+_GOLDEN = jnp.uint32(0x9E3779B9)
 
 
-def _mix64(h: jnp.ndarray) -> jnp.ndarray:
-    h = (h ^ (h >> jnp.uint64(30))) * _MIX1
-    h = (h ^ (h >> jnp.uint64(27))) * _MIX2
-    return h ^ (h >> jnp.uint64(31))
+def _mix32(h: jnp.ndarray) -> jnp.ndarray:
+    h = (h ^ (h >> jnp.uint32(16))) * _M1
+    h = (h ^ (h >> jnp.uint32(13))) * _M2
+    return h ^ (h >> jnp.uint32(16))
 
 
-def device_fingerprint(states: jnp.ndarray) -> jnp.ndarray:
-    """uint32[B, L] -> uint64[B], avoiding both sentinels: 0 (empty slot /
-    no parent) and 2^64-1 (the engines' invalid-lane sort key)."""
-    h = jnp.full(states.shape[0], jnp.uint64(0x5851F42D4C957F2D))
-    lanes = states.astype(jnp.uint64)
+def device_fingerprint(states: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """uint32[B, L] -> (lo uint32[B] nonzero, hi uint32[B])."""
+    lo = jnp.full(states.shape[0], jnp.uint32(0x6C078965))
+    hi = jnp.full(states.shape[0], jnp.uint32(0xB5297A4D))
     for i in range(states.shape[1]):  # static, small
-        h = _mix64(h ^ (lanes[:, i] + _GOLDEN * jnp.uint64(i + 1)))
-    h = jnp.where(h == 0, jnp.uint64(1), h)
-    return jnp.where(h == jnp.uint64(0xFFFFFFFFFFFFFFFF), jnp.uint64(2), h)
+        lane = states[:, i] + _GOLDEN * jnp.uint32(i + 1)
+        lo = _mix32(lo ^ lane)
+        hi = _mix32(hi ^ (lane * _M1 + jnp.uint32(i + 0x1B873593)))
+    lo = jnp.where(lo == 0, jnp.uint32(1), lo)
+    return lo, hi
+
+
+def pack_fp(lo, hi):
+    """Device pair -> host Python int / numpy uint64 (vectorized)."""
+    return (np.uint64(np.asarray(hi)) << np.uint64(32)) | np.uint64(
+        np.asarray(lo)
+    )
+
+
+def unpack_fp(fp: int) -> tuple[int, int]:
+    """Host int -> (lo, hi) pair."""
+    return int(fp) & 0xFFFFFFFF, (int(fp) >> 32) & 0xFFFFFFFF

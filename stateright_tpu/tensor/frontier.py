@@ -3,11 +3,14 @@ reference's hot loop (`check_block`, src/checker/bfs.rs:177-335).
 
 One jitted step fuses, for a batch of up to `batch_size` frontier states:
 property-mask evaluation, successor expansion (`TensorModel.expand`), boundary
-masking, on-device fingerprinting, intra-batch dedup (sort + neighbor compare),
-and visited-set insertion with parent tracking. The host orchestrates the
-frontier queue, eventually-bit bookkeeping, discovery recording, and early
-exit — exactly the split SURVEY.md §7 prescribes (host keeps the user-facing
-API and path reconstruction; the device owns the hot loop).
+masking, on-device fingerprinting (u32 pairs — no 64-bit emulation on TPU),
+and visited-set insertion with parent tracking. Intra-batch duplicates are
+resolved INSIDE the hash-table insert (phase-3 arena, tensor/hashtable.py),
+so there is no per-step sort; new states are compacted with a cumsum scatter.
+The host orchestrates the frontier queue, eventually-bit bookkeeping,
+discovery recording, and early exit — exactly the split SURVEY.md §7
+prescribes (host keeps the user-facing API and path reconstruction; the
+device owns the hot loop).
 
 Search semantics match the host BFS checker bit-for-bit where observable:
 state/unique counts, boundary handling, depth cutoffs, eventually-bit false
@@ -18,7 +21,7 @@ from __future__ import annotations
 
 import time
 from collections import deque
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from functools import partial
 from typing import Optional
 
@@ -30,15 +33,13 @@ import jax.numpy as jnp
 from ..core.discovery import HasDiscoveries
 from ..core.model import Expectation
 from ..core.path import Path
-from .fingerprint import device_fingerprint
-from .hashtable import HashTable
+from .fingerprint import device_fingerprint, pack_fp
+from .hashtable import HashTable, _insert_impl
 from .model import TensorModel
 
-_MAX_U64 = jnp.uint64(0xFFFFFFFFFFFFFFFF)
 
-
-def state_fingerprint(model: "TensorModel", states: jnp.ndarray) -> jnp.ndarray:
-    """Fingerprint for identity purposes: the canonical (symmetry
+def state_fingerprint(model: "TensorModel", states: jnp.ndarray):
+    """(lo, hi) fingerprint for identity purposes: the canonical (symmetry
     representative) form when the model defines one, else the state itself."""
     if model.representative is not None:
         states = model.representative(states)
@@ -48,33 +49,43 @@ def state_fingerprint(model: "TensorModel", states: jnp.ndarray) -> jnp.ndarray:
 def seed_init(model: "TensorModel"):
     """Boundary-filter and fingerprint-dedup the initial states on host.
 
-    Returns (states uint32[n0, L], fps uint64[n0], n_raw) where n_raw is the
-    PRE-dedup in-boundary count — the host checkers seed state_count with the
-    raw init list length (ref: src/checker/bfs.rs:54), so count parity
-    requires it.
+    Returns (states uint32[n0, L], lo uint32[n0], hi uint32[n0], n_raw) where
+    n_raw is the PRE-dedup in-boundary count — the host checkers seed
+    state_count with the raw init list length (ref: src/checker/bfs.rs:54), so
+    count parity requires it.
     """
     init = np.asarray(model.init_states(), dtype=np.uint32)
     in_bounds = np.asarray(model.within_boundary(jnp.asarray(init)))
     init = init[in_bounds]
     n_raw = len(init)
-    init_fps = np.asarray(state_fingerprint(model, jnp.asarray(init)))
-    _, first_pos = np.unique(init_fps, return_index=True)
+    lo, hi = (np.asarray(x) for x in state_fingerprint(model, jnp.asarray(init)))
+    _, first_pos = np.unique(pack_fp(lo, hi), return_index=True)
     keep = np.sort(first_pos)
-    return init[keep], init_fps[keep], n_raw
+    return init[keep], lo[keep], hi[keep], n_raw
 
 
-def expand_insert(model: "TensorModel", keys, parents, states, fps, active):
+# -- u64-as-u32-pair counters (device counts can exceed 2^32) ------------------
+
+
+def count_add(clo, chi, x):
+    """(lo, hi) += x for u32 pair counters; x is u32/i32 (< 2^32)."""
+    nlo = clo + x.astype(jnp.uint32)
+    return nlo, chi + (nlo < clo).astype(jnp.uint32)
+
+
+def count_ge(clo, chi, tlo, thi):
+    return (chi > thi) | ((chi == thi) & (clo >= tlo))
+
+
+def expand_insert(model, t_lo, t_hi, p_lo, p_hi, states, lo, hi, active):
     """The traced core of one frontier step, shared by the host-orchestrated
-    and device-resident engines: expand, boundary-mask, fingerprint, intra-
-    batch dedup (sort + neighbor compare), visited-set insert with parent
-    tracking, and compaction of the newly-discovered states to the front.
+    and device-resident engines: expand, boundary-mask, fingerprint, visited-
+    set insert with parent tracking (the insert also dedups within the batch).
 
-    Returns (keys, parents, out_states, out_fps, src_rows, new_count,
-    gen_count, has_succ, overflow); `src_rows[i] // max_actions` is the input
-    row that produced compacted output row i.
+    Returns (t_lo, t_hi, p_lo, p_hi, flat_states, succ_lo, succ_hi, is_new,
+    gen_count, has_succ, overflow); row i of the flattened successor arrays
+    came from input row i // max_actions.
     """
-    from .hashtable import _insert_impl
-
     K = states.shape[0]
     A = model.max_actions
     succs, valid = model.expand(states)
@@ -82,41 +93,75 @@ def expand_insert(model: "TensorModel", keys, parents, states, fps, active):
     flat = succs.reshape(K * A, model.lanes)
     validf = valid.reshape(-1) & model.within_boundary(flat)
     # Generated-state count is pre-dedup, post-boundary (ref: bfs.rs:288-291).
-    gen_count = validf.sum()
+    gen_count = validf.sum().astype(jnp.uint32)
     # Terminality counts deduped successors too, but not boundary-excluded
     # ones (ref: bfs.rs:287-333).
     has_succ = validf.reshape(K, A).any(axis=1)
 
-    sfps = state_fingerprint(model, flat)
-    sort_key = jnp.where(validf, sfps, _MAX_U64)
-    order = jnp.argsort(sort_key)
-    so_fps = sort_key[order]
-    uniq = so_fps != jnp.roll(so_fps, 1)
-    uniq = uniq.at[0].set(True) & (so_fps != _MAX_U64)
-    parent_rep = jnp.repeat(fps, A)[order]
-    keys, parents, is_new, overflow = _insert_impl(
-        keys, parents, so_fps, parent_rep, uniq
+    slo, shi = state_fingerprint(model, flat)
+    par_lo = jnp.repeat(lo, A)
+    par_hi = jnp.repeat(hi, A)
+    t_lo, t_hi, p_lo, p_hi, is_new, ovf = _insert_impl(
+        t_lo, t_hi, p_lo, p_hi, slo, shi, par_lo, par_hi, validf
     )
-
-    rank = jnp.argsort(~is_new, stable=True)
-    src_rows = order[rank]
-    out_states = flat[src_rows]
-    out_fps = so_fps[rank]
-    new_count = is_new.sum()
     return (
-        keys,
-        parents,
-        out_states,
-        out_fps,
-        src_rows.astype(jnp.int32),
-        new_count,
-        gen_count,
-        has_succ,
-        overflow,
+        t_lo, t_hi, p_lo, p_hi,
+        flat, slo, shi, is_new,
+        gen_count, has_succ, ovf,
     )
 
 
-def record_discovery(discovered, disc_fps, i, hit, fps):
+def pop_batch(q_states, q_lo, q_hi, q_ebits, q_depth, head, tail, K):
+    """Pop up to K rows from the in-device frontier queue as contiguous
+    dynamic slices (the queue never wraps — see the resident engine's
+    capacity argument). Returns (states, lo, hi, ebits, depth, active,
+    new_head). Shared by the resident and sharded engines."""
+    L = q_states.shape[1]
+    take = jnp.minimum(tail - head, K)
+    states = jax.lax.dynamic_slice(q_states, (head, 0), (K, L))
+    lo = jax.lax.dynamic_slice(q_lo, (head,), (K,))
+    hi = jax.lax.dynamic_slice(q_hi, (head,), (K,))
+    ebits = jax.lax.dynamic_slice(q_ebits, (head,), (K,))
+    depth = jax.lax.dynamic_slice(q_depth, (head,), (K,))
+    active = jnp.arange(K, dtype=jnp.int32) < take
+    return states, lo, hi, ebits, depth, active, head + take
+
+
+def append_new(
+    q_states, q_lo, q_hi, q_ebits, q_depth, tail,
+    flat, slo, shi, ebits_rows, depth_rows, is_new,
+):
+    """Append the is_new rows at the queue tail via cumsum-compacted scatter
+    (sort-free). Returns the five queue arrays and the new tail. Shared by
+    the resident and sharded engines."""
+    Q = q_lo.shape[0]
+    pos_all = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+    qpos = jnp.where(is_new, tail + pos_all, Q)
+    q_states = q_states.at[qpos].set(flat, mode="drop")
+    q_lo = q_lo.at[qpos].set(slo, mode="drop")
+    q_hi = q_hi.at[qpos].set(shi, mode="drop")
+    q_ebits = q_ebits.at[qpos].set(ebits_rows, mode="drop")
+    q_depth = q_depth.at[qpos].set(depth_rows, mode="drop")
+    tail = tail + is_new.sum().astype(jnp.int32)
+    return q_states, q_lo, q_hi, q_ebits, q_depth, tail
+
+
+def compact_new(flat, slo, shi, is_new):
+    """Scatter-compact the is_new rows (and their fingerprints + source row
+    indices) to the front — the sort-free replacement for argsort ranking.
+    Returns (states, lo, hi, src_rows, new_count)."""
+    M, L = flat.shape
+    pos_all = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+    pos = jnp.where(is_new, pos_all, M)
+    out_states = jnp.zeros((M, L), flat.dtype).at[pos].set(flat, mode="drop")
+    out_lo = jnp.zeros(M, jnp.uint32).at[pos].set(slo, mode="drop")
+    out_hi = jnp.zeros(M, jnp.uint32).at[pos].set(shi, mode="drop")
+    src = jnp.arange(M, dtype=jnp.int32)
+    out_src = jnp.zeros(M, jnp.int32).at[pos].set(src, mode="drop")
+    return out_states, out_lo, out_hi, out_src, is_new.sum()
+
+
+def record_discovery(discovered, disc_lo, disc_hi, i, hit, lo, hi):
     """First-witness discovery recording for property bit `i` inside a traced
     search body (shared by the resident and sharded engines). Keeps the first
     hit only; cross-batch/cross-chip races are tolerated exactly as the
@@ -127,15 +172,17 @@ def record_discovery(discovered, disc_fps, i, hit, fps):
     any_hit = jnp.any(hit)
     first = jnp.argmax(hit)
     record = (~already) & any_hit
-    disc_fps = disc_fps.at[i].set(jnp.where(record, fps[first], disc_fps[i]))
+    disc_lo = disc_lo.at[i].set(jnp.where(record, lo[first], disc_lo[i]))
+    disc_hi = disc_hi.at[i].set(jnp.where(record, hi[first], disc_hi[i]))
     discovered = jnp.where(record, discovered | bit, discovered)
-    return discovered, disc_fps
+    return discovered, disc_lo, disc_hi
 
 
 def reconstruct_path(model: TensorModel, parent_map: dict, fp: int) -> Path:
     """Walk device parent pointers, then re-execute the tensor model to
     recover decoded states and action labels (the TLC fingerprint-stack
-    technique, ref: src/checker/bfs.rs:380-409)."""
+    technique, ref: src/checker/bfs.rs:380-409). Fingerprints here are packed
+    host ints (see tensor/fingerprint.py pack_fp)."""
     chain: list[int] = []
     cur = fp
     while cur:
@@ -144,7 +191,8 @@ def reconstruct_path(model: TensorModel, parent_map: dict, fp: int) -> Path:
     chain.reverse()
 
     init = np.asarray(model.init_states(), dtype=np.uint32)
-    init_fps = np.asarray(state_fingerprint(model, jnp.asarray(init)))
+    ilo, ihi = state_fingerprint(model, jnp.asarray(init))
+    init_fps = pack_fp(np.asarray(ilo), np.asarray(ihi))
     rows = np.nonzero(init_fps == np.uint64(chain[0]))[0]
     if len(rows) == 0:
         raise RuntimeError(
@@ -155,7 +203,8 @@ def reconstruct_path(model: TensorModel, parent_map: dict, fp: int) -> Path:
     pairs = []
     for next_fp in chain[1:]:
         succs, valid = model.expand(jnp.asarray(cur_row[None]))
-        sfps = np.asarray(state_fingerprint(model, succs[0]))
+        slo, shi = state_fingerprint(model, succs[0])
+        sfps = pack_fp(np.asarray(slo), np.asarray(shi))
         succs = np.asarray(succs)[0]
         valid = np.asarray(valid)[0]
         hits = np.nonzero(valid & (sfps == np.uint64(next_fp)))[0]
@@ -176,7 +225,7 @@ class SearchResult:
     state_count: int
     unique_state_count: int
     max_depth: int
-    discoveries: dict  # name -> device fingerprint
+    discoveries: dict  # name -> device fingerprint (packed int)
     complete: bool  # queue exhausted (vs early exit)
     duration: float
     steps: int = 0
@@ -185,7 +234,8 @@ class SearchResult:
 @dataclass
 class _Chunk:
     states: np.ndarray  # uint32[n, L]
-    fps: np.ndarray  # uint64[n]
+    lo: np.ndarray  # uint32[n]
+    hi: np.ndarray  # uint32[n]
     ebits: np.ndarray  # bool[n, P]
     depth: int
 
@@ -208,20 +258,28 @@ class FrontierSearch:
     def _build_step(self):
         model = self.model
         K = self.batch_size
-        A = model.max_actions
         props = self.properties
 
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def step(keys, parents, states, fps, active):
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+        def step(t_lo, t_hi, p_lo, p_hi, states, lo, hi, active):
             # Property masks on the input states (ref: bfs.rs:230-280).
             prop_masks = (
                 jnp.stack([p.condition(model, states) for p in props])
                 if props
                 else jnp.zeros((0, K), dtype=bool)
             )
+            (
+                t_lo, t_hi, p_lo, p_hi,
+                flat, slo, shi, is_new,
+                gen_count, has_succ, ovf,
+            ) = expand_insert(model, t_lo, t_hi, p_lo, p_hi, states, lo, hi, active)
+            out_states, out_lo, out_hi, out_src, new_count = compact_new(
+                flat, slo, shi, is_new
+            )
             return (
-                *expand_insert(model, keys, parents, states, fps, active),
-                prop_masks,
+                t_lo, t_hi, p_lo, p_hi,
+                out_states, out_lo, out_hi, out_src,
+                new_count, gen_count, has_succ, ovf, prop_masks,
             )
 
         return step
@@ -252,21 +310,25 @@ class FrontierSearch:
         steps = 0
 
         # Seed: boundary-filter init states, dedup, insert with parent 0.
-        init, init_fps, n_raw = seed_init(model)
+        init, init_lo, init_hi, n_raw = seed_init(model)
         n0 = len(init)
         state_count = n_raw  # host checkers count pre-dedup (bfs.rs:54)
         unique_count = 0
         max_depth = 0
 
         # Insert init states (chunked to batch size).
-        for lo in range(0, n0, K):
-            sl = slice(lo, min(lo + K, n0))
-            fps_pad = np.zeros(K, dtype=np.uint64)
+        for b0 in range(0, n0, K):
+            sl = slice(b0, min(b0 + K, n0))
             n = sl.stop - sl.start
-            fps_pad[:n] = init_fps[sl]
+            lo_pad = np.zeros(K, dtype=np.uint32)
+            hi_pad = np.zeros(K, dtype=np.uint32)
+            lo_pad[:n] = init_lo[sl]
+            hi_pad[:n] = init_hi[sl]
             res = self.table.insert(
-                jnp.asarray(fps_pad),
-                jnp.zeros(K, dtype=jnp.uint64),
+                jnp.asarray(lo_pad),
+                jnp.asarray(hi_pad),
+                jnp.zeros(K, dtype=jnp.uint32),
+                jnp.zeros(K, dtype=jnp.uint32),
                 jnp.asarray(np.arange(K) < n),
             )
             if bool(res.overflow):
@@ -277,7 +339,7 @@ class FrontierSearch:
         for i in prop_is["eventually"]:
             ebits0[:, i] = True
         queue: deque = deque()
-        queue.append(_Chunk(init, init_fps, ebits0, depth=1))
+        queue.append(_Chunk(init, init_lo, init_hi, ebits0, depth=1))
 
         complete = True
         while queue:
@@ -291,7 +353,8 @@ class FrontierSearch:
                 nxt = queue.popleft()
                 chunk = _Chunk(
                     np.concatenate([chunk.states, nxt.states]),
-                    np.concatenate([chunk.fps, nxt.fps]),
+                    np.concatenate([chunk.lo, nxt.lo]),
+                    np.concatenate([chunk.hi, nxt.hi]),
                     np.concatenate([chunk.ebits, nxt.ebits]),
                     chunk.depth,
                 )
@@ -300,40 +363,39 @@ class FrontierSearch:
                 # Not expanded, not evaluated (ref: bfs.rs:219-224).
                 continue
             n = len(chunk.states)
-            for lo in range(0, n, K):
-                hi = min(lo + K, n)
-                m = hi - lo
+            for b0 in range(0, n, K):
+                b1 = min(b0 + K, n)
+                m = b1 - b0
                 st = np.zeros((K, model.lanes), dtype=np.uint32)
-                st[:m] = chunk.states[lo:hi]
-                fp = np.zeros(K, dtype=np.uint64)
-                fp[:m] = chunk.fps[lo:hi]
+                st[:m] = chunk.states[b0:b1]
+                lo = np.zeros(K, dtype=np.uint32)
+                lo[:m] = chunk.lo[b0:b1]
+                hi = np.zeros(K, dtype=np.uint32)
+                hi[:m] = chunk.hi[b0:b1]
                 active = np.arange(K) < m
 
                 (
-                    keys,
-                    parents,
-                    out_states,
-                    out_fps,
-                    src_rows,
-                    new_count,
-                    gen_count,
-                    has_succ,
-                    overflow,
-                    prop_masks,
+                    t_lo, t_hi, p_lo, p_hi,
+                    out_states, out_lo, out_hi, out_src,
+                    new_count, gen_count, has_succ, overflow, prop_masks,
                 ) = self._step(
-                    self.table.keys,
-                    self.table.parents,
+                    self.table.t_lo,
+                    self.table.t_hi,
+                    self.table.p_lo,
+                    self.table.p_hi,
                     jnp.asarray(st),
-                    jnp.asarray(fp),
+                    jnp.asarray(lo),
+                    jnp.asarray(hi),
                     jnp.asarray(active),
                 )
-                self.table.keys, self.table.parents = keys, parents
+                self.table.t_lo, self.table.t_hi = t_lo, t_hi
+                self.table.p_lo, self.table.p_hi = p_lo, p_hi
                 steps += 1
                 if bool(overflow):
                     raise RuntimeError("hash table full; raise table_log2")
 
                 prop_masks = np.asarray(prop_masks)
-                ebits = chunk.ebits[lo:hi]
+                ebits = chunk.ebits[b0:b1]
 
                 # Discoveries (ref: bfs.rs:230-280).
                 for i in prop_is["always"]:
@@ -341,13 +403,15 @@ class FrontierSearch:
                         continue
                     viol = active[:m] & ~prop_masks[i][:m]
                     if viol.any():
-                        discoveries[props[i].name] = int(fp[np.argmax(viol)])
+                        j = int(np.argmax(viol))
+                        discoveries[props[i].name] = int(pack_fp(lo[j], hi[j]))
                 for i in prop_is["sometimes"]:
                     if props[i].name in discoveries:
                         continue
                     sat = active[:m] & prop_masks[i][:m]
                     if sat.any():
-                        discoveries[props[i].name] = int(fp[np.argmax(sat)])
+                        j = int(np.argmax(sat))
+                        discoveries[props[i].name] = int(pack_fp(lo[j], hi[j]))
                 if prop_is["eventually"]:
                     for i in prop_is["eventually"]:
                         # Clear pending bits where observed; successors
@@ -361,7 +425,10 @@ class FrontierSearch:
                             continue
                         bad = term & ebits[:, i]
                         if bad.any():
-                            discoveries[props[i].name] = int(fp[np.argmax(bad)])
+                            j = int(np.argmax(bad))
+                            discoveries[props[i].name] = int(
+                                pack_fp(lo[j], hi[j])
+                            )
 
                 # Early exit when every property is discovered
                 # (ref: bfs.rs:278-280) or finish_when matches.
@@ -379,15 +446,19 @@ class FrontierSearch:
                 unique_count += nc
                 if nc:
                     out_states = np.asarray(out_states[:nc])
-                    out_fps = np.asarray(out_fps[:nc])
-                    parent_rows = np.asarray(src_rows[:nc]) // A
+                    out_lo = np.asarray(out_lo[:nc])
+                    out_hi = np.asarray(out_hi[:nc])
+                    parent_rows = np.asarray(out_src[:nc]) // A
                     child_ebits = (
                         ebits[parent_rows]
                         if P
                         else np.zeros((nc, 0), dtype=bool)
                     )
                     queue.append(
-                        _Chunk(out_states, out_fps, child_ebits, chunk.depth + 1)
+                        _Chunk(
+                            out_states, out_lo, out_hi, child_ebits,
+                            chunk.depth + 1,
+                        )
                     )
                 if (
                     target_state_count is not None

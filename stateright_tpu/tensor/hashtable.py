@@ -1,18 +1,35 @@
-"""Device-resident visited set: an open-addressing hash table over HBM.
+"""Device-resident visited set: a bucketed open-addressing hash table over
+HBM, keyed by (lo, hi) uint32 fingerprint pairs.
 
 Replaces the reference's sharded concurrent `DashMap<Fingerprint,
-Option<Fingerprint>>` (ref: src/checker/bfs.rs:29-30): keys are nonzero uint64
-fingerprints, values are parent fingerprints for path reconstruction.
+Option<Fingerprint>>` (ref: src/checker/bfs.rs:29-30): key identity is the
+full 64-bit fingerprint (as two u32 lanes — see tensor/fingerprint.py for why
+pairs), values are parent fingerprints for path reconstruction.
 
-The batched insert-if-absent kernel resolves intra-batch slot races with a
-scatter-max claim: every still-probing lane proposes its fingerprint for its
-current (free) slot, the maximum proposal wins the slot, losers advance to the
-next probe position. Linear-probing lookups stay correct because slots are
-claimed only when observed free along the probe chain and are never emptied.
+TPU-shaped design: random HBM access is the enemy (a probe loop touching one
+slot at a time serializes; it measured ~270 ms per 128k-insert batch on a
+v5e). So slots are grouped into BUCKETS of 8 contiguous u32s — one gather
+fetches a whole 32-byte bucket row — and a round inspects 8 slots at once:
 
-The caller must pre-deduplicate fingerprints within a batch (two lanes with the
-same fp would both observe a "hit" or both claim — FrontierSearch sorts and
-masks duplicates before inserting).
+1. gather the bucket rows for all still-unresolved keys,
+2. hit if any slot matches (lo, hi),
+3. otherwise claim the first free slot (lo == 0) in phased scatter-max
+   steps: propose `lo` (slot winner = max proposal), lo-winners propose `hi`
+   (tie-break among equal-lo distinct keys), then (lo, hi)-winners race their
+   lane index in a scratch arena so exactly ONE of several identical
+   fingerprints in the same batch wins `is_new`. Losers of phases 1-2 retry
+   next round; identical-fingerprint losers of phase 3 resolve as duplicates;
+   full buckets overflow to the next bucket, wrapping modulo the table.
+
+Safety argument for the phased claim: a committed slot always has lo != 0, so
+later rounds/calls never scatter into it (free-slot claims only); within a
+round all proposals land in one scatter-max, so rivals are serialized by the
+max semantics, and losers observe a mismatched readback and retry. Claimed
+slots are never emptied, so linear bucket probing stays correct.
+
+Unlike the round-1 design, batches may contain duplicate fingerprints: the
+phase-3 arena attributes exactly one `is_new` per distinct new key (the
+engines no longer pre-sort batches — sorting 64-bit keys was a per-step tax).
 """
 
 from __future__ import annotations
@@ -20,17 +37,22 @@ from __future__ import annotations
 from functools import partial
 from typing import NamedTuple
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
-MAX_PROBES = 128
+BUCKET = 8
+MAX_ROUNDS = 64
 
 
 class InsertResult(NamedTuple):
-    keys: jnp.ndarray  # uint64[S]
-    parents: jnp.ndarray  # uint64[S]
-    is_new: jnp.ndarray  # bool[B] — inserted by this call
-    overflow: jnp.ndarray  # bool — some lane exhausted MAX_PROBES
+    t_lo: jnp.ndarray  # uint32[S]
+    t_hi: jnp.ndarray  # uint32[S]
+    p_lo: jnp.ndarray  # uint32[S]
+    p_hi: jnp.ndarray  # uint32[S]
+    is_new: jnp.ndarray  # bool[B] — inserted by this call (one per distinct key)
+    overflow: jnp.ndarray  # bool — some lane exhausted MAX_ROUNDS
 
 
 class HashTable:
@@ -39,60 +61,108 @@ class HashTable:
     def __init__(self, log2_size: int):
         self.log2_size = log2_size
         self.size = 1 << log2_size
-        self.keys = jnp.zeros(self.size, dtype=jnp.uint64)
-        self.parents = jnp.zeros(self.size, dtype=jnp.uint64)
+        if self.size < BUCKET:
+            raise ValueError(f"table must have at least {BUCKET} slots")
+        self.t_lo = jnp.zeros(self.size, dtype=jnp.uint32)
+        self.t_hi = jnp.zeros(self.size, dtype=jnp.uint32)
+        self.p_lo = jnp.zeros(self.size, dtype=jnp.uint32)
+        self.p_hi = jnp.zeros(self.size, dtype=jnp.uint32)
 
-    def insert(self, fps, parent_fps, active) -> InsertResult:
-        res = _insert(self.keys, self.parents, fps, parent_fps, active)
-        self.keys, self.parents = res.keys, res.parents
+    def insert(self, lo, hi, parent_lo, parent_hi, active) -> InsertResult:
+        res = _insert(
+            self.t_lo, self.t_hi, self.p_lo, self.p_hi,
+            lo, hi, parent_lo, parent_hi, active,
+        )
+        self.t_lo, self.t_hi, self.p_lo, self.p_hi = res[:4]
         return res
 
     def dump(self) -> dict:
         """Host dict {fingerprint: parent_fingerprint (0 = init)} — used once
         per search for path reconstruction."""
-        import numpy as np
+        from .fingerprint import pack_fp
 
-        keys = np.asarray(self.keys)
-        parents = np.asarray(self.parents)
-        nz = keys != 0
-        return dict(zip(keys[nz].tolist(), parents[nz].tolist()))
+        t_lo = np.asarray(self.t_lo)
+        nz = t_lo != 0
+        keys = pack_fp(t_lo[nz], np.asarray(self.t_hi)[nz])
+        parents = pack_fp(np.asarray(self.p_lo)[nz], np.asarray(self.p_hi)[nz])
+        return dict(zip(keys.tolist(), parents.tolist()))
 
 
-def _insert_impl(keys, parents, fps, parent_fps, active) -> InsertResult:
-    size = keys.shape[0]
-    mask = jnp.uint64(size - 1)
-    idx = (fps & mask).astype(jnp.int64)
+def _insert_impl(t_lo, t_hi, p_lo, p_hi, lo, hi, parent_lo, parent_hi, active):
+    """Batched insert-if-absent. Returns InsertResult; see module docstring.
+
+    The phase-3 arena reuses `p_lo` as scratch: a freshly claimed slot's
+    parent entry is still zero (parents are only written at the end, to slots
+    whose claim succeeded), so claimants race `lane_index + 1` there with
+    scatter-max and exactly one survives; the real parent value overwrites the
+    arena residue immediately after the loop.
+    """
+    size = t_lo.shape[0]
+    n_buckets = size // BUCKET
+    bmask = jnp.uint32(n_buckets - 1)
+    b0 = hi & bmask
+    lane_ix = jnp.arange(lo.shape[0], dtype=jnp.uint32) + jnp.uint32(1)
 
     def cond(carry):
-        _keys, _parents, _idx, done, _is_new, probes = carry
-        return (~jnp.all(done)) & (probes < MAX_PROBES)
+        (_tl, _th, _pl, done, _new, _slot, _off, rounds) = carry
+        return (~jnp.all(done)) & (rounds < MAX_ROUNDS)
 
     def body(carry):
-        keys, parents, idx, done, is_new, probes = carry
-        cur = keys[idx]
-        hit = cur == fps
-        free = cur == 0
-        attempt = (~done) & free
-        # Scatter-max claim: duplicate target slots resolve deterministically
-        # to the largest proposing fingerprint; done lanes propose 0 (no-op).
-        proposal = jnp.where(attempt, fps, jnp.uint64(0))
-        keys = keys.at[idx].max(proposal)
-        claimed = attempt & (keys[idx] == fps)
-        # Record the parent for claimed slots (claimed slots are unique per
-        # lane, so a plain dropped-out-of-bounds scatter is race-free).
-        pidx = jnp.where(claimed, idx, size)
-        parents = parents.at[pidx].set(parent_fps, mode="drop")
-        done = done | hit | claimed
-        is_new = is_new | claimed
-        idx = jnp.where(done, idx, (idx + 1) & jnp.int64(size - 1))
-        return keys, parents, idx, done, is_new, probes + 1
+        t_lo, t_hi, p_lo, done, is_new, slot, off, rounds = carry
+        b = ((b0 + off) & bmask).astype(jnp.int32)
+        rows_lo = t_lo.reshape(n_buckets, BUCKET)[b]  # [B, 8] one 32B gather
+        rows_hi = t_hi.reshape(n_buckets, BUCKET)[b]
+        hit_j = (rows_lo == lo[:, None]) & (rows_hi == hi[:, None])
+        hit = (~done) & jnp.any(hit_j, axis=1)
+        hit_slot = b * BUCKET + jnp.argmax(hit_j, axis=1).astype(jnp.int32)
+
+        free = rows_lo == 0
+        has_free = jnp.any(free, axis=1)
+        cand = b * BUCKET + jnp.argmax(free, axis=1).astype(jnp.int32)
+        attempt = (~done) & (~hit) & has_free
+
+        # Phase 1: claim the slot's lo by scatter-max (winner = max lo).
+        tgt = jnp.where(attempt, cand, size)
+        t_lo = t_lo.at[tgt].max(jnp.where(attempt, lo, 0), mode="drop")
+        got_lo = attempt & (t_lo.at[cand].get(mode="fill", fill_value=0) == lo)
+        # Phase 2: lo-winners tie-break on hi (equal-lo distinct keys).
+        tgt = jnp.where(got_lo, cand, size)
+        t_hi = t_hi.at[tgt].max(jnp.where(got_lo, hi, 0), mode="drop")
+        claimed = got_lo & (
+            t_hi.at[cand].get(mode="fill", fill_value=0) == hi
+        )
+        # Phase 3: identical fingerprints all pass phase 2 together; race the
+        # lane index in the arena so exactly one wins is_new.
+        tgt = jnp.where(claimed, cand, size)
+        p_lo = p_lo.at[tgt].max(jnp.where(claimed, lane_ix, 0), mode="drop")
+        winner = claimed & (
+            p_lo.at[cand].get(mode="fill", fill_value=0) == lane_ix
+        )
+
+        slot = jnp.where(hit | claimed, jnp.where(hit, hit_slot, cand), slot)
+        is_new = is_new | winner
+        newly_done = hit | claimed
+        # Full bucket (no free slot, no hit): overflow to the next bucket.
+        off = jnp.where((~done) & (~newly_done) & (~has_free), off + 1, off)
+        return (
+            t_lo, t_hi, p_lo, done | newly_done, is_new, slot, off, rounds + 1
+        )
 
     done0 = ~active
-    is_new0 = jnp.zeros_like(active)
-    keys, parents, idx, done, is_new, _probes = jax.lax.while_loop(
-        cond, body, (keys, parents, idx, done0, is_new0, jnp.int32(0))
+    zeros_i = jnp.zeros_like(lo, dtype=jnp.int32)
+    t_lo, t_hi, p_lo, done, is_new, slot, _off, _rounds = jax.lax.while_loop(
+        cond,
+        body,
+        (t_lo, t_hi, p_lo, done0, jnp.zeros_like(active), zeros_i, zeros_i,
+         jnp.int32(0)),
     )
-    return InsertResult(keys, parents, is_new, ~jnp.all(done))
+
+    # Parents: one scatter per component, winning lanes only (unique slots),
+    # overwriting any phase-3 arena residue in p_lo.
+    ptgt = jnp.where(is_new, slot, size)
+    p_lo = p_lo.at[ptgt].set(parent_lo, mode="drop")
+    p_hi = p_hi.at[ptgt].set(parent_hi, mode="drop")
+    return InsertResult(t_lo, t_hi, p_lo, p_hi, is_new, ~jnp.all(done))
 
 
-_insert = partial(jax.jit, donate_argnums=(0, 1))(_insert_impl)
+_insert = partial(jax.jit, donate_argnums=(0, 1, 2, 3))(_insert_impl)

@@ -3,15 +3,19 @@ as one `lax.while_loop` inside one `jit` dispatch.
 
 Motivation: the host-orchestrated loop (frontier.py) pays a host↔device round
 trip per step — fatal when the device is reached over a network tunnel and
-merely wasteful otherwise. Here the frontier queue itself lives in HBM as a
-ring buffer; each loop iteration pops a batch, expands it with the model
-kernel, fingerprints + dedups + inserts into the visited table, evaluates
-property masks, and appends fresh states to the queue tail — no host
-involvement until the search finishes.
+merely wasteful otherwise. Here the frontier queue itself lives in HBM; each
+loop iteration pops a batch (a contiguous dynamic slice — the queue never
+wraps, see below), expands it with the model kernel, fingerprints + dedups +
+inserts into the visited table, evaluates property masks, and appends fresh
+states to the queue tail — no host involvement until the search finishes.
 
-Capacity argument: every unique state is enqueued exactly once, so a queue with
-as many rows as the hash table has slots can never overflow before the table
-does.
+Everything on device is 32-bit (u32 fingerprint pairs, u32-pair generated
+counters): TPUs emulate 64-bit integer ops, so the round-1 u64 design paid
+emulation tax on every hot op.
+
+Capacity argument (also why the queue needs no ring wraparound): every unique
+state is enqueued exactly once, so a queue with as many rows as the hash
+table has slots can never fill before the table overflows.
 
 Early-exit parity with the reference checkers: the loop stops when every
 property has a discovery (src/checker/bfs.rs:278-280), when the configured
@@ -32,9 +36,14 @@ import jax.numpy as jnp
 
 from ..core.discovery import HasDiscoveries
 from ..core.model import Expectation
+from .fingerprint import pack_fp
 from .frontier import (
     SearchResult,
+    append_new,
+    count_add,
+    count_ge,
     expand_insert,
+    pop_batch,
     reconstruct_path,
     record_discovery as _record,
     seed_init,
@@ -71,22 +80,26 @@ def _finish_masks(finish_when: HasDiscoveries, props) -> tuple[int, int]:
 
 
 class _Carry(NamedTuple):
-    keys: jnp.ndarray  # uint64[S]
-    parents: jnp.ndarray  # uint64[S]
+    t_lo: jnp.ndarray  # uint32[S] visited-table key halves
+    t_hi: jnp.ndarray  # uint32[S]
+    p_lo: jnp.ndarray  # uint32[S] parent halves
+    p_hi: jnp.ndarray  # uint32[S]
     q_states: jnp.ndarray  # uint32[Q, L]
-    q_fps: jnp.ndarray  # uint64[Q]
+    q_lo: jnp.ndarray  # uint32[Q]
+    q_hi: jnp.ndarray  # uint32[Q]
     q_ebits: jnp.ndarray  # uint32[Q]
     q_depth: jnp.ndarray  # uint32[Q]
-    head: jnp.ndarray  # int64
-    tail: jnp.ndarray  # int64
-    state_count: jnp.ndarray  # int64
-    unique_count: jnp.ndarray  # int64
+    head: jnp.ndarray  # int32
+    tail: jnp.ndarray  # int32
+    gen_lo: jnp.ndarray  # uint32 generated-count pair
+    gen_hi: jnp.ndarray  # uint32
+    unique_count: jnp.ndarray  # int32
     max_depth: jnp.ndarray  # uint32
     discovered: jnp.ndarray  # uint32 bitmask
-    disc_fps: jnp.ndarray  # uint64[P]
-    stop: jnp.ndarray  # bool
+    disc_lo: jnp.ndarray  # uint32[P]
+    disc_hi: jnp.ndarray  # uint32[P]
     overflow: jnp.ndarray  # bool
-    steps: jnp.ndarray  # int64
+    steps: jnp.ndarray  # int32
 
 
 class ResidentSearch:
@@ -123,16 +136,11 @@ class ResidentSearch:
         all_bits = jnp.uint32((1 << P) - 1)
 
         def body(c: _Carry) -> _Carry:
-            # -- pop a batch from the queue ------------------------------------
-            avail = c.tail - c.head
-            take = jnp.minimum(avail, K)
-            pos = (c.head + jnp.arange(K, dtype=jnp.int64)) % Q
-            active = jnp.arange(K) < take
-            states = c.q_states[pos]
-            fps = c.q_fps[pos]
-            ebits = c.q_ebits[pos]
-            depth = c.q_depth[pos]
-            head = c.head + take
+            # -- pop a batch: contiguous dynamic slice (no wraparound) ---------
+            states, lo, hi, ebits, depth, active, head = pop_batch(
+                c.q_states, c.q_lo, c.q_hi, c.q_ebits, c.q_depth,
+                c.head, c.tail, K,
+            )
 
             max_depth = jnp.maximum(
                 c.max_depth, jnp.max(jnp.where(active, depth, 0))
@@ -140,18 +148,16 @@ class ResidentSearch:
 
             # -- property evaluation (ref: bfs.rs:230-280) ---------------------
             discovered = c.discovered
-            disc_fps = c.disc_fps
+            disc_lo, disc_hi = c.disc_lo, c.disc_hi
             if P:
                 masks = jnp.stack([p.condition(model, states) for p in props])
                 for i in always_i:
-                    hit = active & ~masks[i]
-                    discovered, disc_fps = _record(
-                        discovered, disc_fps, i, hit, fps
+                    discovered, disc_lo, disc_hi = _record(
+                        discovered, disc_lo, disc_hi, i, active & ~masks[i], lo, hi
                     )
                 for i in sometimes_i:
-                    hit = active & masks[i]
-                    discovered, disc_fps = _record(
-                        discovered, disc_fps, i, hit, fps
+                    discovered, disc_lo, disc_hi = _record(
+                        discovered, disc_lo, disc_hi, i, active & masks[i], lo, hi
                     )
                 for i in eventually_i:
                     ebits = jnp.where(
@@ -160,90 +166,103 @@ class ResidentSearch:
 
             # -- expand + fingerprint + dedup + insert (shared core) -----------
             (
-                keys,
-                parents,
-                out_states,
-                out_fps,
-                src_rows,
-                new_count,
-                gen,
-                has_succ,
-                ovf,
-            ) = expand_insert(model, c.keys, c.parents, states, fps, active)
+                t_lo, t_hi, p_lo, p_hi,
+                flat, slo, shi, is_new,
+                gen, has_succ, ovf,
+            ) = expand_insert(
+                model, c.t_lo, c.t_hi, c.p_lo, c.p_hi, states, lo, hi, active
+            )
 
             # -- eventually counterexamples at terminal states -----------------
             if eventually_i:
                 term = active & ~has_succ
                 for i in eventually_i:
                     bad = term & ((ebits >> jnp.uint32(i)) & 1).astype(bool)
-                    discovered, disc_fps = _record(
-                        discovered, disc_fps, i, bad, fps
+                    discovered, disc_lo, disc_hi = _record(
+                        discovered, disc_lo, disc_hi, i, bad, lo, hi
                     )
 
-            # -- append new states to the queue tail ---------------------------
-            new_count = new_count.astype(jnp.int64)
-            slot = jnp.arange(K * A, dtype=jnp.int64)
-            qpos = jnp.where(slot < new_count, (c.tail + slot) % Q, Q)
-            q_states = c.q_states.at[qpos].set(out_states, mode="drop")
-            q_fps = c.q_fps.at[qpos].set(out_fps, mode="drop")
-            child_ebits = ebits[src_rows // A]
-            q_ebits = c.q_ebits.at[qpos].set(child_ebits, mode="drop")
-            child_depth = depth[src_rows // A] + 1
-            q_depth = c.q_depth.at[qpos].set(child_depth, mode="drop")
-            tail = c.tail + new_count
+            # -- append new states to the queue tail (cumsum compaction) -------
+            src_row = jnp.arange(K * A, dtype=jnp.int32) // A
+            q_states, q_lo, q_hi, q_ebits, q_depth, tail = append_new(
+                c.q_states, c.q_lo, c.q_hi, c.q_ebits, c.q_depth, c.tail,
+                flat, slo, shi, ebits[src_row], depth[src_row] + 1, is_new,
+            )
+            new_count = tail - c.tail
+            # A nearly-full queue would make the next pop's dynamic_slice
+            # clamp mis-align with the active mask (and a full one would drop
+            # appends); stopping at Q - K fires before either can corrupt
+            # results, and the table overflows around the same occupancy
+            # anyway. Surfaced to the host as overflow.
+            q_full = tail > Q - K
 
+            gen_lo, gen_hi = count_add(c.gen_lo, c.gen_hi, gen)
             return _Carry(
-                keys=keys,
-                parents=parents,
+                t_lo=t_lo,
+                t_hi=t_hi,
+                p_lo=p_lo,
+                p_hi=p_hi,
                 q_states=q_states,
-                q_fps=q_fps,
+                q_lo=q_lo,
+                q_hi=q_hi,
                 q_ebits=q_ebits,
                 q_depth=q_depth,
                 head=head,
                 tail=tail,
-                state_count=c.state_count + gen.astype(jnp.int64),
+                gen_lo=gen_lo,
+                gen_hi=gen_hi,
                 unique_count=c.unique_count + new_count,
                 max_depth=max_depth,
                 discovered=discovered,
-                disc_fps=disc_fps,
-                stop=c.stop,
-                overflow=c.overflow | ovf,
+                disc_lo=disc_lo,
+                disc_hi=disc_hi,
+                overflow=c.overflow | ovf | q_full,
                 steps=c.steps + 1,
             )
 
         @partial(jax.jit, static_argnums=(3, 4, 7))
         def search(
             init_states,  # uint32[K, L] padded
-            init_fps,  # uint64[K]
-            init_active,  # bool[K]
+            init_lo,  # uint32[K]
+            init_hi,  # uint32[K]
             required_mask: int,
             any_mask: int,
-            target_state_count,  # int64 scalar (0 = none)
-            n_raw_seed,  # int64: pre-dedup init count (host count parity)
+            target_lo,  # uint32 scalar pair (0, 0 = none)
+            target_hi,
             max_steps: int,
+            n0,  # int32: number of active seed rows
+            seed_lo,  # uint32 pair: pre-dedup init count (host count parity)
+            seed_hi,
         ):
             # Tables are allocated in-trace: a fresh search per dispatch, and
             # no host-side zero-fill round trip over the device tunnel.
-            keys = jnp.zeros(S, dtype=jnp.uint64)
-            parents = jnp.zeros(S, dtype=jnp.uint64)
-            # Seed the table and queue with the (pre-deduped) init batch.
-            keys, parents, is_new, ovf = _insert_impl(
-                keys, parents, init_fps, jnp.zeros(K, dtype=jnp.uint64), init_active
+            t_lo = jnp.zeros(S, dtype=jnp.uint32)
+            t_hi = jnp.zeros(S, dtype=jnp.uint32)
+            p_lo = jnp.zeros(S, dtype=jnp.uint32)
+            p_hi = jnp.zeros(S, dtype=jnp.uint32)
+            init_active = jnp.arange(K, dtype=jnp.int32) < n0
+            t_lo, t_hi, p_lo, p_hi, is_new, ovf = _insert_impl(
+                t_lo, t_hi, p_lo, p_hi,
+                init_lo, init_hi,
+                jnp.zeros(K, dtype=jnp.uint32), jnp.zeros(K, dtype=jnp.uint32),
+                init_active,
             )
-            n0 = init_active.sum().astype(jnp.int64)
             q_states = jnp.zeros((Q, L), dtype=jnp.uint32)
-            q_fps = jnp.zeros(Q, dtype=jnp.uint64)
+            q_lo = jnp.zeros(Q, dtype=jnp.uint32)
+            q_hi = jnp.zeros(Q, dtype=jnp.uint32)
             q_ebits = jnp.zeros(Q, dtype=jnp.uint32)
             q_depth = jnp.zeros(Q, dtype=jnp.uint32)
-            slot = jnp.arange(K, dtype=jnp.int64)
+            slot = jnp.arange(K, dtype=jnp.int32)
             qpos = jnp.where(slot < n0, slot, Q)
             q_states = q_states.at[qpos].set(init_states, mode="drop")
-            q_fps = q_fps.at[qpos].set(init_fps, mode="drop")
+            q_lo = q_lo.at[qpos].set(init_lo, mode="drop")
+            q_hi = q_hi.at[qpos].set(init_hi, mode="drop")
             q_ebits = q_ebits.at[qpos].set(jnp.uint32(ebits0), mode="drop")
             q_depth = q_depth.at[qpos].set(jnp.uint32(1), mode="drop")
 
             req = jnp.uint32(required_mask)
             anym = jnp.uint32(any_mask)
+            have_target = (target_lo | target_hi) != 0
 
             def cond(c: _Carry):
                 drained = c.head >= c.tail
@@ -251,8 +270,8 @@ class ResidentSearch:
                 policy = ((req != 0) & ((c.discovered & req) == req)) | (
                     (c.discovered & anym) != 0
                 )
-                count_hit = (target_state_count > 0) & (
-                    c.state_count >= target_state_count
+                count_hit = have_target & count_ge(
+                    c.gen_lo, c.gen_hi, target_lo, target_hi
                 )
                 return (
                     (~drained)
@@ -264,22 +283,26 @@ class ResidentSearch:
                 )
 
             carry = _Carry(
-                keys=keys,
-                parents=parents,
+                t_lo=t_lo,
+                t_hi=t_hi,
+                p_lo=p_lo,
+                p_hi=p_hi,
                 q_states=q_states,
-                q_fps=q_fps,
+                q_lo=q_lo,
+                q_hi=q_hi,
                 q_ebits=q_ebits,
                 q_depth=q_depth,
-                head=jnp.int64(0),
-                tail=n0,
-                state_count=n_raw_seed,
-                unique_count=is_new.sum().astype(jnp.int64),
+                head=jnp.int32(0),
+                tail=n0.astype(jnp.int32),
+                gen_lo=seed_lo,
+                gen_hi=seed_hi,
+                unique_count=is_new.sum().astype(jnp.int32),
                 max_depth=jnp.uint32(0),
                 discovered=jnp.uint32(0),
-                disc_fps=jnp.zeros(max(P, 1), dtype=jnp.uint64),
-                stop=jnp.bool_(False),
+                disc_lo=jnp.zeros(max(P, 1), dtype=jnp.uint32),
+                disc_hi=jnp.zeros(max(P, 1), dtype=jnp.uint32),
                 overflow=ovf,
-                steps=jnp.int64(0),
+                steps=jnp.int32(0),
             )
             carry = jax.lax.while_loop(cond, body, carry)
             # Pack every host-facing scalar into ONE small vector so the host
@@ -289,20 +312,22 @@ class ResidentSearch:
                 [
                     jnp.stack(
                         [
-                            carry.state_count.astype(jnp.uint64),
-                            carry.unique_count.astype(jnp.uint64),
-                            carry.max_depth.astype(jnp.uint64),
-                            carry.discovered.astype(jnp.uint64),
-                            carry.head.astype(jnp.uint64),
-                            carry.tail.astype(jnp.uint64),
-                            carry.overflow.astype(jnp.uint64),
-                            carry.steps.astype(jnp.uint64),
+                            carry.gen_lo,
+                            carry.gen_hi,
+                            carry.unique_count.astype(jnp.uint32),
+                            carry.max_depth,
+                            carry.discovered,
+                            carry.head.astype(jnp.uint32),
+                            carry.tail.astype(jnp.uint32),
+                            carry.overflow.astype(jnp.uint32),
+                            carry.steps.astype(jnp.uint32),
                         ]
                     ),
-                    carry.disc_fps,
+                    carry.disc_lo,
+                    carry.disc_hi,
                 ]
             )
-            return carry.keys, carry.parents, summary
+            return carry.t_lo, carry.t_hi, carry.p_lo, carry.p_hi, summary
 
         return search
 
@@ -314,7 +339,7 @@ class ResidentSearch:
         target_state_count: Optional[int] = None,
         target_max_depth: Optional[int] = None,
         timeout: Optional[float] = None,
-        max_steps: int = 1 << 31,
+        max_steps: int = 1 << 30,
     ) -> SearchResult:
         if target_max_depth is not None:
             raise NotImplementedError(
@@ -331,7 +356,7 @@ class ResidentSearch:
         # seed_init is deterministic per model; cache it (and its padded
         # device-side form) so repeat runs skip the host<->device round trips.
         if self._seed is None:
-            init, init_fps, n_raw = seed_init(model)
+            init, init_lo, init_hi, n_raw = seed_init(model)
             if len(init) > K:
                 raise ValueError(
                     "more init states than batch_size; raise batch_size"
@@ -339,10 +364,11 @@ class ResidentSearch:
             n0 = len(init)
             st = np.zeros((K, model.lanes), dtype=np.uint32)
             st[:n0] = init
-            fp = np.zeros(K, dtype=np.uint64)
-            fp[:n0] = init_fps
-            active = np.arange(K) < n0
-            dev = jax.device_put((st, fp, active))
+            lo = np.zeros(K, dtype=np.uint32)
+            lo[:n0] = init_lo
+            hi = np.zeros(K, dtype=np.uint32)
+            hi[:n0] = init_hi
+            dev = jax.device_put((st, lo, hi))
             self._seed = (dev, n0, n_raw)
         dev, n0, n_raw = self._seed
 
@@ -350,10 +376,8 @@ class ResidentSearch:
         # before exploring anything, matching the host checkers' immediate
         # is_awaiting_discoveries early-out (ref: bfs.rs:278-280).
         if finish_when.matches(self.props, set()) or not self.props:
-            self._last_tables = (
-                np.zeros(1 << self.table_log2, dtype=np.uint64),
-                np.zeros(1 << self.table_log2, dtype=np.uint64),
-            )
+            z = np.zeros(1 << self.table_log2, dtype=np.uint32)
+            self._last_tables = (z, z, z, z)
             return SearchResult(
                 state_count=n_raw,
                 unique_state_count=n0,
@@ -365,18 +389,23 @@ class ResidentSearch:
             )
 
         required_mask, any_mask = _finish_masks(finish_when, self.props)
-        keys, parents, summary = self._kernel(
+        target = int(target_state_count or 0)
+        t_lo, t_hi, p_lo, p_hi, summary = self._kernel(
             *dev,
             required_mask,
             any_mask,
-            jnp.int64(target_state_count or 0),
-            jnp.int64(n_raw),
+            jnp.uint32(target & 0xFFFFFFFF),
+            jnp.uint32(target >> 32),
             max_steps,
+            jnp.int32(n0),
+            jnp.uint32(n_raw & 0xFFFFFFFF),
+            jnp.uint32(n_raw >> 32),
         )
         # ONE device->host transfer for the entire result.
         summary = np.asarray(summary)
         (
-            state_count,
+            gen_lo,
+            gen_hi,
             unique_count,
             max_depth,
             discovered,
@@ -384,19 +413,21 @@ class ResidentSearch:
             tail,
             overflow,
             steps,
-        ) = (int(x) for x in summary[:8])
+        ) = (int(x) for x in summary[:9])
         if overflow:
             raise RuntimeError("hash table full; raise table_log2")
-        self._last_tables = (keys, parents)
+        self._last_tables = (t_lo, t_hi, p_lo, p_hi)
 
-        disc_fps = summary[8:]
+        P = len(self.props)
+        disc_lo = summary[9 : 9 + max(P, 1)]
+        disc_hi = summary[9 + max(P, 1) :]
         discoveries = {
-            p.name: int(disc_fps[i])
+            p.name: int(pack_fp(disc_lo[i], disc_hi[i]))
             for i, p in enumerate(self.props)
             if discovered & (1 << i)
         }
         return SearchResult(
-            state_count=state_count,
+            state_count=gen_lo | (gen_hi << 32),
             unique_state_count=unique_count,
             max_depth=max_depth,
             discoveries=discoveries,
@@ -409,11 +440,11 @@ class ResidentSearch:
         """TLC-style reconstruction from the final table contents (the logic
         is shared with the host-orchestrated engine)."""
         if self._parent_map is None:
-            keys, parents = self._last_tables
-            keys = np.asarray(keys)
-            parents = np.asarray(parents)
-            nz = keys != 0
-            self._parent_map = dict(
-                zip(keys[nz].tolist(), parents[nz].tolist())
+            t_lo, t_hi, p_lo, p_hi = (
+                np.asarray(x) for x in self._last_tables
             )
+            nz = t_lo != 0
+            keys = pack_fp(t_lo[nz], t_hi[nz])
+            parents = pack_fp(p_lo[nz], p_hi[nz])
+            self._parent_map = dict(zip(keys.tolist(), parents.tolist()))
         return reconstruct_path(self.model, self._parent_map, fp)

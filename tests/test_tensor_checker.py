@@ -17,31 +17,61 @@ from stateright_tpu.tensor.models import TensorLinearEquation, TensorTwoPhaseSys
 
 
 def test_device_fingerprint_basics():
+    from stateright_tpu.tensor import pack_fp
+
     states = jnp.asarray(np.arange(12, dtype=np.uint32).reshape(6, 2))
-    fps = np.asarray(device_fingerprint(states))
-    assert len(set(fps.tolist())) == 6  # distinct inputs -> distinct fps
-    assert (fps != 0).all()
-    fps2 = np.asarray(device_fingerprint(states))
-    assert (fps == fps2).all()  # deterministic
+    lo, hi = device_fingerprint(states)
+    packed = pack_fp(lo, hi)
+    assert len(set(packed.tolist())) == 6  # distinct inputs -> distinct fps
+    assert (np.asarray(lo) != 0).all()  # lo is the occupied/parent sentinel
+    lo2, hi2 = device_fingerprint(states)
+    assert (packed == pack_fp(lo2, hi2)).all()  # deterministic
+
+
+def _pairs(vals):
+    lo = jnp.asarray(np.array([v & 0xFFFFFFFF for v in vals], dtype=np.uint32))
+    hi = jnp.asarray(np.array([v >> 32 for v in vals], dtype=np.uint32))
+    return lo, hi
 
 
 def test_hashtable_insert_and_dedup():
     ht = HashTable(8)
-    fps = jnp.asarray(np.array([5, 9, 13, 5 + (1 << 8)], dtype=np.uint64))
-    parents = jnp.asarray(np.array([0, 0, 5, 9], dtype=np.uint64))
-    active = jnp.ones(4, dtype=bool)
-    res = ht.insert(fps, parents, active)
-    assert np.asarray(res.is_new).sum() == 4  # incl. colliding 5 and 5+256
-    res = ht.insert(fps, parents, active)
+    # Distinct keys including a same-lo pair and a same-bucket pair.
+    keys = [5, 9, 13, 5 + (1 << 40), 9 + (13 << 32)]
+    parents = [0, 0, 5, 9, 13]
+    lo, hi = _pairs(keys)
+    plo, phi = _pairs(parents)
+    active = jnp.ones(len(keys), dtype=bool)
+    res = ht.insert(lo, hi, plo, phi, active)
+    assert np.asarray(res.is_new).sum() == len(keys)
+    res = ht.insert(lo, hi, plo, phi, active)
     assert np.asarray(res.is_new).sum() == 0  # all duplicates
     dump = ht.dump()
-    assert dump[13] == 5 and dump[5 + (1 << 8)] == 9
+    assert dump[13] == 5 and dump[5 + (1 << 40)] == 9
+    assert dump[9 + (13 << 32)] == 13
+
+
+def test_hashtable_intra_batch_duplicates():
+    # The phase-3 arena attributes exactly one is_new per distinct key even
+    # when the batch repeats fingerprints (engines no longer pre-dedup).
+    ht = HashTable(8)
+    keys = [7, 7, 7, 21, 21, 33]
+    lo, hi = _pairs(keys)
+    plo, phi = _pairs([1, 2, 3, 4, 5, 6])
+    res = ht.insert(lo, hi, plo, phi, jnp.ones(len(keys), dtype=bool))
+    assert np.asarray(res.is_new).sum() == 3  # {7, 21, 33}
+    dump = ht.dump()
+    assert set(dump) == {7, 21, 33}
 
 
 def test_hashtable_overflow_detected():
-    ht = HashTable(2)  # 4 slots
-    fps = jnp.asarray(np.arange(1, 9, dtype=np.uint64))
-    res = ht.insert(fps, jnp.zeros(8, dtype=jnp.uint64), jnp.ones(8, dtype=bool))
+    ht = HashTable(3)  # 8 slots = one bucket
+    lo, hi = _pairs(list(range(1, 17)))
+    res = ht.insert(
+        lo, hi,
+        jnp.zeros(16, dtype=jnp.uint32), jnp.zeros(16, dtype=jnp.uint32),
+        jnp.ones(16, dtype=bool),
+    )
     assert bool(res.overflow)
 
 
@@ -59,9 +89,11 @@ def test_linear_equation_finds_shortest_example():
     r = fs.run()
     assert "solvable" in r.discoveries
     path = fs.reconstruct_path(r.discoveries["solvable"])
-    # BFS shortest: same as the host/reference discovery
-    # (ref: src/checker/bfs.rs:455-476).
-    assert path.actions() == ["IncreaseX", "IncreaseX", "IncreaseY"]
+    # BFS shortest: same depth and final state as the host/reference
+    # discovery (ref: src/checker/bfs.rs:455-476). Which equal-length path is
+    # recorded depends on parent-insertion races, exactly as in the
+    # reference's multithreaded checker (ref: src/checker/bfs.rs:243).
+    assert sorted(path.actions()) == ["IncreaseX", "IncreaseX", "IncreaseY"]
     assert path.last_state() == (2, 1)
 
 
