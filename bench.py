@@ -224,11 +224,14 @@ def main() -> int:
 
     device_error = None
     dev: dict = {}
+    dev_errors: dict = {}
     ok, probe_err = probe_device()
     if not ok:
         device_error = f"device probe failed: {probe_err}"
     else:
         # Smallest-to-largest: each validated workload de-risks the next.
+        # Workloads are independent — one failing (e.g. OOM at a big table
+        # size) must not misreport the device as unavailable for the others.
         for model, n in (("2pc", 4), ("paxos", 2), ("paxos", 3)):
             try:
                 r, perr = device_search(model, n)
@@ -240,13 +243,19 @@ def main() -> int:
                     f"({r['states_per_sec']:.0f}/s, compile {r['compile_sec']}s)"
                 )
             except Exception:  # noqa: BLE001
-                device_error = traceback.format_exc(limit=3).strip().splitlines()[-1]
+                err = traceback.format_exc(limit=3).strip().splitlines()[-1]
+                dev_errors[f"{model}-{n}"] = err
                 log(f"device {model}-{n} failed:\n{traceback.format_exc(limit=5)}")
-                break
+        if dev_errors and not dev:
+            device_error = "; ".join(
+                f"{k}: {v}" for k, v in dev_errors.items()
+            )
     detail["device"] = {
         k: {"states_per_sec": round(v["states_per_sec"], 1), "sec": v["sec"]}
         for k, v in dev.items()
     }
+    if dev_errors:
+        detail["device_errors"] = dev_errors
 
     # Headline: Paxos-3 (the BASELINE.json north-star workload).
     headline_dev = dev.get("paxos-3")
@@ -259,7 +268,8 @@ def main() -> int:
         )
     elif headline_base is not None:
         value = headline_base["states_per_sec"]
-        metric = "paxos-3 generated states/sec (CPU baseline only; device unavailable)"
+        why = "device failed on paxos-3" if dev else "device unavailable"
+        metric = f"paxos-3 generated states/sec (CPU baseline only; {why})"
     else:
         value = 0.0
         metric = "paxos-3 states/sec (no engine available)"

@@ -27,6 +27,7 @@ from .model import TensorModel, TensorProperty
 from .fingerprint import device_fingerprint, pack_fp, unpack_fp
 from .hashtable import HashTable
 from .frontier import FrontierSearch, SearchResult
+from .lowering import LoweredActorModel, LoweringError, lower_actor_model
 
 __all__ = [
     "TensorModel",
@@ -37,4 +38,7 @@ __all__ = [
     "HashTable",
     "FrontierSearch",
     "SearchResult",
+    "LoweredActorModel",
+    "LoweringError",
+    "lower_actor_model",
 ]

@@ -27,10 +27,12 @@ import numpy as np
 
 import jax.numpy as jnp
 
-# murmur3 fmix32 constants (public domain).
-_M1 = jnp.uint32(0x85EBCA6B)
-_M2 = jnp.uint32(0xC2B2AE35)
-_GOLDEN = jnp.uint32(0x9E3779B9)
+# murmur3 fmix32 constants (public domain). numpy scalars, NOT jnp: a
+# module-level jnp constant would initialize the device backend at import
+# time (and hang if the TPU tunnel is down before the caller pins a platform).
+_M1 = np.uint32(0x85EBCA6B)
+_M2 = np.uint32(0xC2B2AE35)
+_GOLDEN = np.uint32(0x9E3779B9)
 
 
 def _mix32(h: jnp.ndarray) -> jnp.ndarray:

@@ -1,0 +1,954 @@
+"""Generic ActorModel -> TensorModel lowering: ANY bounded actor system gets
+device checking, without a hand-written tensor encoding.
+
+The reference's core capability is that any actor system lowers automatically
+into the generic model interface (`ActorModel`, ref: src/actor/model.rs:24-40)
+and from there into any checker. Round 1 only accelerated four hand-encoded
+models; this module closes that gap the TPU-first way: the user's Python actor
+code cannot run inside an XLA kernel, so the lowering LIFTS IT TO DATA —
+
+1. A host-side *local closure* pass enumerates, once, every reachable
+   (local state, incoming envelope) reaction per actor and every
+   (local state, timer) reaction, by running the actual `Actor.on_msg` /
+   `on_timeout` code on a worklist. Local state spaces are usually tiny even
+   when the global product space is huge — that asymmetry is what makes the
+   lowering profitable.
+2. Reactions compile to dense uint32 lookup tables (new-state id, emitted
+   envelope ids, timer set/clear masks, validity, history event).
+3. The device `expand` kernel is then pure gathers + lane arithmetic: deliver
+   the envelope in each action slot, look up the reaction, apply it
+   branchlessly. Histories (e.g. consistency testers) are lowered the same
+   way: the history object vocabulary is closed over *history events*
+   (delivered envelope + ordered emissions), and host predicates over
+   histories — `serialized_history() is not None` included — are evaluated
+   once per history id at build time and become boolean gather tables.
+
+Host-semantics parity (all cited behaviors preserved exactly):
+- one Deliver action per distinct deliverable envelope; Drop actions when
+  lossy (ref: src/actor/model.rs:258-282);
+- no-op elision: delivery that leaves the actor unchanged and emits nothing is
+  not a transition (ref: src/actor/model.rs:345-347);
+- timeout semantics incl. the fired-timer-consumed rule and the
+  unchanged-state + re-set-same-timer elision (ref: src/actor.rs:277-287,
+  src/actor/model.rs:386-392);
+- unordered duplicating networks keep the envelope set + `last_msg` lane
+  (redelivery changes the fingerprint, ref: src/actor/network.rs:52,224-228);
+  unordered non-duplicating networks are a sorted bounded multiset pool;
+- state identity covers (actor states, history, timers, network), matching
+  `ActorModelState`'s manual Hash (ref: src/actor/model_state.rs:134-145).
+
+Soundness guards: every closure is bounded (`max_local_states`,
+`max_histories`, `max_envelopes`); if the device search ever reaches a
+(state, envelope) pair the closure did not cover (possible only when
+`local_boundary` under-approximates the model's real boundary), the successor
+becomes the reserved POISON row and the auto-added "lowering coverage"
+property reports it as a counterexample instead of silently mis-exploring.
+
+Not yet lowered (explicit errors): ordered networks, crashes, random choices.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..actor import CancelTimer, ChooseRandom, Id, Out, Send, SetTimer
+from ..actor.model import ActorModel
+from ..actor.network import (
+    Envelope,
+    ORDERED,
+    UNORDERED_DUPLICATING,
+    UNORDERED_NONDUPLICATING,
+)
+from .model import TensorModel, TensorProperty
+
+EMPTY = np.uint32(0xFFFFFFFF)
+_UNEXPLORED = 0  # D_state value marking an uncovered (eid, sid) combo
+_ELIDED = 1  # no-op elision (not a transition)
+_VALID0 = 2  # new_sid = D_state - _VALID0
+
+
+class LoweringError(Exception):
+    pass
+
+
+class LoweredActorModel(TensorModel):
+    """TensorModel auto-derived from an ActorModel. Build via
+    `lower_actor_model(...)`; then check with any device engine
+    (FrontierSearch / ResidentSearch / ShardedSearch / spawn_tpu)."""
+
+    def __init__(
+        self,
+        model: ActorModel,
+        *,
+        pool_size: int = 16,
+        max_emit: int = 4,
+        local_boundary: Optional[Callable] = None,
+        max_local_states: int = 1 << 12,
+        max_envelopes: int = 1 << 12,
+        max_histories: int = 1 << 16,
+        properties: Optional[Callable] = None,
+        boundary: Optional[Callable] = None,
+    ):
+        self.model = model
+        self.kind = model.init_network.kind
+        if self.kind == ORDERED:
+            raise LoweringError(
+                "ordered networks are not lowered yet; use the host checkers"
+            )
+        if model.max_crashes:
+            raise LoweringError("crash injection is not lowered yet")
+        self.pool_size = pool_size
+        self.max_emit = max_emit
+        self.local_boundary = local_boundary or (lambda i, s: True)
+        self.max_local_states = max_local_states
+        self.max_envelopes = max_envelopes
+        self.max_histories = max_histories
+        self._properties_fn = properties
+        self._boundary_fn = boundary
+
+        self.n = len(model.actors)
+        self.track_history = model.init_history is not None
+        self._close()
+        self._layout()
+        self._bake_tables()
+        self._props = self._build_properties()
+
+    # -- host closure ----------------------------------------------------------
+
+    def _close(self) -> None:
+        model = self.model
+        self.envs: list[Envelope] = []  # eid -> envelope
+        self.env_ids: dict = {}
+        self.sids: list[dict] = [dict() for _ in range(self.n)]  # state->sid
+        self.states: list[list] = [[] for _ in range(self.n)]  # sid->state
+        self.timer_ids: list[dict] = [dict() for _ in range(self.n)]
+        self.timers: list[list] = [[] for _ in range(self.n)]
+
+        pending: deque = deque()  # ("d", eid, sid) | ("t", actor, tid, sid)
+        done: set = set()
+        # sids whose local_boundary failed: encoded but never expanded.
+        frozen: set = set()  # (actor, sid)
+
+        def env_id(env: Envelope) -> int:
+            key = (int(env.src), int(env.dst), env.msg)
+            eid = self.env_ids.get(key)
+            if eid is None:
+                eid = len(self.envs)
+                if eid >= self.max_envelopes:
+                    raise LoweringError(
+                        "envelope vocabulary exceeded max_envelopes="
+                        f"{self.max_envelopes}; the message space may be "
+                        "unbounded (add a local_boundary or raise the cap)"
+                    )
+                self.env_ids[key] = eid
+                self.envs.append(Envelope(Id(key[0]), Id(key[1]), env.msg))
+                dst = key[1]
+                if dst < self.n:
+                    for sid in range(len(self.states[dst])):
+                        if (dst, sid) not in frozen:
+                            pending.append(("d", eid, sid))
+            return eid
+
+        def sid_of(actor: int, state) -> int:
+            sid = self.sids[actor].get(state)
+            if sid is None:
+                sid = len(self.states[actor])
+                if sid >= self.max_local_states:
+                    raise LoweringError(
+                        f"actor {actor} exceeded max_local_states="
+                        f"{self.max_local_states}; its local state space may "
+                        "be unbounded (add a local_boundary or raise the cap)"
+                    )
+                self.sids[actor][state] = sid
+                self.states[actor].append(state)
+                if self.local_boundary(actor, state):
+                    for eid, env in enumerate(self.envs):
+                        if int(env.dst) == actor:
+                            pending.append(("d", eid, sid))
+                    for tid in range(len(self.timers[actor])):
+                        pending.append(("t", actor, tid, sid))
+                else:
+                    frozen.add((actor, sid))
+            return sid
+
+        def timer_id(actor: int, timer) -> int:
+            tid = self.timer_ids[actor].get(timer)
+            if tid is None:
+                tid = len(self.timers[actor])
+                if tid >= 32:
+                    raise LoweringError(f"actor {actor} has > 32 timer kinds")
+                self.timer_ids[actor][timer] = tid
+                self.timers[actor].append(timer)
+                for sid in range(len(self.states[actor])):
+                    if (actor, sid) not in frozen:
+                        pending.append(("t", actor, tid, sid))
+            return tid
+
+        def run_commands(actor: int, out: Out):
+            """-> (emit eids in order, tclr mask, tset mask)"""
+            emits: list[int] = []
+            tclr = 0
+            tset = 0
+            for c in out:
+                if isinstance(c, Send):
+                    if len(emits) >= self.max_emit:
+                        raise LoweringError(
+                            f"a transition of actor {actor} emits more than "
+                            f"max_emit={self.max_emit} messages"
+                        )
+                    emits.append(env_id(Envelope(Id(actor), c.dst, c.msg)))
+                elif isinstance(c, SetTimer):
+                    bit = 1 << timer_id(actor, c.timer)
+                    tset |= bit
+                    tclr &= ~bit
+                elif isinstance(c, CancelTimer):
+                    bit = 1 << timer_id(actor, c.timer)
+                    tclr |= bit
+                    tset &= ~bit
+                elif isinstance(c, ChooseRandom):
+                    raise LoweringError("random choices are not lowered yet")
+                else:
+                    raise LoweringError(f"unknown command {c!r}")
+            return emits, tclr, tset
+
+        # Seed: envelopes pre-loaded in the init network first (the
+        # reference's seeded-network pattern), then on_start per actor
+        # (matches ActorModel.init_states, ref: src/actor/model.rs:236-256).
+        for env in model.init_network.iter_all():
+            env_id(env)
+        if model.init_network.last_msg is not None:
+            env_id(model.init_network.last_msg)
+        self._init_sids = []
+        self._init_emits = []  # ordered emissions for history replay
+        self._init_tset = [0] * self.n
+        for index, actor in enumerate(model.actors):
+            out = Out()
+            state = actor.on_start(Id(index), out)
+            emits, _tclr, tset = run_commands(index, out)
+            self._init_sids.append(sid_of(index, state))
+            self._init_emits.extend(emits)
+            self._init_tset[index] = tset
+
+        # Reaction closure.
+        self.deliver: dict = {}  # (eid, sid) -> entry dict
+        self.timeout: dict = {}  # (actor, tid, sid) -> entry dict
+        while pending:
+            item = pending.popleft()
+            if item in done:
+                continue
+            done.add(item)
+            if item[0] == "d":
+                _, eid, sid = item
+                env = self.envs[eid]
+                dst = int(env.dst)
+                state = self.states[dst][sid]
+                out = Out()
+                try:
+                    nxt = model.actors[dst].on_msg(
+                        Id(dst), state, env.src, env.msg, out
+                    )
+                except Exception as e:
+                    raise LoweringError(
+                        f"actor {dst} on_msg raised for a (state, message) "
+                        "combination explored by the lowering closure (the "
+                        "closure over-approximates reachability, so handlers "
+                        f"must be total): state={state!r}, env={env!r}"
+                    ) from e
+                emits, tclr, tset = run_commands(dst, out)
+                if nxt is None and not out.commands:
+                    self.deliver[(eid, sid)] = None  # elided no-op
+                    continue
+                new_sid = sid if nxt is None else sid_of(dst, nxt)
+                self.deliver[(eid, sid)] = dict(
+                    new_sid=new_sid, emits=emits, tclr=tclr, tset=tset, env=eid
+                )
+            else:
+                _, actor, tid, sid = item
+                timer = self.timers[actor][tid]
+                state = self.states[actor][sid]
+                out = Out()
+                try:
+                    nxt = model.actors[actor].on_timeout(
+                        Id(actor), state, timer, out
+                    )
+                except Exception as e:
+                    raise LoweringError(
+                        f"actor {actor} on_timeout raised during closure: "
+                        f"state={state!r}, timer={timer!r}"
+                    ) from e
+                emits, tclr, tset = run_commands(actor, out)
+                if (
+                    nxt is None
+                    and len(out.commands) == 1
+                    and isinstance(out.commands[0], SetTimer)
+                    and out.commands[0].timer == timer
+                ):
+                    self.timeout[(actor, tid, sid)] = None  # elided
+                    continue
+                new_sid = sid if nxt is None else sid_of(actor, nxt)
+                bit = 1 << tid
+                if not (tset & bit):
+                    tclr |= bit  # fired timer is consumed unless re-set
+                self.timeout[(actor, tid, sid)] = dict(
+                    new_sid=new_sid, emits=emits, tclr=tclr, tset=tset, env=None
+                )
+
+        self._close_histories()
+
+    def _close_histories(self) -> None:
+        """Build the history vocabulary + transition table over history
+        EVENTS (delivered envelope + ordered emissions), replaying the
+        model's record_msg_in/out hooks (ref: src/actor/model.rs:348-357).
+
+        Histories are closed JOINTLY with the per-actor local-state vector:
+        an event only fires from joint states where its destination actor is
+        in the gating local state, and firing advances that actor. Relaxing
+        only the network/timer availability keeps this a sound
+        over-approximation of reachability while staying bounded for
+        histories that a pure history-times-event closure would blow up
+        (e.g. consistency testers, where replaying one event forever would
+        append operations without bound)."""
+        model = self.model
+        self.hevents: list = []  # hevent id -> (eid or None, tuple emit eids)
+        self._hevent_ids: dict = {}
+        if not self.track_history:
+            self.hids = {}
+            self.histories = []
+            self._hd = np.zeros((1, 1), np.uint32)
+            return
+
+        def hevent_id(env_eid, emits) -> int:
+            key = (env_eid, tuple(emits))
+            hid = self._hevent_ids.get(key)
+            if hid is None:
+                hid = len(self.hevents)
+                self._hevent_ids[key] = hid
+                self.hevents.append(key)
+            return hid
+
+        for entry in list(self.deliver.values()) + list(self.timeout.values()):
+            if entry is not None:
+                entry["hevent"] = hevent_id(entry["env"], entry["emits"])
+
+        def apply_event(history, event):
+            env_eid, emits = event
+            if env_eid is not None:
+                env = self.envs[env_eid]
+                nh = model.record_msg_in_(model.cfg, history, env)
+                if nh is not None:
+                    history = nh
+            for e in emits:
+                env = self.envs[e]
+                nh = model.record_msg_out_(model.cfg, history, env)
+                if nh is not None:
+                    history = nh
+            return history
+
+        def hid_of(h) -> int:
+            nid = self.hids.get(h)
+            if nid is None:
+                nid = len(self.histories)
+                if nid >= self.max_histories:
+                    raise LoweringError(
+                        "history vocabulary exceeded max_histories="
+                        f"{self.max_histories}; raise the cap, or the "
+                        "history may be genuinely unbounded (e.g. "
+                        "unbounded counters)"
+                    )
+                self.hids[h] = nid
+                self.histories.append(h)
+            return nid
+
+        # Gated transitions: (dst actor, gate sid, new sid, hevent).
+        gated = []
+        for (eid, sid), entry in self.deliver.items():
+            if entry is not None:
+                dst = int(self.envs[eid].dst)
+                gated.append((dst, sid, entry["new_sid"], entry["hevent"]))
+        for (actor, _tid, sid), entry in self.timeout.items():
+            if entry is not None:
+                gated.append((actor, sid, entry["new_sid"], entry["hevent"]))
+
+        # The initial history replays on_start emissions (record_msg_out).
+        h0 = apply_event(model.init_history, (None, tuple(self._init_emits)))
+        self.hids = {h0: 0}
+        self.histories = [h0]
+        start = (tuple(self._init_sids), 0)
+        seen = {start}
+        worklist = deque([start])
+        trans: dict = {}  # (hid, hevent) -> next hid
+        max_joint = self.max_histories * 16
+        while worklist:
+            sid_vec, hid = worklist.popleft()
+            h = self.histories[hid]
+            for dst, gate, new_sid, ev in gated:
+                if sid_vec[dst] != gate:
+                    continue
+                nid = trans.get((hid, ev))
+                if nid is None:
+                    nid = hid_of(apply_event(h, self.hevents[ev]))
+                    trans[(hid, ev)] = nid
+                nxt = (
+                    sid_vec[:dst] + (new_sid,) + sid_vec[dst + 1 :],
+                    nid,
+                )
+                if nxt not in seen:
+                    if len(seen) >= max_joint:
+                        raise LoweringError(
+                            "joint (actor-states, history) closure exceeded "
+                            f"{max_joint} states; the history may be too "
+                            "entangled with the global state to lower"
+                        )
+                    seen.add(nxt)
+                    worklist.append(nxt)
+        n_events = len(self.hevents)
+        self._hd = np.zeros((len(self.histories), max(n_events, 1)), np.uint32)
+        # Unvisited (hid, event) combos are unreachable per the
+        # over-approximation; route them to hid 0 (harmless — the search can
+        # never take them).
+        for (hid, ev), nid in trans.items():
+            self._hd[hid, ev] = nid
+        self._h0 = 0
+
+    # -- device layout ---------------------------------------------------------
+
+    def _layout(self) -> None:
+        self.E = len(self.envs)
+        self.has_timers = any(self.timers[i] for i in range(self.n))
+        self.timeout_slots = [
+            (i, tid)
+            for i in range(self.n)
+            for tid in range(len(self.timers[i]))
+        ]
+        lane = 0
+        self.sid_off = lane
+        lane += self.n
+        self.timer_off = lane
+        if self.has_timers:
+            lane += self.n
+        self.hist_off = lane
+        if self.track_history:
+            lane += 1
+        self.net_off = lane
+        if self.kind == UNORDERED_NONDUPLICATING:
+            lane += self.pool_size
+            n_net_actions = self.pool_size
+        else:  # duplicating: envelope-set bitmask + last_msg lane
+            self.nbits = (self.E + 31) // 32
+            lane += self.nbits + 1
+            n_net_actions = self.E
+        self.lanes = lane
+        if self.E == 0:
+            # The closure proves no message is ever sent: no network actions.
+            n_net_actions = 0
+        self.deliver_slots = n_net_actions
+        self.drop_slots = n_net_actions if self.model.lossy_network else 0
+        # At least one (all-invalid) slot keeps expand shapes well-formed for
+        # degenerate models with no actions at all.
+        self.max_actions = max(
+            self.deliver_slots + self.drop_slots + len(self.timeout_slots), 1
+        )
+
+    def _bake_tables(self) -> None:
+        E = self.E
+        maxS = max((len(s) for s in self.states), default=1)
+        self.maxS = maxS
+        # Deliver tables [E, maxS] flattened. D_state: 0 = unexplored (POISON
+        # if reached), 1 = elided no-op, else new_sid + 2.
+        D_state = np.zeros((E, maxS), np.uint32)
+        D_emits = np.full((E, maxS, self.max_emit), EMPTY, np.uint32)
+        D_tclr = np.zeros((E, maxS), np.uint32)
+        D_tset = np.zeros((E, maxS), np.uint32)
+        D_hev = np.zeros((E, maxS), np.uint32)
+        for (eid, sid), entry in self.deliver.items():
+            if entry is None:
+                D_state[eid, sid] = _ELIDED
+                continue
+            D_state[eid, sid] = entry["new_sid"] + _VALID0
+            for j, e in enumerate(entry["emits"]):
+                D_emits[eid, sid, j] = e
+            D_tclr[eid, sid] = entry["tclr"]
+            D_tset[eid, sid] = entry["tset"]
+            D_hev[eid, sid] = entry.get("hevent", 0)
+        self._D = (D_state, D_emits, D_tclr, D_tset, D_hev)
+        self._E_dst = np.asarray(
+            [int(e.dst) if int(e.dst) < self.n else self.n for e in self.envs]
+            or [0],
+            np.uint32,
+        )
+
+        nT = len(self.timeout_slots)
+        T_state = np.zeros((max(nT, 1), maxS), np.uint32)
+        T_emits = np.full((max(nT, 1), maxS, self.max_emit), EMPTY, np.uint32)
+        T_tclr = np.zeros((max(nT, 1), maxS), np.uint32)
+        T_tset = np.zeros((max(nT, 1), maxS), np.uint32)
+        T_hev = np.zeros((max(nT, 1), maxS), np.uint32)
+        _missing = object()
+        for k, (i, tid) in enumerate(self.timeout_slots):
+            for sid in range(len(self.states[i])):
+                entry = self.timeout.get((i, tid, sid), _missing)
+                if entry is _missing:
+                    continue  # unexplored (T_state stays 0)
+                if entry is None:
+                    T_state[k, sid] = _ELIDED  # elided no-op
+                    continue
+                T_state[k, sid] = entry["new_sid"] + _VALID0
+                for j, e in enumerate(entry["emits"]):
+                    T_emits[k, sid, j] = e
+                T_tclr[k, sid] = entry["tclr"]
+                T_tset[k, sid] = entry["tset"]
+                T_hev[k, sid] = entry.get("hevent", 0)
+        self._T = (T_state, T_emits, T_tclr, T_tset, T_hev)
+
+    # -- encode / decode -------------------------------------------------------
+
+    def encode_state(self, sys_state) -> np.ndarray:
+        """Host ActorModelState -> device row (used for seeding and tests)."""
+        row = np.zeros(self.lanes, np.uint32)
+        for i, st in enumerate(sys_state.actor_states):
+            row[self.sid_off + i] = self.sids[i][st]
+        if self.has_timers:
+            for i, tset in enumerate(sys_state.timers_set):
+                mask = 0
+                for t in tset:
+                    mask |= 1 << self.timer_ids[i][t]
+                row[self.timer_off + i] = mask
+        if self.track_history:
+            row[self.hist_off] = self.hids[sys_state.history]
+        if self.kind == UNORDERED_NONDUPLICATING:
+            pool = sorted(
+                self.env_ids[(int(e.src), int(e.dst), e.msg)]
+                for e in sys_state.network.iter_all()
+            )
+            if len(pool) > self.pool_size:
+                raise LoweringError("init network exceeds pool_size")
+            for j, e in enumerate(pool):
+                row[self.net_off + j] = e
+            for j in range(len(pool), self.pool_size):
+                row[self.net_off + j] = EMPTY
+        else:
+            for e in sys_state.network.iter_all():
+                eid = self.env_ids[(int(e.src), int(e.dst), e.msg)]
+                row[self.net_off + eid // 32] |= np.uint32(1 << (eid % 32))
+            lm = sys_state.network.last_msg
+            row[self.net_off + self.nbits] = (
+                self.env_ids[(int(lm.src), int(lm.dst), lm.msg)]
+                if lm is not None
+                else EMPTY
+            )
+        return row
+
+    def decode(self, row):
+        """Device row -> a readable dict mirroring ActorModelState."""
+        row = [int(x) for x in row]
+        if all(x == int(EMPTY) for x in row):
+            return "<poison: closure coverage exceeded>"
+        out = {
+            "actor_states": tuple(
+                self.states[i][row[self.sid_off + i]] for i in range(self.n)
+            )
+        }
+        if self.has_timers:
+            out["timers"] = tuple(
+                frozenset(
+                    self.timers[i][t]
+                    for t in range(len(self.timers[i]))
+                    if row[self.timer_off + i] >> t & 1
+                )
+                for i in range(self.n)
+            )
+        if self.track_history:
+            out["history"] = self.histories[row[self.hist_off]]
+        if self.kind == UNORDERED_NONDUPLICATING:
+            out["network"] = [
+                self.envs[e]
+                for e in row[self.net_off : self.net_off + self.pool_size]
+                if e != int(EMPTY)
+            ]
+        else:
+            out["network"] = [
+                self.envs[e]
+                for e in range(self.E)
+                if row[self.net_off + e // 32] >> (e % 32) & 1
+            ]
+            lm = row[self.net_off + self.nbits]
+            out["last_msg"] = self.envs[lm] if lm != int(EMPTY) else None
+        return out
+
+    def action_label(self, row, action_index):
+        if action_index < self.deliver_slots:
+            if self.kind == UNORDERED_NONDUPLICATING:
+                e = int(row[self.net_off + action_index])
+            else:
+                e = action_index
+            if e == int(EMPTY):
+                return "noop"
+            env = self.envs[e]
+            return f"Deliver {{ src: {env.src!r}, dst: {env.dst!r}, msg: {env.msg!r} }}"
+        if action_index < self.deliver_slots + self.drop_slots:
+            j = action_index - self.deliver_slots
+            if self.kind == UNORDERED_NONDUPLICATING:
+                e = int(row[self.net_off + j])
+            else:
+                e = j
+            if e == int(EMPTY):
+                return "noop"
+            return f"Drop({self.envs[e]!r})"
+        i, tid = self.timeout_slots[
+            action_index - self.deliver_slots - self.drop_slots
+        ]
+        return f"Timeout({Id(i)!r}, {self.timers[i][tid]!r})"
+
+    # -- TensorModel interface -------------------------------------------------
+
+    def init_states(self):
+        rows = [self.encode_state(s) for s in self.model.init_states()]
+        return jnp.asarray(np.stack(rows))
+
+    def expand(self, states):
+        B = states.shape[0]
+        n, M = self.n, self.max_actions
+        u = jnp.uint32
+        D_state, D_emits, D_tclr, D_tset, D_hev = (
+            jnp.asarray(t) for t in self._D
+        )
+        T_state, T_emits, T_tclr, T_tset, T_hev = (
+            jnp.asarray(t) for t in self._T
+        )
+        E_dst = jnp.asarray(self._E_dst)
+        maxS = self.maxS
+
+        sid_lanes = states[:, self.sid_off : self.sid_off + n]  # [B, n]
+
+        succ_parts = []
+        valid_parts = []
+
+        def lookup_deliver(eid, deliverable):
+            """eid: [B, S] delivered envelope per slot; -> per-slot updates."""
+            S = eid.shape[1]
+            safe = jnp.minimum(eid, u(self.E - 1)).astype(jnp.int32)
+            dst = jnp.take(E_dst, safe)  # [B, S]; == n for undeliverable
+            dst_ok = dst < n
+            d_srv = jnp.where(dst_ok, dst, 0).astype(jnp.int32)
+            sid = jnp.take_along_axis(sid_lanes, d_srv, axis=1)  # [B, S]
+            flat = safe * maxS + sid.astype(jnp.int32)
+            st = jnp.take(D_state.reshape(-1), flat)
+            explored = st != _UNEXPLORED
+            is_txn = st >= _VALID0
+            new_sid = jnp.where(is_txn, st - u(_VALID0), sid)
+            emits = jnp.take(
+                D_emits.reshape(-1, self.max_emit), flat, axis=0
+            )  # [B, S, max_emit]
+            tclr = jnp.take(D_tclr.reshape(-1), flat)
+            tset = jnp.take(D_tset.reshape(-1), flat)
+            hev = jnp.take(D_hev.reshape(-1), flat)
+            valid = deliverable & dst_ok & is_txn
+            poison = deliverable & dst_ok & ~explored
+            return d_srv, new_sid, emits, tclr, tset, hev, valid, poison
+
+        def apply_common(d_actor, new_sid, emits, tclr, tset, hev, base_succ):
+            """Write actor/timers/history lanes shared by deliver+timeout."""
+            S = d_actor.shape[1]
+            succ = base_succ
+            sel = (
+                jnp.arange(n)[None, None, :] == d_actor[:, :, None]
+            )  # [B, S, n]
+            new_lanes = jnp.where(
+                sel, new_sid[:, :, None], sid_lanes[:, None, :]
+            )
+            succ = succ.at[:, :, self.sid_off : self.sid_off + n].set(new_lanes)
+            if self.has_timers:
+                tl = states[:, self.timer_off : self.timer_off + n]
+                ntl = jnp.where(
+                    sel, (tl[:, None, :] & ~tclr[:, :, None]) | tset[:, :, None], tl[:, None, :]
+                )
+                succ = succ.at[:, :, self.timer_off : self.timer_off + n].set(ntl)
+            if self.track_history:
+                hid = states[:, self.hist_off]
+                nh = jnp.take(
+                    jnp.asarray(self._hd).reshape(-1),
+                    (hid[:, None] * u(self._hd.shape[1]) + hev).astype(jnp.int32),
+                )
+                succ = succ.at[:, :, self.hist_off].set(nh)
+            return succ
+
+        base = jnp.broadcast_to(
+            states[:, None, :], (B, self.deliver_slots, self.lanes)
+        )
+
+        if self.deliver_slots == 0:
+            pass  # no envelopes can ever exist (E == 0)
+        elif self.kind == UNORDERED_NONDUPLICATING:
+            pool = states[:, self.net_off : self.net_off + self.pool_size]
+            e = pool  # [B, P]
+            nonempty = e != EMPTY
+            first = jnp.concatenate(
+                [jnp.ones((B, 1), bool), e[:, 1:] != e[:, :-1]], axis=1
+            )
+            deliverable = nonempty & first
+            (
+                d_actor, new_sid, emits, tclr, tset, hev, valid, poison
+            ) = lookup_deliver(e, deliverable)
+            succ = apply_common(d_actor, new_sid, emits, tclr, tset, hev, base)
+            # Pool: drop the delivered slot, add emissions, re-sort.
+            P = self.pool_size
+            drop = jnp.arange(P)[None, :, None] == jnp.arange(P)[None, None, :]
+            npool = jnp.where(drop, EMPTY, pool[:, None, :])  # [B, P, P]
+            npool = jnp.concatenate([npool, emits], axis=2)
+            npool = jnp.sort(npool, axis=2)
+            overflow = jnp.any(npool[:, :, P:] != EMPTY, axis=2)
+            succ = succ.at[:, :, self.net_off : self.net_off + P].set(
+                npool[:, :, :P]
+            )
+            poison = poison | (valid & overflow)
+            succ_parts.append(succ)
+            valid_parts.append((valid | poison, poison))
+
+            if self.drop_slots:
+                dbase = jnp.broadcast_to(
+                    states[:, None, :], (B, P, self.lanes)
+                )
+                dpool = jnp.where(drop, EMPTY, pool[:, None, :])
+                dpool = jnp.sort(dpool, axis=2)
+                dsucc = dbase.at[:, :, self.net_off : self.net_off + P].set(
+                    dpool
+                )
+                succ_parts.append(dsucc)
+                valid_parts.append((deliverable, jnp.zeros_like(deliverable)))
+        else:
+            # Duplicating: one deliver slot per envelope-vocab id.
+            bits = states[:, self.net_off : self.net_off + self.nbits]
+            eids = jnp.arange(self.E, dtype=u)[None, :]  # [1, E]
+            in_flight = (
+                bits[:, (jnp.arange(self.E) // 32)]
+                >> (eids % u(32))
+            ) & u(1)
+            deliverable = in_flight.astype(bool)
+            e = jnp.broadcast_to(eids, (B, self.E))
+            (
+                d_actor, new_sid, emits, tclr, tset, hev, valid, poison
+            ) = lookup_deliver(e, deliverable)
+            succ = apply_common(d_actor, new_sid, emits, tclr, tset, hev, base)
+            # Network: set unchanged except emissions OR-ed in; last_msg = e.
+            nbits_arr = bits[:, None, :]  # [B, E, nbits]
+            for j in range(self.max_emit):
+                em = emits[:, :, j]
+                emv = jnp.minimum(em, u(self.E - 1))
+                word = (emv // u(32)).astype(jnp.int32)
+                bit = u(1) << (emv % u(32))
+                sel_w = (
+                    jnp.arange(self.nbits)[None, None, :] == word[:, :, None]
+                )
+                add = jnp.where(
+                    (em != EMPTY)[:, :, None] & sel_w, bit[:, :, None], u(0)
+                )
+                nbits_arr = nbits_arr | add
+            succ = succ.at[:, :, self.net_off : self.net_off + self.nbits].set(
+                nbits_arr
+            )
+            succ = succ.at[:, :, self.net_off + self.nbits].set(e)
+            succ_parts.append(succ)
+            valid_parts.append((valid | poison, poison))
+
+            if self.drop_slots:
+                dbase = jnp.broadcast_to(
+                    states[:, None, :], (B, self.E, self.lanes)
+                )
+                word = (jnp.arange(self.E) // 32)[None, :]
+                clr = ~(u(1) << (eids % u(32)))
+                sel_w = (
+                    jnp.arange(self.nbits)[None, None, :]
+                    == word[:, :, None]
+                )
+                nb = jnp.where(
+                    sel_w, bits[:, None, :] & clr[:, :, None], bits[:, None, :]
+                )
+                dsucc = dbase.at[
+                    :, :, self.net_off : self.net_off + self.nbits
+                ].set(nb)
+                succ_parts.append(dsucc)
+                valid_parts.append((deliverable, jnp.zeros_like(deliverable)))
+
+        # Timeouts.
+        if self.timeout_slots:
+            nT = len(self.timeout_slots)
+            t_actor = jnp.asarray(
+                [i for i, _ in self.timeout_slots], jnp.int32
+            )[None, :]
+            t_bit = jnp.asarray(
+                [1 << tid for _, tid in self.timeout_slots], np.uint32
+            )[None, :]
+            t_actor_b = jnp.broadcast_to(t_actor, (B, nT))
+            tl = states[:, self.timer_off : self.timer_off + n]
+            tmask = jnp.take_along_axis(tl, t_actor_b, axis=1)
+            armed = (tmask & t_bit) != 0
+            sid = jnp.take_along_axis(sid_lanes, t_actor_b, axis=1)
+            flat = (
+                jnp.arange(nT, dtype=jnp.int32)[None, :] * maxS
+                + sid.astype(jnp.int32)
+            )
+            st = jnp.take(T_state.reshape(-1), flat)
+            explored = st != _UNEXPLORED
+            is_txn = st >= _VALID0
+            new_sid = jnp.where(is_txn, st - u(_VALID0), sid)
+            emits = jnp.take(T_emits.reshape(-1, self.max_emit), flat, axis=0)
+            tclr = jnp.take(T_tclr.reshape(-1), flat)
+            tset = jnp.take(T_tset.reshape(-1), flat)
+            hev = jnp.take(T_hev.reshape(-1), flat)
+            valid = armed & is_txn
+            poison = armed & ~explored
+            tbase = jnp.broadcast_to(states[:, None, :], (B, nT, self.lanes))
+            succ = apply_common(
+                t_actor_b, new_sid, emits, tclr, tset, hev, tbase
+            )
+            if self.E == 0:
+                pass  # no envelope vocabulary: timeouts cannot emit
+            elif self.kind == UNORDERED_NONDUPLICATING:
+                pool = states[:, self.net_off : self.net_off + self.pool_size]
+                P = self.pool_size
+                npool = jnp.concatenate(
+                    [jnp.broadcast_to(pool[:, None, :], (B, nT, P)), emits],
+                    axis=2,
+                )
+                npool = jnp.sort(npool, axis=2)
+                overflow = jnp.any(npool[:, :, P:] != EMPTY, axis=2)
+                succ = succ.at[:, :, self.net_off : self.net_off + P].set(
+                    npool[:, :, :P]
+                )
+                poison = poison | (valid & overflow)
+            else:
+                nbits_arr = states[:, None, self.net_off : self.net_off + self.nbits]
+                nbits_arr = jnp.broadcast_to(
+                    nbits_arr, (B, nT, self.nbits)
+                )
+                for j in range(self.max_emit):
+                    em = emits[:, :, j]
+                    emv = jnp.minimum(em, u(self.E - 1))
+                    word = (emv // u(32)).astype(jnp.int32)
+                    bit = u(1) << (emv % u(32))
+                    sel_w = (
+                        jnp.arange(self.nbits)[None, None, :]
+                        == word[:, :, None]
+                    )
+                    add = jnp.where(
+                        (em != EMPTY)[:, :, None] & sel_w,
+                        bit[:, :, None],
+                        u(0),
+                    )
+                    nbits_arr = nbits_arr | add
+                succ = succ.at[
+                    :, :, self.net_off : self.net_off + self.nbits
+                ].set(nbits_arr)
+            succ_parts.append(succ)
+            valid_parts.append((valid | poison, poison))
+
+        if not succ_parts:  # degenerate: no possible actions at all
+            return (
+                jnp.broadcast_to(states[:, None, :], (B, 1, self.lanes)),
+                jnp.zeros((B, 1), dtype=bool),
+            )
+        succs = jnp.concatenate(succ_parts, axis=1)
+        valid = jnp.concatenate([v for v, _ in valid_parts], axis=1)
+        poison = jnp.concatenate([p for _, p in valid_parts], axis=1)
+        # Poisoned successors become the reserved all-ones row; the auto
+        # "lowering coverage" property reports them.
+        succs = jnp.where(poison[:, :, None], jnp.uint32(EMPTY), succs)
+        assert succs.shape[1] == M, (succs.shape, M)
+        return succs, valid
+
+    # -- properties ------------------------------------------------------------
+
+    def _build_properties(self):
+        view = LoweredView(self)
+        props = list(self._properties_fn(view)) if self._properties_fn else []
+        if self._boundary_fn is not None:
+            self._tensor_boundary = self._boundary_fn(view)
+        else:
+            self._tensor_boundary = None
+
+        def coverage(model, states):
+            return ~jnp.all(states == jnp.uint32(EMPTY), axis=1)
+
+        props.append(TensorProperty.always("lowering coverage", coverage))
+        return props
+
+    def properties(self):
+        return list(self._props)
+
+    def within_boundary(self, states):
+        if self._tensor_boundary is None:
+            return jnp.ones(states.shape[0], dtype=bool)
+        # Poison rows bypass the boundary so they reach the coverage property.
+        is_poison = jnp.all(states == jnp.uint32(EMPTY), axis=1)
+        return self._tensor_boundary(states) | is_poison
+
+
+class LoweredView:
+    """Helpers for writing vectorized properties/boundaries against a lowered
+    model: plain Python predicates are evaluated over the (small) closure
+    vocabularies at build time and become gather tables."""
+
+    def __init__(self, lowered: LoweredActorModel):
+        self.m = lowered
+
+    def actor_feature(self, fn: Callable) -> Callable:
+        """fn(actor_index, local_state) -> int. Returns states -> [B, n]."""
+        m = self.m
+        tab = np.zeros((m.n, m.maxS), np.int32)
+        for i in range(m.n):
+            for sid, st in enumerate(m.states[i]):
+                tab[i, sid] = fn(i, st)
+        jt = jnp.asarray(tab)
+
+        def eval_(states):
+            sids = states[:, m.sid_off : m.sid_off + m.n].astype(jnp.int32)
+            flat = jnp.arange(m.n, dtype=jnp.int32)[None, :] * m.maxS + sids
+            return jnp.take(jt.reshape(-1), flat)
+
+        return eval_
+
+    def history_pred(self, fn: Callable) -> Callable:
+        """fn(history) -> bool. Returns states -> [B] bool."""
+        m = self.m
+        if not m.track_history:
+            raise LoweringError("model has no history")
+        tab = np.asarray([bool(fn(h)) for h in m.histories], bool)
+        jt = jnp.asarray(tab)
+
+        def eval_(states):
+            return jt[states[:, m.hist_off].astype(jnp.int32)]
+
+        return eval_
+
+    def any_env(self, pred: Callable) -> Callable:
+        """pred(envelope) -> bool over in-flight envelopes.
+        Returns states -> [B] bool."""
+        m = self.m
+        match = np.asarray([bool(pred(e)) for e in m.envs], bool)
+
+        def eval_(states):
+            if m.kind == UNORDERED_NONDUPLICATING:
+                pool = states[:, m.net_off : m.net_off + m.pool_size]
+                safe = jnp.minimum(pool, jnp.uint32(m.E - 1)).astype(jnp.int32)
+                ok = jnp.take(jnp.asarray(match), safe) & (pool != EMPTY)
+                return jnp.any(ok, axis=1)
+            bits = states[:, m.net_off : m.net_off + m.nbits]
+            mask = np.zeros(m.nbits, np.uint32)
+            for e in np.nonzero(match)[0]:
+                mask[e // 32] |= np.uint32(1 << (e % 32))
+            return jnp.any(bits & jnp.asarray(mask) != 0, axis=1)
+
+        return eval_
+
+
+def lower_actor_model(model: ActorModel, **kwargs) -> LoweredActorModel:
+    """Lower an `ActorModel` to a device-checkable `TensorModel`. See
+    `LoweredActorModel` for options; `properties=` / `boundary=` take
+    callables receiving a `LoweredView` and returning the vectorized
+    `TensorProperty` list / boundary mask function."""
+    return LoweredActorModel(model, **kwargs)
