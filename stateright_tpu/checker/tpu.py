@@ -38,13 +38,19 @@ class TpuChecker(Checker):
                 "symmetry reduction on the device checker lands with the "
                 "tensor canonicalization kernel; use spawn_dfs for now"
             )
+        if options.visitor_ is not None:
+            raise NotImplementedError(
+                "visitors require a per-evaluated-state host callback with a "
+                "full Path — incompatible with batched device search; use "
+                "spawn_bfs/spawn_dfs for visitor-driven runs"
+            )
         super().__init__(model)
         # The resident engine runs the whole search in one device dispatch —
-        # the default. The host-orchestrated engine supports live progress,
-        # target_max_depth, and timeout (a device loop can't be interrupted),
-        # and is the fallback for those options.
+        # the default. The host-orchestrated engine supports live progress
+        # and timeout (a device loop can't be interrupted by wall clock), and
+        # is the fallback for that option.
         if resident is None:
-            resident = options.target_max_depth_ is None and options.timeout_ is None
+            resident = options.timeout_ is None
         self._search = (
             ResidentSearch(model, batch_size, table_log2)
             if resident
@@ -115,8 +121,89 @@ class TpuChecker(Checker):
         return not self._thread.is_alive()
 
     def assert_discovery(self, name, actions) -> None:
-        raise NotImplementedError(
-            "assert_discovery validates action lists by host re-execution; "
-            "compare discovery(name).actions() against expectations instead "
-            "for tensor models"
+        """Panics unless `actions` (a list of the model's `action_label`
+        values) also constitutes a valid discovery, validated by re-executing
+        the tensor model (ref: src/checker.rs:521-577)."""
+        import numpy as np
+
+        from ..core.model import Expectation
+
+        found = self.assert_any_discovery(name)
+        model = self._model
+        prop = model.property_by_name(name)
+        additional_info: list[str] = []
+
+        def cond(row) -> bool:
+            import jax.numpy as jnp
+
+            return bool(
+                np.asarray(prop.condition(model, jnp.asarray(row[None])))[0]
+            )
+
+        for init_row in np.asarray(model.init_states()):
+            states = self._replay(init_row, actions)
+            if states is None:
+                continue
+            if prop.expectation == Expectation.ALWAYS:
+                if not cond(states[-1]):
+                    return
+            elif prop.expectation == Expectation.EVENTUALLY:
+                liveness_satisfied = any(cond(s) for s in states)
+                terminal = self._is_terminal(states[-1])
+                if not liveness_satisfied and terminal:
+                    return
+                if liveness_satisfied:
+                    additional_info.append(
+                        "incorrect counterexample satisfies eventually property"
+                    )
+                if not terminal:
+                    additional_info.append(
+                        "incorrect counterexample is nonterminal"
+                    )
+            else:  # SOMETIMES
+                if cond(states[-1]):
+                    return
+        extra = f" ({'; '.join(additional_info)})" if additional_info else ""
+        raise AssertionError(
+            f'Invalid discovery for "{name}"{extra}, but a valid one was '
+            f"found. found={found.actions()!r}"
         )
+
+    def _valid_successors(self, row):
+        """(successors, mask) with boundary-excluded successors masked out —
+        the engines' notion of a transition (frontier.expand_insert)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        model = self._model
+        succs, valid = model.expand(jnp.asarray(np.asarray(row)[None]))
+        in_bounds = model.within_boundary(succs[0])
+        return np.asarray(succs)[0], np.asarray(valid)[0] & np.asarray(
+            in_bounds
+        )
+
+    def _replay(self, init_row, actions):
+        """Re-execute the tensor model along a list of action labels;
+        returns the state rows visited, or None if a label has no valid
+        matching action somewhere along the way."""
+        import numpy as np
+
+        model = self._model
+        cur = np.asarray(init_row, dtype=np.uint32)
+        states = [cur]
+        for action in actions:
+            succs, valid = self._valid_successors(cur)
+            nxt = None
+            for a in range(model.max_actions):
+                if valid[a] and model.action_label(cur, a) == action:
+                    nxt = succs[a]
+                    break
+            if nxt is None:
+                return None
+            cur = nxt
+            states.append(cur)
+        return states
+
+    def _is_terminal(self, row) -> bool:
+        _succs, valid = self._valid_successors(row)
+        return not bool(valid.any())

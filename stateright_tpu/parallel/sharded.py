@@ -170,6 +170,7 @@ class ShardedSearch:
             required_mask,  # uint32 replicated
             any_mask,  # uint32 replicated
             max_steps,  # int32 replicated
+            target_max_depth,  # uint32 replicated (0 = no limit)
         ):
             me = jax.lax.axis_index(ax)
 
@@ -209,6 +210,11 @@ class ShardedSearch:
                 )
                 max_depth = jnp.maximum(
                     c.max_depth, jnp.max(jnp.where(active, depth, 0))
+                )
+                # target_max_depth: states at the cutoff are neither evaluated
+                # nor expanded (ref: bfs.rs:219-224); 0 = no limit.
+                active = active & (
+                    (target_max_depth == 0) | (depth < target_max_depth)
                 )
 
                 # -- property masks on popped states (bfs.rs:230-280) ----------
@@ -430,7 +436,7 @@ class ShardedSearch:
         sharded = jax.shard_map(
             per_chip,
             mesh=mesh,
-            in_specs=(P(),) * 11,
+            in_specs=(P(),) * 12,
             out_specs=P(ax),
             check_vma=False,
         )
@@ -446,12 +452,11 @@ class ShardedSearch:
         timeout: Optional[float] = None,
         max_steps: int = 1 << 30,
     ) -> SearchResult:
-        if target_max_depth is not None:
+        if timeout is not None:
             raise NotImplementedError(
-                "target_max_depth is not supported on the sharded engine yet; "
-                "use the single-chip checkers for depth-bounded runs"
+                "a device-resident while_loop cannot be interrupted by wall "
+                "clock; bound sharded runs via max_steps"
             )
-        del timeout  # device loops can't be interrupted; bound via max_steps
         model = self.model
         K = self.batch_size
         start = time.monotonic()
@@ -514,6 +519,7 @@ class ShardedSearch:
                 jnp.uint32(required_mask),
                 jnp.uint32(any_mask),
                 jnp.int32(max_steps),
+                jnp.uint32(target_max_depth or 0),
             )
         )
         if bool(np.asarray(overflow).any()):
@@ -547,6 +553,10 @@ class ShardedSearch:
             complete=bool(np.asarray(drained).all()),
             duration=time.monotonic() - start,
             steps=int(np.asarray(steps).max()),
+            detail={
+                # fp-sharding balance evidence (task: per-chip spread).
+                "per_chip_unique": [int(x) for x in np.asarray(unique_counts)],
+            },
         )
 
     def reconstruct_path(self, fp: int):

@@ -28,8 +28,10 @@ from .fingerprint import device_fingerprint, pack_fp, unpack_fp
 from .hashtable import HashTable
 from .frontier import FrontierSearch, SearchResult
 from .lowering import LoweredActorModel, LoweringError, lower_actor_model
+from .simulation import DeviceSimulation
 
 __all__ = [
+    "DeviceSimulation",
     "TensorModel",
     "TensorProperty",
     "device_fingerprint",

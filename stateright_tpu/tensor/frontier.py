@@ -178,18 +178,10 @@ def record_discovery(discovered, disc_lo, disc_hi, i, hit, lo, hi):
     return discovered, disc_lo, disc_hi
 
 
-def reconstruct_path(model: TensorModel, parent_map: dict, fp: int) -> Path:
-    """Walk device parent pointers, then re-execute the tensor model to
-    recover decoded states and action labels (the TLC fingerprint-stack
-    technique, ref: src/checker/bfs.rs:380-409). Fingerprints here are packed
-    host ints (see tensor/fingerprint.py pack_fp)."""
-    chain: list[int] = []
-    cur = fp
-    while cur:
-        chain.append(cur)
-        cur = parent_map.get(cur, 0)
-    chain.reverse()
-
+def replay_fp_chain(model: TensorModel, chain: list) -> Path:
+    """Re-execute the tensor model along a chain of packed fingerprints,
+    recovering decoded states and action labels (the host checkers'
+    Path.from_fingerprints technique, ref: src/checker/path.rs:20-97)."""
     init = np.asarray(model.init_states(), dtype=np.uint32)
     ilo, ihi = state_fingerprint(model, jnp.asarray(init))
     init_fps = pack_fp(np.asarray(ilo), np.asarray(ihi))
@@ -220,6 +212,19 @@ def reconstruct_path(model: TensorModel, parent_map: dict, fp: int) -> Path:
     return Path(pairs)
 
 
+def reconstruct_path(model: TensorModel, parent_map: dict, fp: int) -> Path:
+    """Walk device parent pointers, then re-execute (the TLC
+    fingerprint-stack technique, ref: src/checker/bfs.rs:380-409).
+    Fingerprints are packed host ints (see tensor/fingerprint.py pack_fp)."""
+    chain: list[int] = []
+    cur = fp
+    while cur:
+        chain.append(cur)
+        cur = parent_map.get(cur, 0)
+    chain.reverse()
+    return replay_fp_chain(model, chain)
+
+
 @dataclass
 class SearchResult:
     state_count: int
@@ -229,6 +234,7 @@ class SearchResult:
     complete: bool  # queue exhausted (vs early exit)
     duration: float
     steps: int = 0
+    detail: Optional[dict] = None  # engine-specific (e.g. per-chip balance)
 
 
 @dataclass
@@ -252,6 +258,10 @@ class FrontierSearch:
         self.table = HashTable(table_log2)
         self.properties = model.properties()
         self._step = self._build_step()
+        # Resumable search state (seeded lazily by run(); see _seed).
+        self._q = None
+        self._counts = None
+        self._disc: dict = {}
 
     # -- the fused device step -------------------------------------------------
 
@@ -286,35 +296,28 @@ class FrontierSearch:
 
     # -- host orchestration ----------------------------------------------------
 
-    def run(
-        self,
-        finish_when: HasDiscoveries = HasDiscoveries.ALL,
-        target_state_count: Optional[int] = None,
-        target_max_depth: Optional[int] = None,
-        timeout: Optional[float] = None,
-        progress: Optional[callable] = None,
-    ) -> SearchResult:
+    def _seed(self) -> None:
+        """Seed the resumable search state (queue + counters + discoveries)
+        held on the instance — `run()` continues where the last call left
+        off, which is what makes checkpoint/resume possible."""
         model = self.model
         K = self.batch_size
-        A = model.max_actions
         P = len(self.properties)
-        start = time.monotonic()
-        props = self.properties
-        prop_is = {
-            "always": [i for i, p in enumerate(props) if p.expectation == Expectation.ALWAYS],
-            "sometimes": [i for i, p in enumerate(props) if p.expectation == Expectation.SOMETIMES],
-            "eventually": [i for i, p in enumerate(props) if p.expectation == Expectation.EVENTUALLY],
-        }
-
-        discoveries: dict = {}
-        steps = 0
-
-        # Seed: boundary-filter init states, dedup, insert with parent 0.
+        eventually_i = [
+            i
+            for i, p in enumerate(self.properties)
+            if p.expectation == Expectation.EVENTUALLY
+        ]
         init, init_lo, init_hi, n_raw = seed_init(model)
         n0 = len(init)
-        state_count = n_raw  # host checkers count pre-dedup (bfs.rs:54)
-        unique_count = 0
-        max_depth = 0
+        self._counts = dict(
+            state_count=n_raw,  # host checkers count pre-dedup (bfs.rs:54)
+            unique_count=0,
+            max_depth=0,
+            steps=0,
+            early_exit=False,
+        )
+        self._disc = {}
 
         # Insert init states (chunked to batch size).
         for b0 in range(0, n0, K):
@@ -333,13 +336,45 @@ class FrontierSearch:
             )
             if bool(res.overflow):
                 raise RuntimeError("hash table full; raise table_log2")
-            unique_count += int(np.asarray(res.is_new).sum())
+            self._counts["unique_count"] += int(np.asarray(res.is_new).sum())
 
         ebits0 = np.zeros((n0, P), dtype=bool)
-        for i in prop_is["eventually"]:
+        for i in eventually_i:
             ebits0[:, i] = True
-        queue: deque = deque()
-        queue.append(_Chunk(init, init_lo, init_hi, ebits0, depth=1))
+        self._q = deque()
+        self._q.append(_Chunk(init, init_lo, init_hi, ebits0, depth=1))
+
+    def run(
+        self,
+        finish_when: HasDiscoveries = HasDiscoveries.ALL,
+        target_state_count: Optional[int] = None,
+        target_max_depth: Optional[int] = None,
+        timeout: Optional[float] = None,
+        progress: Optional[callable] = None,
+        max_steps: Optional[int] = None,
+    ) -> SearchResult:
+        model = self.model
+        K = self.batch_size
+        A = model.max_actions
+        P = len(self.properties)
+        start = time.monotonic()
+        props = self.properties
+        prop_is = {
+            "always": [i for i, p in enumerate(props) if p.expectation == Expectation.ALWAYS],
+            "sometimes": [i for i, p in enumerate(props) if p.expectation == Expectation.SOMETIMES],
+            "eventually": [i for i, p in enumerate(props) if p.expectation == Expectation.EVENTUALLY],
+        }
+
+        if self._q is None:
+            self._seed()
+        queue = self._q
+        counts = self._counts
+        discoveries = self._disc
+        state_count = counts["state_count"]
+        unique_count = counts["unique_count"]
+        max_depth = counts["max_depth"]
+        steps = counts["steps"]
+        run_steps = 0
 
         complete = True
         while queue:
@@ -391,6 +426,7 @@ class FrontierSearch:
                 self.table.t_lo, self.table.t_hi = t_lo, t_hi
                 self.table.p_lo, self.table.p_hi = p_lo, p_hi
                 steps += 1
+                run_steps += 1
                 if bool(overflow):
                     raise RuntimeError("hash table full; raise table_log2")
 
@@ -434,10 +470,12 @@ class FrontierSearch:
                 # (ref: bfs.rs:278-280) or finish_when matches.
                 if props and len(discoveries) == len(props):
                     complete = False
+                    counts["early_exit"] = True
                     queue.clear()
                     break
                 if finish_when.matches(props, set(discoveries)):
                     complete = False
+                    counts["early_exit"] = True
                     queue.clear()
                     break
 
@@ -465,7 +503,23 @@ class FrontierSearch:
                     and state_count >= target_state_count
                 ):
                     complete = False
+                    counts["early_exit"] = True
                     queue.clear()
+                    break
+                if max_steps is not None and run_steps >= max_steps:
+                    # Suspend mid-search, preserving the unprocessed rest of
+                    # this chunk for resume (possibly after a checkpoint).
+                    if b1 < n:
+                        queue.appendleft(
+                            _Chunk(
+                                chunk.states[b1:],
+                                chunk.lo[b1:],
+                                chunk.hi[b1:],
+                                chunk.ebits[b1:],
+                                chunk.depth,
+                            )
+                        )
+                    complete = False
                     break
                 if progress is not None:
                     progress(state_count, unique_count, max_depth)
@@ -473,15 +527,121 @@ class FrontierSearch:
                 continue
             break
 
+        counts["state_count"] = state_count
+        counts["unique_count"] = unique_count
+        counts["max_depth"] = max_depth
+        counts["steps"] = steps
         return SearchResult(
             state_count=state_count,
             unique_state_count=unique_count,
             max_depth=max_depth,
-            discoveries=discoveries,
-            complete=complete and not queue,
+            discoveries=dict(discoveries),
+            # An early-exited search stays incomplete across resumed run()
+            # calls and checkpoint/restore (the frontier was discarded).
+            complete=complete
+            and not queue
+            and not counts.get("early_exit", False),
             duration=time.monotonic() - start,
             steps=steps,
         )
+
+    # -- checkpoint / resume ---------------------------------------------------
+    # SURVEY.md §5: the reference has no partial-search checkpointing; with
+    # the frontier and visited set as device arrays it is nearly free here.
+
+    def checkpoint(self, path: str) -> None:
+        """Dump the visited table, pending frontier queue, counters, and
+        discoveries to `path` (.npz). Valid any time `run()` has returned —
+        including after a suspension via max_steps/timeout — so an
+        interrupted search can be resumed elsewhere via `load_checkpoint`."""
+        import json
+
+        if self._q is None:
+            raise RuntimeError("nothing to checkpoint: run() has not started")
+        chunks = list(self._q)
+        np.savez_compressed(
+            path,
+            t_lo=np.asarray(self.table.t_lo),
+            t_hi=np.asarray(self.table.t_hi),
+            p_lo=np.asarray(self.table.p_lo),
+            p_hi=np.asarray(self.table.p_hi),
+            q_states=(
+                np.concatenate([c.states for c in chunks])
+                if chunks
+                else np.zeros((0, self.model.lanes), np.uint32)
+            ),
+            q_lo=(
+                np.concatenate([c.lo for c in chunks])
+                if chunks
+                else np.zeros(0, np.uint32)
+            ),
+            q_hi=(
+                np.concatenate([c.hi for c in chunks])
+                if chunks
+                else np.zeros(0, np.uint32)
+            ),
+            q_ebits=(
+                np.concatenate([c.ebits for c in chunks])
+                if chunks
+                else np.zeros((0, len(self.properties)), bool)
+            ),
+            q_lens=np.asarray([len(c.states) for c in chunks], np.int64),
+            q_depths=np.asarray([c.depth for c in chunks], np.int64),
+            meta=np.frombuffer(
+                json.dumps(
+                    {
+                        "counts": self._counts,
+                        "discoveries": self._disc,
+                        "lanes": self.model.lanes,
+                        "max_actions": self.model.max_actions,
+                        "table_log2": self.table.log2_size,
+                    }
+                ).encode(),
+                dtype=np.uint8,
+            ),
+        )
+
+    @classmethod
+    def load_checkpoint(
+        cls, model: TensorModel, path: str, batch_size: int = 1024
+    ) -> "FrontierSearch":
+        """Rebuild a suspended search from a `checkpoint` file; the next
+        `run()` continues exactly where the dump left off."""
+        import json
+
+        data = np.load(path)
+        meta = json.loads(bytes(data["meta"].tobytes()).decode())
+        if (meta["lanes"], meta["max_actions"]) != (
+            model.lanes,
+            model.max_actions,
+        ):
+            raise ValueError(
+                "checkpoint was taken with a different model layout "
+                f"(lanes/max_actions {meta['lanes']}/{meta['max_actions']} "
+                f"!= {model.lanes}/{model.max_actions})"
+            )
+        fs = cls(model, batch_size=batch_size, table_log2=meta["table_log2"])
+        fs.table.t_lo = jnp.asarray(data["t_lo"])
+        fs.table.t_hi = jnp.asarray(data["t_hi"])
+        fs.table.p_lo = jnp.asarray(data["p_lo"])
+        fs.table.p_hi = jnp.asarray(data["p_hi"])
+        fs._counts = meta["counts"]
+        fs._disc = dict(meta["discoveries"])
+        fs._q = deque()
+        off = 0
+        for ln, depth in zip(data["q_lens"], data["q_depths"]):
+            ln = int(ln)
+            fs._q.append(
+                _Chunk(
+                    data["q_states"][off : off + ln],
+                    data["q_lo"][off : off + ln],
+                    data["q_hi"][off : off + ln],
+                    data["q_ebits"][off : off + ln],
+                    int(depth),
+                )
+            )
+            off += ln
+        return fs
 
     # -- path reconstruction ---------------------------------------------------
 

@@ -135,7 +135,7 @@ class ResidentSearch:
         ebits0 = np.uint32(sum(1 << i for i in eventually_i))
         all_bits = jnp.uint32((1 << P) - 1)
 
-        def body(c: _Carry) -> _Carry:
+        def body(c: _Carry, tmd) -> _Carry:
             # -- pop a batch: contiguous dynamic slice (no wraparound) ---------
             states, lo, hi, ebits, depth, active, head = pop_batch(
                 c.q_states, c.q_lo, c.q_hi, c.q_ebits, c.q_depth,
@@ -145,6 +145,9 @@ class ResidentSearch:
             max_depth = jnp.maximum(
                 c.max_depth, jnp.max(jnp.where(active, depth, 0))
             )
+            # target_max_depth: states at the cutoff are neither evaluated
+            # nor expanded (ref: bfs.rs:219-224); 0 = no limit.
+            active = active & ((tmd == 0) | (depth < tmd))
 
             # -- property evaluation (ref: bfs.rs:230-280) ---------------------
             discovered = c.discovered
@@ -233,6 +236,7 @@ class ResidentSearch:
             n0,  # int32: number of active seed rows
             seed_lo,  # uint32 pair: pre-dedup init count (host count parity)
             seed_hi,
+            target_max_depth,  # uint32 (0 = no limit)
         ):
             # Tables are allocated in-trace: a fresh search per dispatch, and
             # no host-side zero-fill round trip over the device tunnel.
@@ -304,7 +308,9 @@ class ResidentSearch:
                 overflow=ovf,
                 steps=jnp.int32(0),
             )
-            carry = jax.lax.while_loop(cond, body, carry)
+            carry = jax.lax.while_loop(
+                cond, lambda c: body(c, target_max_depth), carry
+            )
             # Pack every host-facing scalar into ONE small vector so the host
             # reads the whole result in a single device transfer (each fetch
             # over the device tunnel costs a full round trip).
@@ -341,13 +347,13 @@ class ResidentSearch:
         timeout: Optional[float] = None,
         max_steps: int = 1 << 30,
     ) -> SearchResult:
-        if target_max_depth is not None:
+        if timeout is not None:
             raise NotImplementedError(
-                "target_max_depth on the resident engine lands with the "
-                "depth-masked body; use the host-orchestrated FrontierSearch "
-                "(TpuChecker(resident=False)) meanwhile"
+                "a device-resident while_loop cannot be interrupted by wall "
+                "clock; use the host-orchestrated FrontierSearch for timeouts "
+                "(spawn_tpu routes there automatically) or bound via "
+                "max_steps"
             )
-        del timeout  # device loops can't be interrupted; bound via max_steps
         model = self.model
         K = self.batch_size
         start = time.monotonic()
@@ -400,6 +406,7 @@ class ResidentSearch:
             jnp.int32(n0),
             jnp.uint32(n_raw & 0xFFFFFFFF),
             jnp.uint32(n_raw >> 32),
+            jnp.uint32(target_max_depth or 0),
         )
         # ONE device->host transfer for the entire result.
         summary = np.asarray(summary)

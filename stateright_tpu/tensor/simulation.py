@@ -1,0 +1,389 @@
+"""Device simulation checker: vmapped random root-to-terminal walks — the
+TPU analogue of the host `SimulationChecker` (ref:
+src/checker/simulation.rs:102-209), closing the promise in
+stateright_tpu/checker/simulation.py.
+
+Where the reference runs one walk per OS thread, here a whole BATCH of traces
+advances in lockstep inside one `lax.while_loop` dispatch: per step every
+active trace evaluates the property masks on its current state, detects
+cycles against its own per-trace visited table, chooses uniformly among the
+valid successors with a counter-based `jax.random` stream (explicit keys —
+reproducible by construction, unlike the reference's FIXMEd StdRng,
+ref: src/checker/simulation.rs:47,154), and steps. Finished traces go
+inactive; the dispatch returns when all traces end or a finish policy hits.
+
+Walk-semantics parity with the host checker (same order of checks per
+iteration, ref: src/checker/simulation.rs:254-397):
+ depth cap -> return WITHOUT the eventually check; boundary exit, cycle
+ exit, and genuine terminals DO record pending eventually-bits as
+ counterexamples; properties are evaluated before expansion; there is no
+ global dedup (`unique_state_count == state_count`).
+
+Discoveries record the discovering trace's fingerprint path (the per-trace
+ring); the host reconstructs a `Path` by re-executing the model along those
+fingerprints, exactly like the exhaustive engines.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.discovery import HasDiscoveries
+from ..core.model import Expectation
+from ..core.path import Path
+from .fingerprint import pack_fp
+from .frontier import SearchResult, state_fingerprint
+from .model import TensorModel
+
+
+class _Carry(NamedTuple):
+    keys: jnp.ndarray  # PRNG keys [T]
+    states: jnp.ndarray  # uint32[T, L] current state per trace
+    done: jnp.ndarray  # bool[T]
+    at_depth_cap: jnp.ndarray  # bool[T] — ended by cap (skip ebits)
+    ebits: jnp.ndarray  # uint32[T]
+    v_lo: jnp.ndarray  # uint32[T, C] per-trace cycle table
+    v_hi: jnp.ndarray  # uint32[T, C]
+    path_lo: jnp.ndarray  # uint32[T, D] per-trace fingerprint path
+    path_hi: jnp.ndarray  # uint32[T, D]
+    path_len: jnp.ndarray  # int32[T]
+    state_count: jnp.ndarray  # int32 (total across traces)
+    max_depth: jnp.ndarray  # int32
+    discovered: jnp.ndarray  # uint32 bitmask
+    disc_trace: jnp.ndarray  # int32[P] trace index of first witness
+    disc_len: jnp.ndarray  # int32[P] fingerprint-path length at witness
+    step: jnp.ndarray  # int32
+
+
+class DeviceSimulation:
+    """One dispatch = `traces` independent random walks of length <=
+    `max_depth`. Call `run()` repeatedly (the seed advances) for more
+    coverage, like the host checker's per-thread trace loop."""
+
+    def __init__(
+        self,
+        model: TensorModel,
+        seed: int = 0,
+        traces: int = 256,
+        max_depth: int = 256,
+        table_log2: int = 9,
+    ):
+        self.model = model
+        self.seed = seed
+        self.traces = traces
+        self.max_depth = max_depth
+        self.table_log2 = table_log2
+        if (1 << table_log2) < 2 * max_depth:
+            raise ValueError(
+                "per-trace cycle table must hold 2x max_depth entries; "
+                "raise table_log2"
+            )
+        self.props = model.properties()
+        self._kernel = self._build()
+        self._rounds = 0
+        self._totals = dict(states=0, max_depth=0, steps=0)
+        self._discoveries: dict = {}  # name -> list of packed fps (the path)
+
+    def _build(self):
+        model = self.model
+        T = self.traces
+        D = self.max_depth
+        C = 1 << self.table_log2
+        L = model.lanes
+        A = model.max_actions
+        props = self.props
+        P = len(props)
+        always_i = [i for i, p in enumerate(props) if p.expectation == Expectation.ALWAYS]
+        sometimes_i = [i for i, p in enumerate(props) if p.expectation == Expectation.SOMETIMES]
+        eventually_i = [i for i, p in enumerate(props) if p.expectation == Expectation.EVENTUALLY]
+        ebits0 = np.uint32(sum(1 << i for i in eventually_i))
+        all_bits = jnp.uint32((1 << P) - 1)
+
+        def record(c_discovered, c_trace, c_len, i, hit, path_len):
+            bit = jnp.uint32(1 << i)
+            already = (c_discovered & bit) != 0
+            any_hit = jnp.any(hit)
+            first = jnp.argmax(hit).astype(jnp.int32)
+            rec = (~already) & any_hit
+            c_trace = c_trace.at[i].set(
+                jnp.where(rec, first, c_trace[i])
+            )
+            c_len = c_len.at[i].set(
+                jnp.where(rec, path_len[first], c_len[i])
+            )
+            return jnp.where(rec, c_discovered | bit, c_discovered), c_trace, c_len
+
+        def probe_insert(v_lo, v_hi, lo, hi, active):
+            """Per-trace linear probe of (lo, hi) in each trace's own table.
+            Returns (v_lo, v_hi, seen)."""
+            idx0 = (hi % jnp.uint32(C)).astype(jnp.int32)
+
+            def cond(s):
+                _vl, _vh, _idx, resolved, _seen, n = s
+                return (~jnp.all(resolved)) & (n < C)
+
+            def body(s):
+                v_lo, v_hi, idx, resolved, seen, n = s
+                cur_lo = jnp.take_along_axis(v_lo, idx[:, None], axis=1)[:, 0]
+                cur_hi = jnp.take_along_axis(v_hi, idx[:, None], axis=1)[:, 0]
+                hit = (cur_lo == lo) & (cur_hi == hi)
+                free = cur_lo == 0
+                claim = (~resolved) & free
+                # One fp per trace per call: no intra-trace races possible.
+                tgt = jnp.where(claim, idx, C)[:, None]
+                v_lo = jnp.put_along_axis(
+                    v_lo, tgt, jnp.where(claim, lo, 0)[:, None], axis=1,
+                    inplace=False, mode="drop",
+                )
+                v_hi = jnp.put_along_axis(
+                    v_hi, tgt, jnp.where(claim, hi, 0)[:, None], axis=1,
+                    inplace=False, mode="drop",
+                )
+                seen = seen | ((~resolved) & hit)
+                resolved = resolved | hit | claim
+                idx = jnp.where(resolved, idx, (idx + 1) % C)
+                return v_lo, v_hi, idx, resolved, seen, n + 1
+
+            resolved0 = ~active
+            seen0 = jnp.zeros_like(active)
+            v_lo, v_hi, _i, _r, seen, _n = jax.lax.while_loop(
+                cond, body,
+                (v_lo, v_hi, idx0, resolved0, seen0, jnp.int32(0)),
+            )
+            return v_lo, v_hi, seen
+
+        def body(c: _Carry) -> _Carry:
+            active = ~c.done
+            # Host parity order (simulation.rs:254-397): depth cap first.
+            capped = active & (c.path_len >= D)
+            # Boundary.
+            in_bounds = model.within_boundary(c.states)
+            out_b = active & ~capped & ~in_bounds
+            # Fingerprint + per-trace cycle check.
+            lo, hi = state_fingerprint(model, c.states)
+            live = active & ~capped & in_bounds
+            v_lo, v_hi, seen = probe_insert(c.v_lo, c.v_hi, lo, hi, live)
+            looped = live & seen
+            walking = live & ~seen
+
+            # Record the fp into the trace path (also for loop/boundary
+            # breaks, matching the host's fingerprint_path.append order:
+            # the fp is appended BEFORE the loop check).
+            rec_fp = active & ~capped & in_bounds
+            ppos = jnp.where(
+                rec_fp, c.path_len, D
+            )  # boundary-exited traces do NOT append (host breaks first)
+            path_lo = jnp.put_along_axis(
+                c.path_lo, ppos[:, None], lo[:, None], axis=1,
+                inplace=False, mode="drop",
+            )
+            path_hi = jnp.put_along_axis(
+                c.path_hi, ppos[:, None], hi[:, None], axis=1,
+                inplace=False, mode="drop",
+            )
+            path_len = c.path_len + rec_fp.astype(jnp.int32)
+
+            state_count = c.state_count + walking.sum(dtype=jnp.int32)
+            max_depth = jnp.maximum(c.max_depth, jnp.max(path_len))
+
+            # Properties on the current state (walking traces only).
+            discovered = c.discovered
+            disc_trace, disc_len = c.disc_trace, c.disc_len
+            ebits = c.ebits
+            if P:
+                masks = jnp.stack([p.condition(model, c.states) for p in props])
+                for i in always_i:
+                    discovered, disc_trace, disc_len = record(
+                        discovered, disc_trace, disc_len, i,
+                        walking & ~masks[i], path_len,
+                    )
+                for i in sometimes_i:
+                    discovered, disc_trace, disc_len = record(
+                        discovered, disc_trace, disc_len, i,
+                        walking & masks[i], path_len,
+                    )
+                for i in eventually_i:
+                    ebits = jnp.where(
+                        walking & masks[i],
+                        ebits & jnp.uint32(~(1 << i) & 0xFFFFFFFF),
+                        ebits,
+                    )
+
+            # Expand and choose uniformly among valid successors.
+            succs, valid = model.expand(c.states)
+            vcount = valid.sum(axis=1).astype(jnp.int32)
+            sub = jax.vmap(jax.random.fold_in)(c.keys, jnp.arange(T))
+            sub = jax.vmap(jax.random.fold_in)(
+                sub, jnp.broadcast_to(c.step, (T,))
+            )
+            r = jax.vmap(
+                lambda k, n: jax.random.randint(k, (), 0, jnp.maximum(n, 1))
+            )(sub, vcount)
+            pick = jnp.argmax(
+                jnp.cumsum(valid.astype(jnp.int32), axis=1) == (r + 1)[:, None],
+                axis=1,
+            )
+            next_states = jnp.take_along_axis(
+                succs, pick[:, None, None], axis=1
+            )[:, 0]
+            terminal = walking & (vcount == 0)
+            stepping = walking & (vcount > 0)
+            states = jnp.where(stepping[:, None], next_states, c.states)
+
+            # Trace endings. Terminal/loop/boundary record pending
+            # eventually-bits; the depth cap does not (host `return` parity).
+            ended_ebits = looped | out_b | terminal
+            if eventually_i:
+                for i in eventually_i:
+                    bad = ended_ebits & (
+                        (ebits >> jnp.uint32(i)) & 1
+                    ).astype(bool)
+                    discovered, disc_trace, disc_len = record(
+                        discovered, disc_trace, disc_len, i, bad, path_len
+                    )
+            done = c.done | capped | ended_ebits
+
+            return _Carry(
+                keys=c.keys,
+                states=states,
+                done=done,
+                at_depth_cap=c.at_depth_cap | capped,
+                ebits=ebits,
+                v_lo=v_lo,
+                v_hi=v_hi,
+                path_lo=path_lo,
+                path_hi=path_hi,
+                path_len=path_len,
+                state_count=state_count,
+                max_depth=max_depth,
+                discovered=discovered,
+                disc_trace=disc_trace,
+                disc_len=disc_len,
+                step=c.step + 1,
+            )
+
+        @partial(jax.jit, static_argnums=(2, 3))
+        def simulate(seed, init_states, required_mask: int, any_mask: int):
+            n0 = init_states.shape[0]
+            base = jax.random.key(seed)
+            keys = jax.random.split(base, T)
+            pick0 = jax.vmap(
+                lambda k: jax.random.randint(k, (), 0, n0)
+            )(jax.vmap(lambda k: jax.random.fold_in(k, 0x5EED))(keys))
+            states0 = init_states[pick0]
+
+            req = jnp.uint32(required_mask)
+            anym = jnp.uint32(any_mask)
+
+            def cond(c: _Carry):
+                all_done = jnp.all(c.done)
+                all_found = (P > 0) & (c.discovered == all_bits)
+                policy = ((req != 0) & ((c.discovered & req) == req)) | (
+                    (c.discovered & anym) != 0
+                )
+                return (~all_done) & (~all_found) & (~policy) & (
+                    c.step < D + 2
+                )
+
+            carry = _Carry(
+                keys=keys,
+                states=states0,
+                done=jnp.zeros(T, bool),
+                at_depth_cap=jnp.zeros(T, bool),
+                ebits=jnp.full(T, jnp.uint32(ebits0)),
+                v_lo=jnp.zeros((T, 1 << self.table_log2), jnp.uint32),
+                v_hi=jnp.zeros((T, 1 << self.table_log2), jnp.uint32),
+                path_lo=jnp.zeros((T, D), jnp.uint32),
+                path_hi=jnp.zeros((T, D), jnp.uint32),
+                path_len=jnp.zeros(T, jnp.int32),
+                state_count=jnp.int32(0),
+                max_depth=jnp.int32(0),
+                discovered=jnp.uint32(0),
+                disc_trace=jnp.zeros(max(P, 1), jnp.int32),
+                disc_len=jnp.zeros(max(P, 1), jnp.int32),
+                step=jnp.int32(0),
+            )
+            carry = jax.lax.while_loop(cond, body, carry)
+            summary = jnp.concatenate(
+                [
+                    jnp.stack(
+                        [
+                            carry.state_count,
+                            carry.max_depth,
+                            carry.discovered.astype(jnp.int32),
+                            carry.step,
+                        ]
+                    ),
+                    carry.disc_trace,
+                    carry.disc_len,
+                ]
+            )
+            return carry.path_lo, carry.path_hi, summary
+
+        return simulate
+
+    # -- host entry ------------------------------------------------------------
+
+    def run(
+        self, finish_when: HasDiscoveries = HasDiscoveries.ALL
+    ) -> SearchResult:
+        from .resident import _finish_masks
+
+        start = time.monotonic()
+        model = self.model
+        init = np.asarray(model.init_states(), dtype=np.uint32)
+        in_bounds = np.asarray(model.within_boundary(jnp.asarray(init)))
+        init = init[in_bounds]
+        required_mask, any_mask = _finish_masks(finish_when, self.props)
+        path_lo, path_hi, summary = self._kernel(
+            self.seed + self._rounds,
+            jnp.asarray(init),
+            required_mask,
+            any_mask,
+        )
+        self._rounds += 1
+        summary = np.asarray(summary)
+        state_count, max_depth, discovered, steps = (
+            int(x) for x in summary[:4]
+        )
+        P = max(len(self.props), 1)
+        disc_trace = summary[4 : 4 + P]
+        disc_len = summary[4 + P :]
+        path_lo = np.asarray(path_lo)
+        path_hi = np.asarray(path_hi)
+        for i, p in enumerate(self.props):
+            if discovered & (1 << i) and p.name not in self._discoveries:
+                t = int(disc_trace[i])
+                ln = int(disc_len[i])
+                fps = pack_fp(path_lo[t, :ln], path_hi[t, :ln])
+                self._discoveries[p.name] = [int(f) for f in fps]
+
+        self._totals["states"] += state_count
+        self._totals["max_depth"] = max(self._totals["max_depth"], max_depth)
+        self._totals["steps"] += steps
+        return SearchResult(
+            state_count=self._totals["states"],
+            unique_state_count=self._totals["states"],  # no global dedup
+            max_depth=self._totals["max_depth"],
+            discoveries={
+                name: fps[-1] for name, fps in self._discoveries.items()
+            },
+            complete=False,  # simulation never proves exhaustion
+            duration=time.monotonic() - start,
+            steps=self._totals["steps"],
+        )
+
+    def discovery_path(self, name: str) -> Path:
+        """Re-execute the model along the recorded fingerprint path of the
+        discovering trace (the host checkers' Path.from_fingerprints
+        technique, ref: src/checker/path.rs:20-97)."""
+        from .frontier import replay_fp_chain
+
+        return replay_fp_chain(self.model, self._discoveries[name])

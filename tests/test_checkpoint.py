@@ -1,0 +1,96 @@
+"""Search checkpoint/resume tests (SURVEY.md §5: the reference has no
+partial-search checkpointing; with device-array frontiers it is nearly free):
+a suspended search dumped to disk and restored into a fresh engine must finish
+with exactly the counts of an uninterrupted run."""
+
+import numpy as np
+import pytest
+
+from stateright_tpu.tensor import FrontierSearch
+from stateright_tpu.tensor.models import TensorLinearEquation, TensorTwoPhaseSys
+
+
+def test_kill_and_resume_reproduces_exact_counts(tmp_path):
+    # Uninterrupted oracle.
+    full = FrontierSearch(TensorTwoPhaseSys(4), 256, 14).run()
+    assert full.complete
+
+    # Interrupt after 2 device steps, checkpoint, "kill", restore, finish.
+    fs = FrontierSearch(TensorTwoPhaseSys(4), 256, 14)
+    partial = fs.run(max_steps=2)
+    assert not partial.complete
+    assert partial.state_count < full.state_count
+    ckpt = str(tmp_path / "search.npz")
+    fs.checkpoint(ckpt)
+    del fs
+
+    resumed = FrontierSearch.load_checkpoint(
+        TensorTwoPhaseSys(4), ckpt, batch_size=256
+    )
+    r = resumed.run()
+    assert r.complete
+    assert r.unique_state_count == full.unique_state_count
+    assert r.state_count == full.state_count
+    assert r.max_depth == full.max_depth
+    assert set(r.discoveries) == set(full.discoveries)
+    # Path reconstruction works from the restored table too.
+    path = resumed.reconstruct_path(r.discoveries["commit agreement"])
+    assert path.last_state() is not None
+
+
+def test_multiple_suspensions(tmp_path):
+    full = FrontierSearch(TensorLinearEquation(2, 4, 7), 256, 18).run()
+    fs = FrontierSearch(TensorLinearEquation(2, 4, 7), 256, 18)
+    ckpt = str(tmp_path / "s.npz")
+    for _ in range(50):
+        r = fs.run(max_steps=3)
+        fs.checkpoint(ckpt)
+        fs = FrontierSearch.load_checkpoint(
+            TensorLinearEquation(2, 4, 7), ckpt, batch_size=256
+        )
+        if r.complete:
+            break
+    else:
+        r = fs.run()
+    assert r.state_count == full.state_count
+    assert r.unique_state_count == full.unique_state_count
+
+
+def test_layout_mismatch_rejected(tmp_path):
+    fs = FrontierSearch(TensorTwoPhaseSys(4), 64, 12)
+    fs.run(max_steps=1)
+    ckpt = str(tmp_path / "s.npz")
+    fs.checkpoint(ckpt)
+    with pytest.raises(ValueError):
+        FrontierSearch.load_checkpoint(TensorTwoPhaseSys(5), ckpt)
+
+
+def test_checkpoint_before_run_rejected(tmp_path):
+    fs = FrontierSearch(TensorTwoPhaseSys(3), 64, 12)
+    with pytest.raises(RuntimeError):
+        fs.checkpoint(str(tmp_path / "s.npz"))
+
+
+def test_early_exit_stays_incomplete_across_runs(tmp_path):
+    from stateright_tpu.core.discovery import HasDiscoveries
+
+    fs = FrontierSearch(TensorTwoPhaseSys(3), 64, 12)
+    r1 = fs.run(finish_when=HasDiscoveries.ANY)
+    assert not r1.complete and r1.unique_state_count < 288
+    # Resuming after an early exit must not claim exhaustion: the frontier
+    # was discarded, not drained.
+    r2 = fs.run()
+    assert not r2.complete
+    fs.checkpoint(str(tmp_path / "s.npz"))
+    resumed = FrontierSearch.load_checkpoint(
+        TensorTwoPhaseSys(3), str(tmp_path / "s.npz"), batch_size=64
+    )
+    assert not resumed.run().complete
+
+
+def test_suspended_result_discoveries_are_snapshots():
+    fs = FrontierSearch(TensorTwoPhaseSys(3), 64, 12)
+    r1 = fs.run(max_steps=1)
+    snapshot = dict(r1.discoveries)
+    fs.run()
+    assert r1.discoveries == snapshot  # no aliasing of the live dict

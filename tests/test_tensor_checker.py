@@ -213,3 +213,67 @@ def test_tpu_checker_finish_when():
     )
     assert len(checker.discoveries()) >= 1
     assert checker.unique_state_count() < 288  # stopped early
+
+
+def test_resident_target_max_depth_matches_host():
+    from stateright_tpu.examples.two_phase_commit import TwoPhaseSys
+    from stateright_tpu.tensor.resident import ResidentSearch
+
+    host = (
+        TwoPhaseSys(4).checker().target_max_depth(6).spawn_bfs().join()
+    )
+    r = ResidentSearch(TensorTwoPhaseSys(4), 256, 14).run(target_max_depth=6)
+    assert r.unique_state_count == host.unique_state_count()
+    assert r.state_count == host.state_count()
+    assert r.max_depth == host.max_depth() == 6
+
+
+def test_sharded_target_max_depth_matches_host():
+    from stateright_tpu.examples.two_phase_commit import TwoPhaseSys
+    from stateright_tpu.parallel.sharded import ShardedSearch, make_mesh
+
+    host = (
+        TwoPhaseSys(3).checker().target_max_depth(5).spawn_bfs().join()
+    )
+    r = ShardedSearch(
+        TensorTwoPhaseSys(3), mesh=make_mesh(), batch_size=64, table_log2=10
+    ).run(target_max_depth=5)
+    assert r.unique_state_count == host.unique_state_count()
+    assert r.state_count == host.state_count()
+
+
+def test_tpu_checker_rejects_visitor():
+    from stateright_tpu.core.visitor import StateRecorder
+
+    with pytest.raises(NotImplementedError):
+        (
+            TensorTwoPhaseSys(3)
+            .checker()
+            .visitor(StateRecorder())
+            .spawn_tpu(batch_size=64, table_log2=10)
+        )
+
+
+def test_resident_rejects_timeout_directly():
+    from stateright_tpu.tensor.resident import ResidentSearch
+
+    with pytest.raises(NotImplementedError):
+        ResidentSearch(TensorTwoPhaseSys(3), 64, 10).run(timeout=1.0)
+
+
+def test_tpu_checker_assert_discovery():
+    checker = (
+        TensorTwoPhaseSys(3)
+        .checker()
+        .spawn_tpu(batch_size=512, table_log2=16)
+        .join()
+    )
+    # The checker's own witness must re-validate by re-execution.
+    witness = checker.discovery("commit agreement").actions()
+    checker.assert_discovery("commit agreement", witness)
+    # A bogus action list must be rejected.
+    with pytest.raises(AssertionError):
+        checker.assert_discovery("commit agreement", ["TmAbort"])
+    # An action list that replays but does not witness the property: reject.
+    with pytest.raises(AssertionError):
+        checker.assert_discovery("commit agreement", witness[:-1])
