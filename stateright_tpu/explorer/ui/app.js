@@ -71,14 +71,50 @@ function render() {
       o.textContent = v.outcome;
       li.appendChild(o);
     }
+    // Per-state property verdicts as inline chips. Only an unsatisfied
+    // ALWAYS is a violation; an unsatisfied sometimes/eventually condition
+    // on an intermediate state is simply "not (yet) witnessed here".
+    if (!v.ignored && v.properties && v.properties.length) {
+      const chips = document.createElement("span");
+      chips.className = "chips";
+      for (const p of v.properties) {
+        const c = document.createElement("span");
+        const cls = p.satisfied
+          ? "ok"
+          : p.expectation === "always"
+            ? "bad"
+            : "idle";
+        c.className = "chip " + cls;
+        c.title = `${p.expectation} "${p.name}": ` +
+          (p.satisfied
+            ? "holds here"
+            : p.expectation === "always"
+              ? "VIOLATED here"
+              : "not witnessed here");
+        c.textContent = p.name;
+        chips.appendChild(c);
+      }
+      li.appendChild(chips);
+    }
     if (!v.ignored) li.onclick = () => follow(i);
     stepsEl.appendChild(li);
   });
 
+  // Sequence diagram of the SELECTED next step (path + that step);
+  // follows j/k selection like the reference's diagram pane.
   const svgHost = $("svg");
-  const cur = views.find((v) => v.svg);
   svgHost.innerHTML = "";
-  if (steps.length && cur && cur.svg) svgHost.innerHTML = cur.svg;
+  const sel = views[selected] && !views[selected].ignored
+    ? views[selected]
+    : views.find((v) => v.svg);
+  if (sel && sel.svg) svgHost.innerHTML = sel.svg;
+
+  // Preview of the selected successor state.
+  const preview = $("preview");
+  if (preview) {
+    preview.textContent =
+      sel && sel.state ? sel.state : "";
+  }
 }
 
 function follow(i) {

@@ -594,6 +594,7 @@ class FrontierSearch:
                         "discoveries": self._disc,
                         "lanes": self.model.lanes,
                         "max_actions": self.model.max_actions,
+                        "properties": [p.name for p in self.properties],
                         "table_log2": self.table.log2_size,
                     }
                 ).encode(),
@@ -619,6 +620,14 @@ class FrontierSearch:
                 "checkpoint was taken with a different model layout "
                 f"(lanes/max_actions {meta['lanes']}/{meta['max_actions']} "
                 f"!= {model.lanes}/{model.max_actions})"
+            )
+        prop_names = [p.name for p in model.properties()]
+        if meta.get("properties", prop_names) != prop_names:
+            # q_ebits columns and discovery bits are indexed by property
+            # position; a different set/order would silently misalign them.
+            raise ValueError(
+                "checkpoint was taken with a different property list "
+                f"({meta['properties']} != {prop_names})"
             )
         fs = cls(model, batch_size=batch_size, table_log2=meta["table_log2"])
         fs.table.t_lo = jnp.asarray(data["t_lo"])

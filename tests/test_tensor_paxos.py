@@ -84,3 +84,22 @@ def test_sharded_paxos_parity():
     # Host oracle: PaxosModelCfg(1, 3) -> 265 unique / 482 generated.
     assert r.unique_state_count == 265
     assert r.state_count == 482
+
+
+@pytest.mark.slow
+def test_paxos3_golden_counts():
+    """The north-star workload (BASELINE.json): 3-client / 3-server Paxos.
+    Golden counts were established by the compiled C++ baseline checker
+    (stateright_tpu/_native/baseline_bfs.cpp), whose semantics are anchored to
+    the reference's 16,668-state paxos-2 golden (examples/paxos.rs:327), and
+    independently reproduced by the device engine on real TPU hardware
+    (BASELINE_MEASURED.md): 1,194,428 unique / 2,420,477 generated."""
+    from stateright_tpu.tensor.resident import ResidentSearch
+
+    r = ResidentSearch(
+        TensorPaxos(client_count=3), batch_size=8192, table_log2=22
+    ).run()
+    assert r.unique_state_count == 1_194_428
+    assert r.state_count == 2_420_477
+    assert r.complete
+    assert set(r.discoveries) == {"value chosen"}
