@@ -96,12 +96,32 @@ def run_baseline(exe: str, model: str, n: int, repeats: int = 3):
 # -- device ----------------------------------------------------------------
 
 
-_PROBE_SNIPPET = (
-    "import jax, jax.numpy as jnp;"
+# The image's site config re-registers the axon TPU platform and overrides a
+# plain JAX_PLATFORMS env var; applying the env var at the jax.config level
+# restores it, so `JAX_PLATFORMS=cpu python bench.py` really benches on CPU
+# (used by verification runs when the TPU tunnel is down).
+_PIN_SNIPPET = (
+    "import os, jax;"
+    "p = os.environ.get('JAX_PLATFORMS');"
+    "jax.config.update('jax_platforms', p) if p else None;"
+)
+
+_PROBE_SNIPPET = _PIN_SNIPPET + (
+    "import jax.numpy as jnp;"
     "x = jax.jit(lambda a: a * 2 + 1)(jnp.arange(8));"
     "x.block_until_ready();"
     "print('PROBE_OK', jax.devices())"
 )
+
+
+def _pin_platform() -> None:
+    import os
+
+    p = os.environ.get("JAX_PLATFORMS")
+    if p:
+        import jax
+
+        jax.config.update("jax_platforms", p)
 
 
 def probe_device(attempts: int = 6, delay: float = 20.0):
@@ -122,7 +142,7 @@ def probe_device(attempts: int = 6, delay: float = 20.0):
                 [sys.executable, "-c", _PROBE_SNIPPET],
                 capture_output=True,
                 text=True,
-                timeout=300,
+                timeout=180,
             )
         except Exception as e:  # noqa: BLE001
             last = f"probe subprocess failed: {e}"
@@ -143,6 +163,7 @@ def probe_device(attempts: int = 6, delay: float = 20.0):
 
 def device_search(model_name: str, n: int, repeats: int = 3):
     """Run the resident engine; returns (result dict, parity error or None)."""
+    _pin_platform()
     from stateright_tpu.tensor.resident import ResidentSearch
 
     if model_name == "paxos":

@@ -609,7 +609,7 @@ static void run(const Model& model, int threads, const char* name) {
   double sec = std::chrono::duration<double>(
                    std::chrono::steady_clock::now() - t0).count();
   std::printf(
-      "model=%s states=%llu unique=%llu depth=%d sec=%.3f threads=%d "
+      "model=%s states=%llu unique=%llu depth=%d sec=%.6f threads=%d "
       "violations=%llu\n",
       name, (unsigned long long)bfs.generated.load(),
       (unsigned long long)bfs.unique, bfs.depth, sec, threads,

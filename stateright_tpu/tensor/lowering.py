@@ -34,6 +34,9 @@ Host-semantics parity (all cited behaviors preserved exactly):
 - unordered duplicating networks keep the envelope set + `last_msg` lane
   (redelivery changes the fingerprint, ref: src/actor/network.rs:52,224-228);
   unordered non-duplicating networks are a sorted bounded multiset pool;
+  ordered networks are per-directed-flow left-aligned FIFO rings where only
+  flow heads are deliverable and a no-op delivery still pops the head
+  (ref: src/actor/network.rs:243-265, src/actor/model.rs:345-347);
 - state identity covers (actor states, history, timers, network), matching
   `ActorModelState`'s manual Hash (ref: src/actor/model_state.rs:134-145).
 
@@ -44,7 +47,7 @@ Soundness guards: every closure is bounded (`max_local_states`,
 becomes the reserved POISON row and the auto-added "lowering coverage"
 property reports it as a counterexample instead of silently mis-exploring.
 
-Not yet lowered (explicit errors): ordered networks, crashes, random choices.
+Not yet lowered (explicit errors): crashes, random choices.
 """
 
 from __future__ import annotations
@@ -86,6 +89,7 @@ class LoweredActorModel(TensorModel):
         model: ActorModel,
         *,
         pool_size: int = 16,
+        flow_depth: int = 8,
         max_emit: int = 4,
         local_boundary: Optional[Callable] = None,
         max_local_states: int = 1 << 12,
@@ -96,13 +100,10 @@ class LoweredActorModel(TensorModel):
     ):
         self.model = model
         self.kind = model.init_network.kind
-        if self.kind == ORDERED:
-            raise LoweringError(
-                "ordered networks are not lowered yet; use the host checkers"
-            )
         if model.max_crashes:
             raise LoweringError("crash injection is not lowered yet")
         self.pool_size = pool_size
+        self.flow_depth = flow_depth
         self.max_emit = max_emit
         self.local_boundary = local_boundary or (lambda i, s: True)
         self.max_local_states = max_local_states
@@ -260,7 +261,13 @@ class LoweredActorModel(TensorModel):
                         f"must be total): state={state!r}, env={env!r}"
                     ) from e
                 emits, tclr, tset = run_commands(dst, out)
-                if nxt is None and not out.commands:
+                # No-op elision — except on ordered networks, where delivery
+                # still pops the flow head (ref: src/actor/model.rs:345-347).
+                if (
+                    nxt is None
+                    and not out.commands
+                    and self.kind != ORDERED
+                ):
                     self.deliver[(eid, sid)] = None  # elided no-op
                     continue
                 new_sid = sid if nxt is None else sid_of(dst, nxt)
@@ -438,6 +445,24 @@ class LoweredActorModel(TensorModel):
         if self.kind == UNORDERED_NONDUPLICATING:
             lane += self.pool_size
             n_net_actions = self.pool_size
+        elif self.kind == ORDERED:
+            # Per directed flow: a left-aligned FIFO ring of eids. Flows are
+            # the (src, dst) pairs observed in the envelope vocabulary.
+            self.flows = sorted(
+                {(int(e.src), int(e.dst)) for e in self.envs}
+            )
+            self.flow_ids = {f: i for i, f in enumerate(self.flows)}
+            self.F = len(self.flows)
+            self._E_flow = np.asarray(
+                [
+                    self.flow_ids[(int(e.src), int(e.dst))]
+                    for e in self.envs
+                ]
+                or [0],
+                np.uint32,
+            )
+            lane += self.F * self.flow_depth
+            n_net_actions = self.F
         else:  # duplicating: envelope-set bitmask + last_msg lane
             self.nbits = (self.E + 31) // 32
             lane += self.nbits + 1
@@ -531,6 +556,17 @@ class LoweredActorModel(TensorModel):
                 row[self.net_off + j] = e
             for j in range(len(pool), self.pool_size):
                 row[self.net_off + j] = EMPTY
+        elif self.kind == ORDERED:
+            row[self.net_off : self.net_off + self.F * self.flow_depth] = EMPTY
+            counts = [0] * self.F
+            for e in sys_state.network.iter_all():  # FIFO order per flow
+                f = self.flow_ids[(int(e.src), int(e.dst))]
+                if counts[f] >= self.flow_depth:
+                    raise LoweringError("init network exceeds flow_depth")
+                row[self.net_off + f * self.flow_depth + counts[f]] = (
+                    self.env_ids[(int(e.src), int(e.dst), e.msg)]
+                )
+                counts[f] += 1
         else:
             for e in sys_state.network.iter_all():
                 eid = self.env_ids[(int(e.src), int(e.dst), e.msg)]
@@ -570,6 +606,19 @@ class LoweredActorModel(TensorModel):
                 for e in row[self.net_off : self.net_off + self.pool_size]
                 if e != int(EMPTY)
             ]
+        elif self.kind == ORDERED:
+            out["network"] = {
+                self.flows[f]: [
+                    self.envs[e].msg
+                    for e in row[
+                        self.net_off + f * self.flow_depth :
+                        self.net_off + (f + 1) * self.flow_depth
+                    ]
+                    if e != int(EMPTY)
+                ]
+                for f in range(self.F)
+                if row[self.net_off + f * self.flow_depth] != int(EMPTY)
+            }
         else:
             out["network"] = [
                 self.envs[e]
@@ -580,22 +629,22 @@ class LoweredActorModel(TensorModel):
             out["last_msg"] = self.envs[lm] if lm != int(EMPTY) else None
         return out
 
+    def _slot_env(self, row, j: int) -> int:
+        if self.kind == UNORDERED_NONDUPLICATING:
+            return int(row[self.net_off + j])
+        if self.kind == ORDERED:
+            return int(row[self.net_off + j * self.flow_depth])  # flow head
+        return j
+
     def action_label(self, row, action_index):
         if action_index < self.deliver_slots:
-            if self.kind == UNORDERED_NONDUPLICATING:
-                e = int(row[self.net_off + action_index])
-            else:
-                e = action_index
+            e = self._slot_env(row, action_index)
             if e == int(EMPTY):
                 return "noop"
             env = self.envs[e]
             return f"Deliver {{ src: {env.src!r}, dst: {env.dst!r}, msg: {env.msg!r} }}"
         if action_index < self.deliver_slots + self.drop_slots:
-            j = action_index - self.deliver_slots
-            if self.kind == UNORDERED_NONDUPLICATING:
-                e = int(row[self.net_off + j])
-            else:
-                e = j
+            e = self._slot_env(row, action_index - self.deliver_slots)
             if e == int(EMPTY):
                 return "noop"
             return f"Drop({self.envs[e]!r})"
@@ -681,8 +730,76 @@ class LoweredActorModel(TensorModel):
             states[:, None, :], (B, self.deliver_slots, self.lanes)
         )
 
+        def push_emits_ordered(flows4, emits):
+            """Append emissions to their flows' tails, in order.
+            flows4: [B, S, F, Dq]; emits: [B, S, max_emit].
+            Returns (flows4, overflow[B, S])."""
+            F, Dq = self.F, self.flow_depth
+            flow_of = jnp.asarray(self._E_flow)
+            overflow = jnp.zeros(flows4.shape[:2], bool)
+            for j in range(self.max_emit):
+                em = emits[:, :, j]  # [B, S]
+                tf = jnp.take(
+                    flow_of,
+                    jnp.minimum(em, u(self.E - 1)).astype(jnp.int32),
+                ).astype(jnp.int32)
+                cnt = (flows4 != EMPTY).sum(axis=3)  # [B, S, F]
+                pos = jnp.take_along_axis(cnt, tf[:, :, None], axis=2)[:, :, 0]
+                live = em != EMPTY
+                overflow = overflow | (live & (pos >= Dq))
+                sel = (
+                    (jnp.arange(F)[None, None, :, None] == tf[:, :, None, None])
+                    & (
+                        jnp.arange(Dq)[None, None, None, :]
+                        == pos[:, :, None, None]
+                    )
+                    & live[:, :, None, None]
+                )
+                flows4 = jnp.where(sel, em[:, :, None, None], flows4)
+            return flows4, overflow
+
         if self.deliver_slots == 0:
             pass  # no envelopes can ever exist (E == 0)
+        elif self.kind == ORDERED:
+            F, Dq = self.F, self.flow_depth
+            flows = states[:, self.net_off : self.net_off + F * Dq].reshape(
+                B, F, Dq
+            )
+            head = flows[:, :, 0]  # [B, F]
+            deliverable = head != EMPTY
+            (
+                d_actor, new_sid, emits, tclr, tset, hev, valid, poison
+            ) = lookup_deliver(head, deliverable)
+            succ = apply_common(d_actor, new_sid, emits, tclr, tset, hev, base)
+            # Pop the delivered flow's head (slot f pops flow f), then push
+            # emissions FIFO.
+            shifted = jnp.concatenate(
+                [flows[:, :, 1:], jnp.full((B, F, 1), EMPTY)], axis=2
+            )
+            eye = jnp.arange(F)[:, None] == jnp.arange(F)[None, :]  # [S, F]
+            # Slot f pops flow f (shared by deliver and drop successors).
+            popped = jnp.where(
+                eye[None, :, :, None],
+                shifted[:, None, :, :],
+                flows[:, None, :, :],
+            )
+            flows4, push_ovf = push_emits_ordered(popped, emits)
+            succ = succ.at[:, :, self.net_off : self.net_off + F * Dq].set(
+                flows4.reshape(B, F, F * Dq)
+            )
+            poison = poison | (valid & push_ovf)
+            succ_parts.append(succ)
+            valid_parts.append((valid | poison, poison))
+
+            if self.drop_slots:
+                dbase = jnp.broadcast_to(
+                    states[:, None, :], (B, F, self.lanes)
+                )
+                dsucc = dbase.at[
+                    :, :, self.net_off : self.net_off + F * Dq
+                ].set(popped.reshape(B, F, F * Dq))
+                succ_parts.append(dsucc)
+                valid_parts.append((deliverable, jnp.zeros_like(deliverable)))
         elif self.kind == UNORDERED_NONDUPLICATING:
             pool = states[:, self.net_off : self.net_off + self.pool_size]
             e = pool  # [B, P]
@@ -808,6 +925,19 @@ class LoweredActorModel(TensorModel):
             )
             if self.E == 0:
                 pass  # no envelope vocabulary: timeouts cannot emit
+            elif self.kind == ORDERED:
+                F, Dq = self.F, self.flow_depth
+                flows = states[
+                    :, self.net_off : self.net_off + F * Dq
+                ].reshape(B, F, Dq)
+                tflows4 = jnp.broadcast_to(
+                    flows[:, None, :, :], (B, nT, F, Dq)
+                )
+                tflows4, push_ovf = push_emits_ordered(tflows4, emits)
+                succ = succ.at[
+                    :, :, self.net_off : self.net_off + F * Dq
+                ].set(tflows4.reshape(B, nT, F * Dq))
+                poison = poison | (valid & push_ovf)
             elif self.kind == UNORDERED_NONDUPLICATING:
                 pool = states[:, self.net_off : self.net_off + self.pool_size]
                 P = self.pool_size
@@ -936,6 +1066,16 @@ class LoweredView:
                 pool = states[:, m.net_off : m.net_off + m.pool_size]
                 safe = jnp.minimum(pool, jnp.uint32(m.E - 1)).astype(jnp.int32)
                 ok = jnp.take(jnp.asarray(match), safe) & (pool != EMPTY)
+                return jnp.any(ok, axis=1)
+            if m.kind == ORDERED:
+                # Deliverable envelopes = flow heads (iter_deliverable
+                # semantics, matching host properties like "value chosen").
+                flows = states[
+                    :, m.net_off : m.net_off + m.F * m.flow_depth
+                ].reshape(states.shape[0], m.F, m.flow_depth)
+                head = flows[:, :, 0]
+                safe = jnp.minimum(head, jnp.uint32(m.E - 1)).astype(jnp.int32)
+                ok = jnp.take(jnp.asarray(match), safe) & (head != EMPTY)
                 return jnp.any(ok, axis=1)
             bits = states[:, m.net_off : m.net_off + m.nbits]
             mask = np.zeros(m.nbits, np.uint32)

@@ -266,12 +266,71 @@ def test_timer_lowering_parity():
 
 
 def test_lowering_rejects_unsupported_features():
-    cfg = PingPongCfg(max_nat=1).into_model()
-    with pytest.raises(LoweringError):
-        lower_actor_model(cfg.with_init_network(Network.new_ordered()))
     cfg2 = PingPongCfg(max_nat=1).into_model().with_max_crashes(1)
     with pytest.raises(LoweringError):
         lower_actor_model(cfg2)
+
+
+def test_ping_pong_ordered_network_golden():
+    # Ordered networks: only flow heads deliver, and a no-op delivery still
+    # pops the head (3-state golden of the host test suite).
+    lowered = _ping_pong_lowered(5, LossyNetwork.NO, Network.new_ordered())
+    host = _host(
+        PingPongCfg(max_nat=5, maintains_history=False)
+        .into_model()
+        .with_init_network(Network.new_ordered())
+        .with_lossy_network(LossyNetwork.NO)
+    )
+    r = FrontierSearch(lowered, batch_size=64, table_log2=10).run()
+    assert r.unique_state_count == host.unique_state_count()
+    assert r.state_count == host.state_count()
+    assert set(r.discoveries) == set(host.discoveries())
+
+
+def test_ping_pong_ordered_lossy_parity():
+    lowered = _ping_pong_lowered(3, LossyNetwork.YES, Network.new_ordered())
+    host = _host(
+        PingPongCfg(max_nat=3, maintains_history=False)
+        .into_model()
+        .with_init_network(Network.new_ordered())
+        .with_lossy_network(LossyNetwork.YES)
+    )
+    r = FrontierSearch(lowered, batch_size=256, table_log2=14).run()
+    assert r.unique_state_count == host.unique_state_count()
+    assert r.state_count == host.state_count()
+    assert set(r.discoveries) == set(host.discoveries())
+
+
+def test_single_copy_register_ordered_with_history():
+    # Ordered network + lowered LinearizabilityTester together (the shape of
+    # the reference's `linearizable-register check N ordered` bench config).
+    from stateright_tpu.actor.register import GetOk
+    from stateright_tpu.examples.single_copy_register import (
+        NULL_VALUE,
+        SingleCopyModelCfg,
+    )
+
+    cfg = SingleCopyModelCfg(
+        client_count=2, server_count=1, network=Network.new_ordered()
+    )
+    host = _host(cfg.into_model())
+
+    def properties(view):
+        lin = view.history_pred(lambda h: h.serialized_history() is not None)
+        chosen = view.any_env(
+            lambda env: isinstance(env.msg, GetOk)
+            and env.msg.value != NULL_VALUE
+        )
+        return [
+            TensorProperty.always("linearizable", lambda m, s: lin(s)),
+            TensorProperty.sometimes("value chosen", lambda m, s: chosen(s)),
+        ]
+
+    lowered = lower_actor_model(cfg.into_model(), properties=properties)
+    r = FrontierSearch(lowered, batch_size=128, table_log2=12).run()
+    assert r.unique_state_count == host.unique_state_count()
+    assert r.state_count == host.state_count()
+    assert set(r.discoveries) == set(host.discoveries())
 
 
 def test_unbounded_local_state_is_reported():
