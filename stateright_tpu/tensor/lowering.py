@@ -47,7 +47,15 @@ Soundness guards: every closure is bounded (`max_local_states`,
 becomes the reserved POISON row and the auto-added "lowering coverage"
 property reports it as a counterexample instead of silently mis-exploring.
 
-Not yet lowered (explicit errors): crashes, random choices.
+Random choices lower via per-actor vocabularies (pending-choice maps, choice
+values, and command deltas become gather tables; SelectRandom action slots pop
+a choice and run the real `on_random` reaction); crash injection lowers to a
+crash-bitmask lane with per-actor Crash actions that clear timers and pending
+choices (ref: src/actor/model.rs:291-313, 400-426). Both are auxiliary state
+the reference EXCLUDES from identity (manual Hash,
+ref: src/actor/model_state.rs:134-145) — the lowering mirrors that through the
+`representative` canonicalization hook, so engines fingerprint states with
+those lanes stripped while continuing the search with the originals.
 """
 
 from __future__ import annotations
@@ -100,8 +108,9 @@ class LoweredActorModel(TensorModel):
     ):
         self.model = model
         self.kind = model.init_network.kind
-        if model.max_crashes:
-            raise LoweringError("crash injection is not lowered yet")
+        if model.max_crashes and len(model.actors) > 32:
+            raise LoweringError("crash lowering supports at most 32 actors")
+        self.max_crashes = model.max_crashes
         self.pool_size = pool_size
         self.flow_depth = flow_depth
         self.max_emit = max_emit
@@ -118,6 +127,21 @@ class LoweredActorModel(TensorModel):
         self._layout()
         self._bake_tables()
         self._props = self._build_properties()
+        if self.has_randoms or self.max_crashes:
+            # Pending random choices and crash flags are auxiliary state the
+            # reference EXCLUDES from identity (manual Hash,
+            # ref: src/actor/model_state.rs:134-145): engines fingerprint the
+            # canonical form below while continuing with the original state.
+            self.representative = self._strip_aux
+
+    def _strip_aux(self, states):
+        if self.has_randoms:
+            states = states.at[
+                :, self.rand_off : self.rand_off + self.n
+            ].set(0)
+        if self.max_crashes:
+            states = states.at[:, self.crash_off].set(0)
+        return states
 
     # -- host closure ----------------------------------------------------------
 
@@ -130,7 +154,19 @@ class LoweredActorModel(TensorModel):
         self.timer_ids: list[dict] = [dict() for _ in range(self.n)]
         self.timers: list[list] = [[] for _ in range(self.n)]
 
+        # Random-choice vocabularies (ref: src/actor/model.rs:302-313,
+        # 411-426). A randoms MAP (key -> choices) is a canonical tuple of
+        # items sorted by key repr; a DELTA is the ordered ChooseRandom ops a
+        # transition issued; a CHOICE is one selectable value.
+        self.rmaps: list[list] = [[()] for _ in range(self.n)]  # rid -> map
+        self.rmap_ids: list[dict] = [{(): 0} for _ in range(self.n)]
+        self.rdeltas: list[list] = [[()] for _ in range(self.n)]  # did -> ops
+        self.rdelta_ids: list[dict] = [{(): 0} for _ in range(self.n)]
+        self.rchoices: list[list] = [[] for _ in range(self.n)]  # cid -> value
+        self.rchoice_ids: list[dict] = [dict() for _ in range(self.n)]
+
         pending: deque = deque()  # ("d", eid, sid) | ("t", actor, tid, sid)
+        #                         | ("r", actor, cid, sid)
         done: set = set()
         # sids whose local_boundary failed: encoded but never expanded.
         frozen: set = set()  # (actor, sid)
@@ -173,6 +209,8 @@ class LoweredActorModel(TensorModel):
                             pending.append(("d", eid, sid))
                     for tid in range(len(self.timers[actor])):
                         pending.append(("t", actor, tid, sid))
+                    for cid in range(len(self.rchoices[actor])):
+                        pending.append(("r", actor, cid, sid))
                 else:
                     frozen.add((actor, sid))
             return sid
@@ -190,11 +228,31 @@ class LoweredActorModel(TensorModel):
                         pending.append(("t", actor, tid, sid))
             return tid
 
+        def choice_id(actor: int, value) -> int:
+            cid = self.rchoice_ids[actor].get(value)
+            if cid is None:
+                cid = len(self.rchoices[actor])
+                self.rchoice_ids[actor][value] = cid
+                self.rchoices[actor].append(value)
+                for sid in range(len(self.states[actor])):
+                    if (actor, sid) not in frozen:
+                        pending.append(("r", actor, cid, sid))
+            return cid
+
+        def delta_id(actor: int, rops: tuple) -> int:
+            did = self.rdelta_ids[actor].get(rops)
+            if did is None:
+                did = len(self.rdeltas[actor])
+                self.rdelta_ids[actor][rops] = did
+                self.rdeltas[actor].append(rops)
+            return did
+
         def run_commands(actor: int, out: Out):
-            """-> (emit eids in order, tclr mask, tset mask)"""
+            """-> (emit eids in order, tclr mask, tset mask, randoms delta)"""
             emits: list[int] = []
             tclr = 0
             tset = 0
+            rops: list = []
             for c in out:
                 if isinstance(c, Send):
                     if len(emits) >= self.max_emit:
@@ -212,10 +270,12 @@ class LoweredActorModel(TensorModel):
                     tclr |= bit
                     tset &= ~bit
                 elif isinstance(c, ChooseRandom):
-                    raise LoweringError("random choices are not lowered yet")
+                    for v in c.choices:
+                        choice_id(actor, v)
+                    rops.append((c.key, tuple(c.choices)))
                 else:
                     raise LoweringError(f"unknown command {c!r}")
-            return emits, tclr, tset
+            return emits, tclr, tset, delta_id(actor, tuple(rops))
 
         # Seed: envelopes pre-loaded in the init network first (the
         # reference's seeded-network pattern), then on_start per actor
@@ -230,7 +290,7 @@ class LoweredActorModel(TensorModel):
         for index, actor in enumerate(model.actors):
             out = Out()
             state = actor.on_start(Id(index), out)
-            emits, _tclr, tset = run_commands(index, out)
+            emits, _tclr, tset, _did = run_commands(index, out)
             self._init_sids.append(sid_of(index, state))
             self._init_emits.extend(emits)
             self._init_tset[index] = tset
@@ -238,11 +298,35 @@ class LoweredActorModel(TensorModel):
         # Reaction closure.
         self.deliver: dict = {}  # (eid, sid) -> entry dict
         self.timeout: dict = {}  # (actor, tid, sid) -> entry dict
+        self.random: dict = {}  # (actor, cid, sid) -> entry dict
         while pending:
             item = pending.popleft()
             if item in done:
                 continue
             done.add(item)
+            if item[0] == "r":
+                _, actor, cid, sid = item
+                value = self.rchoices[actor][cid]
+                state = self.states[actor][sid]
+                out = Out()
+                try:
+                    nxt = model.actors[actor].on_random(
+                        Id(actor), state, value, out
+                    )
+                except Exception as e:
+                    raise LoweringError(
+                        f"actor {actor} on_random raised during closure: "
+                        f"state={state!r}, random={value!r}"
+                    ) from e
+                emits, tclr, tset, did = run_commands(actor, out)
+                new_sid = sid if nxt is None else sid_of(actor, nxt)
+                # No elision: selecting consumes the pending choice even when
+                # the handler does nothing (ref: src/actor/model.rs:411-426).
+                self.random[(actor, cid, sid)] = dict(
+                    new_sid=new_sid, emits=emits, tclr=tclr, tset=tset,
+                    env=None, delta=did,
+                )
+                continue
             if item[0] == "d":
                 _, eid, sid = item
                 env = self.envs[eid]
@@ -260,7 +344,7 @@ class LoweredActorModel(TensorModel):
                         "closure over-approximates reachability, so handlers "
                         f"must be total): state={state!r}, env={env!r}"
                     ) from e
-                emits, tclr, tset = run_commands(dst, out)
+                emits, tclr, tset, did = run_commands(dst, out)
                 # No-op elision — except on ordered networks, where delivery
                 # still pops the flow head (ref: src/actor/model.rs:345-347).
                 if (
@@ -272,7 +356,8 @@ class LoweredActorModel(TensorModel):
                     continue
                 new_sid = sid if nxt is None else sid_of(dst, nxt)
                 self.deliver[(eid, sid)] = dict(
-                    new_sid=new_sid, emits=emits, tclr=tclr, tset=tset, env=eid
+                    new_sid=new_sid, emits=emits, tclr=tclr, tset=tset,
+                    env=eid, delta=did,
                 )
             else:
                 _, actor, tid, sid = item
@@ -288,7 +373,7 @@ class LoweredActorModel(TensorModel):
                         f"actor {actor} on_timeout raised during closure: "
                         f"state={state!r}, timer={timer!r}"
                     ) from e
-                emits, tclr, tset = run_commands(actor, out)
+                emits, tclr, tset, did = run_commands(actor, out)
                 if (
                     nxt is None
                     and len(out.commands) == 1
@@ -302,11 +387,78 @@ class LoweredActorModel(TensorModel):
                 if not (tset & bit):
                     tclr |= bit  # fired timer is consumed unless re-set
                 self.timeout[(actor, tid, sid)] = dict(
-                    new_sid=new_sid, emits=emits, tclr=tclr, tset=tset, env=None
+                    new_sid=new_sid, emits=emits, tclr=tclr, tset=tset,
+                    env=None, delta=did,
                 )
 
+        self._close_randoms()
         self._close_histories()
 
+    def _close_randoms(self) -> None:
+        """Close the per-actor randoms-map vocabulary (key -> pending
+        choices) under delta application and choice-popping, and resolve the
+        flattened SelectRandom slot tables. Over-approximates by applying
+        every delta to every map — sound, and bounded for the usual
+        replace-or-clear usage of choose_random."""
+        self.has_randoms = any(
+            any(ops for ops in deltas) for deltas in self.rdeltas
+        )
+        self._rapply: list[dict] = []
+        self._rsel: list[dict] = []  # (rid, j) -> (cid, rid_after_pop)
+        self.max_rand_slots: list[int] = []
+        for i in range(self.n):
+            maps = self.rmaps[i]
+            ids = self.rmap_ids[i]
+
+            def canon(d):
+                return tuple(sorted(d.items(), key=lambda kv: repr(kv[0])))
+
+            work = deque(range(len(maps)))
+
+            def map_id(t):
+                mid = ids.get(t)
+                if mid is None:
+                    mid = len(maps)
+                    if mid >= 4096:
+                        raise LoweringError(
+                            f"actor {i} randoms-map vocabulary exceeded 4096; "
+                            "choose_random usage may be unbounded"
+                        )
+                    ids[t] = mid
+                    maps.append(t)
+                    work.append(mid)
+                return mid
+
+            rapply: dict = {}
+            rsel: dict = {}
+            seen: set = set()
+            max_j = 0
+            while work:
+                rid = work.popleft()
+                if rid in seen:
+                    continue
+                seen.add(rid)
+                base = dict(maps[rid])
+                for did, ops in enumerate(self.rdeltas[i]):
+                    d2 = dict(base)
+                    for key, choices in ops:
+                        if choices:
+                            d2[key] = choices
+                        else:
+                            d2.pop(key, None)
+                    rapply[(rid, did)] = map_id(canon(d2))
+                j = 0
+                for key, choices in maps[rid]:
+                    d2 = dict(base)
+                    d2.pop(key, None)
+                    popped = map_id(canon(d2))
+                    for v in choices:
+                        rsel[(rid, j)] = (self.rchoice_ids[i][v], popped)
+                        j += 1
+                max_j = max(max_j, j)
+            self._rapply.append(rapply)
+            self._rsel.append(rsel)
+            self.max_rand_slots.append(max_j)
     def _close_histories(self) -> None:
         """Build the history vocabulary + transition table over history
         EVENTS (delivered envelope + ordered emissions), replaying the
@@ -338,7 +490,11 @@ class LoweredActorModel(TensorModel):
                 self.hevents.append(key)
             return hid
 
-        for entry in list(self.deliver.values()) + list(self.timeout.values()):
+        for entry in (
+            list(self.deliver.values())
+            + list(self.timeout.values())
+            + list(self.random.values())
+        ):
             if entry is not None:
                 entry["hevent"] = hevent_id(entry["env"], entry["emits"])
 
@@ -378,6 +534,9 @@ class LoweredActorModel(TensorModel):
                 dst = int(self.envs[eid].dst)
                 gated.append((dst, sid, entry["new_sid"], entry["hevent"]))
         for (actor, _tid, sid), entry in self.timeout.items():
+            if entry is not None:
+                gated.append((actor, sid, entry["new_sid"], entry["hevent"]))
+        for (actor, _cid, sid), entry in self.random.items():
             if entry is not None:
                 gated.append((actor, sid, entry["new_sid"], entry["hevent"]))
 
@@ -441,6 +600,15 @@ class LoweredActorModel(TensorModel):
         self.hist_off = lane
         if self.track_history:
             lane += 1
+        # Randoms / crashed lanes are EXCLUDED from state identity via
+        # `representative` (the reference's manual Hash skips them,
+        # ref: src/actor/model_state.rs:134-145).
+        self.rand_off = lane
+        if self.has_randoms:
+            lane += self.n
+        self.crash_off = lane
+        if self.max_crashes:
+            lane += 1
         self.net_off = lane
         if self.kind == UNORDERED_NONDUPLICATING:
             lane += self.pool_size
@@ -473,10 +641,21 @@ class LoweredActorModel(TensorModel):
             n_net_actions = 0
         self.deliver_slots = n_net_actions
         self.drop_slots = n_net_actions if self.model.lossy_network else 0
+        self.random_slots = [
+            (i, j)
+            for i in range(self.n)
+            for j in range(self.max_rand_slots[i] if self.has_randoms else 0)
+        ]
+        self.crash_slots = self.n if self.max_crashes else 0
         # At least one (all-invalid) slot keeps expand shapes well-formed for
         # degenerate models with no actions at all.
         self.max_actions = max(
-            self.deliver_slots + self.drop_slots + len(self.timeout_slots), 1
+            self.deliver_slots
+            + self.drop_slots
+            + len(self.timeout_slots)
+            + len(self.random_slots)
+            + self.crash_slots,
+            1,
         )
 
     def _bake_tables(self) -> None:
@@ -490,6 +669,7 @@ class LoweredActorModel(TensorModel):
         D_tclr = np.zeros((E, maxS), np.uint32)
         D_tset = np.zeros((E, maxS), np.uint32)
         D_hev = np.zeros((E, maxS), np.uint32)
+        D_delta = np.zeros((E, maxS), np.uint32)
         for (eid, sid), entry in self.deliver.items():
             if entry is None:
                 D_state[eid, sid] = _ELIDED
@@ -500,7 +680,8 @@ class LoweredActorModel(TensorModel):
             D_tclr[eid, sid] = entry["tclr"]
             D_tset[eid, sid] = entry["tset"]
             D_hev[eid, sid] = entry.get("hevent", 0)
-        self._D = (D_state, D_emits, D_tclr, D_tset, D_hev)
+            D_delta[eid, sid] = entry["delta"]
+        self._D = (D_state, D_emits, D_tclr, D_tset, D_hev, D_delta)
         self._E_dst = np.asarray(
             [int(e.dst) if int(e.dst) < self.n else self.n for e in self.envs]
             or [0],
@@ -513,6 +694,7 @@ class LoweredActorModel(TensorModel):
         T_tclr = np.zeros((max(nT, 1), maxS), np.uint32)
         T_tset = np.zeros((max(nT, 1), maxS), np.uint32)
         T_hev = np.zeros((max(nT, 1), maxS), np.uint32)
+        T_delta = np.zeros((max(nT, 1), maxS), np.uint32)
         _missing = object()
         for k, (i, tid) in enumerate(self.timeout_slots):
             for sid in range(len(self.states[i])):
@@ -528,7 +710,43 @@ class LoweredActorModel(TensorModel):
                 T_tclr[k, sid] = entry["tclr"]
                 T_tset[k, sid] = entry["tset"]
                 T_hev[k, sid] = entry.get("hevent", 0)
-        self._T = (T_state, T_emits, T_tclr, T_tset, T_hev)
+                T_delta[k, sid] = entry["delta"]
+        self._T = (T_state, T_emits, T_tclr, T_tset, T_hev, T_delta)
+
+        if self.has_randoms:
+            maxR = max(len(m) for m in self.rmaps)
+            maxD = max(len(d) for d in self.rdeltas)
+            maxC = max((len(c) for c in self.rchoices), default=1) or 1
+            nJ = max(self.max_rand_slots) or 1
+            RAPP = np.zeros((self.n, maxR, maxD), np.uint32)
+            for i in range(self.n):
+                for (rid, did), nrid in self._rapply[i].items():
+                    RAPP[i, rid, did] = nrid
+            RSEL = np.zeros((self.n, maxR, nJ), np.uint32)  # cid + 1; 0 = none
+            RPOP = np.zeros((self.n, maxR, nJ), np.uint32)
+            for i in range(self.n):
+                for (rid, j), (cid, popped) in self._rsel[i].items():
+                    RSEL[i, rid, j] = cid + 1
+                    RPOP[i, rid, j] = popped
+            R_state = np.zeros((self.n, maxC, maxS), np.uint32)
+            R_emits = np.full(
+                (self.n, maxC, maxS, self.max_emit), EMPTY, np.uint32
+            )
+            R_tclr = np.zeros((self.n, maxC, maxS), np.uint32)
+            R_tset = np.zeros((self.n, maxC, maxS), np.uint32)
+            R_hev = np.zeros((self.n, maxC, maxS), np.uint32)
+            R_delta = np.zeros((self.n, maxC, maxS), np.uint32)
+            for (i, cid, sid), entry in self.random.items():
+                R_state[i, cid, sid] = entry["new_sid"] + _VALID0
+                for j, e in enumerate(entry["emits"]):
+                    R_emits[i, cid, sid, j] = e
+                R_tclr[i, cid, sid] = entry["tclr"]
+                R_tset[i, cid, sid] = entry["tset"]
+                R_hev[i, cid, sid] = entry.get("hevent", 0)
+                R_delta[i, cid, sid] = entry["delta"]
+            self._R = (RAPP, RSEL, RPOP, R_state, R_emits, R_tclr, R_tset,
+                       R_hev, R_delta)
+            self._R_dims = (maxR, maxD, maxC, nJ)
 
     # -- encode / decode -------------------------------------------------------
 
@@ -545,6 +763,18 @@ class LoweredActorModel(TensorModel):
                 row[self.timer_off + i] = mask
         if self.track_history:
             row[self.hist_off] = self.hids[sys_state.history]
+        if self.has_randoms:
+            for i, randoms in enumerate(sys_state.random_choices):
+                canon = tuple(
+                    sorted(randoms.items(), key=lambda kv: repr(kv[0]))
+                )
+                row[self.rand_off + i] = self.rmap_ids[i][canon]
+        if self.max_crashes:
+            mask = 0
+            for i, c in enumerate(sys_state.crashed):
+                if c:
+                    mask |= 1 << i
+            row[self.crash_off] = mask
         if self.kind == UNORDERED_NONDUPLICATING:
             pool = sorted(
                 self.env_ids[(int(e.src), int(e.dst), e.msg)]
@@ -600,6 +830,15 @@ class LoweredActorModel(TensorModel):
             )
         if self.track_history:
             out["history"] = self.histories[row[self.hist_off]]
+        if self.has_randoms:
+            out["random_choices"] = tuple(
+                dict(self.rmaps[i][row[self.rand_off + i]])
+                for i in range(self.n)
+            )
+        if self.max_crashes:
+            out["crashed"] = tuple(
+                bool(row[self.crash_off] >> i & 1) for i in range(self.n)
+            )
         if self.kind == UNORDERED_NONDUPLICATING:
             out["network"] = [
                 self.envs[e]
@@ -648,10 +887,24 @@ class LoweredActorModel(TensorModel):
             if e == int(EMPTY):
                 return "noop"
             return f"Drop({self.envs[e]!r})"
-        i, tid = self.timeout_slots[
-            action_index - self.deliver_slots - self.drop_slots
-        ]
-        return f"Timeout({Id(i)!r}, {self.timers[i][tid]!r})"
+        k = action_index - self.deliver_slots - self.drop_slots
+        if k < len(self.timeout_slots):
+            i, tid = self.timeout_slots[k]
+            return f"Timeout({Id(i)!r}, {self.timers[i][tid]!r})"
+        k -= len(self.timeout_slots)
+        if k < len(self.random_slots):
+            i, j = self.random_slots[k]
+            rid = int(row[self.rand_off + i]) if self.has_randoms else 0
+            sel = self._rsel[i].get((rid, j))
+            if sel is None:
+                return "noop"
+            cid, _popped = sel
+            return (
+                f"SelectRandom {{ actor: {Id(i)!r}, "
+                f"random: {self.rchoices[i][cid]!r} }}"
+            )
+        k -= len(self.random_slots)
+        return f"Crash({Id(k)!r})"
 
     # -- TensorModel interface -------------------------------------------------
 
@@ -663,16 +916,29 @@ class LoweredActorModel(TensorModel):
         B = states.shape[0]
         n, M = self.n, self.max_actions
         u = jnp.uint32
-        D_state, D_emits, D_tclr, D_tset, D_hev = (
+        D_state, D_emits, D_tclr, D_tset, D_hev, D_delta = (
             jnp.asarray(t) for t in self._D
         )
-        T_state, T_emits, T_tclr, T_tset, T_hev = (
+        T_state, T_emits, T_tclr, T_tset, T_hev, T_delta = (
             jnp.asarray(t) for t in self._T
         )
         E_dst = jnp.asarray(self._E_dst)
         maxS = self.maxS
 
         sid_lanes = states[:, self.sid_off : self.sid_off + n]  # [B, n]
+        if self.has_randoms:
+            rand_lanes = states[:, self.rand_off : self.rand_off + n]
+            maxR, maxD, maxC, nJ = self._R_dims
+        if self.max_crashes:
+            crash_mask = states[:, self.crash_off]  # [B] bitmask
+
+        def not_crashed(actor_idx):
+            """actor_idx: [B, S] -> bool[B, S]; True when no crash support."""
+            if not self.max_crashes:
+                return jnp.ones(actor_idx.shape, bool)
+            return (
+                (crash_mask[:, None] >> actor_idx.astype(u)) & u(1)
+            ) == 0
 
         succ_parts = []
         valid_parts = []
@@ -696,12 +962,20 @@ class LoweredActorModel(TensorModel):
             tclr = jnp.take(D_tclr.reshape(-1), flat)
             tset = jnp.take(D_tset.reshape(-1), flat)
             hev = jnp.take(D_hev.reshape(-1), flat)
-            valid = deliverable & dst_ok & is_txn
-            poison = deliverable & dst_ok & ~explored
-            return d_srv, new_sid, emits, tclr, tset, hev, valid, poison
+            delta = jnp.take(D_delta.reshape(-1), flat)
+            # Delivery to a crashed actor is not a transition
+            # (ref: src/actor/model.rs:332-337).
+            alive = not_crashed(d_srv)
+            valid = deliverable & dst_ok & is_txn & alive
+            poison = deliverable & dst_ok & ~explored & alive
+            return d_srv, new_sid, emits, tclr, tset, hev, delta, valid, poison
 
-        def apply_common(d_actor, new_sid, emits, tclr, tset, hev, base_succ):
-            """Write actor/timers/history lanes shared by deliver+timeout."""
+        def apply_common(
+            d_actor, new_sid, emits, tclr, tset, hev, base_succ,
+            delta=None, rid_base=None,
+        ):
+            """Write actor/timers/history/randoms lanes shared by
+            deliver/timeout/select-random transitions."""
             S = d_actor.shape[1]
             succ = base_succ
             sel = (
@@ -724,6 +998,22 @@ class LoweredActorModel(TensorModel):
                     (hid[:, None] * u(self._hd.shape[1]) + hev).astype(jnp.int32),
                 )
                 succ = succ.at[:, :, self.hist_off].set(nh)
+            if self.has_randoms and delta is not None:
+                RAPP = jnp.asarray(self._R[0])
+                if rid_base is None:
+                    rid_base = jnp.take_along_axis(
+                        rand_lanes, d_actor, axis=1
+                    )
+                flat_r = (
+                    d_actor * (maxR * maxD)
+                    + rid_base.astype(jnp.int32) * maxD
+                    + delta.astype(jnp.int32)
+                )
+                nrid = jnp.take(RAPP.reshape(-1), flat_r)
+                nrl = jnp.where(
+                    sel, nrid[:, :, None], rand_lanes[:, None, :]
+                )
+                succ = succ.at[:, :, self.rand_off : self.rand_off + n].set(nrl)
             return succ
 
         base = jnp.broadcast_to(
@@ -768,9 +1058,11 @@ class LoweredActorModel(TensorModel):
             head = flows[:, :, 0]  # [B, F]
             deliverable = head != EMPTY
             (
-                d_actor, new_sid, emits, tclr, tset, hev, valid, poison
+                d_actor, new_sid, emits, tclr, tset, hev, delta, valid, poison
             ) = lookup_deliver(head, deliverable)
-            succ = apply_common(d_actor, new_sid, emits, tclr, tset, hev, base)
+            succ = apply_common(
+                d_actor, new_sid, emits, tclr, tset, hev, base, delta=delta
+            )
             # Pop the delivered flow's head (slot f pops flow f), then push
             # emissions FIFO.
             shifted = jnp.concatenate(
@@ -809,9 +1101,11 @@ class LoweredActorModel(TensorModel):
             )
             deliverable = nonempty & first
             (
-                d_actor, new_sid, emits, tclr, tset, hev, valid, poison
+                d_actor, new_sid, emits, tclr, tset, hev, delta, valid, poison
             ) = lookup_deliver(e, deliverable)
-            succ = apply_common(d_actor, new_sid, emits, tclr, tset, hev, base)
+            succ = apply_common(
+                d_actor, new_sid, emits, tclr, tset, hev, base, delta=delta
+            )
             # Pool: drop the delivered slot, add emissions, re-sort.
             P = self.pool_size
             drop = jnp.arange(P)[None, :, None] == jnp.arange(P)[None, None, :]
@@ -848,9 +1142,11 @@ class LoweredActorModel(TensorModel):
             deliverable = in_flight.astype(bool)
             e = jnp.broadcast_to(eids, (B, self.E))
             (
-                d_actor, new_sid, emits, tclr, tset, hev, valid, poison
+                d_actor, new_sid, emits, tclr, tset, hev, delta, valid, poison
             ) = lookup_deliver(e, deliverable)
-            succ = apply_common(d_actor, new_sid, emits, tclr, tset, hev, base)
+            succ = apply_common(
+                d_actor, new_sid, emits, tclr, tset, hev, base, delta=delta
+            )
             # Network: set unchanged except emissions OR-ed in; last_msg = e.
             nbits_arr = bits[:, None, :]  # [B, E, nbits]
             for j in range(self.max_emit):
@@ -917,11 +1213,13 @@ class LoweredActorModel(TensorModel):
             tclr = jnp.take(T_tclr.reshape(-1), flat)
             tset = jnp.take(T_tset.reshape(-1), flat)
             hev = jnp.take(T_hev.reshape(-1), flat)
-            valid = armed & is_txn
-            poison = armed & ~explored
+            delta = jnp.take(T_delta.reshape(-1), flat)
+            alive = not_crashed(t_actor_b)
+            valid = armed & is_txn & alive
+            poison = armed & ~explored & alive
             tbase = jnp.broadcast_to(states[:, None, :], (B, nT, self.lanes))
             succ = apply_common(
-                t_actor_b, new_sid, emits, tclr, tset, hev, tbase
+                t_actor_b, new_sid, emits, tclr, tset, hev, tbase, delta=delta
             )
             if self.E == 0:
                 pass  # no envelope vocabulary: timeouts cannot emit
@@ -976,6 +1274,138 @@ class LoweredActorModel(TensorModel):
                 ].set(nbits_arr)
             succ_parts.append(succ)
             valid_parts.append((valid | poison, poison))
+
+        # SelectRandom actions (ref: src/actor/model.rs:302-313, 411-426).
+        if self.random_slots:
+            RAPP, RSEL, RPOP, R_state, R_emits, R_tclr, R_tset, R_hev, R_delta = (
+                jnp.asarray(t) for t in self._R
+            )
+            nR = len(self.random_slots)
+            r_actor = jnp.asarray(
+                [i for i, _ in self.random_slots], jnp.int32
+            )[None, :]
+            r_j = jnp.asarray([j for _, j in self.random_slots], jnp.int32)[
+                None, :
+            ]
+            r_actor_b = jnp.broadcast_to(r_actor, (B, nR))
+            rid = jnp.take_along_axis(rand_lanes, r_actor_b, axis=1)
+            flat_sel = (
+                r_actor * (maxR * nJ) + rid.astype(jnp.int32) * nJ + r_j
+            )
+            cid1 = jnp.take(RSEL.reshape(-1), flat_sel)  # cid + 1; 0 = none
+            popped = jnp.take(RPOP.reshape(-1), flat_sel)
+            has_choice = cid1 != 0
+            cid = jnp.where(has_choice, cid1 - u(1), u(0)).astype(jnp.int32)
+            sid = jnp.take_along_axis(sid_lanes, r_actor_b, axis=1)
+            flat_rr = (
+                r_actor * (maxC * maxS)
+                + cid * maxS
+                + sid.astype(jnp.int32)
+            )
+            st = jnp.take(R_state.reshape(-1), flat_rr)
+            explored = st != _UNEXPLORED
+            is_txn = st >= _VALID0
+            new_sid = jnp.where(is_txn, st - u(_VALID0), sid)
+            emits = jnp.take(R_emits.reshape(-1, self.max_emit), flat_rr, axis=0)
+            tclr = jnp.take(R_tclr.reshape(-1), flat_rr)
+            tset = jnp.take(R_tset.reshape(-1), flat_rr)
+            hev = jnp.take(R_hev.reshape(-1), flat_rr)
+            delta = jnp.take(R_delta.reshape(-1), flat_rr)
+            alive = not_crashed(r_actor_b)
+            valid = has_choice & is_txn & alive
+            poison = has_choice & ~explored & alive
+            rbase = jnp.broadcast_to(states[:, None, :], (B, nR, self.lanes))
+            # The selected key's pending choice is consumed BEFORE the
+            # handler's own choose_random commands apply
+            # (ref: src/actor/model.rs:411-426).
+            succ = apply_common(
+                r_actor_b, new_sid, emits, tclr, tset, hev, rbase,
+                delta=delta, rid_base=popped,
+            )
+            if self.E == 0:
+                pass
+            elif self.kind == ORDERED:
+                F, Dq = self.F, self.flow_depth
+                flows = states[
+                    :, self.net_off : self.net_off + F * Dq
+                ].reshape(B, F, Dq)
+                rflows4 = jnp.broadcast_to(
+                    flows[:, None, :, :], (B, nR, F, Dq)
+                )
+                rflows4, push_ovf = push_emits_ordered(rflows4, emits)
+                succ = succ.at[
+                    :, :, self.net_off : self.net_off + F * Dq
+                ].set(rflows4.reshape(B, nR, F * Dq))
+                poison = poison | (valid & push_ovf)
+            elif self.kind == UNORDERED_NONDUPLICATING:
+                pool = states[:, self.net_off : self.net_off + self.pool_size]
+                P = self.pool_size
+                npool = jnp.concatenate(
+                    [jnp.broadcast_to(pool[:, None, :], (B, nR, P)), emits],
+                    axis=2,
+                )
+                npool = jnp.sort(npool, axis=2)
+                overflow = jnp.any(npool[:, :, P:] != EMPTY, axis=2)
+                succ = succ.at[:, :, self.net_off : self.net_off + P].set(
+                    npool[:, :, :P]
+                )
+                poison = poison | (valid & overflow)
+            else:
+                bits = states[:, self.net_off : self.net_off + self.nbits]
+                nbits_arr = jnp.broadcast_to(
+                    bits[:, None, :], (B, nR, self.nbits)
+                )
+                for j in range(self.max_emit):
+                    em = emits[:, :, j]
+                    emv = jnp.minimum(em, u(self.E - 1))
+                    word = (emv // u(32)).astype(jnp.int32)
+                    bit = u(1) << (emv % u(32))
+                    sel_w = (
+                        jnp.arange(self.nbits)[None, None, :]
+                        == word[:, :, None]
+                    )
+                    add = jnp.where(
+                        (em != EMPTY)[:, :, None] & sel_w,
+                        bit[:, :, None],
+                        u(0),
+                    )
+                    nbits_arr = nbits_arr | add
+                succ = succ.at[
+                    :, :, self.net_off : self.net_off + self.nbits
+                ].set(nbits_arr)
+            succ_parts.append(succ)
+            valid_parts.append((valid | poison, poison))
+
+        # Crash actions (ref: src/actor/model.rs:291-300, 431-437): mark the
+        # actor crashed, clear its timers and pending random choices.
+        if self.crash_slots:
+            nC = self.n
+            c_actor = jnp.arange(nC, dtype=jnp.int32)[None, :]
+            already = (
+                (crash_mask[:, None] >> c_actor.astype(u)) & u(1)
+            ) != 0
+            n_crashed = jnp.zeros((B,), jnp.int32)
+            for i in range(nC):
+                n_crashed = n_crashed + (
+                    (crash_mask >> u(i)) & u(1)
+                ).astype(jnp.int32)
+            valid = (~already) & (n_crashed < self.max_crashes)[:, None]
+            cbase = jnp.broadcast_to(states[:, None, :], (B, nC, self.lanes))
+            nmask = crash_mask[:, None] | (u(1) << c_actor.astype(u))
+            succ = cbase.at[:, :, self.crash_off].set(nmask)
+            sel = jnp.arange(nC)[None, None, :] == c_actor[:, :, None]
+            if self.has_timers:
+                tl = states[:, self.timer_off : self.timer_off + nC]
+                succ = succ.at[
+                    :, :, self.timer_off : self.timer_off + nC
+                ].set(jnp.where(sel, u(0), tl[:, None, :]))
+            if self.has_randoms:
+                # Crashed actors lose their pending choices: empty map id 0.
+                succ = succ.at[
+                    :, :, self.rand_off : self.rand_off + nC
+                ].set(jnp.where(sel, u(0), rand_lanes[:, None, :]))
+            succ_parts.append(succ)
+            valid_parts.append((valid, jnp.zeros_like(valid)))
 
         if not succ_parts:  # degenerate: no possible actions at all
             return (

@@ -383,3 +383,220 @@ def test_decode_roundtrip():
     d = lowered.decode(init)
     assert d["actor_states"] == (0, 0)
     assert len(d["network"]) == 1  # the initial Ping(0)
+
+
+class CoinFlipper(Actor):
+    """choose_random/on_random fixture: flip up to `limit` coins, with the
+    choice set varying by state (exercises the randoms-map vocabulary)."""
+
+    def __init__(self, limit):
+        self.limit = limit
+
+    def on_start(self, id, out):
+        out.choose_random("flip", ["H", "T"])
+        return (0, 0)
+
+    def on_random(self, id, state, random, out):
+        flips, heads = state
+        if flips >= self.limit:
+            # Total handler: the closure over-approximates (pairs every
+            # choice with every state), so unreachable combos must not grow
+            # the local state space.
+            return None
+        flips += 1
+        heads += random == "H"
+        if flips < self.limit:
+            # Vary the choices with state: exercises multiple map ids.
+            choices = ["H", "T"] if heads % 2 == 0 else ["T", "H", "H2"]
+            out.choose_random("flip", choices)
+        return (flips, heads)
+
+
+def test_random_choices_parity():
+    # An undiscoverable always-property keeps both searches exhaustive: with
+    # only the sometimes-property, BOTH engines would early-exit at its first
+    # witness, and partial counts are visit-order-dependent.
+    def build():
+        return (
+            ActorModel.new(None, None)
+            .actor(CoinFlipper(3))
+            .actor(CoinFlipper(2))
+            .property(
+                Expectation.SOMETIMES,
+                "all heads",
+                lambda m, s: all(st[1] == st[0] == 2 for st in s.actor_states[1:]),
+            )
+            .property(
+                Expectation.ALWAYS,
+                "bounded",
+                lambda m, s: all(st[0] <= 3 for st in s.actor_states),
+            )
+        )
+
+    host = _host(build())
+
+    def properties(view):
+        flips = view.actor_feature(lambda i, s: s[0])
+        heads = view.actor_feature(lambda i, s: s[1])
+        return [
+            TensorProperty.sometimes(
+                "all heads",
+                lambda m, s: (heads(s)[:, 1:] == 2) .all(1)
+                & (flips(s)[:, 1:] == 2).all(1),
+            ),
+            TensorProperty.always(
+                "bounded", lambda m, s: (flips(s) <= 3).all(1)
+            ),
+        ]
+
+    lowered = lower_actor_model(build(), properties=properties)
+    r = FrontierSearch(lowered, batch_size=128, table_log2=12).run()
+    assert r.unique_state_count == host.unique_state_count()
+    assert r.state_count == host.state_count()
+    assert set(r.discoveries) == set(host.discoveries())
+
+
+def test_crash_injection_parity():
+    # A bare rebuild of ping-pong (PingPongCfg defines extra properties that
+    # would change early-exit behavior between host and lowered).
+    from stateright_tpu.actor.test_util import PingPongActor
+
+    def bare():
+        return (
+            ActorModel.new(None, None)
+            .actor(PingPongActor(serve_to=Id(1)))
+            .actor(PingPongActor(serve_to=None))
+            .with_init_network(Network.new_unordered_nonduplicating())
+            .with_max_crashes(1)
+            .with_within_boundary(
+                lambda cfg, state: all(c <= 3 for c in state.actor_states)
+            )
+            .property(
+                Expectation.ALWAYS,
+                "delta within 1",
+                lambda m, s: max(s.actor_states) - min(s.actor_states) <= 1,
+            )
+        )
+
+    host = _host(bare())
+
+    def properties(view):
+        counters = view.actor_feature(lambda i, s: s)
+        return [
+            TensorProperty.always(
+                "delta within 1",
+                lambda m, s: counters(s).max(1) - counters(s).min(1) <= 1,
+            )
+        ]
+
+    def boundary(view):
+        counters = view.actor_feature(lambda i, s: s)
+        return lambda s: (counters(s) <= 3).all(1)
+
+    lowered = lower_actor_model(
+        bare(),
+        local_boundary=lambda i, s: s <= 3,
+        properties=properties,
+        boundary=boundary,
+    )
+    r = FrontierSearch(lowered, batch_size=128, table_log2=12).run()
+    assert r.unique_state_count == host.unique_state_count()
+    assert r.state_count == host.state_count()
+    assert set(r.discoveries) == set(host.discoveries())
+
+
+def test_crash_and_randoms_identity_exclusion():
+    # States differing only in crash flags / pending choices share identity
+    # (the reference's manual Hash, ref: src/actor/model_state.rs:134-145) —
+    # verified indirectly by count parity above; directly here via the
+    # canonicalization hook.
+    import jax.numpy as jnp
+
+    def bare():
+        return (
+            ActorModel.new(None, None)
+            .actor(CoinFlipper(1))
+            .with_max_crashes(1)
+            .property(Expectation.ALWAYS, "t", lambda m, s: True)
+        )
+
+    lowered = lower_actor_model(
+        bare(),
+        properties=lambda view: [
+            TensorProperty.always("t", lambda m, s: s[:, 0] == s[:, 0])
+        ],
+    )
+    assert lowered.representative is not None
+    row = np.asarray(lowered.init_states())[0]
+    variant = row.copy()
+    variant[lowered.crash_off] = 1  # crashed bit set
+    variant[lowered.rand_off] = 0  # choices cleared
+    canon = np.asarray(
+        lowered.representative(jnp.asarray(np.stack([row, variant])))
+    )
+    assert (canon[0] == canon[1]).all()
+
+
+class RandomReplier(Actor):
+    """on_msg installs a random choice; on_random SENDS the chosen value —
+    exercises delta propagation through deliver transitions and message
+    emission from random reactions."""
+
+    def on_start(self, id, out):
+        if int(id) == 0:
+            out.send(Id(1), "ping")
+        return 0
+
+    def on_msg(self, id, state, src, msg, out):
+        if int(id) == 1 and msg == "ping" and state == 0:
+            out.choose_random("reply", ["a", "b"])
+            return 1
+        if int(id) == 0 and msg in ("a", "b") and state == 0:
+            return {"a": 1, "b": 2}[msg]
+        return None
+
+    def on_random(self, id, state, random, out):
+        if int(id) == 1 and state == 1:
+            out.send(Id(0), random)
+            return 2
+        return None
+
+
+def test_random_choices_with_messages_parity():
+    def build():
+        return (
+            ActorModel.new(None, None)
+            .actor(RandomReplier())
+            .actor(RandomReplier())
+            .with_init_network(Network.new_unordered_nonduplicating())
+            .property(
+                Expectation.ALWAYS,
+                "no b outcome... just kidding, bounded",
+                lambda m, s: all(st <= 2 for st in s.actor_states),
+            )
+            .property(
+                Expectation.SOMETIMES,
+                "b chosen",
+                lambda m, s: s.actor_states[0] == 2,
+            )
+        )
+
+    host = _host(build())
+
+    def properties(view):
+        v = view.actor_feature(lambda i, s: s)
+        return [
+            TensorProperty.always(
+                "no b outcome... just kidding, bounded",
+                lambda m, s: (v(s) <= 2).all(1),
+            ),
+            TensorProperty.sometimes(
+                "b chosen", lambda m, s: v(s)[:, 0] == 2
+            ),
+        ]
+
+    lowered = lower_actor_model(build(), properties=properties)
+    r = FrontierSearch(lowered, batch_size=64, table_log2=10).run()
+    assert r.unique_state_count == host.unique_state_count()
+    assert r.state_count == host.state_count()
+    assert set(r.discoveries) == set(host.discoveries()) == {"b chosen"}
