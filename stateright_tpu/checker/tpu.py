@@ -35,8 +35,11 @@ class TpuChecker(Checker):
             )
         if options.symmetry_fn_ is not None:
             raise NotImplementedError(
-                "symmetry reduction on the device checker lands with the "
-                "tensor canonicalization kernel; use spawn_dfs for now"
+                "the builder's symmetry_fn is a host-level callable and "
+                "cannot run inside a device kernel; device symmetry "
+                "reduction is expressed as the TensorModel.representative "
+                "canonicalization kernel instead (see tensor/symmetry.py), "
+                "which every device engine honors automatically"
             )
         if options.visitor_ is not None:
             raise NotImplementedError(

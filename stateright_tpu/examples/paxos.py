@@ -128,9 +128,13 @@ class PaxosActor(Actor):
 
     def on_msg(self, id: Id, state: PaxosState, src: Id, msg, out: Out):
         if state.is_decided:
-            if isinstance(msg, Get):
-                # Only reply once a decision is known locally; an undecided
-                # server stays silent (ref: examples/paxos.rs:145-157).
+            # Only reply once a decision is known locally; an undecided
+            # server stays silent (ref: examples/paxos.rs:145-157). The
+            # accepted-is-set guard keeps the handler TOTAL (required by the
+            # generic device lowering, whose closure pass over-approximates
+            # reachable local states): a decided server always has an
+            # accepted proposal on every globally reachable path.
+            if isinstance(msg, Get) and state.accepted is not None:
                 _ballot, (_req, _src, value) = state.accepted
                 out.send(src, GetOk(msg.request_id, value))
             return None
