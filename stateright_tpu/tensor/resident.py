@@ -115,7 +115,7 @@ class ResidentSearch:
         self.batch_size = batch_size
         self.table_log2 = table_log2
         self.props = model.properties()
-        self._kernel = self._build()
+        self._kernel, self._seed_k, self._chunk_k = self._build()
         self._last_tables = None
         self._parent_map = None
         self._seed = None
@@ -223,21 +223,27 @@ class ResidentSearch:
                 steps=c.steps + 1,
             )
 
-        @partial(jax.jit, static_argnums=(3, 4, 7))
-        def search(
-            init_states,  # uint32[K, L] padded
-            init_lo,  # uint32[K]
-            init_hi,  # uint32[K]
-            required_mask: int,
-            any_mask: int,
-            target_lo,  # uint32 scalar pair (0, 0 = none)
-            target_hi,
-            max_steps: int,
-            n0,  # int32: number of active seed rows
-            seed_lo,  # uint32 pair: pre-dedup init count (host count parity)
-            seed_hi,
-            target_max_depth,  # uint32 (0 = no limit)
+        def should_continue(
+            c: _Carry, req, anym, have_target, target_lo, target_hi, max_steps
         ):
+            drained = c.head >= c.tail
+            all_found = (P > 0) & (c.discovered == all_bits)
+            policy = ((req != 0) & ((c.discovered & req) == req)) | (
+                (c.discovered & anym) != 0
+            )
+            count_hit = have_target & count_ge(
+                c.gen_lo, c.gen_hi, target_lo, target_hi
+            )
+            return (
+                (~drained)
+                & (~all_found)
+                & (~policy)
+                & (~count_hit)
+                & (~c.overflow)
+                & (c.steps < max_steps)
+            )
+
+        def make_carry(init_states, init_lo, init_hi, n0, seed_lo, seed_hi):
             # Tables are allocated in-trace: a fresh search per dispatch, and
             # no host-side zero-fill round trip over the device tunnel.
             t_lo = jnp.zeros(S, dtype=jnp.uint32)
@@ -264,29 +270,7 @@ class ResidentSearch:
             q_ebits = q_ebits.at[qpos].set(jnp.uint32(ebits0), mode="drop")
             q_depth = q_depth.at[qpos].set(jnp.uint32(1), mode="drop")
 
-            req = jnp.uint32(required_mask)
-            anym = jnp.uint32(any_mask)
-            have_target = (target_lo | target_hi) != 0
-
-            def cond(c: _Carry):
-                drained = c.head >= c.tail
-                all_found = (P > 0) & (c.discovered == all_bits)
-                policy = ((req != 0) & ((c.discovered & req) == req)) | (
-                    (c.discovered & anym) != 0
-                )
-                count_hit = have_target & count_ge(
-                    c.gen_lo, c.gen_hi, target_lo, target_hi
-                )
-                return (
-                    (~drained)
-                    & (~all_found)
-                    & (~policy)
-                    & (~count_hit)
-                    & (~c.overflow)
-                    & (c.steps < max_steps)
-                )
-
-            carry = _Carry(
+            return _Carry(
                 t_lo=t_lo,
                 t_hi=t_hi,
                 p_lo=p_lo,
@@ -308,13 +292,12 @@ class ResidentSearch:
                 overflow=ovf,
                 steps=jnp.int32(0),
             )
-            carry = jax.lax.while_loop(
-                cond, lambda c: body(c, target_max_depth), carry
-            )
+
+        def summary_of(carry: _Carry, stop):
             # Pack every host-facing scalar into ONE small vector so the host
             # reads the whole result in a single device transfer (each fetch
             # over the device tunnel costs a full round trip).
-            summary = jnp.concatenate(
+            return jnp.concatenate(
                 [
                     jnp.stack(
                         [
@@ -327,15 +310,77 @@ class ResidentSearch:
                             carry.tail.astype(jnp.uint32),
                             carry.overflow.astype(jnp.uint32),
                             carry.steps.astype(jnp.uint32),
+                            stop.astype(jnp.uint32),
                         ]
                     ),
                     carry.disc_lo,
                     carry.disc_hi,
                 ]
             )
+
+        @partial(jax.jit, static_argnums=(3, 4, 7))
+        def search(
+            init_states,  # uint32[K, L] padded
+            init_lo,  # uint32[K]
+            init_hi,  # uint32[K]
+            required_mask: int,
+            any_mask: int,
+            target_lo,  # uint32 scalar pair (0, 0 = none)
+            target_hi,
+            max_steps: int,
+            n0,  # int32: number of active seed rows
+            seed_lo,  # uint32 pair: pre-dedup init count (host count parity)
+            seed_hi,
+            target_max_depth,  # uint32 (0 = no limit)
+        ):
+            req = jnp.uint32(required_mask)
+            anym = jnp.uint32(any_mask)
+            have_target = (target_lo | target_hi) != 0
+            carry = make_carry(
+                init_states, init_lo, init_hi, n0, seed_lo, seed_hi
+            )
+            carry = jax.lax.while_loop(
+                lambda c: should_continue(
+                    c, req, anym, have_target, target_lo, target_hi, max_steps
+                ),
+                lambda c: body(c, target_max_depth),
+                carry,
+            )
+            summary = summary_of(carry, jnp.bool_(True))
             return carry.t_lo, carry.t_hi, carry.p_lo, carry.p_hi, summary
 
-        return search
+        @jax.jit
+        def seed_k(init_states, init_lo, init_hi, n0, seed_lo, seed_hi):
+            return make_carry(init_states, init_lo, init_hi, n0, seed_lo, seed_hi)
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def chunk_k(
+            carry: _Carry,
+            req,  # uint32 dynamic (one compiled chunk kernel per model/shape)
+            anym,
+            target_lo,
+            target_hi,
+            target_max_depth,
+            budget,  # int32: max loop steps THIS dispatch
+            max_steps,  # int32: global step cap
+        ):
+            have_target = (target_lo | target_hi) != 0
+            start = carry.steps
+
+            def cond(c: _Carry):
+                return should_continue(
+                    c, req, anym, have_target, target_lo, target_hi, max_steps
+                ) & (c.steps < start + budget)
+
+            carry = jax.lax.while_loop(
+                cond, lambda c: body(c, target_max_depth), carry
+            )
+            stop = ~should_continue(
+                carry, req, anym, have_target, target_lo, target_hi, max_steps
+            )
+            return carry, summary_of(carry, stop)
+
+        return search, seed_k, chunk_k
 
     # -- host entry ------------------------------------------------------------
 
