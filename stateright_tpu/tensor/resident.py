@@ -465,14 +465,15 @@ class ResidentSearch:
             tail,
             overflow,
             steps,
-        ) = (int(x) for x in summary[:9])
+            _stop,
+        ) = (int(x) for x in summary[:10])
         if overflow:
             raise RuntimeError("hash table full; raise table_log2")
         self._last_tables = (t_lo, t_hi, p_lo, p_hi)
 
         P = len(self.props)
-        disc_lo = summary[9 : 9 + max(P, 1)]
-        disc_hi = summary[9 + max(P, 1) :]
+        disc_lo = summary[10 : 10 + max(P, 1)]
+        disc_hi = summary[10 + max(P, 1) :]
         discoveries = {
             p.name: int(pack_fp(disc_lo[i], disc_hi[i]))
             for i, p in enumerate(self.props)

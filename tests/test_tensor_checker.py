@@ -277,3 +277,17 @@ def test_tpu_checker_assert_discovery():
     # An action list that replays but does not witness the property: reject.
     with pytest.raises(AssertionError):
         checker.assert_discovery("commit agreement", witness[:-1])
+
+
+def test_resident_frontier_discovery_parity():
+    # Regression for the summary-layout off-by-one: run() must unpack all 10
+    # packed scalars before slicing discovery lanes, or every witness
+    # fingerprint shifts by one lane (stop flag read as disc_lo[0]).
+    from stateright_tpu.tensor.resident import ResidentSearch
+
+    fr = FrontierSearch(TensorTwoPhaseSys(3), 512, 16).run()
+    rr = ResidentSearch(TensorTwoPhaseSys(3), 512, 16).run()
+    assert set(rr.discoveries) == set(fr.discoveries)
+    for name, fp in rr.discoveries.items():
+        assert fp == fr.discoveries[name]
+        assert fp not in (0, 1)  # 1 == stop flag; 0 == empty lane
