@@ -306,9 +306,11 @@ def main() -> int:
         # size) must not misreport the device as unavailable for the others.
         for model, n in (("2pc", 4), ("paxos", 2), ("paxos", 3)):
             r, perr = device_search_subprocess(model, n)
-            if perr and r is None:
-                dev_errors[f"{model}-{n}"] = perr
-                log(f"device {model}-{n} failed: {perr}")
+            if r is None:
+                # No result is a failure even without an error string (e.g.
+                # a truncated worker payload missing both keys).
+                dev_errors[f"{model}-{n}"] = perr or "worker returned no result"
+                log(f"device {model}-{n} failed: {perr or 'no result'}")
                 continue
             if perr:
                 errors.append(perr)

@@ -49,11 +49,12 @@ class TpuChecker(Checker):
             )
         super().__init__(model)
         # The resident engine runs the whole search in one device dispatch —
-        # the default. The host-orchestrated engine supports live progress
-        # and timeout (a device loop can't be interrupted by wall clock), and
-        # is the fallback for that option.
+        # the default. A timeout makes it run in chunked dispatches (the
+        # wall clock is polled between chunks), which also feeds the live
+        # counters; pass resident=False for the host-orchestrated engine's
+        # finer-grained (per-device-step) progress instead.
         if resident is None:
-            resident = options.timeout_ is None
+            resident = True
         self._search = (
             ResidentSearch(model, batch_size, table_log2)
             if resident
@@ -73,7 +74,7 @@ class TpuChecker(Checker):
             self._live["unique"] = unique
             self._live["depth"] = depth
 
-        from ..tensor.frontier import FrontierSearch
+        from ..tensor.resident import ResidentSearch
 
         kwargs = dict(
             finish_when=self._options.finish_when_,
@@ -81,7 +82,13 @@ class TpuChecker(Checker):
             target_max_depth=self._options.target_max_depth_,
             timeout=self._options.timeout_,
         )
-        if isinstance(self._search, FrontierSearch):
+        if (
+            self._options.timeout_ is not None
+            or not isinstance(self._search, ResidentSearch)
+        ):
+            # Chunked/host-orchestrated runs surface live counters; a
+            # single-dispatch resident run has no host involvement to report
+            # from (forcing it chunked just for counters would cost perf).
             kwargs["progress"] = progress
         try:
             self._result = self._search.run(**kwargs)

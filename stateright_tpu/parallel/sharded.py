@@ -61,7 +61,7 @@ from ..tensor.frontier import (
 )
 from ..tensor.hashtable import _insert_impl
 from ..tensor.model import TensorModel
-from ..tensor.resident import _finish_masks
+from ..tensor.resident import _finish_masks, _resolve_chunking
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "d") -> Mesh:
@@ -128,9 +128,13 @@ class ShardedSearch:
             else batch_size * model.max_actions
         )
         self.props = model.properties()
-        self._kernel = self._build()
+        self._kernel, self._seed_k, self._chunk_k = self._build()
         self._last_tables = None
         self._parent_map = None
+        self._seed = None
+        # Suspended-search carry (chunked runs only): retained across run()
+        # calls so budget/timeout suspensions and overflows are resumable.
+        self._carry = None
 
     def _build(self):
         model = self.model
@@ -156,9 +160,34 @@ class ShardedSearch:
             # the two independent avoids occupancy skew (module docstring).
             return (lo % jnp.uint32(N)).astype(jnp.int32)
 
+        def continue_expr(
+            g_pending, g_overflow, discovered, gen_lo, gen_hi, steps,
+            required_mask, any_mask, target_lo, target_hi, max_steps,
+        ):
+            # The ONE definition of "keep searching" — used by the in-loop
+            # body and by chunk-entry recomputation so fresh and resumed runs
+            # can never drift on termination semantics.
+            all_found = (P_ > 0) & (discovered == all_bits)
+            policy = (
+                (required_mask != 0)
+                & ((discovered & required_mask) == required_mask)
+            ) | ((discovered & any_mask) != 0)
+            have_target = (target_lo | target_hi) != 0
+            count_hit = have_target & count_ge(
+                gen_lo, gen_hi, target_lo, target_hi
+            )
+            return (
+                (g_pending > 0)
+                & ~all_found
+                & ~policy
+                & ~count_hit
+                & ~g_overflow
+                & (steps < max_steps)
+            )
+
         _record = _record_impl
 
-        def per_chip(
+        def seed_carry(
             init_states,  # uint32[K, L] replicated
             init_lo,  # uint32[K] replicated
             init_hi,  # uint32[K] replicated
@@ -167,11 +196,8 @@ class ShardedSearch:
             target_hi,
             seed_lo,  # uint32 replicated: pre-dedup init count pair
             seed_hi,
-            required_mask,  # uint32 replicated
-            any_mask,  # uint32 replicated
             max_steps,  # int32 replicated
-            target_max_depth,  # uint32 replicated (0 = no limit)
-        ):
+        ) -> _Carry:
             me = jax.lax.axis_index(ax)
 
             # -- seed: each chip keeps only the init states it owns ------------
@@ -202,6 +228,46 @@ class ShardedSearch:
                 .at[qpos].set(jnp.uint32(1), mode="drop")
             )
 
+            # The seed counter pair is global (identical on every chip).
+            # Stop conditions that can already hold at seed time (empty init
+            # set, target <= seed count, max_steps == 0, seed overflow) must
+            # prevent the first expansion step, matching the resident
+            # engine's check-cond-before-first-body semantics.
+            have_target0 = (target_lo | target_hi) != 0
+            cont0 = (
+                (jax.lax.psum(n0, ax) > 0)
+                & ~(have_target0 & count_ge(seed_lo, seed_hi, target_lo, target_hi))
+                & ~(jax.lax.psum(ovf0.astype(jnp.int32), ax) > 0)
+                & (max_steps > 0)
+            )
+            return _Carry(
+                t_lo=t_lo,
+                t_hi=t_hi,
+                p_lo=p_lo,
+                p_hi=p_hi,
+                q_states=q_states,
+                q_lo=q_lo,
+                q_hi=q_hi,
+                q_ebits=q_ebits,
+                q_depth=q_depth,
+                head=jnp.int32(0),
+                tail=n0,
+                gen_lo=seed_lo,
+                gen_hi=seed_hi,
+                unique_count=is_new0.sum().astype(jnp.int32),
+                max_depth=jnp.uint32(0),
+                discovered=jnp.uint32(0),
+                disc_lo=jnp.zeros(max(P_, 1), dtype=jnp.uint32),
+                disc_hi=jnp.zeros(max(P_, 1), dtype=jnp.uint32),
+                cont=cont0,
+                overflow=ovf0,
+                steps=jnp.int32(0),
+            )
+
+        def make_body(
+            required_mask, any_mask, target_lo, target_hi, max_steps,
+            target_max_depth,
+        ):
             def body(c: _Carry) -> _Carry:
                 # -- pop a local batch (contiguous; queue never wraps) ---------
                 states, lo, hi, ebits, depth, active, head = pop_batch(
@@ -333,23 +399,10 @@ class ShardedSearch:
                 )
                 g_pending = jax.lax.psum(tail - head, ax)
                 g_overflow = jax.lax.psum(overflow.astype(jnp.int32), ax) > 0
-                all_found = (P_ > 0) & (discovered == all_bits)
-                policy = (
-                    (required_mask != 0)
-                    & ((discovered & required_mask) == required_mask)
-                ) | ((discovered & any_mask) != 0)
-                have_target = (target_lo | target_hi) != 0
-                count_hit = have_target & count_ge(
-                    gen_lo, gen_hi, target_lo, target_hi
-                )
                 steps = c.steps + 1
-                cont = (
-                    (g_pending > 0)
-                    & ~all_found
-                    & ~policy
-                    & ~count_hit
-                    & ~g_overflow
-                    & (steps < max_steps)
+                cont = continue_expr(
+                    g_pending, g_overflow, discovered, gen_lo, gen_hi, steps,
+                    required_mask, any_mask, target_lo, target_hi, max_steps,
                 )
 
                 return _Carry(
@@ -376,45 +429,38 @@ class ShardedSearch:
                     steps=steps,
                 )
 
-            # The seed counter pair is global (identical on every chip).
-            # Stop conditions that can already hold at seed time (empty init
-            # set, target <= seed count, max_steps == 0, seed overflow) must
-            # prevent the first expansion step, matching the resident
-            # engine's check-cond-before-first-body semantics.
-            have_target0 = (target_lo | target_hi) != 0
-            cont0 = (
-                (jax.lax.psum(n0, ax) > 0)
-                & ~(have_target0 & count_ge(seed_lo, seed_hi, target_lo, target_hi))
-                & ~(jax.lax.psum(ovf0.astype(jnp.int32), ax) > 0)
-                & (max_steps > 0)
+            return body
+
+        def recompute_cont(c: _Carry, required_mask, any_mask, target_lo,
+                           target_hi, max_steps):
+            # Re-derive the global continue flag from the carry's state so a
+            # resumed chunk honors the CURRENT run options (finish policy,
+            # target, step cap) rather than whatever stopped the prior run.
+            g_pending = jax.lax.psum(c.tail - c.head, ax)
+            g_overflow = jax.lax.psum(c.overflow.astype(jnp.int32), ax) > 0
+            return continue_expr(
+                g_pending, g_overflow, c.discovered, c.gen_lo, c.gen_hi,
+                c.steps, required_mask, any_mask, target_lo, target_hi,
+                max_steps,
             )
-            carry = _Carry(
-                t_lo=t_lo,
-                t_hi=t_hi,
-                p_lo=p_lo,
-                p_hi=p_hi,
-                q_states=q_states,
-                q_lo=q_lo,
-                q_hi=q_hi,
-                q_ebits=q_ebits,
-                q_depth=q_depth,
-                head=jnp.int32(0),
-                tail=n0,
-                gen_lo=seed_lo,
-                gen_hi=seed_hi,
-                unique_count=is_new0.sum().astype(jnp.int32),
-                max_depth=jnp.uint32(0),
-                discovered=jnp.uint32(0),
-                disc_lo=jnp.zeros(max(P_, 1), dtype=jnp.uint32),
-                disc_hi=jnp.zeros(max(P_, 1), dtype=jnp.uint32),
-                cont=cont0,
-                overflow=ovf0,
-                steps=jnp.int32(0),
+
+        def shard(x):
+            return x.reshape(1, *jnp.shape(x))
+
+        def per_chip(
+            init_states, init_lo, init_hi, init_active,
+            target_lo, target_hi, seed_lo, seed_hi,
+            required_mask, any_mask, max_steps, target_max_depth,
+        ):
+            carry = seed_carry(
+                init_states, init_lo, init_hi, init_active,
+                target_lo, target_hi, seed_lo, seed_hi, max_steps,
+            )
+            body = make_body(
+                required_mask, any_mask, target_lo, target_hi, max_steps,
+                target_max_depth,
             )
             carry = jax.lax.while_loop(lambda c: c.cont, body, carry)
-
-            def shard(x):
-                return x.reshape(1, *jnp.shape(x))
 
             return (
                 shard(carry.t_lo),
@@ -433,6 +479,59 @@ class ShardedSearch:
                 shard(carry.steps),
             )
 
+        def per_chip_seed(
+            init_states, init_lo, init_hi, init_active,
+            target_lo, target_hi, seed_lo, seed_hi, max_steps,
+        ):
+            carry = seed_carry(
+                init_states, init_lo, init_hi, init_active,
+                target_lo, target_hi, seed_lo, seed_hi, max_steps,
+            )
+            return jax.tree.map(lambda x: jnp.asarray(x)[None], carry)
+
+        def per_chip_chunk(
+            carry: _Carry,  # per-chip view: leading dim 1 on every leaf
+            required_mask, any_mask, target_lo, target_hi,
+            target_max_depth, budget, max_steps,
+        ):
+            c = jax.tree.map(lambda x: x[0], carry)
+            c = c._replace(
+                cont=recompute_cont(
+                    c, required_mask, any_mask, target_lo, target_hi,
+                    max_steps,
+                )
+            )
+            body = make_body(
+                required_mask, any_mask, target_lo, target_hi, max_steps,
+                target_max_depth,
+            )
+            start = c.steps
+            c = jax.lax.while_loop(
+                lambda c: c.cont & (c.steps < start + budget), body, c
+            )
+            summary = jnp.concatenate(
+                [
+                    jnp.stack(
+                        [
+                            c.gen_lo,
+                            c.gen_hi,
+                            c.unique_count.astype(jnp.uint32),
+                            c.max_depth,
+                            c.discovered,
+                            c.head.astype(jnp.uint32),
+                            c.tail.astype(jnp.uint32),
+                            c.overflow.astype(jnp.uint32),
+                            c.steps.astype(jnp.uint32),
+                            (~c.cont).astype(jnp.uint32),
+                        ]
+                    ),
+                    c.disc_lo,
+                    c.disc_hi,
+                ]
+            )
+            out = jax.tree.map(lambda x: jnp.asarray(x)[None], c)
+            return out, shard(summary)
+
         sharded = jax.shard_map(
             per_chip,
             mesh=mesh,
@@ -440,7 +539,24 @@ class ShardedSearch:
             out_specs=P(ax),
             check_vma=False,
         )
-        return jax.jit(sharded)
+        seed_sm = jax.shard_map(
+            per_chip_seed,
+            mesh=mesh,
+            in_specs=(P(),) * 9,
+            out_specs=P(ax),
+            check_vma=False,
+        )
+        # NOTE: deliberately NOT donated — the host keeps the pre-chunk carry
+        # alive so an overflow reverts to the last sound chunk boundary
+        # (checkpoint-then-raise instead of discarding the run).
+        chunk_sm = jax.shard_map(
+            per_chip_chunk,
+            mesh=mesh,
+            in_specs=(P(ax),) + (P(),) * 7,
+            out_specs=(P(ax), P(ax)),
+            check_vma=False,
+        )
+        return jax.jit(sharded), jax.jit(seed_sm), jax.jit(chunk_sm)
 
     # -- host entry ------------------------------------------------------------
 
@@ -451,21 +567,41 @@ class ShardedSearch:
         target_max_depth: Optional[int] = None,
         timeout: Optional[float] = None,
         max_steps: int = 1 << 30,
+        budget: Optional[int] = None,
+        progress: Optional[callable] = None,
     ) -> SearchResult:
-        if timeout is not None:
-            raise NotImplementedError(
-                "a device-resident while_loop cannot be interrupted by wall "
-                "clock; bound sharded runs via max_steps"
-            )
+        """Run (or resume) the multi-chip search. Without `budget` the whole
+        search is ONE shard_map dispatch. With `budget`, it runs in chunks of
+        at most `budget` globally-synced loop steps per dispatch — enabling
+        `progress`, `timeout` (polled between chunks), `checkpoint()`/resume,
+        and recoverable overflow (the carry reverts to the last chunk
+        boundary; see `load_checkpoint(table_log2=...)`)."""
+        chunked, budget = _resolve_chunking(
+            budget, timeout, progress, self._carry
+        )
         model = self.model
         K = self.batch_size
         start = time.monotonic()
         self._parent_map = None
 
-        init, init_lo, init_hi, n_raw = seed_init(model)
-        if len(init) > K:
-            raise ValueError("more init states than batch_size; raise batch_size")
-        n0 = len(init)
+        # seed_init is deterministic per model; cache its padded host form so
+        # resumed runs skip the host expansion/fingerprint work entirely.
+        if self._seed is None:
+            init, init_lo, init_hi, n_raw = seed_init(model)
+            if len(init) > K:
+                raise ValueError(
+                    "more init states than batch_size; raise batch_size"
+                )
+            n0 = len(init)
+            st = np.zeros((K, model.lanes), dtype=np.uint32)
+            st[:n0] = init
+            lo = np.zeros(K, dtype=np.uint32)
+            lo[:n0] = init_lo
+            hi = np.zeros(K, dtype=np.uint32)
+            hi[:n0] = init_hi
+            active = np.arange(K) < n0
+            self._seed = (st, lo, hi, active, n0, n_raw)
+        st, lo, hi, active, n0, n_raw = self._seed
 
         if finish_when.matches(self.props, set()) or not self.props:
             # Vacuous finish policy: stop before exploring (bfs.rs:278-280).
@@ -481,83 +617,254 @@ class ShardedSearch:
                 steps=0,
             )
 
-        st = np.zeros((K, model.lanes), dtype=np.uint32)
-        st[:n0] = init
-        lo = np.zeros(K, dtype=np.uint32)
-        lo[:n0] = init_lo
-        hi = np.zeros(K, dtype=np.uint32)
-        hi[:n0] = init_hi
-        active = np.arange(K) < n0
-
         required_mask, any_mask = _finish_masks(finish_when, self.props)
         target = int(target_state_count or 0)
-        (
-            t_lo,
-            t_hi,
-            p_lo,
-            p_hi,
-            gen_lo,
-            gen_hi,
-            unique_counts,
-            max_depths,
-            discovered,
-            disc_lo,
-            disc_hi,
-            drained,
-            overflow,
-            steps,
-        ) = jax.block_until_ready(
-            self._kernel(
-                jnp.asarray(st),
-                jnp.asarray(lo),
-                jnp.asarray(hi),
-                jnp.asarray(active),
-                jnp.uint32(target & 0xFFFFFFFF),
-                jnp.uint32(target >> 32),
-                jnp.uint32(n_raw & 0xFFFFFFFF),
-                jnp.uint32(n_raw >> 32),
-                jnp.uint32(required_mask),
-                jnp.uint32(any_mask),
-                jnp.int32(max_steps),
-                jnp.uint32(target_max_depth or 0),
-            )
-        )
-        if bool(np.asarray(overflow).any()):
-            raise RuntimeError(
-                "sharded search overflow: raise table_log2 or dest_capacity"
-            )
-        self._last_tables = (
-            np.asarray(t_lo), np.asarray(t_hi),
-            np.asarray(p_lo), np.asarray(p_hi),
+        t32 = (jnp.uint32(target & 0xFFFFFFFF), jnp.uint32(target >> 32))
+        seed32 = (
+            jnp.uint32(n_raw & 0xFFFFFFFF),
+            jnp.uint32(n_raw >> 32),
         )
 
-        # The generated-count pair is globally synced (identical per shard).
-        state_count = int(np.asarray(gen_lo)[0]) | (
-            int(np.asarray(gen_hi)[0]) << 32
-        )
-        # discovered is globally OR-synced, identical on every shard.
-        disc_mask = int(np.asarray(discovered)[0])
-        disc_lo = np.asarray(disc_lo)  # [N, P]
-        disc_hi = np.asarray(disc_hi)
+        if not chunked:
+            (
+                t_lo, t_hi, p_lo, p_hi,
+                gen_lo, gen_hi, unique_counts, max_depths,
+                discovered, disc_lo, disc_hi, drained, overflow, steps,
+            ) = jax.block_until_ready(
+                self._kernel(
+                    jnp.asarray(st),
+                    jnp.asarray(lo),
+                    jnp.asarray(hi),
+                    jnp.asarray(active),
+                    *t32,
+                    *seed32,
+                    jnp.uint32(required_mask),
+                    jnp.uint32(any_mask),
+                    jnp.int32(max_steps),
+                    jnp.uint32(target_max_depth or 0),
+                )
+            )
+            if bool(np.asarray(overflow).any()):
+                raise RuntimeError(
+                    "sharded search overflow: raise table_log2 or "
+                    "dest_capacity (or run with budget=... for a recoverable "
+                    "checkpoint-then-raise)"
+                )
+            self._last_tables = (
+                np.asarray(t_lo), np.asarray(t_hi),
+                np.asarray(p_lo), np.asarray(p_hi),
+            )
+            state_count = int(np.asarray(gen_lo)[0]) | (
+                int(np.asarray(gen_hi)[0]) << 32
+            )
+            disc_mask = int(np.asarray(discovered)[0])
+            disc_lo = np.asarray(disc_lo)  # [N, P]
+            disc_hi = np.asarray(disc_hi)
+            unique_counts = np.asarray(unique_counts)
+            result_max_depth = int(np.asarray(max_depths).max())
+            result_steps = int(np.asarray(steps).max())
+            complete = bool(np.asarray(drained).all())
+        else:
+            if self._carry is None:
+                self._carry = self._seed_k(
+                    jnp.asarray(st),
+                    jnp.asarray(lo),
+                    jnp.asarray(hi),
+                    jnp.asarray(active),
+                    *t32,
+                    *seed32,
+                    jnp.int32(max_steps),
+                )
+            req = jnp.uint32(required_mask)
+            anym = jnp.uint32(any_mask)
+            tmd = jnp.uint32(target_max_depth or 0)
+            timed_out = False
+            while True:
+                carry, summary = self._chunk_k(
+                    self._carry, req, anym, *t32, tmd,
+                    jnp.int32(budget), jnp.int32(max_steps),
+                )
+                s = np.asarray(summary)  # [N, 10 + 2*max(P,1)] — one transfer
+                if s[:, 7].any():  # overflow on any chip: the carry was kept
+                    # at the last sound chunk boundary for checkpoint+regrow.
+                    raise RuntimeError(
+                        "sharded search overflow; the carry was kept at the "
+                        "last chunk boundary — checkpoint(path) then "
+                        "ShardedSearch.load_checkpoint(model, path, "
+                        "table_log2=<bigger>) to continue without losing the "
+                        "run"
+                    )
+                self._carry = carry
+                if progress is not None:
+                    progress(
+                        int(s[0, 0]) | (int(s[0, 1]) << 32),
+                        int(s[:, 2].sum()),
+                        int(s[:, 3].max()),
+                    )
+                if s[0, 9]:  # stop flag (globally synced)
+                    break
+                if timeout is not None and time.monotonic() - start > timeout:
+                    timed_out = True
+                    break
+            self._last_tables = (
+                np.asarray(self._carry.t_lo),
+                np.asarray(self._carry.t_hi),
+                np.asarray(self._carry.p_lo),
+                np.asarray(self._carry.p_hi),
+            )
+            P_ = max(len(self.props), 1)
+            state_count = int(s[0, 0]) | (int(s[0, 1]) << 32)
+            disc_mask = int(s[0, 4])
+            disc_lo = s[:, 10 : 10 + P_]
+            disc_hi = s[:, 10 + P_ : 10 + 2 * P_]
+            unique_counts = s[:, 2]
+            result_max_depth = int(s[:, 3].max())
+            result_steps = int(s[:, 8].max())
+            complete = bool((s[:, 5] >= s[:, 6]).all()) and not timed_out
+
         discoveries = {}
         for i, p in enumerate(self.props):
             if disc_mask & (1 << i):
-                witnesses = pack_fp(disc_lo[:, i], disc_hi[:, i])
+                witnesses = pack_fp(
+                    disc_lo[:, i].astype(np.uint32),
+                    disc_hi[:, i].astype(np.uint32),
+                )
                 witnesses = witnesses[witnesses != 0]
                 discoveries[p.name] = int(witnesses[0])
         return SearchResult(
             state_count=state_count,
-            unique_state_count=int(np.asarray(unique_counts).sum()),
-            max_depth=int(np.asarray(max_depths).max()),
+            unique_state_count=int(unique_counts.sum()),
+            max_depth=result_max_depth,
             discoveries=discoveries,
-            complete=bool(np.asarray(drained).all()),
+            complete=complete,
             duration=time.monotonic() - start,
-            steps=int(np.asarray(steps).max()),
+            steps=result_steps,
             detail={
                 # fp-sharding balance evidence (task: per-chip spread).
-                "per_chip_unique": [int(x) for x in np.asarray(unique_counts)],
+                "per_chip_unique": [int(x) for x in unique_counts],
             },
         )
+
+    def reset(self) -> None:
+        """Drop any suspended carry so the next `run()` starts fresh."""
+        self._carry = None
+        self._parent_map = None
+        self._last_tables = None
+
+    # -- checkpoint / resume ---------------------------------------------------
+    # SURVEY.md §5: per-shard carry dump. Only chunked runs (budget=...)
+    # keep a carry to dump; the restore mesh must have the same chip count
+    # (the fp→owner map depends on it).
+
+    def checkpoint(self, path: str) -> None:
+        """Dump the suspended per-shard search carry to `path` (.npz)."""
+        import json
+
+        if self._carry is None:
+            raise RuntimeError(
+                "nothing to checkpoint: no suspended carry (run with "
+                "budget=... to enable chunked dispatch)"
+            )
+        from ..tensor.resident import _ckpt_path
+
+        c = self._carry
+        arrays = {f: np.asarray(getattr(c, f)) for f in c._fields}
+        arrays["meta"] = np.frombuffer(
+            json.dumps(
+                {
+                    "lanes": self.model.lanes,
+                    "max_actions": self.model.max_actions,
+                    "properties": [p.name for p in self.props],
+                    "table_log2": self.table_log2,
+                    "batch_size": self.batch_size,
+                    "n_chips": self.n_chips,
+                    "dest_capacity": self.dest_capacity,
+                }
+            ).encode(),
+            dtype=np.uint8,
+        )
+        np.savez_compressed(_ckpt_path(path), **arrays)
+
+    @classmethod
+    def load_checkpoint(
+        cls,
+        model: TensorModel,
+        path: str,
+        mesh: Optional[Mesh] = None,
+        batch_size: Optional[int] = None,
+        table_log2: Optional[int] = None,
+    ) -> "ShardedSearch":
+        """Rebuild a suspended sharded search. A larger `table_log2` re-hashes
+        every shard's visited set into a bigger per-chip table (the recovery
+        path for an overflow abort). The next `run()` continues exactly."""
+        import json
+
+        from jax.sharding import NamedSharding
+
+        from ..tensor.resident import _ckpt_path, _regrow, _validate_ckpt_meta
+
+        data = np.load(_ckpt_path(path))
+        meta = json.loads(bytes(data["meta"].tobytes()).decode())
+        _validate_ckpt_meta(model, meta)
+        ss = cls(
+            model,
+            mesh=mesh,
+            batch_size=batch_size or meta["batch_size"],
+            table_log2=table_log2 or meta["table_log2"],
+            dest_capacity=meta["dest_capacity"],
+        )
+        if ss.n_chips != meta["n_chips"]:
+            raise ValueError(
+                f"checkpoint was taken on {meta['n_chips']} chips; restoring "
+                f"on {ss.n_chips} is not supported (the fingerprint→owner "
+                "map depends on the chip count)"
+            )
+        log2 = table_log2 if table_log2 is not None else meta["table_log2"]
+        if log2 < meta["table_log2"]:
+            raise ValueError("cannot shrink the table on resume")
+        fields = {f: data[f] for f in _Carry._fields}
+        if log2 != meta["table_log2"]:
+            grown = [
+                _regrow(
+                    model,
+                    {
+                        k: fields[k][i]
+                        for k in (
+                            "t_lo", "t_hi", "p_lo", "p_hi",
+                            "q_states", "q_lo", "q_hi", "q_ebits", "q_depth",
+                        )
+                    },
+                    meta["table_log2"],
+                    log2,
+                    ss.batch_size,
+                )
+                for i in range(ss.n_chips)
+            ]
+            for k in grown[0]:
+                if k == "overflow":
+                    fields[k] = np.zeros(ss.n_chips, dtype=bool)
+                else:
+                    fields[k] = np.stack(
+                        [np.asarray(g[k]) for g in grown]
+                    )
+        # The per-shard queue guard (tail <= Q - K) was enforced with the
+        # CHECKPOINT's batch size; a larger K here could let pop_batch's
+        # dynamic_slice clamp past a shard's restored tail.
+        max_tail = int(np.max(fields["tail"]))
+        if max_tail > (1 << log2) - ss.batch_size:
+            raise ValueError(
+                "batch_size too large for the restored queue occupancy "
+                f"(max per-shard tail={max_tail}, capacity={1 << log2}); "
+                "use a smaller batch_size or a larger table_log2"
+            )
+        sh = NamedSharding(ss.mesh, P(ss.axis))
+        ss._carry = _Carry(
+            **{
+                f: jax.device_put(jnp.asarray(v), sh)
+                for f, v in fields.items()
+            }
+        )
+        return ss
 
     def reconstruct_path(self, fp: int):
         """Union the per-chip parent maps, then reconstruct as usual."""

@@ -102,6 +102,92 @@ class _Carry(NamedTuple):
     steps: jnp.ndarray  # int32
 
 
+def _resolve_chunking(budget, timeout, progress, carry):
+    """Shared run()-entry policy for the chunked engines: validate the
+    budget, decide whether this run is chunked, and default the chunk size
+    (64 steps between wall-clock polls; effectively-unbounded otherwise)."""
+    if budget is not None and budget <= 0:
+        raise ValueError("budget must be a positive step count")
+    chunked = (
+        budget is not None
+        or timeout is not None
+        or progress is not None
+        or carry is not None
+    )
+    if timeout is not None and budget is None:
+        budget = 64  # poll granularity for wall-clock checks
+    if chunked and budget is None:
+        budget = 1 << 20
+    return chunked, budget
+
+
+_ins_jit = jax.jit(_insert_impl)  # one compile cache shared by every regrow
+
+
+def _ckpt_path(path: str) -> str:
+    """`np.savez` appends `.npz` when the suffix is absent; normalize so
+    `checkpoint(p)` / `load_checkpoint(..., p)` round-trip on the same
+    string."""
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def _validate_ckpt_meta(model, meta: dict) -> None:
+    """Shared layout/property guards for engine checkpoints: lane widths and
+    property positions index into the dumped arrays, so any mismatch would
+    silently misalign them."""
+    if (meta["lanes"], meta["max_actions"]) != (
+        model.lanes,
+        model.max_actions,
+    ):
+        raise ValueError(
+            "checkpoint was taken with a different model layout "
+            f"(lanes/max_actions {meta['lanes']}/{meta['max_actions']} "
+            f"!= {model.lanes}/{model.max_actions})"
+        )
+    prop_names = [p.name for p in model.properties()]
+    if meta["properties"] != prop_names:
+        raise ValueError(
+            "checkpoint was taken with a different property list "
+            f"({meta['properties']} != {prop_names})"
+        )
+
+
+def _regrow(model, fields, old_log2: int, new_log2: int, K: int) -> dict:
+    """Re-hash a checkpointed visited table into a larger one and pad the
+    frontier queue to the matching capacity (queue rows live at [0, tail)).
+    Bucket slots depend on the table size, so growth is a full re-insert of
+    every occupied slot — done on device in `K`-row batches."""
+    S_new = 1 << new_log2
+    t_lo, t_hi = fields["t_lo"], fields["t_hi"]
+    p_lo, p_hi = fields["p_lo"], fields["p_hi"]
+    nz = t_lo != 0  # lo == 0 is the empty-slot sentinel (fingerprint.py)
+    keys = [a[nz] for a in (t_lo, t_hi, p_lo, p_hi)]
+    ins = _ins_jit
+    zero = jnp.zeros(S_new, dtype=jnp.uint32)
+    tl, th, pl, ph = zero, zero, zero, zero
+    n = keys[0].size
+    for i in range(0, max(n, 1), K):
+        batch = [np.zeros(K, dtype=np.uint32) for _ in range(4)]
+        m = min(K, n - i) if n else 0
+        for b, k in zip(batch, keys):
+            b[:m] = k[i : i + m]
+        active = np.arange(K) < m
+        tl, th, pl, ph, _, ovf = ins(tl, th, pl, ph, *batch, active)
+        if bool(ovf):
+            raise RuntimeError(
+                "table overflow while re-growing; raise table_log2 further"
+            )
+    out = {"t_lo": tl, "t_hi": th, "p_lo": pl, "p_hi": ph}
+    Q_old, Q_new = 1 << old_log2, S_new
+    for f in ("q_states", "q_lo", "q_hi", "q_ebits", "q_depth"):
+        old = fields[f]
+        grown = np.zeros((Q_new,) + old.shape[1:], dtype=old.dtype)
+        grown[:Q_old] = old
+        out[f] = grown
+    out["overflow"] = np.bool_(False)  # the abort reason is being fixed
+    return out
+
+
 class ResidentSearch:
     """One-dispatch whole-search engine for a `TensorModel`."""
 
@@ -119,6 +205,9 @@ class ResidentSearch:
         self._last_tables = None
         self._parent_map = None
         self._seed = None
+        # Suspended-search carry (chunked runs only): retained across run()
+        # calls so budget/timeout suspensions and overflows are resumable.
+        self._carry = None
 
     def _build(self):
         model = self.model
@@ -353,7 +442,10 @@ class ResidentSearch:
         def seed_k(init_states, init_lo, init_hi, n0, seed_lo, seed_hi):
             return make_carry(init_states, init_lo, init_hi, n0, seed_lo, seed_hi)
 
-        @partial(jax.jit, donate_argnums=(0,))
+        # NOTE: deliberately NOT donated — the host keeps the pre-chunk carry
+        # alive so a table/queue overflow can revert to the last sound chunk
+        # boundary (checkpoint-then-raise instead of discarding the run).
+        @jax.jit
         def chunk_k(
             carry: _Carry,
             req,  # uint32 dynamic (one compiled chunk kernel per model/shape)
@@ -391,14 +483,24 @@ class ResidentSearch:
         target_max_depth: Optional[int] = None,
         timeout: Optional[float] = None,
         max_steps: int = 1 << 30,
+        budget: Optional[int] = None,
+        progress: Optional[callable] = None,
     ) -> SearchResult:
-        if timeout is not None:
-            raise NotImplementedError(
-                "a device-resident while_loop cannot be interrupted by wall "
-                "clock; use the host-orchestrated FrontierSearch for timeouts "
-                "(spawn_tpu routes there automatically) or bound via "
-                "max_steps"
-            )
+        """Run (or resume) the search.
+
+        Without `budget`, the whole search is ONE device dispatch (fastest;
+        no suspension possible). With `budget`, the search runs in chunks of
+        at most `budget` loop steps per dispatch, which enables:
+        - `progress(state_count, unique_count, max_depth)` between chunks,
+        - `timeout` (polled between chunks, so it overshoots by <=1 chunk),
+        - `checkpoint()` / resume (a later `run()` continues the carry), and
+        - recoverable overflow: the carry reverts to the last chunk boundary
+          so `checkpoint()` + `load_checkpoint(..., table_log2=bigger)` can
+          continue the run instead of discarding it.
+        """
+        chunked, budget = _resolve_chunking(
+            budget, timeout, progress, self._carry
+        )
         model = self.model
         K = self.batch_size
         start = time.monotonic()
@@ -441,20 +543,75 @@ class ResidentSearch:
 
         required_mask, any_mask = _finish_masks(finish_when, self.props)
         target = int(target_state_count or 0)
-        t_lo, t_hi, p_lo, p_hi, summary = self._kernel(
-            *dev,
-            required_mask,
-            any_mask,
-            jnp.uint32(target & 0xFFFFFFFF),
-            jnp.uint32(target >> 32),
-            max_steps,
-            jnp.int32(n0),
-            jnp.uint32(n_raw & 0xFFFFFFFF),
-            jnp.uint32(n_raw >> 32),
-            jnp.uint32(target_max_depth or 0),
-        )
-        # ONE device->host transfer for the entire result.
-        summary = np.asarray(summary)
+        t_lo32 = jnp.uint32(target & 0xFFFFFFFF)
+        t_hi32 = jnp.uint32(target >> 32)
+        tmd = jnp.uint32(target_max_depth or 0)
+
+        timed_out = False
+        if not chunked:
+            t_lo, t_hi, p_lo, p_hi, summary = self._kernel(
+                *dev,
+                required_mask,
+                any_mask,
+                t_lo32,
+                t_hi32,
+                max_steps,
+                jnp.int32(n0),
+                jnp.uint32(n_raw & 0xFFFFFFFF),
+                jnp.uint32(n_raw >> 32),
+                tmd,
+            )
+            # ONE device->host transfer for the entire result.
+            summary = np.asarray(summary)
+            self._last_tables = (t_lo, t_hi, p_lo, p_hi)
+        else:
+            if self._carry is None:
+                self._carry = self._seed_k(
+                    *dev,
+                    jnp.int32(n0),
+                    jnp.uint32(n_raw & 0xFFFFFFFF),
+                    jnp.uint32(n_raw >> 32),
+                )
+            req = jnp.uint32(required_mask)
+            anym = jnp.uint32(any_mask)
+            while True:
+                carry, summary = self._chunk_k(
+                    self._carry,
+                    req,
+                    anym,
+                    t_lo32,
+                    t_hi32,
+                    tmd,
+                    jnp.int32(budget),
+                    jnp.int32(max_steps),
+                )
+                summary = np.asarray(summary)  # one small transfer per chunk
+                if summary[7]:  # overflow: revert to the pre-chunk carry so
+                    # checkpoint() + load_checkpoint(table_log2=bigger) can
+                    # resume exactly from the last sound chunk boundary.
+                    raise RuntimeError(
+                        "hash table or queue full; the search carry was kept "
+                        "at the last chunk boundary — checkpoint(path) then "
+                        "ResidentSearch.load_checkpoint(model, path, "
+                        "table_log2=<bigger>) to continue without losing the "
+                        "run"
+                    )
+                self._carry = carry
+                if progress is not None:
+                    gl, gh, uc, md = (int(x) for x in summary[:4])
+                    progress(gl | (gh << 32), uc, md)
+                if summary[9]:  # stop: search finished (or hit max_steps)
+                    break
+                if timeout is not None and time.monotonic() - start > timeout:
+                    timed_out = True
+                    break
+            self._last_tables = (
+                self._carry.t_lo,
+                self._carry.t_hi,
+                self._carry.p_lo,
+                self._carry.p_hi,
+            )
+
         (
             gen_lo,
             gen_hi,
@@ -469,7 +626,6 @@ class ResidentSearch:
         ) = (int(x) for x in summary[:10])
         if overflow:
             raise RuntimeError("hash table full; raise table_log2")
-        self._last_tables = (t_lo, t_hi, p_lo, p_hi)
 
         P = len(self.props)
         disc_lo = summary[10 : 10 + max(P, 1)]
@@ -484,10 +640,97 @@ class ResidentSearch:
             unique_state_count=unique_count,
             max_depth=max_depth,
             discoveries=discoveries,
-            complete=head >= tail,
+            complete=head >= tail and not timed_out,
             duration=time.monotonic() - start,
             steps=steps,
         )
+
+    def reset(self) -> None:
+        """Drop any suspended carry so the next `run()` starts fresh."""
+        self._carry = None
+        self._parent_map = None
+        self._last_tables = None
+
+    # -- checkpoint / resume ---------------------------------------------------
+    # SURVEY.md §5: the reference has no partial-search checkpointing; the
+    # whole resident carry (tables + queue + counters) is a handful of device
+    # arrays, so dumping it is one transfer. Only chunked runs
+    # (`run(budget=...)`) keep a carry to dump.
+
+    def checkpoint(self, path: str) -> None:
+        """Dump the suspended search carry to `path` (.npz). Valid after a
+        chunked `run()` has suspended (budget/timeout exhausted) or raised on
+        overflow; `load_checkpoint` rebuilds the search — optionally into a
+        LARGER table — and the next `run()` continues exactly."""
+        import json
+
+        if self._carry is None:
+            raise RuntimeError(
+                "nothing to checkpoint: no suspended carry (run with "
+                "budget=... to enable chunked dispatch)"
+            )
+        c = self._carry
+        arrays = {f: np.asarray(getattr(c, f)) for f in c._fields}
+        arrays["meta"] = np.frombuffer(
+            json.dumps(
+                {
+                    "lanes": self.model.lanes,
+                    "max_actions": self.model.max_actions,
+                    "properties": [p.name for p in self.props],
+                    "table_log2": self.table_log2,
+                    "batch_size": self.batch_size,
+                }
+            ).encode(),
+            dtype=np.uint8,
+        )
+        np.savez_compressed(_ckpt_path(path), **arrays)
+
+    @classmethod
+    def load_checkpoint(
+        cls,
+        model: TensorModel,
+        path: str,
+        batch_size: Optional[int] = None,
+        table_log2: Optional[int] = None,
+    ) -> "ResidentSearch":
+        """Rebuild a suspended search from a `checkpoint` file. Passing a
+        larger `table_log2` re-hashes the visited set into the bigger table
+        (the recovery path for an overflow abort); the queue is padded to the
+        matching capacity. The next `run()` continues where the dump left
+        off."""
+        import json
+
+        data = np.load(_ckpt_path(path))
+        meta = json.loads(bytes(data["meta"].tobytes()).decode())
+        _validate_ckpt_meta(model, meta)
+        log2 = table_log2 if table_log2 is not None else meta["table_log2"]
+        if log2 < meta["table_log2"]:
+            raise ValueError("cannot shrink the table on resume")
+        rs = cls(
+            model,
+            batch_size=batch_size or meta["batch_size"],
+            table_log2=log2,
+        )
+        fields = {f: data[f] for f in _Carry._fields}
+        if log2 != meta["table_log2"]:
+            fields.update(
+                _regrow(
+                    model, fields, meta["table_log2"], log2, rs.batch_size
+                )
+            )
+        # The queue guard (tail <= Q - K, see body()) was enforced with the
+        # CHECKPOINT's batch size; a larger K here could let pop_batch's
+        # dynamic_slice clamp past the restored tail and re-expand rows.
+        if int(fields["tail"]) > (1 << log2) - rs.batch_size:
+            raise ValueError(
+                "batch_size too large for the restored queue occupancy "
+                f"(tail={int(fields['tail'])}, capacity={1 << log2}); use a "
+                "smaller batch_size or a larger table_log2"
+            )
+        rs._carry = _Carry(
+            **{f: jax.device_put(jnp.asarray(v)) for f, v in fields.items()}
+        )
+        return rs
 
     def reconstruct_path(self, fp: int):
         """TLC-style reconstruction from the final table contents (the logic

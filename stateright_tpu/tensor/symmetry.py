@@ -11,10 +11,25 @@ fingerprint the canonical form while continuing the search with the original
 state (preserving the reference DFS's representative-insert/original-continue
 semantics, ref: src/checker/dfs.rs:309-334).
 
-Count parity: a stable sort keyed on the entity value places equal-key
-entities in original index order, so the induced state partition — and hence
-the unique-state count — is independent of the key order chosen, matching the
-host `RewritePlan.from_values_to_sort` counts (e.g. 2PC-5: 8,832 → 665).
+COUNT CONTRACT — device counts intentionally differ from reference
+`check-sym` goldens. The reference sorts entities by their primary value
+only (`RewritePlan.from_values_to_sort`, ref: src/checker/rewrite_plan.rs:
+81-107), which breaks ties between equal-valued entities by original index;
+states whose satellite bits (e.g. 2PC's per-RM prepared/message flags)
+differ only under a tie permutation then land on different representatives,
+so the reduced count depends on traversal order (2PC-5: 8,832 → 665 under
+the reference's DFS). The canonicalizations built from these helpers key
+the sort on the FULL per-entity tuple (value + satellite bits), which is a
+true orbit invariant: every member of a permutation orbit maps to the same
+representative regardless of which engine or traversal order found it
+(2PC-5: 8,832 → 314; cross-validated against a host DFS using the same
+canonicalization in tests/test_tensor_symmetry.py). Both reductions are
+sound for property checking — they only affect which orbit member is
+counted/stored — but the counts are NOT comparable:
+
+- assert device-engine symmetry counts against full-key goldens (314);
+- assert host `spawn_dfs` + `symmetry_fn` counts against the reference's
+  value-sort goldens (665), which that path reproduces exactly.
 """
 
 from __future__ import annotations

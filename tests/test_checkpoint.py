@@ -94,3 +94,101 @@ def test_suspended_result_discoveries_are_snapshots():
     snapshot = dict(r1.discoveries)
     fs.run()
     assert r1.discoveries == snapshot  # no aliasing of the live dict
+
+
+# -- resident engine (chunked dispatch) ---------------------------------------
+
+
+def test_resident_chunked_matches_single_dispatch():
+    from stateright_tpu.tensor.resident import ResidentSearch
+
+    full = ResidentSearch(TensorTwoPhaseSys(4), 256, 14).run()
+    chunked = ResidentSearch(TensorTwoPhaseSys(4), 256, 14).run(budget=3)
+    assert chunked.complete
+    assert chunked.state_count == full.state_count
+    assert chunked.unique_state_count == full.unique_state_count
+    assert chunked.max_depth == full.max_depth
+    assert chunked.discoveries == full.discoveries
+
+
+def test_resident_suspend_and_resume_in_place():
+    from stateright_tpu.tensor.resident import ResidentSearch
+
+    full = ResidentSearch(TensorTwoPhaseSys(4), 256, 14).run()
+    rs = ResidentSearch(TensorTwoPhaseSys(4), 256, 14)
+    partial = rs.run(max_steps=2, budget=1)
+    assert not partial.complete
+    assert partial.state_count < full.state_count
+    resumed = rs.run()  # continues the retained carry
+    assert resumed.complete
+    assert resumed.state_count == full.state_count
+    assert resumed.unique_state_count == full.unique_state_count
+
+
+def test_resident_progress_callback():
+    from stateright_tpu.tensor.resident import ResidentSearch
+
+    seen = []
+    ResidentSearch(TensorTwoPhaseSys(3), 128, 12).run(
+        budget=2, progress=lambda sc, uc, md: seen.append((sc, uc, md))
+    )
+    assert len(seen) >= 2
+    assert seen[-1][1] == 288  # unique count at completion
+    assert all(a <= b for a, b in zip(seen, seen[1:]))  # monotone
+
+
+def test_resident_kill_and_resume_reproduces_exact_counts(tmp_path):
+    from stateright_tpu.tensor.resident import ResidentSearch
+
+    full = ResidentSearch(TensorTwoPhaseSys(4), 256, 14).run()
+    rs = ResidentSearch(TensorTwoPhaseSys(4), 256, 14)
+    partial = rs.run(max_steps=2, budget=1)
+    assert not partial.complete
+    ckpt = str(tmp_path / "resident.npz")
+    rs.checkpoint(ckpt)
+    del rs
+
+    resumed = ResidentSearch.load_checkpoint(TensorTwoPhaseSys(4), ckpt)
+    r = resumed.run()
+    assert r.complete
+    assert r.state_count == full.state_count
+    assert r.unique_state_count == full.unique_state_count
+    assert r.max_depth == full.max_depth
+    assert set(r.discoveries) == set(full.discoveries)
+    path = resumed.reconstruct_path(r.discoveries["commit agreement"])
+    assert path.last_state() is not None
+
+
+def test_resident_overflow_checkpoints_then_regrows(tmp_path):
+    from stateright_tpu.tensor.resident import ResidentSearch
+
+    full = ResidentSearch(TensorTwoPhaseSys(4), 256, 14).run()
+    # 2pc-4 has 1,568 unique states; a 2^10-slot table must overflow.
+    rs = ResidentSearch(TensorTwoPhaseSys(4), 256, 10)
+    with pytest.raises(RuntimeError, match="checkpoint"):
+        rs.run(budget=2)
+    ckpt = str(tmp_path / "overflowed.npz")
+    rs.checkpoint(ckpt)  # the carry reverted to the last sound boundary
+    del rs
+
+    grown = ResidentSearch.load_checkpoint(
+        TensorTwoPhaseSys(4), ckpt, table_log2=14
+    )
+    r = grown.run()
+    assert r.complete
+    assert r.state_count == full.state_count
+    assert r.unique_state_count == full.unique_state_count
+    assert r.discoveries == full.discoveries
+
+
+def test_resident_timeout_suspends_not_raises():
+    from stateright_tpu.tensor.resident import ResidentSearch
+
+    full = ResidentSearch(TensorTwoPhaseSys(4), 64, 14).run()
+    rs = ResidentSearch(TensorTwoPhaseSys(4), 64, 14)
+    r = rs.run(timeout=0.0, budget=1)
+    assert not r.complete
+    resumed = rs.run()
+    assert resumed.complete
+    assert resumed.unique_state_count == full.unique_state_count
+    assert resumed.state_count == full.state_count

@@ -113,3 +113,100 @@ def test_sharded_at_scale_2pc7():
     # Balanced ownership: no chip more than 10% off the mean.
     mean = sum(per_chip) / len(per_chip)
     assert max(per_chip) <= 1.1 * mean and min(per_chip) >= 0.9 * mean, per_chip
+
+
+# -- chunked dispatch / checkpoint-resume -------------------------------------
+
+
+def test_sharded_chunked_matches_single_dispatch():
+    full = ShardedSearch(
+        TensorTwoPhaseSys(4), mesh=make_mesh(4), batch_size=128, table_log2=13
+    ).run()
+    chunked = ShardedSearch(
+        TensorTwoPhaseSys(4), mesh=make_mesh(4), batch_size=128, table_log2=13
+    ).run(budget=3)
+    assert chunked.complete
+    assert chunked.state_count == full.state_count
+    assert chunked.unique_state_count == full.unique_state_count
+    assert chunked.max_depth == full.max_depth
+    assert chunked.discoveries == full.discoveries
+
+
+def test_sharded_suspend_resume_and_progress():
+    full = ShardedSearch(
+        TensorTwoPhaseSys(4), mesh=make_mesh(4), batch_size=128, table_log2=13
+    ).run()
+    ss = ShardedSearch(
+        TensorTwoPhaseSys(4), mesh=make_mesh(4), batch_size=128, table_log2=13
+    )
+    partial = ss.run(max_steps=2, budget=1)
+    assert not partial.complete
+    assert partial.state_count < full.state_count
+    seen = []
+    resumed = ss.run(progress=lambda sc, uc, md: seen.append(sc))
+    assert resumed.complete
+    assert resumed.state_count == full.state_count
+    assert resumed.unique_state_count == full.unique_state_count
+    assert seen and seen[-1] == full.state_count
+
+
+def test_sharded_kill_and_resume_reproduces_exact_counts(tmp_path):
+    full = ShardedSearch(
+        TensorTwoPhaseSys(4), mesh=make_mesh(4), batch_size=128, table_log2=13
+    ).run()
+    ss = ShardedSearch(
+        TensorTwoPhaseSys(4), mesh=make_mesh(4), batch_size=128, table_log2=13
+    )
+    assert not ss.run(max_steps=2, budget=1).complete
+    ckpt = str(tmp_path / "sharded.npz")
+    ss.checkpoint(ckpt)
+    del ss
+
+    resumed = ShardedSearch.load_checkpoint(
+        TensorTwoPhaseSys(4), ckpt, mesh=make_mesh(4)
+    )
+    r = resumed.run()
+    assert r.complete
+    assert r.state_count == full.state_count
+    assert r.unique_state_count == full.unique_state_count
+    assert r.max_depth == full.max_depth
+    assert set(r.discoveries) == set(full.discoveries)
+    path = resumed.reconstruct_path(r.discoveries["commit agreement"])
+    assert path.last_state() is not None
+
+
+def test_sharded_overflow_checkpoints_then_regrows(tmp_path):
+    full = ShardedSearch(
+        TensorTwoPhaseSys(5), mesh=make_mesh(4), batch_size=128, table_log2=14
+    ).run()
+    # 2pc-5 has 8,832 unique states; 4 chips x 2^9 slots must overflow.
+    ss = ShardedSearch(
+        TensorTwoPhaseSys(5), mesh=make_mesh(4), batch_size=128, table_log2=9
+    )
+    with pytest.raises(RuntimeError, match="checkpoint"):
+        ss.run(budget=2)
+    ckpt = str(tmp_path / "overflowed.npz")
+    ss.checkpoint(ckpt)
+    del ss
+
+    grown = ShardedSearch.load_checkpoint(
+        TensorTwoPhaseSys(5), ckpt, mesh=make_mesh(4), table_log2=14
+    )
+    r = grown.run()
+    assert r.complete
+    assert r.state_count == full.state_count
+    assert r.unique_state_count == full.unique_state_count
+    assert r.discoveries == full.discoveries
+
+
+def test_sharded_chip_count_mismatch_rejected(tmp_path):
+    ss = ShardedSearch(
+        TensorTwoPhaseSys(3), mesh=make_mesh(4), batch_size=64, table_log2=12
+    )
+    ss.run(max_steps=1, budget=1)
+    ckpt = str(tmp_path / "s.npz")
+    ss.checkpoint(ckpt)
+    with pytest.raises(ValueError, match="chips"):
+        ShardedSearch.load_checkpoint(
+            TensorTwoPhaseSys(3), ckpt, mesh=make_mesh(2)
+        )

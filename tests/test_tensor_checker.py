@@ -254,11 +254,14 @@ def test_tpu_checker_rejects_visitor():
         )
 
 
-def test_resident_rejects_timeout_directly():
+def test_resident_timeout_runs_chunked():
+    # timeout used to be rejected outright; it now implies chunked dispatch
+    # (polled between chunks), so a generous timeout completes normally.
     from stateright_tpu.tensor.resident import ResidentSearch
 
-    with pytest.raises(NotImplementedError):
-        ResidentSearch(TensorTwoPhaseSys(3), 64, 10).run(timeout=1.0)
+    r = ResidentSearch(TensorTwoPhaseSys(3), 64, 10).run(timeout=300.0)
+    assert r.complete
+    assert r.unique_state_count == 288
 
 
 def test_tpu_checker_assert_discovery():
