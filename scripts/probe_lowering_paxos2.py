@@ -22,7 +22,7 @@ cfg = PaxosModelCfg(
     network=Network.new_unordered_nonduplicating(),
 )
 
-def local_boundary(i, s):
+def _unused_local_boundary(i, s):
     return i >= 3 or s.state.ballot[0] <= C
 
 def properties(view):
@@ -38,9 +38,13 @@ def properties(view):
 t0 = time.monotonic()
 lowered = lower_actor_model(
     cfg.into_model(),
-    local_boundary=local_boundary,
+
     properties=properties,
     max_histories=1 << 17,
+    closure="exact",
+    max_local_states=1 << 16,
+    max_joint_states=1 << 22,
+    max_envelopes=1 << 15,
 )
 t1 = time.monotonic()
 print(f"closure: {t1-t0:.1f}s", flush=True)
@@ -50,7 +54,7 @@ print(f"  histories: {len(lowered.histories)}  hevents: {len(lowered.hevents)}")
 print(f"  lanes: {lowered.lanes}  max_actions: {lowered.max_actions}", flush=True)
 
 t2 = time.monotonic()
-r = FrontierSearch(lowered, batch_size=2048, table_log2=20).run()
+r = FrontierSearch(lowered, batch_size=2048, table_log2=22).run()
 t3 = time.monotonic()
 print(f"search: {t3-t2:.1f}s  states={r.state_count} unique={r.unique_state_count} depth={r.max_depth}")
 print(f"discoveries: {sorted(r.discoveries)}")

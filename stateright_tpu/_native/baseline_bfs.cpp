@@ -599,6 +599,54 @@ struct Paxos {
   }
 };
 
+// ---------------------------------------------------------------------------
+// increment_lock — scalar port of tensor/models.py TensorIncrementLock
+// (itself matching examples/increment_lock.rs). Lanes: [i, lock, t0, pc0, ...]
+// ---------------------------------------------------------------------------
+
+struct IncrementLock {
+  static constexpr int LANES = 16;  // supports up to 7 threads
+  struct State { std::array<u32, LANES> lanes; };
+  int threads_n;
+
+  explicit IncrementLock(int n) : threads_n(n) {
+    if (n > 7) { std::fprintf(stderr, "increment_lock: n > 7\n"); std::exit(2); }
+  }
+
+  std::vector<State> init_states() const {
+    State s{};
+    return {s};
+  }
+
+  void expand(const State& s, std::vector<State>& out) const {
+    u32 i = s.lanes[0], lock = s.lanes[1];
+    for (int t = 0; t < threads_n; ++t) {
+      u32 tv = s.lanes[2 + 2 * t], pc = s.lanes[3 + 2 * t];
+      if (pc == 0 && !lock) {        // lock
+        State n = s; n.lanes[1] = 1; n.lanes[3 + 2 * t] = 1; out.push_back(n);
+      } else if (pc == 1) {          // read
+        State n = s; n.lanes[2 + 2 * t] = i; n.lanes[3 + 2 * t] = 2;
+        out.push_back(n);
+      } else if (pc == 2) {          // write
+        State n = s; n.lanes[0] = tv + 1; n.lanes[3 + 2 * t] = 3;
+        out.push_back(n);
+      } else if (pc == 3 && lock) {  // release
+        State n = s; n.lanes[1] = 0; n.lanes[3 + 2 * t] = 4; out.push_back(n);
+      }
+    }
+  }
+
+  bool properties_hold(const State& s) const {  // fin && mutex (always)
+    u32 done = 0, held = 0;
+    for (int t = 0; t < threads_n; ++t) {
+      u32 pc = s.lanes[3 + 2 * t];
+      done += pc >= 3;
+      held += pc >= 1 && pc < 4;
+    }
+    return done == s.lanes[0] && held <= 1;
+  }
+};
+
 }  // namespace
 
 template <typename Model>
@@ -633,6 +681,9 @@ int main(int argc, char** argv) {
   } else if (std::strcmp(argv[1], "2pc") == 0) {
     TwoPhase m(n);
     run(m, threads, "2pc");
+  } else if (std::strcmp(argv[1], "increment_lock") == 0) {
+    IncrementLock m(n);
+    run(m, threads, "increment_lock");
   } else {
     std::fprintf(stderr, "unknown model %s\n", argv[1]);
     return 2;

@@ -41,12 +41,28 @@ class TpuChecker(Checker):
                 "canonicalization kernel instead (see tensor/symmetry.py), "
                 "which every device engine honors automatically"
             )
+        from ..core.visitor import StateRecorder
+
+        self._recorder = None
         if options.visitor_ is not None:
-            raise NotImplementedError(
-                "visitors require a per-evaluated-state host callback with a "
-                "full Path — incompatible with batched device search; use "
-                "spawn_bfs/spawn_dfs for visitor-driven runs"
-            )
+            if isinstance(options.visitor_, StateRecorder):
+                # State-set recording maps to the engines' batched queue dump
+                # (every unique state, one transfer) — the visitor pattern the
+                # reference's tests lean on (ref: src/checker/visitor.rs:
+                # 75-111). Path-carrying visitors stay host-only.
+                if resident is False:
+                    raise NotImplementedError(
+                        "StateRecorder on spawn_tpu requires the resident "
+                        "engine (the default); drop resident=False"
+                    )
+                self._recorder = options.visitor_
+            else:
+                raise NotImplementedError(
+                    "visitors other than StateRecorder require a "
+                    "per-evaluated-state host callback with a full Path — "
+                    "incompatible with batched device search; use "
+                    "spawn_bfs/spawn_dfs for visitor-driven runs"
+                )
         super().__init__(model)
         # The resident engine runs the whole search in one device dispatch —
         # the default. A timeout makes it run in chunked dispatches (the
@@ -90,8 +106,25 @@ class TpuChecker(Checker):
             # single-dispatch resident run has no host involvement to report
             # from (forcing it chunked just for counters would cost perf).
             kwargs["progress"] = progress
+        if (
+            self._recorder is not None
+            and isinstance(self._search, ResidentSearch)
+            and self._options.timeout_ is None
+        ):
+            # dump_states() needs the retained carry of a chunked run. With a
+            # timeout, _resolve_chunking already picks the 64-step polling
+            # budget — overriding it here would defeat the wall clock.
+            kwargs.setdefault("budget", 1 << 20)
         try:
             self._result = self._search.run(**kwargs)
+            if self._recorder is not None:
+                from ..core.path import Path as _Path
+
+                # evaluated_only: rows the search actually popped — on an
+                # early exit the queue tail also holds never-evaluated
+                # frontier rows, which the reference's visitor never sees.
+                for s in self._search.dump_states(evaluated_only=True):
+                    self._recorder.visit(self._model, _Path([(s, None)]))
         except BaseException as e:  # noqa: BLE001 — surfaced by join()
             self._panic = e
 

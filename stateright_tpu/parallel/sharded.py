@@ -751,6 +751,35 @@ class ShardedSearch:
         self._parent_map = None
         self._last_tables = None
 
+    def dump_states(
+        self, decode: bool = True, evaluated_only: bool = False
+    ) -> list:
+        """Batched state dump across all shards: each chip's frontier queue
+        rows [0, tail) are exactly the unique states that chip owns (every
+        unique state is enqueued on its owner chip once), so the union over
+        shards is the global unique state set. Device analogue of the
+        reference's `StateRecorder` (ref: src/checker/visitor.rs:75-111).
+        Requires a chunked run, which retains the per-shard carry.
+        `evaluated_only` restricts to popped rows ([0, head) per shard)."""
+        if self._carry is None:
+            raise RuntimeError(
+                "no retained carry to dump: run with budget=... (chunked "
+                "dispatch) before dump_states()"
+            )
+        q = np.asarray(self._carry.q_states)  # [N, Q, L]
+        ends = np.asarray(
+            self._carry.head if evaluated_only else self._carry.tail
+        )
+        out = []
+        for i in range(self.n_chips):
+            for r in q[i, : int(ends[i])]:
+                out.append(
+                    self.model.decode(r)
+                    if decode
+                    else tuple(int(x) for x in r)
+                )
+        return out
+
     # -- checkpoint / resume ---------------------------------------------------
     # SURVEY.md §5: per-shard carry dump. Only chunked runs (budget=...)
     # keep a carry to dump; the restore mesh must have the same chip count

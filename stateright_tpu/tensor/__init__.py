@@ -24,6 +24,7 @@ design forced it globally and paid u64 emulation tax in every hot op).
 """
 
 from .model import TensorModel, TensorProperty
+from .adapter import TensorModelAdapter, as_host_model
 from .fingerprint import device_fingerprint, pack_fp, unpack_fp
 from .hashtable import HashTable
 from .frontier import FrontierSearch, SearchResult
@@ -32,6 +33,8 @@ from .simulation import DeviceSimulation
 
 __all__ = [
     "DeviceSimulation",
+    "TensorModelAdapter",
+    "as_host_model",
     "TensorModel",
     "TensorProperty",
     "device_fingerprint",

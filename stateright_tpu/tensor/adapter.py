@@ -1,0 +1,136 @@
+"""Host-Model adapter over a `TensorModel`: the bridge that lets device
+workloads use every host-side facility — the Explorer web UI (which re-executes
+states on demand, ref: src/checker/explorer.rs:224-320), the on-demand checker,
+host BFS/DFS for cross-validation, and visitor-driven exact state-set
+assertions (ref: src/checker/visitor.rs:40-111).
+
+States on the host side are the encoded uint32 rows as plain tuples (hashable
+and stably-encodable); `actions` are the valid action-slot labels from
+`TensorModel.action_label`, and each expansion is a 1-row device `expand`
+call — interactive-browsing sized, by design. `format_state` decodes rows via
+`TensorModel.decode`, so the Explorer shows human-readable states, not lane
+dumps.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.model import Property
+from .model import TensorModel
+
+
+class TensorModelAdapter:
+    """`Model`-protocol view of a `TensorModel` (duck-typed like every host
+    model; `Model` is a protocol, not a required base)."""
+
+    def __init__(self, tm: TensorModel):
+        self.tensor_model = tm
+
+        class Row(tuple):
+            """Encoded state row whose repr is the DECODED state, so the
+            Explorer and reports show protocol-level values, not u32 lanes.
+            A tuple subclass keeps host fingerprinting/identity unchanged
+            (stable_encode treats it as a tuple)."""
+
+            __slots__ = ()
+
+            def __repr__(row) -> str:  # noqa: N805 — row, not self
+                return repr(tm.decode(np.asarray(row, dtype=np.uint32)))
+
+        self._row = Row
+
+    # -- expansion -------------------------------------------------------------
+
+    def _expand_row(self, row):
+        tm = self.tensor_model
+        batch = jnp.asarray(np.asarray(row, dtype=np.uint32)[None])
+        succs, valid = tm.expand(batch)
+        in_bounds = tm.within_boundary(succs[0])
+        return np.asarray(succs)[0], np.asarray(valid)[0] & np.asarray(
+            in_bounds
+        )
+
+    def init_states(self) -> list:
+        rows = np.asarray(self.tensor_model.init_states(), dtype=np.uint32)
+        return [self._row(int(x) for x in r) for r in rows]
+
+    def actions(self, state, actions: list) -> None:
+        tm = self.tensor_model
+        row = np.asarray(state, dtype=np.uint32)
+        _succs, valid = self._expand_row(row)
+        for a in range(tm.max_actions):
+            if valid[a]:
+                actions.append(tm.action_label(row, a))
+
+    def next_state(self, state, action):
+        tm = self.tensor_model
+        row = np.asarray(state, dtype=np.uint32)
+        succs, valid = self._expand_row(row)
+        for a in range(tm.max_actions):
+            if valid[a] and tm.action_label(row, a) == action:
+                return self._row(int(x) for x in succs[a])
+        return None
+
+    def next_steps(self, state) -> list:
+        """One device expand per state (the Model-protocol default would do
+        one per action)."""
+        tm = self.tensor_model
+        row = np.asarray(state, dtype=np.uint32)
+        succs, valid = self._expand_row(row)
+        return [
+            (tm.action_label(row, a), self._row(int(x) for x in succs[a]))
+            for a in range(tm.max_actions)
+            if valid[a]
+        ]
+
+    def next_states(self, state) -> list:
+        return [ns for _, ns in self.next_steps(state)]
+
+    # -- properties / boundary -------------------------------------------------
+
+    def properties(self) -> list[Property]:
+        def host_cond(tp):
+            def cond(_model, state):
+                batch = jnp.asarray(np.asarray(state, dtype=np.uint32)[None])
+                return bool(
+                    np.asarray(tp.condition(self.tensor_model, batch))[0]
+                )
+
+            return cond
+
+        return [
+            Property(p.expectation, p.name, host_cond(p))
+            for p in self.tensor_model.properties()
+        ]
+
+    def within_boundary(self, state) -> bool:
+        batch = jnp.asarray(np.asarray(state, dtype=np.uint32)[None])
+        return bool(np.asarray(self.tensor_model.within_boundary(batch))[0])
+
+    # -- display ---------------------------------------------------------------
+
+    def format_action(self, action) -> str:
+        return self.tensor_model.format_action(action)
+
+    def format_state(self, state) -> str:
+        return repr(self.tensor_model.decode(np.asarray(state, np.uint32)))
+
+    def format_step(self, last_state, action):
+        return None
+
+    def as_svg(self, path):
+        return None
+
+    def checker(self):
+        from ..checker.builder import CheckerBuilder
+
+        return CheckerBuilder(self)
+
+
+def as_host_model(tm: TensorModel) -> TensorModelAdapter:
+    """Wrap a `TensorModel` so host checkers, visitors, and the Explorer can
+    drive it: `as_host_model(tm).checker().serve("localhost:3000")`."""
+    return TensorModelAdapter(tm)

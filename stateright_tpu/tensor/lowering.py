@@ -40,12 +40,40 @@ Host-semantics parity (all cited behaviors preserved exactly):
 - state identity covers (actor states, history, timers, network), matching
   `ActorModelState`'s manual Hash (ref: src/actor/model_state.rs:134-145).
 
+Three closure strategies (`closure=`), trading host work against the size of
+the abstraction:
+
+- "independent" (default): closes each actor against the whole envelope
+  vocabulary — cheapest, but the per-actor cross product explodes when local
+  states accumulate message contents (Paxos quorum sets overflow a 2^16 cap
+  at 2 clients), and it REQUIRES `local_boundary` whenever handlers can grow
+  state unboundedly.
+- "joint": worklist over actor-sid VECTORS with a sticky envelope vocabulary —
+  keeps inter-actor correlations, but still needs `local_boundary` for models
+  bounded only by a global `within_boundary` (a sid vector cannot evaluate a
+  global-state predicate), and the sticky network is still too coarse for
+  Paxos-scale entanglement.
+- "exact": one host BFS of the REAL global model records precisely the
+  reaction pairs + history transitions that occur. Self-bounding, no
+  `local_boundary` needed, and it is what lowers the reference's headline
+  configs: paxos-2 (16,668 unique) closes in ~3 s, paxos-3 (1,194,428
+  unique) in ~6.5 min, both at exact golden parity (probe:
+  scripts/probe_lowering_paxos2.py). The closure costs one host traversal of
+  the global space — worth it because the resulting tables are tiny (paxos-3:
+  675/723/777 local states per server, 240 envelopes, 7 histories) and every
+  subsequent device run (re-checks, symmetry variants, sharded scale-out,
+  simulation walks) reuses them. The incremental path that avoids the full
+  host traversal — run the device search, extend the closure from POISON
+  hits, repeat — is the designed follow-on; the POISON guard below already
+  provides its correctness backstop.
+
 Soundness guards: every closure is bounded (`max_local_states`,
-`max_histories`, `max_envelopes`); if the device search ever reaches a
-(state, envelope) pair the closure did not cover (possible only when
-`local_boundary` under-approximates the model's real boundary), the successor
-becomes the reserved POISON row and the auto-added "lowering coverage"
-property reports it as a counterexample instead of silently mis-exploring.
+`max_histories`, `max_envelopes`, `max_joint_states`); if the device search
+ever reaches a (state, envelope) pair the closure did not cover (possible
+only when `local_boundary` under-approximates the model's real boundary), the
+successor becomes the reserved POISON row and the auto-added "lowering
+coverage" property reports it as a counterexample instead of silently
+mis-exploring.
 
 Random choices lower via per-actor vocabularies (pending-choice maps, choice
 values, and command deltas become gather tables; SelectRandom action slots pop
@@ -105,6 +133,9 @@ class LoweredActorModel(TensorModel):
         max_histories: int = 1 << 16,
         properties: Optional[Callable] = None,
         boundary: Optional[Callable] = None,
+        closure: str = "independent",
+        max_joint_states: int = 1 << 20,
+        closure_max_depth: Optional[int] = None,
     ):
         self.model = model
         self.kind = model.init_network.kind
@@ -118,6 +149,40 @@ class LoweredActorModel(TensorModel):
         self.max_local_states = max_local_states
         self.max_envelopes = max_envelopes
         self.max_histories = max_histories
+        if closure not in ("independent", "joint", "exact"):
+            raise ValueError(
+                "closure must be 'independent', 'joint', or 'exact'"
+            )
+        # "independent" closes each actor against the whole envelope
+        # vocabulary — cheap, but the cross product explodes for actors whose
+        # local state accumulates message contents (e.g. Paxos quorum sets).
+        # "joint" explores the actor-sid VECTOR with a sticky (monotone)
+        # envelope vocabulary — a tighter over-approximation of reachability
+        # that only closes (state, envelope) pairs some relaxed execution
+        # produces, the same abstraction _close_histories uses. "exact"
+        # enumerates the REAL global model once on the host and records
+        # exactly the reaction pairs + history transitions that occur — the
+        # closure cost then scales with the global space (host-BFS speed),
+        # which is the right trade when local states accumulate message
+        # contents too entangled for either abstraction (Paxos quorum sets:
+        # 2-client Paxos overflows a 2^16 per-actor cap under "independent"
+        # and a 2^20 vector cap under "joint"). All modes are sound: the
+        # POISON coverage guard flags any under-coverage at search time
+        # instead of mis-exploring.
+        self.joint = closure == "joint"
+        self.exact = closure == "exact"
+        self.max_joint_states = max_joint_states
+        # Exact-mode depth bound for DEEP-BFS workloads whose full space is
+        # not enumerable: the closure covers exactly the states within
+        # `closure_max_depth` (init = depth 1, expand while depth < bound),
+        # matching the engines' target_max_depth semantics — device runs MUST
+        # pass target_max_depth <= closure_max_depth. `closure_stats` records
+        # the host traversal's (generated, unique, max_depth) as the parity
+        # oracle for that bounded space.
+        if closure_max_depth is not None and not self.exact:
+            raise ValueError("closure_max_depth requires closure='exact'")
+        self.closure_max_depth = closure_max_depth
+        self.closure_stats: Optional[dict] = None
         self._properties_fn = properties
         self._boundary_fn = boundary
 
@@ -185,7 +250,7 @@ class LoweredActorModel(TensorModel):
                 self.env_ids[key] = eid
                 self.envs.append(Envelope(Id(key[0]), Id(key[1]), env.msg))
                 dst = key[1]
-                if dst < self.n:
+                if not (self.joint or self.exact) and dst < self.n:
                     for sid in range(len(self.states[dst])):
                         if (dst, sid) not in frozen:
                             pending.append(("d", eid, sid))
@@ -203,7 +268,9 @@ class LoweredActorModel(TensorModel):
                     )
                 self.sids[actor][state] = sid
                 self.states[actor].append(state)
-                if self.local_boundary(actor, state):
+                if not self.local_boundary(actor, state):
+                    frozen.add((actor, sid))
+                elif not (self.joint or self.exact):
                     for eid, env in enumerate(self.envs):
                         if int(env.dst) == actor:
                             pending.append(("d", eid, sid))
@@ -211,8 +278,6 @@ class LoweredActorModel(TensorModel):
                         pending.append(("t", actor, tid, sid))
                     for cid in range(len(self.rchoices[actor])):
                         pending.append(("r", actor, cid, sid))
-                else:
-                    frozen.add((actor, sid))
             return sid
 
         def timer_id(actor: int, timer) -> int:
@@ -223,9 +288,10 @@ class LoweredActorModel(TensorModel):
                     raise LoweringError(f"actor {actor} has > 32 timer kinds")
                 self.timer_ids[actor][timer] = tid
                 self.timers[actor].append(timer)
-                for sid in range(len(self.states[actor])):
-                    if (actor, sid) not in frozen:
-                        pending.append(("t", actor, tid, sid))
+                if not (self.joint or self.exact):
+                    for sid in range(len(self.states[actor])):
+                        if (actor, sid) not in frozen:
+                            pending.append(("t", actor, tid, sid))
             return tid
 
         def choice_id(actor: int, value) -> int:
@@ -234,9 +300,10 @@ class LoweredActorModel(TensorModel):
                 cid = len(self.rchoices[actor])
                 self.rchoice_ids[actor][value] = cid
                 self.rchoices[actor].append(value)
-                for sid in range(len(self.states[actor])):
-                    if (actor, sid) not in frozen:
-                        pending.append(("r", actor, cid, sid))
+                if not (self.joint or self.exact):
+                    for sid in range(len(self.states[actor])):
+                        if (actor, sid) not in frozen:
+                            pending.append(("r", actor, cid, sid))
             return cid
 
         def delta_id(actor: int, rops: tuple) -> int:
@@ -295,104 +362,327 @@ class LoweredActorModel(TensorModel):
             self._init_emits.extend(emits)
             self._init_tset[index] = tset
 
-        # Reaction closure.
+        # Reaction closure. The react_* functions run one real handler call,
+        # memoize its compiled entry, and are shared by both closure modes.
         self.deliver: dict = {}  # (eid, sid) -> entry dict
         self.timeout: dict = {}  # (actor, tid, sid) -> entry dict
         self.random: dict = {}  # (actor, cid, sid) -> entry dict
-        while pending:
-            item = pending.popleft()
-            if item in done:
-                continue
-            done.add(item)
-            if item[0] == "r":
-                _, actor, cid, sid = item
-                value = self.rchoices[actor][cid]
-                state = self.states[actor][sid]
-                out = Out()
-                try:
-                    nxt = model.actors[actor].on_random(
-                        Id(actor), state, value, out
-                    )
-                except Exception as e:
-                    raise LoweringError(
-                        f"actor {actor} on_random raised during closure: "
-                        f"state={state!r}, random={value!r}"
-                    ) from e
-                emits, tclr, tset, did = run_commands(actor, out)
-                new_sid = sid if nxt is None else sid_of(actor, nxt)
-                # No elision: selecting consumes the pending choice even when
-                # the handler does nothing (ref: src/actor/model.rs:411-426).
-                self.random[(actor, cid, sid)] = dict(
-                    new_sid=new_sid, emits=emits, tclr=tclr, tset=tset,
-                    env=None, delta=did,
+
+        def react_random(actor: int, cid: int, sid: int):
+            key = (actor, cid, sid)
+            if key in self.random:
+                return self.random[key]
+            value = self.rchoices[actor][cid]
+            state = self.states[actor][sid]
+            out = Out()
+            try:
+                nxt = model.actors[actor].on_random(
+                    Id(actor), state, value, out
                 )
-                continue
-            if item[0] == "d":
-                _, eid, sid = item
-                env = self.envs[eid]
-                dst = int(env.dst)
-                state = self.states[dst][sid]
-                out = Out()
-                try:
-                    nxt = model.actors[dst].on_msg(
-                        Id(dst), state, env.src, env.msg, out
-                    )
-                except Exception as e:
-                    raise LoweringError(
-                        f"actor {dst} on_msg raised for a (state, message) "
-                        "combination explored by the lowering closure (the "
-                        "closure over-approximates reachability, so handlers "
-                        f"must be total): state={state!r}, env={env!r}"
-                    ) from e
-                emits, tclr, tset, did = run_commands(dst, out)
-                # No-op elision — except on ordered networks, where delivery
-                # still pops the flow head (ref: src/actor/model.rs:345-347).
-                if (
-                    nxt is None
-                    and not out.commands
-                    and self.kind != ORDERED
-                ):
-                    self.deliver[(eid, sid)] = None  # elided no-op
-                    continue
+            except Exception as e:
+                raise LoweringError(
+                    f"actor {actor} on_random raised during closure: "
+                    f"state={state!r}, random={value!r}"
+                ) from e
+            emits, tclr, tset, did = run_commands(actor, out)
+            new_sid = sid if nxt is None else sid_of(actor, nxt)
+            # No elision: selecting consumes the pending choice even when
+            # the handler does nothing (ref: src/actor/model.rs:411-426).
+            entry = dict(
+                new_sid=new_sid, emits=emits, tclr=tclr, tset=tset,
+                env=None, delta=did,
+            )
+            self.random[key] = entry
+            return entry
+
+        def react_deliver(eid: int, sid: int):
+            key = (eid, sid)
+            if key in self.deliver:
+                return self.deliver[key]
+            env = self.envs[eid]
+            dst = int(env.dst)
+            state = self.states[dst][sid]
+            out = Out()
+            try:
+                nxt = model.actors[dst].on_msg(
+                    Id(dst), state, env.src, env.msg, out
+                )
+            except Exception as e:
+                raise LoweringError(
+                    f"actor {dst} on_msg raised for a (state, message) "
+                    "combination explored by the lowering closure (the "
+                    "closure over-approximates reachability, so handlers "
+                    f"must be total): state={state!r}, env={env!r}"
+                ) from e
+            emits, tclr, tset, did = run_commands(dst, out)
+            # No-op elision — except on ordered networks, where delivery
+            # still pops the flow head (ref: src/actor/model.rs:345-347).
+            if nxt is None and not out.commands and self.kind != ORDERED:
+                entry = None  # elided no-op
+            else:
                 new_sid = sid if nxt is None else sid_of(dst, nxt)
-                self.deliver[(eid, sid)] = dict(
+                entry = dict(
                     new_sid=new_sid, emits=emits, tclr=tclr, tset=tset,
                     env=eid, delta=did,
                 )
+            self.deliver[key] = entry
+            return entry
+
+        def react_timeout(actor: int, tid: int, sid: int):
+            key = (actor, tid, sid)
+            if key in self.timeout:
+                return self.timeout[key]
+            timer = self.timers[actor][tid]
+            state = self.states[actor][sid]
+            out = Out()
+            try:
+                nxt = model.actors[actor].on_timeout(
+                    Id(actor), state, timer, out
+                )
+            except Exception as e:
+                raise LoweringError(
+                    f"actor {actor} on_timeout raised during closure: "
+                    f"state={state!r}, timer={timer!r}"
+                ) from e
+            emits, tclr, tset, did = run_commands(actor, out)
+            if (
+                nxt is None
+                and len(out.commands) == 1
+                and isinstance(out.commands[0], SetTimer)
+                and out.commands[0].timer == timer
+            ):
+                entry = None  # elided (unchanged state, same timer re-set)
             else:
-                _, actor, tid, sid = item
-                timer = self.timers[actor][tid]
-                state = self.states[actor][sid]
-                out = Out()
-                try:
-                    nxt = model.actors[actor].on_timeout(
-                        Id(actor), state, timer, out
-                    )
-                except Exception as e:
-                    raise LoweringError(
-                        f"actor {actor} on_timeout raised during closure: "
-                        f"state={state!r}, timer={timer!r}"
-                    ) from e
-                emits, tclr, tset, did = run_commands(actor, out)
-                if (
-                    nxt is None
-                    and len(out.commands) == 1
-                    and isinstance(out.commands[0], SetTimer)
-                    and out.commands[0].timer == timer
-                ):
-                    self.timeout[(actor, tid, sid)] = None  # elided
-                    continue
                 new_sid = sid if nxt is None else sid_of(actor, nxt)
                 bit = 1 << tid
                 if not (tset & bit):
                     tclr |= bit  # fired timer is consumed unless re-set
-                self.timeout[(actor, tid, sid)] = dict(
+                entry = dict(
                     new_sid=new_sid, emits=emits, tclr=tclr, tset=tset,
                     env=None, delta=did,
                 )
+            self.timeout[key] = entry
+            return entry
+
+        def exact_bfs():
+            """closure='exact': breadth-first enumerate the REAL global model
+            on the host and record exactly the (envelope, local-state)
+            reaction pairs and (history, event) transitions that occur. No
+            over-approximation — the tables cover precisely global
+            reachability, at the cost of one host traversal of the space."""
+            from ..actor.model import (
+                Deliver as ADeliver,
+                SelectRandom as ASelect,
+                Timeout as ATimeout,
+            )
+
+            track = self.track_history
+            self.hevents = []
+            self._hevent_ids = {}
+            self.hids = {}
+            self.histories = []
+
+            def hevent_id(env_eid, emits) -> int:
+                key = (env_eid, tuple(emits))
+                hid = self._hevent_ids.get(key)
+                if hid is None:
+                    hid = len(self.hevents)
+                    self._hevent_ids[key] = hid
+                    self.hevents.append(key)
+                return hid
+
+            def hid_of(h) -> int:
+                nid = self.hids.get(h)
+                if nid is None:
+                    nid = len(self.histories)
+                    if nid >= self.max_histories:
+                        raise LoweringError(
+                            "history vocabulary exceeded max_histories="
+                            f"{self.max_histories}; raise the cap"
+                        )
+                    self.hids[h] = nid
+                    self.histories.append(h)
+                return nid
+
+            trans: dict = {}  # (hid, hevent) -> next hid
+            tmd = self.closure_max_depth
+            init = [
+                s for s in model.init_states() if model.within_boundary(s)
+            ]
+            for s in init:
+                for i, a in enumerate(s.actor_states):
+                    sid_of(i, a)
+                if track:
+                    hid_of(s.history)
+            generated = len(init)  # pre-dedup seed, mirroring seed_init
+            seen_max_depth = 1 if init else 0
+            seen = set(init)
+            work = deque((s, 1) for s in set(init))
+            while work:
+                st, depth = work.popleft()
+                if tmd is not None and depth >= tmd:
+                    continue  # at the cutoff: not expanded (bfs.rs:219-224)
+                acts: list = []
+                model.actions(st, acts)
+                for a in acts:
+                    entry = None
+                    if isinstance(a, ADeliver):
+                        dst = int(a.dst)
+                        if dst < self.n:
+                            eid = env_id(Envelope(a.src, a.dst, a.msg))
+                            sid = sid_of(dst, st.actor_states[dst])
+                            if (dst, sid) not in frozen:
+                                entry = react_deliver(eid, sid)
+                    elif isinstance(a, ATimeout):
+                        actor = int(a.id)
+                        tid = timer_id(actor, a.timer)
+                        sid = sid_of(actor, st.actor_states[actor])
+                        if (actor, sid) not in frozen:
+                            entry = react_timeout(actor, tid, sid)
+                    elif isinstance(a, ASelect):
+                        actor = int(a.actor)
+                        cid = choice_id(actor, a.random)
+                        sid = sid_of(actor, st.actor_states[actor])
+                        if (actor, sid) not in frozen:
+                            entry = react_random(actor, cid, sid)
+                    # Crash / DropEnv need no reaction table (crash lane /
+                    # lossy-drop are modeled directly on device).
+                    if track and entry is not None and "hevent" not in entry:
+                        entry["hevent"] = hevent_id(
+                            entry["env"], entry["emits"]
+                        )
+                    nxt = model.next_state(st, a)
+                    if nxt is None or not model.within_boundary(nxt):
+                        continue
+                    generated += 1
+                    if track and entry is not None:
+                        trans[(hid_of(st.history), entry["hevent"])] = hid_of(
+                            nxt.history
+                        )
+                    if nxt not in seen:
+                        if len(seen) >= self.max_joint_states:
+                            raise LoweringError(
+                                "exact closure exceeded max_joint_states="
+                                f"{self.max_joint_states}; the global space "
+                                "is too large to enumerate on the host — "
+                                "use closure='independent'/'joint' with a "
+                                "local_boundary, or a hand encoding"
+                            )
+                        seen.add(nxt)
+                        work.append((nxt, depth + 1))
+                        seen_max_depth = max(seen_max_depth, depth + 1)
+            self.closure_stats = {
+                "generated": generated,
+                "unique": len(seen),
+                "max_depth": seen_max_depth,
+            }
+            if track:
+                self._hd = np.zeros(
+                    (len(self.histories), max(len(self.hevents), 1)),
+                    np.uint32,
+                )
+                for (hid, ev), nid in trans.items():
+                    self._hd[hid, ev] = nid
+            else:
+                self._hd = np.zeros((1, 1), np.uint32)
+            self._h0 = 0
+
+        if self.exact:
+            exact_bfs()
+        elif self.joint:
+            self._close_joint(react_deliver, react_timeout, react_random, frozen)
+        else:
+            while pending:
+                item = pending.popleft()
+                if item in done:
+                    continue
+                done.add(item)
+                if item[0] == "r":
+                    react_random(item[1], item[2], item[3])
+                elif item[0] == "d":
+                    react_deliver(item[1], item[2])
+                else:
+                    react_timeout(item[1], item[2], item[3])
 
         self._close_randoms()
-        self._close_histories()
+        if not self.exact:  # exact mode closed histories during the BFS
+            self._close_histories()
+
+    def _close_joint(self, react_deliver, react_timeout, react_random,
+                     frozen) -> None:
+        """Joint reaction closure: a worklist over actor-sid VECTORS with a
+        sticky (grow-only) envelope/timer/choice vocabulary. Network, timer,
+        and pending-choice availability are relaxed — anything ever emitted
+        stays deliverable, any timer kind can fire, any known choice value
+        can be selected — so the explored vectors over-approximate every real
+        interleaving's projection while preserving the correlations BETWEEN
+        actors that the independent closure throws away (the cross product
+        that explodes for quorum-accumulating actors like Paxos servers).
+        Each (vector, vocabulary-entry) pair is processed exactly once via
+        per-vector watermarks; vocabulary growth re-enqueues only the vectors
+        whose watermark is stale."""
+        zero = (0,) * self.n
+        init_vec = tuple(self._init_sids)
+        jmarks: dict = {init_vec: None}  # vec -> (e, t-tuple, c-tuple) marks
+        jwork = deque([init_vec])
+
+        def visit(vec):
+            marks = jmarks[vec]
+            e0, t0, c0 = marks if marks is not None else (0, zero, zero)
+            nE = len(self.envs)
+            nT = tuple(len(self.timers[a]) for a in range(self.n))
+            nC = tuple(len(self.rchoices[a]) for a in range(self.n))
+
+            def push(a, new_sid):
+                if new_sid == vec[a]:
+                    return
+                nv = vec[:a] + (new_sid,) + vec[a + 1 :]
+                if nv not in jmarks:
+                    if len(jmarks) >= self.max_joint_states:
+                        raise LoweringError(
+                            "joint closure exceeded max_joint_states="
+                            f"{self.max_joint_states}; tighten local_boundary "
+                            "or raise the cap"
+                        )
+                    jmarks[nv] = None
+                    jwork.append(nv)
+
+            for eid in range(e0, nE):
+                dst = int(self.envs[eid].dst)
+                if dst >= self.n:
+                    continue
+                sid = vec[dst]
+                if (dst, sid) in frozen:
+                    continue
+                entry = react_deliver(eid, sid)
+                if entry is not None:
+                    push(dst, entry["new_sid"])
+            for a in range(self.n):
+                sid = vec[a]
+                if (a, sid) in frozen:
+                    continue
+                for tid in range(t0[a], nT[a]):
+                    entry = react_timeout(a, tid, sid)
+                    if entry is not None:
+                        push(a, entry["new_sid"])
+                for cid in range(c0[a], nC[a]):
+                    push(a, react_random(a, cid, sid)["new_sid"])
+            jmarks[vec] = (nE, nT, nC)
+
+        while True:
+            while jwork:
+                visit(jwork.popleft())
+            # Reactions may have grown the vocabulary after a vector was
+            # visited; re-enqueue exactly the stale ones and fix-point.
+            nE = len(self.envs)
+            nT = tuple(len(self.timers[a]) for a in range(self.n))
+            nC = tuple(len(self.rchoices[a]) for a in range(self.n))
+            stale = [
+                v for v, m in jmarks.items() if m != (nE, nT, nC)
+            ]
+            if not stale:
+                return
+            jwork.extend(stale)
 
     def _close_randoms(self) -> None:
         """Close the per-actor randoms-map vocabulary (key -> pending

@@ -341,3 +341,120 @@ class TensorIncrement(TensorModel):
     def action_label(self, row, action_index):
         pc = int(row[2 + 2 * action_index])
         return ("read" if pc == 1 else "write", action_index)
+
+
+@dataclass
+class TensorIncrementLock(TensorModel):
+    """Lock-fixed increment (ref: examples/increment_lock.rs), tensor-encoded.
+    Lanes: [i, lock, t0, pc0, t1, pc1, ...]; one action slot per thread (each
+    thread has at most one enabled step: lock at pc=0, read at pc=1, write at
+    pc=2, release at pc=3).
+
+    Device symmetry sorts the per-thread (t, pc) pairs — identical to the
+    host representative (``tuple(sorted(s))``), and since that pair IS the
+    entire per-entity state there are no satellite-bit ties to split: the
+    reduced counts match the host ``check-sym`` goldens exactly (contrast the
+    2PC case in tensor/symmetry.py's COUNT CONTRACT)."""
+
+    thread_count: int
+    symmetry: bool = False
+
+    def __post_init__(self):
+        self.lanes = 2 + 2 * self.thread_count
+        self.max_actions = self.thread_count
+        if self.symmetry:
+            self.representative = self._representative
+
+    def init_states(self):
+        return jnp.asarray(
+            [[0, 0] + [0, 0] * self.thread_count], dtype=jnp.uint32
+        )
+
+    def expand(self, states):
+        i = states[:, 0]
+        lock = states[:, 1]
+        succ_list, valid_list = [], []
+        for tid in range(self.thread_count):
+            t = states[:, 2 + 2 * tid]
+            pc = states[:, 3 + 2 * tid]
+            can_lock = (pc == 0) & (lock == 0)
+            is_read = pc == 1
+            is_write = pc == 2
+            can_rel = (pc == 3) & (lock == 1)
+            new_i = jnp.where(is_write, t + 1, i)
+            new_lock = jnp.where(
+                can_lock, 1, jnp.where(can_rel, 0, lock)
+            ).astype(jnp.uint32)
+            new_t = jnp.where(is_read, i, t)
+            new_pc = jnp.where(
+                can_lock,
+                1,
+                jnp.where(
+                    is_read, 2, jnp.where(is_write, 3, jnp.where(can_rel, 4, pc))
+                ),
+            ).astype(jnp.uint32)
+            cols = [new_i, new_lock]
+            for o in range(self.thread_count):
+                if o == tid:
+                    cols += [new_t, new_pc]
+                else:
+                    cols += [states[:, 2 + 2 * o], states[:, 3 + 2 * o]]
+            succ_list.append(jnp.stack(cols, axis=1))
+            valid_list.append(can_lock | is_read | is_write | can_rel)
+        succs = jnp.stack(succ_list, axis=1).astype(jnp.uint32)
+        valid = jnp.stack(valid_list, axis=1)
+        return succs, valid
+
+    def _representative(self, states):
+        from .symmetry import gather_entities, stable_argsort
+
+        t = states[:, 2::2]
+        pc = states[:, 3::2]
+        # Key order matches the host's sorted((t, pc)) tuples (t <= threads,
+        # pc <= 4, so t*8+pc is collision-free and order-preserving).
+        perm = stable_argsort(t * jnp.uint32(8) + pc)
+        t_new = gather_entities(t, perm)
+        pc_new = gather_entities(pc, perm)
+        out = [states[:, 0:1], states[:, 1:2]]
+        for k in range(self.thread_count):
+            out += [t_new[:, k : k + 1], pc_new[:, k : k + 1]]
+        return jnp.concatenate(out, axis=1).astype(jnp.uint32)
+
+    def properties(self):
+        n = self.thread_count
+
+        def fin(model, states):
+            done = jnp.stack(
+                [states[:, 3 + 2 * t] >= 3 for t in range(n)], axis=1
+            ).sum(axis=1)
+            return done == states[:, 0]
+
+        def mutex(model, states):
+            held = jnp.stack(
+                [
+                    (states[:, 3 + 2 * t] >= 1) & (states[:, 3 + 2 * t] < 4)
+                    for t in range(n)
+                ],
+                axis=1,
+            ).sum(axis=1)
+            return held <= 1
+
+        return [
+            TensorProperty.always("fin", fin),
+            TensorProperty.always("mutex", mutex),
+        ]
+
+    def decode(self, row):
+        n = self.thread_count
+        return (
+            int(row[0]),
+            bool(row[1]),
+            tuple((int(row[2 + 2 * t]), int(row[3 + 2 * t])) for t in range(n)),
+        )
+
+    def action_label(self, row, action_index):
+        pc = int(row[3 + 2 * action_index])
+        return (
+            {0: "lock", 1: "read", 2: "write", 3: "release"}.get(pc, "?"),
+            action_index,
+        )

@@ -563,7 +563,8 @@ class ResidentSearch:
             )
             # ONE device->host transfer for the entire result.
             summary = np.asarray(summary)
-            self._last_tables = (t_lo, t_hi, p_lo, p_hi)
+            if not summary[7]:  # keep any previous run's tables on overflow
+                self._last_tables = (t_lo, t_hi, p_lo, p_hi)
         else:
             if self._carry is None:
                 self._carry = self._seed_k(
@@ -650,6 +651,33 @@ class ResidentSearch:
         self._carry = None
         self._parent_map = None
         self._last_tables = None
+
+    def dump_states(
+        self, decode: bool = True, evaluated_only: bool = False
+    ) -> list:
+        """Batched state dump: every unique state the search reached, pulled
+        from the frontier queue in ONE device transfer (the queue never
+        wraps, so rows [0, tail) are exactly the unique states ever
+        enqueued). This is the device analogue of the reference's
+        `StateRecorder` visitor (ref: src/checker/visitor.rs:75-111) — exact
+        state-set assertions against device engines. Requires a chunked run
+        (`budget=`/`timeout=`/`progress=`), which retains the carry.
+
+        `evaluated_only` restricts the dump to rows the search popped
+        ([0, head)) — on an early exit the tail also holds never-evaluated
+        frontier rows; for an exhausted run the two dumps coincide. (Rows
+        cut off by target_max_depth are popped-but-unevaluated and still
+        appear — the one divergence from reference visitor semantics.)"""
+        if self._carry is None:
+            raise RuntimeError(
+                "no retained carry to dump: run with budget=... (chunked "
+                "dispatch) before dump_states()"
+            )
+        end = int(self._carry.head if evaluated_only else self._carry.tail)
+        rows = np.asarray(self._carry.q_states[:end])
+        if not decode:
+            return [tuple(int(x) for x in r) for r in rows]
+        return [self.model.decode(r) for r in rows]
 
     # -- checkpoint / resume ---------------------------------------------------
     # SURVEY.md §5: the reference has no partial-search checkpointing; the

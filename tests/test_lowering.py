@@ -600,3 +600,96 @@ def test_random_choices_with_messages_parity():
     assert r.unique_state_count == host.unique_state_count()
     assert r.state_count == host.state_count()
     assert set(r.discoveries) == set(host.discoveries()) == {"b chosen"}
+
+
+@pytest.mark.slow
+def test_paxos2_exact_closure_golden():
+    """THE headline golden through the GENERIC lowering: 2-client / 3-server
+    Paxos at exact reference parity (32,971 generated / 16,668 unique,
+    ref: examples/paxos.rs:327,351) — no hand encoding, no local_boundary.
+
+    closure='exact' is the documented answer for models whose local states
+    accumulate message contents: 2-client Paxos overflows a 2^16 per-actor
+    cap under 'independent' and a 2^20 vector cap under 'joint', while the
+    exact host traversal closes it in seconds (local spaces: ~85/93/22 per
+    server, 3 per client; 68 envelopes; 5 histories)."""
+    from stateright_tpu.actor.register import GetOk
+    from stateright_tpu.examples.paxos import NULL_VALUE, PaxosModelCfg
+
+    cfg = PaxosModelCfg(
+        client_count=2,
+        server_count=3,
+        network=Network.new_unordered_nonduplicating(),
+    )
+
+    def properties(view):
+        lin = view.history_pred(lambda h: h.serialized_history() is not None)
+        chosen = view.any_env(
+            lambda e: isinstance(e.msg, GetOk) and e.msg.value != NULL_VALUE
+        )
+        return [
+            TensorProperty.always("linearizable", lambda m, s: lin(s)),
+            TensorProperty.sometimes("value chosen", lambda m, s: chosen(s)),
+        ]
+
+    lowered = lower_actor_model(
+        cfg.into_model(), properties=properties, closure="exact"
+    )
+    r = FrontierSearch(lowered, batch_size=2048, table_log2=18).run()
+    assert r.unique_state_count == 16668
+    assert r.state_count == 32971
+    assert set(r.discoveries) == {"value chosen"}  # linearizability holds
+
+    # Count parity with the hand-built encoding on the same protocol.
+    from stateright_tpu.tensor.paxos import TensorPaxos
+
+    hand = FrontierSearch(TensorPaxos(2), 2048, 18).run()
+    assert hand.unique_state_count == r.unique_state_count
+    assert hand.state_count == r.state_count
+
+
+def test_closure_mode_validation():
+    cfg = PingPongCfg(max_nat=2, maintains_history=False)
+    with pytest.raises(ValueError, match="closure"):
+        lower_actor_model(cfg.into_model(), closure="bogus")
+
+
+@pytest.mark.parametrize("mode", ["joint", "exact"])
+def test_closure_modes_match_independent_on_ping_pong(mode):
+    # Same search results from every closure mode (host oracle: 7 unique for
+    # lossless duplicating ping-pong max_nat=3). Termination contract per
+    # mode: "independent" and "joint" need the local_boundary when the model
+    # is bounded only by a GLOBAL within_boundary (per-actor counters grow
+    # forever otherwise — joint vectors cannot evaluate a global-state
+    # predicate); "exact" self-bounds by walking real reachability.
+    def boundary(view):
+        counters = view.actor_feature(lambda i, s: s)
+        return lambda s: (counters(s) <= 3).all(1)
+
+    def build(closure):
+        cfg = PingPongCfg(max_nat=3, maintains_history=False)
+        model = cfg.into_model().with_lossy_network(False)
+        kw = (
+            {}
+            if closure == "exact"
+            else {"local_boundary": lambda i, s: s <= 3}
+        )
+        return lower_actor_model(
+            model, closure=closure, boundary=boundary, **kw
+        )
+
+    host = _host(
+        PingPongCfg(max_nat=3, maintains_history=False)
+        .into_model()
+        .with_lossy_network(False)
+    )
+    r_ind = FrontierSearch(build("independent"), 128, 12).run()
+    r_mode = FrontierSearch(build(mode), 128, 12).run()
+    assert (
+        r_mode.unique_state_count
+        == r_ind.unique_state_count
+        == host.unique_state_count()
+        == 7
+    )
+    assert r_mode.state_count == r_ind.state_count == host.state_count()
+    assert r_mode.max_depth == r_ind.max_depth

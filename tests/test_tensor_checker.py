@@ -242,14 +242,17 @@ def test_sharded_target_max_depth_matches_host():
     assert r.state_count == host.state_count()
 
 
-def test_tpu_checker_rejects_visitor():
-    from stateright_tpu.core.visitor import StateRecorder
+def test_tpu_checker_rejects_path_visitors():
+    # Path-carrying visitors need a per-evaluated-state host callback —
+    # still host-only. (StateRecorder IS supported via the batched queue
+    # dump; see tests/test_tensor_adapter.py.)
+    from stateright_tpu.core.visitor import PathRecorder
 
     with pytest.raises(NotImplementedError):
         (
             TensorTwoPhaseSys(3)
             .checker()
-            .visitor(StateRecorder())
+            .visitor(PathRecorder())
             .spawn_tpu(batch_size=64, table_log2=10)
         )
 

@@ -134,3 +134,38 @@ def test_symmetric_path_reconstruction():
     states = path.states()
     i, threads = states[-1]
     assert sum(1 for (_, pc) in threads if pc == 3) != i
+
+
+def test_increment_lock_goldens_all_modes():
+    """increment_lock (ref: examples/increment_lock.rs): the per-thread
+    (t, pc) pair is the ENTIRE per-entity state, so the device full-key sort
+    and the host value-sort coincide — device symmetry counts match the host
+    check-sym goldens exactly here (unlike 2PC; see tensor/symmetry.py)."""
+    from stateright_tpu.examples.increment import IncrementLockSys
+    from stateright_tpu.tensor.models import TensorIncrementLock
+
+    for n, full_golden, sym_golden in ((2, 17, 9), (3, 61, 13)):
+        host = IncrementLockSys(n).checker().spawn_dfs().join()
+        host_sym = IncrementLockSys(n).checker().symmetry().spawn_dfs().join()
+        dev = FrontierSearch(TensorIncrementLock(n), 256, 14).run()
+        dev_sym = FrontierSearch(
+            TensorIncrementLock(n, symmetry=True), 256, 14
+        ).run()
+        assert host.unique_state_count() == dev.unique_state_count == full_golden
+        assert (
+            host_sym.unique_state_count()
+            == dev_sym.unique_state_count
+            == sym_golden
+        )
+        assert not dev.discoveries  # fin + mutex hold under the lock
+
+
+def test_increment_lock_6_sym_golden():
+    # The BASELINE.json config #4 workload: N=6 with device symmetry
+    # (host-DFS-sym cross-validated: 7,825 full -> 25 representatives).
+    from stateright_tpu.tensor.models import TensorIncrementLock
+
+    full = FrontierSearch(TensorIncrementLock(6), 2048, 14).run()
+    sym = FrontierSearch(TensorIncrementLock(6, symmetry=True), 1024, 12).run()
+    assert full.unique_state_count == 7825
+    assert sym.unique_state_count == 25
