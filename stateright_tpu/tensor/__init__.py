@@ -28,7 +28,12 @@ from .adapter import TensorModelAdapter, as_host_model
 from .fingerprint import device_fingerprint, pack_fp, unpack_fp
 from .hashtable import HashTable
 from .frontier import FrontierSearch, SearchResult
-from .lowering import LoweredActorModel, LoweringError, lower_actor_model
+from .lowering import (
+    LoweredActorModel,
+    LoweringError,
+    lower_actor_model,
+    refine_check,
+)
 from .simulation import DeviceSimulation
 
 __all__ = [
@@ -46,4 +51,5 @@ __all__ = [
     "LoweredActorModel",
     "LoweringError",
     "lower_actor_model",
+    "refine_check",
 ]

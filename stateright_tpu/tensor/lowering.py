@@ -62,10 +62,16 @@ the abstraction:
   the global space — worth it because the resulting tables are tiny (paxos-3:
   675/723/777 local states per server, 240 envelopes, 7 histories) and every
   subsequent device run (re-checks, symmetry variants, sharded scale-out,
-  simulation walks) reuses them. The incremental path that avoids the full
-  host traversal — run the device search, extend the closure from POISON
-  hits, repeat — is the designed follow-on; the POISON guard below already
-  provides its correctness backstop.
+  simulation walks) reuses them.
+- "seed" + `refine_check`: INCREMENTAL, device-search-driven closure. Start
+  from a tiny best-effort joint seed; each search surfaces exactly the
+  uncovered (state, envelope) pairs — and, for histories, the uncovered
+  (history, event) transitions — as poison PAYLOAD rows; `extend()` runs the
+  real handlers for just those; repeat until poison-free. Host work scales
+  with the number of distinct reaction pairs (paxos-2: ~2.3k local states ×
+  touched envelopes), NOT with the global edge count like "exact" — the
+  device does the state-space heavy lifting, the host only compiles the
+  reaction vocabulary the search proves it needs.
 
 Soundness guards: every closure is bounded (`max_local_states`,
 `max_histories`, `max_envelopes`, `max_joint_states`); if the device search
@@ -149,9 +155,9 @@ class LoweredActorModel(TensorModel):
         self.max_local_states = max_local_states
         self.max_envelopes = max_envelopes
         self.max_histories = max_histories
-        if closure not in ("independent", "joint", "exact"):
+        if closure not in ("independent", "joint", "exact", "seed"):
             raise ValueError(
-                "closure must be 'independent', 'joint', or 'exact'"
+                "closure must be 'independent', 'joint', 'exact', or 'seed'"
             )
         # "independent" closes each actor against the whole envelope
         # vocabulary — cheap, but the cross product explodes for actors whose
@@ -169,9 +175,28 @@ class LoweredActorModel(TensorModel):
         # and a 2^20 vector cap under "joint"). All modes are sound: the
         # POISON coverage guard flags any under-coverage at search time
         # instead of mis-exploring.
-        self.joint = closure == "joint"
+        # "seed" = best-effort joint closure: stop silently at the vector cap
+        # instead of raising; the gaps become poison payloads that
+        # `refine_check` feeds back through `extend()` (incremental,
+        # device-search-driven closure — no host traversal of the global
+        # space).
+        self.joint = closure in ("joint", "seed")
+        self.best_effort = closure == "seed"
         self.exact = closure == "exact"
         self.max_joint_states = max_joint_states
+        if self.best_effort and (
+            max_local_states > 1 << 16
+            or max_envelopes > 1 << 24
+            or max_histories > 1 << 24
+        ):
+            # Poison payloads pack sid into 16 bits and eid/hid into 24;
+            # beyond that a surfaced gap would decode as the WRONG pair and
+            # refinement would loop on it forever.
+            raise ValueError(
+                "closure='seed' (refinement) requires max_local_states <= "
+                "2^16, max_envelopes <= 2^24, and max_histories <= 2^24 — "
+                "the poison-payload field widths"
+            )
         # Exact-mode depth bound for DEEP-BFS workloads whose full space is
         # not enumerable: the closure covers exactly the states within
         # `closure_max_depth` (init = depth 1, expand while depth < bound),
@@ -189,6 +214,11 @@ class LoweredActorModel(TensorModel):
         self.n = len(model.actors)
         self.track_history = model.init_history is not None
         self._close()
+        self._finalize()
+
+    def _finalize(self) -> None:
+        """Layout + tables + properties from the current closure contents;
+        rerun by `extend()` after incremental closure growth."""
         self._layout()
         self._bake_tables()
         self._props = self._build_properties()
@@ -198,6 +228,40 @@ class LoweredActorModel(TensorModel):
             # ref: src/actor/model_state.rs:134-145): engines fingerprint the
             # canonical form below while continuing with the original state.
             self.representative = self._strip_aux
+
+    def extend(self, gaps) -> None:
+        """Incrementally close the given coverage gaps — (kind, idx1, idx2,
+        sid) tuples as decoded by `poison_payload` — by running the REAL
+        handlers for exactly those pairs, then re-derive histories, layout,
+        and tables. New local states / envelopes a reaction creates stay
+        unexplored until a later search surfaces them as gaps: coverage is
+        driven by actual device-search reachability, one frontier layer per
+        round (see `refine_check`)."""
+        hist_gaps = []
+        for kind, i1, i2, sid in gaps:
+            if kind == 0:
+                self._react_deliver(i1, sid)
+            elif kind == 1:
+                self._react_timeout(i1, i2, sid)
+            elif kind == 2:
+                self._react_random(i1, i2, sid)
+            elif kind == 4:
+                hist_gaps.append((i1, i2))
+            else:
+                raise LoweringError(f"cannot extend gap kind {kind}")
+        self._close_randoms()
+        # Lazy mode: _close_histories keeps the vocabulary, assigns hevents
+        # to the new entries, and re-bakes; then apply the surfaced
+        # (history, event) transitions exactly.
+        self._close_histories()
+        if hist_gaps:
+            _hevent_id, apply_event, hid_of = self._hist_fns
+            for hid, ev in hist_gaps:
+                self._htrans[(hid, ev)] = hid_of(
+                    apply_event(self.histories[hid], self.hevents[ev])
+                )
+            self._bake_hd()
+        self._finalize()
 
     def _strip_aux(self, states):
         if self.has_randoms:
@@ -604,6 +668,12 @@ class LoweredActorModel(TensorModel):
                 else:
                     react_timeout(item[1], item[2], item[3])
 
+        # Kept for incremental extension (`extend`).
+        self._react_deliver = react_deliver
+        self._react_timeout = react_timeout
+        self._react_random = react_random
+        self._frozen = frozen
+
         self._close_randoms()
         if not self.exact:  # exact mode closed histories during the BFS
             self._close_histories()
@@ -639,6 +709,8 @@ class LoweredActorModel(TensorModel):
                 nv = vec[:a] + (new_sid,) + vec[a + 1 :]
                 if nv not in jmarks:
                     if len(jmarks) >= self.max_joint_states:
+                        if self.best_effort:
+                            return  # seed mode: the gap will poison-surface
                         raise LoweringError(
                             "joint closure exceeded max_joint_states="
                             f"{self.max_joint_states}; tighten local_boundary "
@@ -761,13 +833,25 @@ class LoweredActorModel(TensorModel):
         over-approximation of reachability while staying bounded for
         histories that a pure history-times-event closure would blow up
         (e.g. consistency testers, where replaying one event forever would
-        append operations without bound)."""
+        append operations without bound).
+
+        In refinement mode (`closure="seed"`), histories are LAZY instead:
+        the transition table defaults to a sentinel, the device search
+        surfaces missing (history, event) transitions as kind-4 poison
+        payloads, and `extend()` applies exactly those — the same
+        search-driven strategy as the reaction closure, which sidesteps the
+        joint over-approximation blowing up as refinement grows the tables.
+        """
         model = self.model
-        self.hevents: list = []  # hevent id -> (eid or None, tuple emit eids)
-        self._hevent_ids: dict = {}
+        lazy = self.best_effort
+        fresh = not (lazy and hasattr(self, "_htrans"))
+        if fresh:
+            self.hevents: list = []  # id -> (eid or None, tuple emit eids)
+            self._hevent_ids: dict = {}
+            self.hids: dict = {}
+            self.histories: list = []
+            self._htrans: dict = {}  # (hid, hevent) -> next hid
         if not self.track_history:
-            self.hids = {}
-            self.histories = []
             self._hd = np.zeros((1, 1), np.uint32)
             return
 
@@ -785,7 +869,7 @@ class LoweredActorModel(TensorModel):
             + list(self.timeout.values())
             + list(self.random.values())
         ):
-            if entry is not None:
+            if entry is not None and "hevent" not in entry:
                 entry["hevent"] = hevent_id(entry["env"], entry["emits"])
 
         def apply_event(history, event):
@@ -817,57 +901,79 @@ class LoweredActorModel(TensorModel):
                 self.histories.append(h)
             return nid
 
-        # Gated transitions: (dst actor, gate sid, new sid, hevent).
-        gated = []
-        for (eid, sid), entry in self.deliver.items():
-            if entry is not None:
-                dst = int(self.envs[eid].dst)
-                gated.append((dst, sid, entry["new_sid"], entry["hevent"]))
-        for (actor, _tid, sid), entry in self.timeout.items():
-            if entry is not None:
-                gated.append((actor, sid, entry["new_sid"], entry["hevent"]))
-        for (actor, _cid, sid), entry in self.random.items():
-            if entry is not None:
-                gated.append((actor, sid, entry["new_sid"], entry["hevent"]))
+        self._hist_fns = (hevent_id, apply_event, hid_of)
 
         # The initial history replays on_start emissions (record_msg_out).
         h0 = apply_event(model.init_history, (None, tuple(self._init_emits)))
-        self.hids = {h0: 0}
-        self.histories = [h0]
-        start = (tuple(self._init_sids), 0)
-        seen = {start}
-        worklist = deque([start])
-        trans: dict = {}  # (hid, hevent) -> next hid
-        max_joint = self.max_histories * 16
-        while worklist:
-            sid_vec, hid = worklist.popleft()
-            h = self.histories[hid]
-            for dst, gate, new_sid, ev in gated:
-                if sid_vec[dst] != gate:
-                    continue
-                nid = trans.get((hid, ev))
-                if nid is None:
-                    nid = hid_of(apply_event(h, self.hevents[ev]))
-                    trans[(hid, ev)] = nid
-                nxt = (
-                    sid_vec[:dst] + (new_sid,) + sid_vec[dst + 1 :],
-                    nid,
-                )
-                if nxt not in seen:
-                    if len(seen) >= max_joint:
-                        raise LoweringError(
-                            "joint (actor-states, history) closure exceeded "
-                            f"{max_joint} states; the history may be too "
-                            "entangled with the global state to lower"
-                        )
-                    seen.add(nxt)
-                    worklist.append(nxt)
+        if fresh:
+            self.hids = {h0: 0}
+            self.histories = [h0]
+
+        if not lazy:
+            # Gated transitions: (dst actor, gate sid, new sid, hevent).
+            gated = []
+            for (eid, sid), entry in self.deliver.items():
+                if entry is not None:
+                    dst = int(self.envs[eid].dst)
+                    gated.append((dst, sid, entry["new_sid"], entry["hevent"]))
+            for (actor, _tid, sid), entry in self.timeout.items():
+                if entry is not None:
+                    gated.append((actor, sid, entry["new_sid"], entry["hevent"]))
+            for (actor, _cid, sid), entry in self.random.items():
+                if entry is not None:
+                    gated.append((actor, sid, entry["new_sid"], entry["hevent"]))
+
+            start = (tuple(self._init_sids), 0)
+            seen = {start}
+            worklist = deque([start])
+            max_joint = self.max_histories * 16
+            while worklist:
+                sid_vec, hid = worklist.popleft()
+                h = self.histories[hid]
+                for dst, gate, new_sid, ev in gated:
+                    if sid_vec[dst] != gate:
+                        continue
+                    nid = self._htrans.get((hid, ev))
+                    if nid is None:
+                        nid = hid_of(apply_event(h, self.hevents[ev]))
+                        self._htrans[(hid, ev)] = nid
+                    nxt = (
+                        sid_vec[:dst] + (new_sid,) + sid_vec[dst + 1 :],
+                        nid,
+                    )
+                    if nxt not in seen:
+                        if len(seen) >= max_joint:
+                            raise LoweringError(
+                                "joint (actor-states, history) closure "
+                                f"exceeded {max_joint} states; the history "
+                                "may be too entangled with the global state "
+                                "to lower (refine_check closes histories "
+                                "lazily instead)"
+                            )
+                        seen.add(nxt)
+                        worklist.append(nxt)
+        self._bake_hd()
+
+    def _bake_hd(self) -> None:
+        """Bake the (history, event) transition matrix. Unknown combos are 0
+        in the eager modes (unreachable per the joint over-approximation —
+        harmless) but the EMPTY sentinel in lazy/refinement mode, where the
+        device search must surface them as kind-4 poison payloads."""
+        if not self.track_history:
+            self._hd = np.zeros((1, 1), np.uint32)
+            return
         n_events = len(self.hevents)
-        self._hd = np.zeros((len(self.histories), max(n_events, 1)), np.uint32)
-        # Unvisited (hid, event) combos are unreachable per the
-        # over-approximation; route them to hid 0 (harmless — the search can
-        # never take them).
-        for (hid, ev), nid in trans.items():
+        if self.best_effort and n_events > 1 << 16:
+            raise LoweringError(
+                "history-event vocabulary exceeds the 16-bit poison-payload "
+                "field; refinement cannot address these transitions (use "
+                "closure='exact')"
+            )
+        default = EMPTY if self.best_effort else np.uint32(0)
+        self._hd = np.full(
+            (len(self.histories), max(n_events, 1)), default, np.uint32
+        )
+        for (hid, ev), nid in self._htrans.items():
             self._hd[hid, ev] = nid
         self._h0 = 0
 
@@ -1099,11 +1205,37 @@ class LoweredActorModel(TensorModel):
             )
         return row
 
+    def poison_payload(self, row):
+        """Decode a poison marker row -> (kind, idx1, idx2, sid) or None.
+        kind: 0 deliver-gap / 1 timeout-gap / 2 random-gap; +16 = capacity
+        overflow on a covered pair (see expand's materialization block)."""
+        row = [int(x) for x in row]
+        if row[0] != int(EMPTY):
+            return None
+        if len(row) < 3 or row[1] == int(EMPTY):
+            return (-1, 0, 0, 0)  # payload-less narrow marker (no refinement)
+        return (
+            row[1] >> 24,
+            row[1] & 0xFFFFFF,
+            row[2] >> 16,
+            row[2] & 0xFFFF,
+        )
+
     def decode(self, row):
         """Device row -> a readable dict mirroring ActorModelState."""
+        payload = self.poison_payload(row)
+        if payload is not None:
+            kind, i1, i2, sid = payload
+            if kind < 0:
+                return "<poison: closure coverage exceeded>"
+            what = {0: "deliver", 1: "timeout", 2: "random", 4: "history"}.get(
+                kind & 15, "?"
+            )
+            tag = "capacity overflow" if kind & 16 else "closure gap"
+            return (
+                f"<poison ({tag}): {what} idx1={i1} idx2={i2} sid={sid}>"
+            )
         row = [int(x) for x in row]
-        if all(x == int(EMPTY) for x in row):
-            return "<poison: closure coverage exceeded>"
         out = {
             "actor_states": tuple(
                 self.states[i][row[self.sid_off + i]] for i in range(self.n)
@@ -1232,6 +1364,17 @@ class LoweredActorModel(TensorModel):
 
         succ_parts = []
         valid_parts = []
+        # Stashes for the poison-payload block at the end (which (eid, sid)
+        # pair each slot would have taken — what incremental refinement needs
+        # to extend the closure).
+        deliver_eids = None
+        t_sid_stash = None
+        r_cid_stash = r_sid_stash = None
+        # Poison rows are terminal: everything expanding FROM one is invalid
+        # (they only exist to carry the uncovered pair to the host).
+        src_poison = states[:, 0] == jnp.uint32(EMPTY)
+
+        deliver_stash = {}  # st/hev/sid reused by the poison-payload block
 
         def lookup_deliver(eid, deliverable):
             """eid: [B, S] delivered envelope per slot; -> per-slot updates."""
@@ -1258,6 +1401,7 @@ class LoweredActorModel(TensorModel):
             alive = not_crashed(d_srv)
             valid = deliverable & dst_ok & is_txn & alive
             poison = deliverable & dst_ok & ~explored & alive
+            deliver_stash.update(st=st, hev=hev, sid=sid)
             return d_srv, new_sid, emits, tclr, tset, hev, delta, valid, poison
 
         def apply_common(
@@ -1346,6 +1490,7 @@ class LoweredActorModel(TensorModel):
                 B, F, Dq
             )
             head = flows[:, :, 0]  # [B, F]
+            deliver_eids = head
             deliverable = head != EMPTY
             (
                 d_actor, new_sid, emits, tclr, tset, hev, delta, valid, poison
@@ -1385,6 +1530,7 @@ class LoweredActorModel(TensorModel):
         elif self.kind == UNORDERED_NONDUPLICATING:
             pool = states[:, self.net_off : self.net_off + self.pool_size]
             e = pool  # [B, P]
+            deliver_eids = e
             nonempty = e != EMPTY
             first = jnp.concatenate(
                 [jnp.ones((B, 1), bool), e[:, 1:] != e[:, :-1]], axis=1
@@ -1431,6 +1577,7 @@ class LoweredActorModel(TensorModel):
             ) & u(1)
             deliverable = in_flight.astype(bool)
             e = jnp.broadcast_to(eids, (B, self.E))
+            deliver_eids = e
             (
                 d_actor, new_sid, emits, tclr, tset, hev, delta, valid, poison
             ) = lookup_deliver(e, deliverable)
@@ -1491,6 +1638,7 @@ class LoweredActorModel(TensorModel):
             tmask = jnp.take_along_axis(tl, t_actor_b, axis=1)
             armed = (tmask & t_bit) != 0
             sid = jnp.take_along_axis(sid_lanes, t_actor_b, axis=1)
+            t_sid_stash = sid
             flat = (
                 jnp.arange(nT, dtype=jnp.int32)[None, :] * maxS
                 + sid.astype(jnp.int32)
@@ -1587,6 +1735,7 @@ class LoweredActorModel(TensorModel):
             has_choice = cid1 != 0
             cid = jnp.where(has_choice, cid1 - u(1), u(0)).astype(jnp.int32)
             sid = jnp.take_along_axis(sid_lanes, r_actor_b, axis=1)
+            r_cid_stash, r_sid_stash = cid, sid
             flat_rr = (
                 r_actor * (maxC * maxS)
                 + cid * maxS
@@ -1705,9 +1854,124 @@ class LoweredActorModel(TensorModel):
         succs = jnp.concatenate(succ_parts, axis=1)
         valid = jnp.concatenate([v for v, _ in valid_parts], axis=1)
         poison = jnp.concatenate([p for _, p in valid_parts], axis=1)
-        # Poisoned successors become the reserved all-ones row; the auto
-        # "lowering coverage" property reports them.
-        succs = jnp.where(poison[:, :, None], jnp.uint32(EMPTY), succs)
+        # Poison rows are terminal (without this they would expand through
+        # clamped garbage gathers into phantom states).
+        valid = valid & ~src_poison[:, None]
+        poison = poison & ~src_poison[:, None]
+        # Lazy-history mode: a successor whose history transition hit the
+        # EMPTY sentinel is a (history, event) coverage gap — poison it too
+        # (kind 4 below) so refinement can apply exactly that transition.
+        hgap = None
+        if self.track_history and self.best_effort:
+            hgap = valid & (succs[:, :, self.hist_off] == u(EMPTY))
+            poison = poison | hgap
+
+        # -- poison materialization -------------------------------------------
+        # A poisoned successor becomes a TERMINAL marker row (lane0 = EMPTY —
+        # impossible for a real state, whose lane0 is a sid < maxS) that
+        # ENCODES the uncovered pair, so incremental refinement can read the
+        # exact (slot kind, eid/actor, tid/cid, sid) gaps back out of a
+        # state dump: lane1 = kind << 24 | idx1, lane2 = idx2 << 16 | sid.
+        # kind: 0 deliver / 1 timeout / 2 random; +16 when the pair IS
+        # covered and the poison is a capacity overflow (pool/flow/emit) —
+        # refinement must grow capacity, not the closure. The auto "lowering
+        # coverage" property reports marker rows either way.
+        if self.lanes >= 3:
+            def seg_zero(width):
+                z = jnp.zeros((B, width), u)
+                return z, z, z, z, z
+
+            segs = []  # (kind, idx1, idx2, sid) per part, same order/widths
+
+            def deliver_seg(eid):
+                # st/hev/sid were stashed by lookup_deliver — same gathers,
+                # no re-derivation to drift out of sync.
+                st = deliver_stash["st"]
+                psid = deliver_stash["sid"]
+                kind = jnp.where(st != _UNEXPLORED, u(16), u(0))
+                return kind, eid, jnp.zeros_like(psid), psid, deliver_stash["hev"]
+
+            if self.deliver_slots:
+                segs.append(deliver_seg(deliver_eids))
+                if self.drop_slots:
+                    segs.append(seg_zero(self.deliver_slots))
+            if self.timeout_slots:
+                nT = len(self.timeout_slots)
+                ta = jnp.broadcast_to(
+                    jnp.asarray(
+                        [i for i, _ in self.timeout_slots], u
+                    )[None, :],
+                    (B, nT),
+                )
+                tt = jnp.broadcast_to(
+                    jnp.asarray(
+                        [tid for _, tid in self.timeout_slots], u
+                    )[None, :],
+                    (B, nT),
+                )
+                tflat = (
+                    jnp.arange(nT, dtype=jnp.int32)[None, :] * maxS
+                    + t_sid_stash.astype(jnp.int32)
+                )
+                tst = jnp.take(T_state.reshape(-1), tflat)
+                tkind = jnp.where(
+                    tst != _UNEXPLORED, u(17), u(1)
+                )
+                thev = jnp.take(T_hev.reshape(-1), tflat)
+                segs.append((tkind, ta, tt, t_sid_stash, thev))
+            if self.random_slots:
+                nR = len(self.random_slots)
+                ra = jnp.broadcast_to(
+                    jnp.asarray(
+                        [i for i, _ in self.random_slots], u
+                    )[None, :],
+                    (B, nR),
+                )
+                maxR_, maxD_, maxC_, nJ_ = self._R_dims
+                rflat = (
+                    ra.astype(jnp.int32) * (maxC_ * maxS)
+                    + r_cid_stash * maxS
+                    + r_sid_stash.astype(jnp.int32)
+                )
+                rst = jnp.take(jnp.asarray(self._R[3]).reshape(-1), rflat)
+                rhev = jnp.take(
+                    jnp.asarray(self._R[7]).reshape(-1), rflat
+                )
+                # Covered pair + poison = capacity overflow (kind 2 | 16),
+                # same convention as the deliver/timeout segments.
+                rkind = jnp.where(rst != _UNEXPLORED, u(18), u(2))
+                segs.append(
+                    (rkind, ra, r_cid_stash.astype(u), r_sid_stash, rhev)
+                )
+            if self.crash_slots:
+                segs.append(seg_zero(self.n))
+            kind = jnp.concatenate([s[0] for s in segs], axis=1)
+            idx1 = jnp.concatenate([s[1] for s in segs], axis=1)
+            idx2 = jnp.concatenate([s[2] for s in segs], axis=1)
+            psid = jnp.concatenate([s[3] for s in segs], axis=1)
+            if hgap is not None:
+                # A pure history gap (the reaction itself IS covered):
+                # kind 4, idx1 = source hid, idx2 = hevent.
+                hev = jnp.concatenate([s[4] for s in segs], axis=1)
+                pure = hgap & ~jnp.concatenate(
+                    [p for _, p in valid_parts], axis=1
+                )
+                src_hid = jnp.broadcast_to(
+                    states[:, None, self.hist_off], (B, M)
+                )
+                kind = jnp.where(pure, u(4), kind)
+                idx1 = jnp.where(pure, src_hid, idx1)
+                idx2 = jnp.where(pure, hev, idx2)
+                psid = jnp.where(pure, u(0), psid)
+            prow = jnp.full((B, M, self.lanes), EMPTY, u)
+            prow = prow.at[:, :, 1].set((kind << u(24)) | idx1)
+            prow = prow.at[:, :, 2].set((idx2 << u(16)) | psid)
+            succs = jnp.where(poison[:, :, None], prow, succs)
+        else:
+            # Too few lanes to carry a payload: uniform marker row (coverage
+            # detection still works; refinement is unavailable).
+            succs = jnp.where(poison[:, :, None], jnp.uint32(EMPTY), succs)
+
         assert succs.shape[1] == M, (succs.shape, M)
         return succs, valid
 
@@ -1722,7 +1986,9 @@ class LoweredActorModel(TensorModel):
             self._tensor_boundary = None
 
         def coverage(model, states):
-            return ~jnp.all(states == jnp.uint32(EMPTY), axis=1)
+            # lane0 == EMPTY is the poison marker (impossible for a real
+            # state — lane0 is actor 0's sid, bounded by the closure size).
+            return states[:, 0] != jnp.uint32(EMPTY)
 
         props.append(TensorProperty.always("lowering coverage", coverage))
         return props
@@ -1734,7 +2000,7 @@ class LoweredActorModel(TensorModel):
         if self._tensor_boundary is None:
             return jnp.ones(states.shape[0], dtype=bool)
         # Poison rows bypass the boundary so they reach the coverage property.
-        is_poison = jnp.all(states == jnp.uint32(EMPTY), axis=1)
+        is_poison = states[:, 0] == jnp.uint32(EMPTY)
         return self._tensor_boundary(states) | is_poison
 
 
@@ -1812,3 +2078,80 @@ def lower_actor_model(model: ActorModel, **kwargs) -> LoweredActorModel:
     callables receiving a `LoweredView` and returning the vectorized
     `TensorProperty` list / boundary mask function."""
     return LoweredActorModel(model, **kwargs)
+
+
+def refine_check(
+    model: ActorModel,
+    *,
+    batch_size: int = 1024,
+    table_log2: int = 16,
+    seed_states: int = 2048,
+    max_rounds: int = 64,
+    progress=None,
+    run_kwargs: Optional[dict] = None,
+    **lower_kwargs,
+):
+    """Incremental, device-search-driven lowering + check: the closure is
+    grown by the search itself instead of by a host traversal.
+
+    Start from a cheap best-effort seed closure, run the device search, read
+    the uncovered (state, envelope) pairs back out of the poison payloads in
+    the state dump, run the REAL handlers for exactly those pairs
+    (`extend`), rebuild the tables, and repeat until a run is poison-free.
+    Host work is proportional to the number of distinct reaction pairs the
+    search actually reaches — NOT to the global state count, which is the
+    difference from `closure="exact"` (one host handler call per pair vs one
+    `next_state` per global edge). Rounds ≈ the protocol's reaction-dependency
+    depth; each round re-jits (table shapes grow).
+
+    Returns (final SearchResult, LoweredActorModel). Raises LoweringError on
+    capacity overflows (grow pool_size/flow_depth/max_emit) or
+    non-convergence; a table overflow raises the engine's RuntimeError
+    (raise table_log2).
+
+    `progress(round, gaps, result)` is called after each non-final round.
+    """
+    from .resident import ResidentSearch
+
+    lowered = LoweredActorModel(
+        model, closure="seed", max_joint_states=seed_states, **lower_kwargs
+    )
+    rkw = dict(run_kwargs or {})
+    rkw.setdefault("budget", 1 << 20)
+    for rnd in range(max_rounds):
+        search = ResidentSearch(
+            lowered, batch_size=batch_size, table_log2=table_log2
+        )
+        result = search.run(**rkw)
+        gaps, capacity = set(), []
+        for row in search.dump_states(decode=False):
+            p = lowered.poison_payload(row)
+            if p is None:
+                continue
+            if p[0] < 0:
+                raise LoweringError(
+                    "coverage gap without a decodable payload (model rows "
+                    "too narrow for refinement; use closure='exact')"
+                )
+            if p[0] & 16:
+                capacity.append(p)
+            else:
+                gaps.add(p)
+        if capacity:
+            raise LoweringError(
+                f"capacity overflow during refinement ({len(capacity)} "
+                f"poisoned transitions, e.g. {capacity[:3]}): raise "
+                "pool_size / flow_depth / max_emit"
+            )
+        if not gaps:
+            if "lowering coverage" in result.discoveries:
+                raise LoweringError(
+                    "coverage counterexample without a decodable payload "
+                    "(model rows too narrow for refinement; use "
+                    "closure='exact')"
+                )
+            return result, lowered
+        if progress is not None:
+            progress(rnd, len(gaps), result)
+        lowered.extend(sorted(gaps))
+    raise LoweringError(f"refinement did not converge in {max_rounds} rounds")

@@ -693,3 +693,80 @@ def test_closure_modes_match_independent_on_ping_pong(mode):
     )
     assert r_mode.state_count == r_ind.state_count == host.state_count()
     assert r_mode.max_depth == r_ind.max_depth
+
+
+def test_refine_check_converges_on_ping_pong():
+    """Incremental device-search-driven closure: from a tiny best-effort
+    seed, poison payloads feed extend() until a run is poison-free — exact
+    host-count parity with NO host traversal of the global space and no
+    local_boundary."""
+    from stateright_tpu.tensor.lowering import refine_check
+
+    def boundary(view):
+        counters = view.actor_feature(lambda i, s: s)
+        return lambda s: (counters(s) <= 3).all(1)
+
+    cfg = PingPongCfg(max_nat=3, maintains_history=False)
+    r, lowered = refine_check(
+        cfg.into_model().with_lossy_network(False),
+        batch_size=64,
+        table_log2=12,
+        seed_states=2,
+        boundary=boundary,
+    )
+    host = _host(cfg.into_model().with_lossy_network(False))
+    assert r.complete
+    assert r.unique_state_count == host.unique_state_count() == 7
+    assert r.state_count == host.state_count()
+    assert "lowering coverage" not in r.discoveries
+
+
+def test_refine_check_paxos1_golden():
+    # 1-client Paxos (265/482, incl. the linearizability history automaton)
+    # through pure refinement — no local_boundary, no exact host traversal.
+    from stateright_tpu.actor.register import GetOk
+    from stateright_tpu.examples.paxos import NULL_VALUE, PaxosModelCfg
+    from stateright_tpu.tensor.lowering import refine_check
+
+    def props(view):
+        lin = view.history_pred(lambda h: h.serialized_history() is not None)
+        chosen = view.any_env(
+            lambda e: isinstance(e.msg, GetOk) and e.msg.value != NULL_VALUE
+        )
+        return [
+            TensorProperty.always("linearizable", lambda m, s: lin(s)),
+            TensorProperty.sometimes("value chosen", lambda m, s: chosen(s)),
+        ]
+
+    cfg = PaxosModelCfg(client_count=1, server_count=3)
+    r, _ = refine_check(
+        cfg.into_model(),
+        batch_size=256,
+        table_log2=12,
+        seed_states=32,
+        properties=props,
+    )
+    assert r.complete
+    assert r.unique_state_count == 265
+    assert r.state_count == 482
+    assert set(r.discoveries) == {"value chosen"}
+
+
+def test_poison_rows_are_terminal():
+    # Regression: an uncovered pair's marker row must not expand through
+    # clamped gathers into phantom states.
+    import jax.numpy as jnp
+
+    def boundary(view):
+        counters = view.actor_feature(lambda i, s: s)
+        return lambda s: (counters(s) <= 3).all(1)
+
+    cfg = PingPongCfg(max_nat=3, maintains_history=False)
+    m = lower_actor_model(
+        cfg.into_model().with_lossy_network(False),
+        local_boundary=lambda i, s: s <= 1,  # deliberately under-approximate
+        boundary=boundary,
+    )
+    row = jnp.full((1, m.lanes), 0xFFFFFFFF, dtype=jnp.uint32)
+    _succs, valid = m.expand(row)
+    assert int(np.asarray(valid).sum()) == 0
