@@ -1,0 +1,88 @@
+"""Real-TPU tuning sweep for the resident engine on the north-star workload.
+
+Runs paxos-3 (and optionally 2pc-4 as a smoke test) across a grid of
+(batch_size, table_log2) configs on the DEFAULT jax backend (i.e. the axon
+TPU when the tunnel is up), asserting golden parity every time and printing
+states/sec per config. One workload config per subprocess invocation keeps a
+wedged tunnel from eating the whole sweep — run via scripts/tpu_tune.sh.
+
+Usage: python scripts/tpu_tune.py MODEL N BATCH TABLE_LOG2 [REPEATS]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".jax_cache",
+    ),
+)
+
+GOLDEN = {
+    ("paxos", 2): (32_971, 16_668),
+    ("paxos", 3): (2_420_477, 1_194_428),
+    ("2pc", 4): (8_258, 1_568),
+    ("2pc", 10): (817_760_258, 61_515_776),
+}
+
+
+def main() -> int:
+    model_name, n, batch, table_log2 = (
+        sys.argv[1],
+        int(sys.argv[2]),
+        int(sys.argv[3]),
+        int(sys.argv[4]),
+    )
+    repeats = int(sys.argv[5]) if len(sys.argv) > 5 else 3
+
+    from stateright_tpu.tensor.resident import ResidentSearch
+
+    if model_name == "paxos":
+        from stateright_tpu.tensor.paxos import TensorPaxos
+
+        model = TensorPaxos(client_count=n)
+    else:
+        from stateright_tpu.tensor.models import TensorTwoPhaseSys
+
+        model = TensorTwoPhaseSys(n)
+
+    print(
+        f"devices={jax.devices()} workload={model_name}-{n} "
+        f"batch={batch} table=2^{table_log2}",
+        flush=True,
+    )
+    search = ResidentSearch(model, batch_size=batch, table_log2=table_log2)
+    t0 = time.monotonic()
+    r = search.run()
+    compile_s = time.monotonic() - t0
+    print(f"compile+first: {compile_s:.1f}s", flush=True)
+    best = None
+    for i in range(repeats):
+        r = search.run()
+        print(
+            f"  run {i}: {r.duration:.4f}s "
+            f"({r.state_count / max(r.duration, 1e-9):,.0f} states/s, "
+            f"steps={r.steps})",
+            flush=True,
+        )
+        if best is None or r.duration < best.duration:
+            best = r
+    gold = GOLDEN.get((model_name, n))
+    if gold and (best.state_count, best.unique_state_count) != gold:
+        print(f"PARITY FAIL: {best.state_count}/{best.unique_state_count} != {gold}")
+        return 1
+    print(
+        f"BEST {model_name}-{n} b={batch} t={table_log2}: "
+        f"{best.duration:.4f}s {best.state_count / max(best.duration, 1e-9):,.0f}/s"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,16 @@
+#!/bin/bash
+# Sweep the resident engine on the real TPU: smoke test first, then the
+# north-star paxos-3 across batch/table configs. Each config is its own
+# subprocess (the tunnel is single-client; a hang only costs that config).
+cd "$(dirname "$0")/.." || exit 1
+set -u
+run() {
+  echo "== $* =="
+  timeout 900 python scripts/tpu_tune.py "$@"
+  echo
+}
+run 2pc 4 512 14 2
+run paxos 3 8192 22 3
+run paxos 3 16384 22 3
+run paxos 3 32768 22 3
+run paxos 3 65536 22 2
