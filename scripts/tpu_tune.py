@@ -16,30 +16,27 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        ".jax_cache",
-    ),
-)
+from bench import _CACHE_DIR, GOLDEN  # one golden table, one cache dir
 
-GOLDEN = {
-    ("paxos", 2): (32_971, 16_668),
-    ("paxos", 3): (2_420_477, 1_194_428),
-    ("2pc", 4): (8_258, 1_568),
-    ("2pc", 10): (817_760_258, 61_515_776),
-}
+# The image's site config re-pins the axon TPU platform over a plain env
+# var; honor JAX_PLATFORMS at the config level like bench.py does.
+_p = os.environ.get("JAX_PLATFORMS")
+if _p:
+    jax.config.update("jax_platforms", _p)
+jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
 
 
 def main() -> int:
+    if len(sys.argv) < 5:
+        print(__doc__)
+        return 2
     model_name, n, batch, table_log2 = (
         sys.argv[1],
         int(sys.argv[2]),
         int(sys.argv[3]),
         int(sys.argv[4]),
     )
-    repeats = int(sys.argv[5]) if len(sys.argv) > 5 else 3
+    repeats = max(1, int(sys.argv[5])) if len(sys.argv) > 5 else 3
 
     from stateright_tpu.tensor.resident import ResidentSearch
 

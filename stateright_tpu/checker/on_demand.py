@@ -128,7 +128,12 @@ class OnDemandChecker(Checker):
                 self._max_depth = max(self._max_depth, depth)
         if self._target_max_depth is not None and depth >= self._target_max_depth:
             return
-        if self._visitor is not None:
+        if self._visitor is not None and getattr(
+            self._visitor, "should_visit", lambda: True
+        )():
+            # should_visit lets rate-limited visitors (the Explorer's
+            # recent-path snapshot) skip the O(depth) path reconstruction
+            # entirely between windows.
             self._visitor.visit(model, self._reconstruct_path(state_fp))
         is_awaiting, ebits = evaluate_properties(
             model, self._properties, state, self._discoveries, self._lock,

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path as FsPath
 from typing import Optional
@@ -32,6 +33,36 @@ from typing import Optional
 from ..core.fingerprint import fingerprint
 from ..core.model import Expectation
 from ..core.path import Path
+from ..core.visitor import CheckerVisitor
+
+
+class RecentPathSnapshot(CheckerVisitor):
+    """Rate-limited snapshot of a recently-evaluated path, surfaced in
+    `/.status` so the UI can show live activity during a background run
+    (ref: src/checker/explorer.rs:61-94 — the reference refreshes every 4 s).
+    Chains to any user-provided visitor."""
+
+    def __init__(self, inner: Optional[CheckerVisitor] = None,
+                 period: float = 4.0):
+        self.inner = inner
+        self.period = period
+        self._next = 0.0
+        self.encoded: Optional[str] = None
+
+    def should_visit(self) -> bool:
+        """Checker-side gate: with no chained visitor, skip the expensive
+        path reconstruction outside the snapshot window (the reconstruction
+        happens BEFORE visit(), so rate limiting inside visit() alone would
+        not save it)."""
+        return self.inner is not None or time.monotonic() >= self._next
+
+    def visit(self, model, path) -> None:
+        if self.inner is not None:
+            self.inner.visit(model, path)
+        now = time.monotonic()
+        if now >= self._next:
+            self._next = now + self.period
+            self.encoded = path.encode()
 
 _UI_DIR = FsPath(__file__).parent / "ui"
 _ASSETS = {
@@ -117,7 +148,7 @@ def states_view(model, fingerprints: list[int]) -> list[dict]:
     return views
 
 
-def status_view(checker) -> dict:
+def status_view(checker, recent: Optional[RecentPathSnapshot] = None) -> dict:
     """JSON for `GET /.status` (ref: src/checker/explorer.rs:171-190)."""
     model = checker.model
     discoveries = checker.discoveries()
@@ -143,6 +174,9 @@ def status_view(checker) -> dict:
         "max_depth": checker.max_depth(),
         "done": checker.is_done(),
         "properties": props,
+        # A recently-evaluated path (fp1/fp2/... form) for live-activity
+        # display (ref: src/checker/explorer.rs:61-94).
+        "recent_path": None if recent is None else recent.encoded,
     }
 
 
@@ -169,7 +203,15 @@ def serve(builder, address: str = "localhost:3000", block: bool = False):
     """Start the Explorer for a `CheckerBuilder`
     (ref: src/checker.rs:144-151 → src/checker/explorer.rs:79-99)."""
     host, _, port = address.partition(":")
-    checker = builder.spawn_on_demand()
+    snapshot = RecentPathSnapshot(inner=builder.visitor_)
+    # Install the snapshot only for THIS spawn — the caller's builder must
+    # not permanently inherit the explorer's visitor.
+    saved_visitor = builder.visitor_
+    builder.visitor_ = snapshot
+    try:
+        checker = builder.spawn_on_demand()
+    finally:
+        builder.visitor_ = saved_visitor
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):  # quiet by default
@@ -194,7 +236,7 @@ def serve(builder, address: str = "localhost:3000", block: bool = False):
                 self.wfile.write(body)
                 return
             if self.path == "/.status":
-                self._json(status_view(checker))
+                self._json(status_view(checker, snapshot))
                 return
             if self.path == "/.states" or self.path.startswith("/.states/"):
                 raw = self.path[len("/.states") :].strip("/")

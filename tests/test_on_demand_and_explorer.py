@@ -122,3 +122,33 @@ def test_explorer_http_roundtrip():
         assert get("/.status")["unique_state_count"] == 288
     finally:
         server.shutdown()
+
+
+def test_status_recent_path_snapshot():
+    """/.status carries a recently-evaluated path during/after a background
+    run (ref: src/checker/explorer.rs:61-94)."""
+    import json as _json
+    import urllib.request
+
+    from stateright_tpu.explorer.server import serve
+
+    server = serve(LinearEquation(2, 10, 14).checker(), "localhost:0")
+    try:
+        port = server.httpd.server_address[1]
+
+        def status():
+            with urllib.request.urlopen(
+                f"http://localhost:{port}/.status", timeout=10
+            ) as r:
+                return _json.loads(r.read())
+
+        assert status()["recent_path"] is None  # lazy: nothing evaluated yet
+        req = urllib.request.Request(
+            f"http://localhost:{port}/.runtocompletion", method="POST"
+        )
+        urllib.request.urlopen(req, timeout=10).read()
+        assert _wait(lambda: status()["done"], timeout=60)
+        rp = status()["recent_path"]
+        assert rp and all(int(p) != 0 for p in rp.split("/"))
+    finally:
+        server.shutdown()
