@@ -14,16 +14,11 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
+import jax  # noqa: F401 — backend selected by _pin_platform below
 
-from bench import _CACHE_DIR, GOLDEN  # one golden table, one cache dir
+from bench import GOLDEN, _pin_platform  # one golden table, one platform pin
 
-# The image's site config re-pins the axon TPU platform over a plain env
-# var; honor JAX_PLATFORMS at the config level like bench.py does.
-_p = os.environ.get("JAX_PLATFORMS")
-if _p:
-    jax.config.update("jax_platforms", _p)
-jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+_pin_platform()
 
 
 def main() -> int:

@@ -128,9 +128,7 @@ class OnDemandChecker(Checker):
                 self._max_depth = max(self._max_depth, depth)
         if self._target_max_depth is not None and depth >= self._target_max_depth:
             return
-        if self._visitor is not None and getattr(
-            self._visitor, "should_visit", lambda: True
-        )():
+        if self._visitor is not None and self._visitor.should_visit():
             # should_visit lets rate-limited visitors (the Explorer's
             # recent-path snapshot) skip the O(depth) path reconstruction
             # entirely between windows.

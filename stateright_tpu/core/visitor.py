@@ -14,6 +14,12 @@ from .path import Path
 
 
 class CheckerVisitor:
+    def should_visit(self) -> bool:
+        """Checkers consult this BEFORE building the (expensive) visit Path;
+        rate-limited visitors override it to skip reconstruction entirely
+        between windows."""
+        return True
+
     def visit(self, model, path: Path) -> None:
         raise NotImplementedError
 
