@@ -29,6 +29,10 @@ _MAX_DATAGRAM = 65_507
 
 
 def _encode_value(v):
+    # Tagged forms keep every container/identity type EXACT through the
+    # round trip (the reference's serde_json on typed structs does the same,
+    # ref: src/actor/spawn.rs:64-130): tuples are not degraded to lists,
+    # frozensets/sets survive, and Id stays Id.
     if dataclasses.is_dataclass(v) and not isinstance(v, type):
         return {
             "__type__": type(v).__name__,
@@ -37,35 +41,63 @@ def _encode_value(v):
                 for f in dataclasses.fields(v)
             },
         }
-    if isinstance(v, (list, tuple)):
-        return [_encode_value(x) for x in v]
-    if isinstance(v, (str, int, float, bool)) or v is None:
+    if isinstance(v, Id):
+        return {"__id__": int(v)}
+    if isinstance(v, bool) or v is None or isinstance(v, (str, float)):
         return v
+    if isinstance(v, int):
+        return v
+    if isinstance(v, tuple):
+        return {"__tuple__": [_encode_value(x) for x in v]}
+    if isinstance(v, list):
+        return [_encode_value(x) for x in v]
+    if isinstance(v, (set, frozenset)):
+        items = sorted((_encode_value(x) for x in v), key=json.dumps)
+        tag = "__frozenset__" if isinstance(v, frozenset) else "__set__"
+        return {tag: items}
+    if isinstance(v, dict):
+        pairs = [[_encode_value(k), _encode_value(x)] for k, x in v.items()]
+        pairs.sort(key=lambda kv: json.dumps(kv[0]))
+        return {"__dict__": pairs}
     raise TypeError(f"cannot JSON-encode message part {v!r}; pass custom serde")
 
 
 def make_json_serde(msg_types: Iterable[type] = ()):
-    """Default JSON codec: dataclasses tagged by class name. Decoding tuples
-    is lossy (JSON arrays decode as lists); dataclass fields that were tuples
-    are restored as tuples."""
+    """Default JSON codec: dataclasses tagged by class name; tuples, sets,
+    frozensets, dicts, and `Id` carry explicit tags so every message value
+    round-trips EXACTLY (lww/vector-clock-style tuple- and set-valued
+    messages included)."""
     registry = {t.__name__: t for t in msg_types}
 
     def serialize(msg) -> bytes:
         return json.dumps(_encode_value(msg)).encode("utf-8")
 
     def _decode(v):
-        if isinstance(v, dict) and "__type__" in v:
-            cls = registry.get(v["__type__"])
-            if cls is None:
-                raise ValueError(f"unknown message type {v['__type__']!r}")
-            kwargs = {}
-            for f in dataclasses.fields(cls):
-                if f.name in v:
-                    val = _decode(v[f.name])
-                    if isinstance(val, list):
-                        val = tuple(val)
-                    kwargs[f.name] = val
-            return cls(**kwargs)
+        if isinstance(v, dict):
+            if "__type__" in v:
+                cls = registry.get(v["__type__"])
+                if cls is None:
+                    raise ValueError(
+                        f"unknown message type {v['__type__']!r}"
+                    )
+                return cls(
+                    **{
+                        f.name: _decode(v[f.name])
+                        for f in dataclasses.fields(cls)
+                        if f.name in v
+                    }
+                )
+            if "__id__" in v:
+                return Id(v["__id__"])
+            if "__tuple__" in v:
+                return tuple(_decode(x) for x in v["__tuple__"])
+            if "__frozenset__" in v:
+                return frozenset(_decode(x) for x in v["__frozenset__"])
+            if "__set__" in v:
+                return {_decode(x) for x in v["__set__"]}
+            if "__dict__" in v:
+                return {_decode(k): _decode(x) for k, x in v["__dict__"]}
+            return v
         if isinstance(v, list):
             return [_decode(x) for x in v]
         return v

@@ -80,7 +80,7 @@ class BfsChecker(WorkerLoopMixin, Checker):
             if self._target_max_depth is not None and depth >= self._target_max_depth:
                 continue
 
-            if self._visitor is not None:
+            if self._visitor is not None and self._visitor.should_visit():
                 self._visitor.visit(model, self._reconstruct_path(state_fp))
 
             is_awaiting_discoveries, ebits = evaluate_properties(

@@ -80,7 +80,7 @@ class DfsChecker(WorkerLoopMixin, Checker):
             if self._target_max_depth is not None and depth >= self._target_max_depth:
                 continue
 
-            if self._visitor is not None:
+            if self._visitor is not None and self._visitor.should_visit():
                 self._visitor.visit(
                     model, Path.from_fingerprints(model, fingerprints)
                 )

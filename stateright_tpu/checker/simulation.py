@@ -160,7 +160,7 @@ class SimulationChecker(Checker):
             with self._lock:
                 self._state_count += 1
 
-            if self._visitor is not None:
+            if self._visitor is not None and self._visitor.should_visit():
                 self._visitor.visit(
                     model, Path.from_fingerprints(model, fingerprint_path)
                 )

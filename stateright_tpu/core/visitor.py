@@ -15,8 +15,9 @@ from .path import Path
 
 class CheckerVisitor:
     def should_visit(self) -> bool:
-        """Checkers consult this BEFORE building the (expensive) visit Path;
-        rate-limited visitors override it to skip reconstruction entirely
+        """Consulted by every checker BEFORE building the (expensive) visit
+        Path; rate-limited visitors (e.g. the Explorer's recent-path
+        snapshot) override it to skip the O(depth) reconstruction entirely
         between windows."""
         return True
 
