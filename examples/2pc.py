@@ -26,7 +26,12 @@ def main():
             f"Checking two phase commit with {rm_count} resource managers "
             "on the device frontier checker."
         )
-        report(TwoPhaseSys(rm_count).checker().spawn_tpu())
+        from _cli import pin_device_platform
+
+        pin_device_platform()
+        from stateright_tpu.tensor.models import TensorTwoPhaseSys
+
+        report(TensorTwoPhaseSys(rm_count).checker().spawn_tpu())
     elif cmd == "check-sym":
         rm_count = argv_int(2, 2)
         print(

@@ -10,6 +10,18 @@ from stateright_tpu import WriteReporter  # noqa: E402
 from stateright_tpu.actor import Network  # noqa: E402
 
 
+def pin_device_platform() -> None:
+    """Honor JAX_PLATFORMS for the device (`check-tpu`) subcommands: this
+    image's site config re-pins the axon TPU platform over a plain env var,
+    so apply it at the jax.config level (same workaround as bench.py).
+    Called only from device branches — host-only subcommands never import
+    jax at all."""
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
 def argv_subcommand():
     return sys.argv[1] if len(sys.argv) > 1 else None
 

@@ -24,6 +24,23 @@ def main():
             .symmetry()
             .spawn_dfs()
         )
+    elif cmd in ("check-tpu", "check-tpu-sym"):
+        n = argv_int(2, 3)
+        sym = cmd == "check-tpu-sym"
+        print(
+            f"Model checking increment_lock with {n} threads on the device "
+            f"frontier checker{' using symmetry reduction' if sym else ''}."
+        )
+        from _cli import pin_device_platform
+
+        pin_device_platform()
+        from stateright_tpu.tensor.models import TensorIncrementLock
+
+        report(
+            TensorIncrementLock(n, symmetry=sym)
+            .checker()
+            .spawn_tpu(batch_size=1024, table_log2=14)
+        )
     elif cmd == "explore":
         n = argv_int(2, 3)
         address = argv_str(3, "localhost:3000")
@@ -35,6 +52,8 @@ def main():
         print("USAGE:")
         print("  ./increment_lock.py check [THREAD_COUNT]")
         print("  ./increment_lock.py check-sym [THREAD_COUNT]")
+        print("  ./increment_lock.py check-tpu [THREAD_COUNT]")
+        print("  ./increment_lock.py check-tpu-sym [THREAD_COUNT]")
         print("  ./increment_lock.py explore [THREAD_COUNT] [ADDRESS]")
 
 

@@ -28,6 +28,23 @@ def main():
         )
         checker = builder.spawn_dfs() if cmd == "check-dfs" else builder.spawn_bfs()
         report(checker)
+    elif cmd == "check-tpu":
+        client_count = argv_int(2, 2)
+        print(
+            f"Model checking Single Decree Paxos with {client_count} clients "
+            "on the device frontier checker."
+        )
+        from _cli import pin_device_platform
+
+        pin_device_platform()
+        from stateright_tpu.tensor.paxos import TensorPaxos
+
+        batch, table = (2048, 16) if client_count <= 2 else (8192, 22)
+        report(
+            TensorPaxos(client_count=client_count)
+            .checker()
+            .spawn_tpu(batch_size=batch, table_log2=table)
+        )
     elif cmd == "check-simulation":
         client_count = argv_int(2, 2)
         network = argv_network(3)
@@ -87,6 +104,7 @@ def main():
         print("  ./paxos.py check-dfs [CLIENT_COUNT] [NETWORK]")
         print("  ./paxos.py check-bfs [CLIENT_COUNT] [NETWORK]")
         print("  ./paxos.py check-simulation [CLIENT_COUNT] [NETWORK]")
+        print("  ./paxos.py check-tpu [CLIENT_COUNT]")
         print("  ./paxos.py explore [CLIENT_COUNT] [ADDRESS] [NETWORK]")
         print("  ./paxos.py spawn")
         print(f"NETWORK: {network_names()}")

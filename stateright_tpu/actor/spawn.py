@@ -56,9 +56,13 @@ def _encode_value(v):
         tag = "__frozenset__" if isinstance(v, frozenset) else "__set__"
         return {tag: items}
     if isinstance(v, dict):
-        pairs = [[_encode_value(k), _encode_value(x)] for k, x in v.items()]
-        pairs.sort(key=lambda kv: json.dumps(kv[0]))
-        return {"__dict__": pairs}
+        # Insertion order IS part of dict semantics (the repo uses dicts as
+        # insertion-ordered sets) — encode pairs in order, no sorting.
+        return {
+            "__dict__": [
+                [_encode_value(k), _encode_value(x)] for k, x in v.items()
+            ]
+        }
     raise TypeError(f"cannot JSON-encode message part {v!r}; pass custom serde")
 
 

@@ -225,3 +225,29 @@ def test_interaction_success_reachable():
     # Within the bounded depth the client can observe success; the eventually
     # property must not produce a counterexample.
     assert checker.discovery("success") is None
+
+
+@pytest.mark.slow
+def test_check_tpu_cli_subcommands():
+    """The device subcommands run end-to-end as real CLIs (regression: an
+    earlier check-tpu passed the HOST model to spawn_tpu and crashed)."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    ex = os.path.join(os.path.dirname(os.path.dirname(__file__)), "examples")
+    for args, needle in (
+        (["2pc.py", "check-tpu", "3"], "unique=288"),
+        (["increment_lock.py", "check-tpu-sym", "3"], "unique=13"),
+    ):
+        proc = subprocess.run(
+            [sys.executable] + args,
+            cwd=ex,
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        assert proc.returncode == 0, proc.stderr[-500:]
+        assert needle in proc.stdout, proc.stdout[-500:]
