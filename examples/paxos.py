@@ -30,6 +30,13 @@ def main():
         report(checker)
     elif cmd == "check-tpu":
         client_count = argv_int(2, 2)
+        if client_count > 3:
+            print(
+                "The hand tensor encoding supports at most 3 clients; for "
+                "bigger configs lower the actor model generically "
+                "(stateright_tpu.tensor.refine_check or closure='exact')."
+            )
+            return
         print(
             f"Model checking Single Decree Paxos with {client_count} clients "
             "on the device frontier checker."
@@ -104,7 +111,7 @@ def main():
         print("  ./paxos.py check-dfs [CLIENT_COUNT] [NETWORK]")
         print("  ./paxos.py check-bfs [CLIENT_COUNT] [NETWORK]")
         print("  ./paxos.py check-simulation [CLIENT_COUNT] [NETWORK]")
-        print("  ./paxos.py check-tpu [CLIENT_COUNT]")
+        print("  ./paxos.py check-tpu [CLIENT_COUNT<=3]")
         print("  ./paxos.py explore [CLIENT_COUNT] [ADDRESS] [NETWORK]")
         print("  ./paxos.py spawn")
         print(f"NETWORK: {network_names()}")

@@ -2089,6 +2089,8 @@ def refine_check(
     max_rounds: int = 64,
     progress=None,
     run_kwargs: Optional[dict] = None,
+    engine: str = "resident",
+    mesh=None,
     **lower_kwargs,
 ):
     """Incremental, device-search-driven lowering + check: the closure is
@@ -2110,8 +2112,35 @@ def refine_check(
     (raise table_log2).
 
     `progress(round, gaps, result)` is called after each non-final round.
+    `engine="sharded"` refines over the multi-chip engine (optionally on an
+    explicit `mesh`) — the state dump unions the per-shard queues, so gaps
+    surface from every chip.
     """
-    from .resident import ResidentSearch
+    if engine == "resident":
+        if mesh is not None:
+            raise ValueError(
+                "mesh is only meaningful with engine='sharded' (a mesh "
+                "passed to the single-chip resident engine would be "
+                "silently ignored)"
+            )
+        from .resident import ResidentSearch
+
+        def make_search(lowered):
+            return ResidentSearch(
+                lowered, batch_size=batch_size, table_log2=table_log2
+            )
+    elif engine == "sharded":
+        from ..parallel.sharded import ShardedSearch
+
+        def make_search(lowered):
+            return ShardedSearch(
+                lowered,
+                mesh=mesh,
+                batch_size=batch_size,
+                table_log2=table_log2,
+            )
+    else:
+        raise ValueError("engine must be 'resident' or 'sharded'")
 
     lowered = LoweredActorModel(
         model, closure="seed", max_joint_states=seed_states, **lower_kwargs
@@ -2119,9 +2148,7 @@ def refine_check(
     rkw = dict(run_kwargs or {})
     rkw.setdefault("budget", 1 << 20)
     for rnd in range(max_rounds):
-        search = ResidentSearch(
-            lowered, batch_size=batch_size, table_log2=table_log2
-        )
+        search = make_search(lowered)
         result = search.run(**rkw)
         gaps, capacity = set(), []
         for row in search.dump_states(decode=False):

@@ -210,3 +210,31 @@ def test_sharded_chip_count_mismatch_rejected(tmp_path):
         ShardedSearch.load_checkpoint(
             TensorTwoPhaseSys(3), ckpt, mesh=make_mesh(2)
         )
+
+
+def test_refine_check_over_sharded_engine():
+    """Incremental closure refinement driven by the MULTI-CHIP engine: gaps
+    surface from every shard's queue and the final run is poison-free."""
+    from stateright_tpu.actor.test_util import PingPongCfg
+    from stateright_tpu.tensor.lowering import refine_check
+
+    def boundary(view):
+        counters = view.actor_feature(lambda i, s: s)
+        return lambda s: (counters(s) <= 3).all(1)
+
+    cfg = PingPongCfg(max_nat=3, maintains_history=False)
+    r, _ = refine_check(
+        cfg.into_model().with_lossy_network(False),
+        batch_size=32,
+        table_log2=10,
+        seed_states=2,
+        boundary=boundary,
+        engine="sharded",
+        mesh=make_mesh(4),
+    )
+    host = (
+        cfg.into_model().with_lossy_network(False).checker().spawn_bfs().join()
+    )
+    assert r.complete
+    assert r.unique_state_count == host.unique_state_count() == 7
+    assert r.state_count == host.state_count()
