@@ -281,7 +281,10 @@ def _build_workload(model_name: str, n: int):
         from stateright_tpu.tensor.paxos import TensorPaxos
 
         model = TensorPaxos(client_count=n)
-        batch, table_log2 = (2048, 16) if n <= 2 else (8192, 22)
+        # Step count shrinks ~linearly with batch while per-step cost grows
+        # sub-linearly (CPU sweep: 158 steps @8192 -> 52 @32768, +30%
+        # states/s; scripts/tpu_tune.sh re-sweeps on real hardware).
+        batch, table_log2 = (2048, 16) if n <= 2 else (32768, 22)
         run_kwargs, golden = {}, GOLDEN[(model_name, n)]
     elif model_name == "2pc":
         from stateright_tpu.tensor.models import TensorTwoPhaseSys
