@@ -789,3 +789,99 @@ def test_device_simulation_over_lowered_model():
             break
         r = sim.run()
     assert "can reach max" in r.discoveries
+
+
+def test_refine_check_with_randoms():
+    """kind-2 (random) poison payloads drive the incremental closure: the
+    CoinFlipper vocabulary (pending-choice maps, varying choice sets) is
+    discovered by the search, not by an up-front closure."""
+    from stateright_tpu.tensor.lowering import refine_check
+
+    def build():
+        return (
+            ActorModel.new(None, None)
+            .actor(CoinFlipper(3))
+            .actor(CoinFlipper(2))
+            .property(Expectation.ALWAYS, "t", lambda m, s: True)
+        )
+
+    r, lowered = refine_check(
+        build(), batch_size=64, table_log2=12, seed_states=2
+    )
+    host = _host(build())
+    assert r.complete
+    assert r.unique_state_count == host.unique_state_count()
+    assert r.state_count == host.state_count()
+    assert lowered.has_randoms
+
+
+def test_refine_check_with_timers_depth_bounded():
+    """kind-1 (timeout) poison payloads + a depth-bounded refinement loop on
+    an UNBOUNDED model (recurring timers): gaps only surface within the
+    bound, so the closure stays finite and matches the host's bounded
+    counts."""
+    from stateright_tpu.actor import Network
+    from stateright_tpu.examples.timers import PingerModelCfg
+    from stateright_tpu.tensor.lowering import refine_check
+
+    cfg = PingerModelCfg(
+        server_count=2, network=Network.new_unordered_nonduplicating()
+    )
+    host = (
+        cfg.into_model()
+        .checker()
+        .target_max_depth(5)
+        .spawn_bfs()
+        .join()
+    )
+    r, lowered = refine_check(
+        cfg.into_model(),
+        batch_size=128,
+        table_log2=14,
+        seed_states=2,
+        run_kwargs={"target_max_depth": 5},
+    )
+    assert r.unique_state_count == host.unique_state_count()
+    assert r.state_count == host.state_count()
+    assert lowered.has_timers
+
+
+def test_refine_check_capacity_overflow_is_actionable():
+    """kind-16 poison payloads (covered pair, capacity overflow) must raise
+    the actionable grow-capacity error instead of looping on a gap that
+    re-reacting can never fix."""
+    from stateright_tpu.tensor.lowering import refine_check
+
+    class Flooder(Actor):
+        def on_start(self, id, out):
+            if int(id) == 0:
+                out.send(Id(1), ("m", 0))
+            return 0
+
+        def on_msg(self, id, state, src, msg, out):
+            kind, n = msg
+            if n < 3:
+                out.send(src, ("m", n + 1))
+                out.send(src, ("x", n + 1))
+            return state + 1 if state < 8 else None
+
+    def build():
+        return (
+            ActorModel.new(None, None)
+            .actor(Flooder())
+            .actor(Flooder())
+            .with_init_network(Network.new_unordered_nonduplicating())
+            .property(Expectation.ALWAYS, "t", lambda m, s: True)
+        )
+
+    with pytest.raises(LoweringError, match="capacity overflow"):
+        refine_check(
+            build(), batch_size=64, table_log2=12, seed_states=2, pool_size=2
+        )
+    # The same model refines fine with enough pool headroom.
+    r, _ = refine_check(
+        build(), batch_size=64, table_log2=12, seed_states=2, pool_size=8
+    )
+    host = _host(build())
+    assert r.unique_state_count == host.unique_state_count()
+    assert r.state_count == host.state_count()
