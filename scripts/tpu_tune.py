@@ -7,6 +7,8 @@ states/sec per config. One workload config per subprocess invocation keeps a
 wedged tunnel from eating the whole sweep — run via scripts/tpu_tune.sh.
 
 Usage: python scripts/tpu_tune.py MODEL N BATCH TABLE_LOG2 [REPEATS]
+Set TPU_TUNE_TRACE=/path to capture a jax.profiler trace of the timed runs
+(inspect with tensorboard or xprof to see the per-step op breakdown).
 """
 import os
 import sys
@@ -54,17 +56,27 @@ def main() -> int:
     r = search.run()
     compile_s = time.monotonic() - t0
     print(f"compile+first: {compile_s:.1f}s", flush=True)
+    trace_dir = os.environ.get("TPU_TUNE_TRACE")
+    if trace_dir:
+        jax.profiler.start_trace(trace_dir)
     best = None
-    for i in range(repeats):
-        r = search.run()
-        print(
-            f"  run {i}: {r.duration:.4f}s "
-            f"({r.state_count / max(r.duration, 1e-9):,.0f} states/s, "
-            f"steps={r.steps})",
-            flush=True,
-        )
-        if best is None or r.duration < best.duration:
-            best = r
+    try:
+        for i in range(repeats):
+            r = search.run()
+            print(
+                f"  run {i}: {r.duration:.4f}s "
+                f"({r.state_count / max(r.duration, 1e-9):,.0f} states/s, "
+                f"steps={r.steps})",
+                flush=True,
+            )
+            if best is None or r.duration < best.duration:
+                best = r
+    finally:
+        if trace_dir:
+            # Flush even when a run dies mid-loop — that is exactly when
+            # the trace explains the failure.
+            jax.profiler.stop_trace()
+            print(f"profiler trace written to {trace_dir}", flush=True)
     gold = GOLDEN.get((model_name, n))
     if gold and (best.state_count, best.unique_state_count) != gold:
         print(f"PARITY FAIL: {best.state_count}/{best.unique_state_count} != {gold}")

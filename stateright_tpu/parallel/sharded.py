@@ -65,7 +65,13 @@ from ..tensor.resident import _finish_masks, _resolve_chunking
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "d") -> Mesh:
-    """A 1-D device mesh over the first `n_devices` visible devices."""
+    """A 1-D device mesh over the first `n_devices` visible devices.
+
+    Multi-host: under `jax.distributed.initialize()`, `jax.devices()` is the
+    GLOBAL device list, so the same call assembles a cross-host mesh and the
+    search's all_to_all/psum ride ICI within a slice and DCN across hosts —
+    no code changes in the engine (the reference's multi-machine story is
+    manual spawn-per-host; here it is one flag on the launcher)."""
     devices = jax.devices()
     if n_devices is not None:
         if n_devices > len(devices):
