@@ -1,0 +1,50 @@
+"""bench.py JSON-contract tests.
+
+The driver records bench.py's one-line JSON as BENCH_r{N}.json; the judge and
+dashboards read `value`/`vs_baseline` from it.  The contract (VERDICT r3 weak
+#1): those fields describe the DEVICE engine only — when no device result
+exists they must be null, never the C++ baseline number, so an empty-device
+run can't masquerade as a healthy 1.0x.
+"""
+
+import importlib.util
+import pathlib
+
+_spec = importlib.util.spec_from_file_location(
+    "bench", pathlib.Path(__file__).resolve().parent.parent / "bench.py"
+)
+bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench)
+
+BASE = {"paxos-3": {"states_per_sec": 1_674_699.0, "sec": 1.0}}
+
+
+def test_device_result_reports_device_number_and_ratio():
+    dev = {"paxos-3": {"states_per_sec": 3_349_398.0, "sec": 1.0}}
+    metric, value, vs_baseline = bench.headline_summary(dev, BASE)
+    assert value == 3_349_398.0
+    assert vs_baseline == 2.0
+    assert "device whole-search" in metric
+
+
+def test_empty_device_reports_nulls_not_baseline():
+    metric, value, vs_baseline = bench.headline_summary({}, BASE)
+    assert value is None
+    assert vs_baseline is None
+    assert "device unavailable" in metric
+
+
+def test_device_failed_on_headline_reports_nulls():
+    # Device produced *some* result but not the headline workload.
+    dev = {"2pc-4": {"states_per_sec": 1000.0, "sec": 1.0}}
+    metric, value, vs_baseline = bench.headline_summary(dev, BASE)
+    assert value is None
+    assert vs_baseline is None
+    assert "device failed on paxos-3" in metric
+
+
+def test_no_baseline_still_reports_device_value():
+    dev = {"paxos-3": {"states_per_sec": 5.0, "sec": 1.0}}
+    metric, value, vs_baseline = bench.headline_summary(dev, {})
+    assert value == 5.0
+    assert vs_baseline is None
