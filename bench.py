@@ -395,7 +395,7 @@ def device_search_sharded(model_name: str, n: int, n_chips: int = 8):
 # -- main ----------------------------------------------------------------------
 
 
-def headline_summary(dev: dict, base: dict):
+def headline_summary(dev: dict, base: dict, smoke: bool = False):
     """Headline metric for the one-line JSON: Paxos-3 (the BASELINE.json
     north-star workload).
 
@@ -414,7 +414,7 @@ def headline_summary(dev: dict, base: dict):
         )
     else:
         value = None
-        if os.environ.get("BENCH_SMOKE") == "1":
+        if smoke:
             why = "paxos-3 not run in smoke mode"
         elif dev:
             why = "device failed on paxos-3"
@@ -561,7 +561,7 @@ def main() -> int:
     if dev_errors:
         detail["device_errors"] = dev_errors
 
-    metric, value, vs_baseline = headline_summary(dev, base)
+    metric, value, vs_baseline = headline_summary(dev, base, smoke=smoke)
     if smoke:
         metric = f"[SMOKE MODE — not a benchmark] {metric}"
 

@@ -30,6 +30,23 @@ counted/stored — but the counts are NOT comparable:
 - assert device-engine symmetry counts against full-key goldens (314);
 - assert host `spawn_dfs` + `symmetry_fn` counts against the reference's
   value-sort goldens (665), which that path reproduces exactly.
+
+Why the device engines do not (and should not) target the 665 golden:
+value-sort reduction is TRAVERSAL-ORDER-DEPENDENT. Measured on 2PC-5
+(tests/test_tensor_symmetry.py::test_value_sort_reduction_is_traversal_order_dependent):
+
+    reduction     BFS order   DFS order
+    value-sort        508         665      <- order-dependent
+    full-key          314         314      <- orbit invariant
+
+The device engines are parallel level-synchronous BFS with scatter-resolved
+dedup: which orbit member is inserted first depends on batch layout, so a
+value-sort port could never pin a meaningful golden there. The full-key
+canonicalization is the only choice whose count is a property of the state
+space rather than of the schedule — every engine (host DFS, host BFS, device
+frontier/resident/sharded at any batch size) lands on the same number.
+Property verdicts are identical under both reductions and under no reduction
+(verdict-parity tests in tests/test_tensor_symmetry.py).
 """
 
 from __future__ import annotations

@@ -43,6 +43,12 @@ def test_device_failed_on_headline_reports_nulls():
     assert "device failed on paxos-3" in metric
 
 
+def test_smoke_mode_says_not_run_instead_of_failed():
+    metric, value, vs_baseline = bench.headline_summary({}, BASE, smoke=True)
+    assert value is None and vs_baseline is None
+    assert "not run in smoke mode" in metric
+
+
 def test_no_baseline_still_reports_device_value():
     dev = {"paxos-3": {"states_per_sec": 5.0, "sec": 1.0}}
     metric, value, vs_baseline = bench.headline_summary(dev, {})
