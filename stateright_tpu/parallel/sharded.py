@@ -64,6 +64,33 @@ from ..tensor.model import TensorModel
 from ..tensor.resident import _finish_masks, _resolve_chunking
 
 
+def _host(x):
+    """Device-to-host transfer that also works in multi-process runs.
+
+    Single-process (all shards addressable): plain `np.asarray`. Under
+    `jax.distributed.initialize()` the kernel outputs are sharded across
+    hosts, so each process first all-gathers the shards it cannot address
+    (`process_allgather(tiled=True)` reassembles the global array on every
+    host). This is what lets `ShardedSearch.run()` return identical global
+    `SearchResult`s on every participating process with no engine changes —
+    the multi-host twin of the reference's spawn-per-host aggregation
+    (ref: src/job_market.rs:149-176 is single-machine; cross-machine the
+    reference has no built-in story at all).
+
+    Accepts a pytree and gathers it with ONE `process_allgather` dispatch —
+    callers batch related outputs into a single `_host` call so multi-host
+    epilogues pay one DCN round-trip, not one per array."""
+    leaves = jax.tree.leaves(x)
+    if any(
+        isinstance(l, jax.Array) and not l.is_fully_addressable
+        for l in leaves
+    ):
+        from jax.experimental import multihost_utils
+
+        x = multihost_utils.process_allgather(x, tiled=True)
+    return jax.tree.map(np.asarray, x)
+
+
 def make_mesh(n_devices: Optional[int] = None, axis: str = "d") -> Mesh:
     """A 1-D device mesh over the first `n_devices` visible devices.
 
@@ -650,26 +677,30 @@ class ShardedSearch:
                     jnp.uint32(target_max_depth or 0),
                 )
             )
-            if bool(np.asarray(overflow).any()):
+            # ONE gather for the whole output tuple (one DCN round-trip on
+            # multi-host meshes instead of one per array).
+            (
+                t_lo, t_hi, p_lo, p_hi,
+                gen_lo, gen_hi, unique_counts, max_depths,
+                discovered, disc_lo, disc_hi, drained, overflow, steps,
+            ) = _host((
+                t_lo, t_hi, p_lo, p_hi,
+                gen_lo, gen_hi, unique_counts, max_depths,
+                discovered, disc_lo, disc_hi, drained, overflow, steps,
+            ))
+            if bool(overflow.any()):
                 raise RuntimeError(
                     "sharded search overflow: raise table_log2 or "
                     "dest_capacity (or run with budget=... for a recoverable "
                     "checkpoint-then-raise)"
                 )
-            self._last_tables = (
-                np.asarray(t_lo), np.asarray(t_hi),
-                np.asarray(p_lo), np.asarray(p_hi),
-            )
-            state_count = int(np.asarray(gen_lo)[0]) | (
-                int(np.asarray(gen_hi)[0]) << 32
-            )
-            disc_mask = int(np.asarray(discovered)[0])
-            disc_lo = np.asarray(disc_lo)  # [N, P]
-            disc_hi = np.asarray(disc_hi)
-            unique_counts = np.asarray(unique_counts)
-            result_max_depth = int(np.asarray(max_depths).max())
-            result_steps = int(np.asarray(steps).max())
-            complete = bool(np.asarray(drained).all())
+            self._last_tables = (t_lo, t_hi, p_lo, p_hi)
+            state_count = int(gen_lo[0]) | (int(gen_hi[0]) << 32)
+            disc_mask = int(discovered[0])
+            # disc_lo/disc_hi: [N, P]
+            result_max_depth = int(max_depths.max())
+            result_steps = int(steps.max())
+            complete = bool(drained.all())
         else:
             if self._carry is None:
                 self._carry = self._seed_k(
@@ -690,7 +721,7 @@ class ShardedSearch:
                     self._carry, req, anym, *t32, tmd,
                     jnp.int32(budget), jnp.int32(max_steps),
                 )
-                s = np.asarray(summary)  # [N, 10 + 2*max(P,1)] — one transfer
+                s = _host(summary)  # [N, 10 + 2*max(P,1)] — one transfer
                 if s[:, 7].any():  # overflow on any chip: the carry was kept
                     # at the last sound chunk boundary for checkpoint+regrow.
                     raise RuntimeError(
@@ -709,15 +740,29 @@ class ShardedSearch:
                     )
                 if s[0, 9]:  # stop flag (globally synced)
                     break
-                if timeout is not None and time.monotonic() - start > timeout:
-                    timed_out = True
-                    break
-            self._last_tables = (
-                np.asarray(self._carry.t_lo),
-                np.asarray(self._carry.t_hi),
-                np.asarray(self._carry.p_lo),
-                np.asarray(self._carry.p_hi),
-            )
+                if timeout is not None:
+                    # Multi-process: every rank must take the SAME branch or
+                    # the next collective deadlocks (ranks' host clocks and
+                    # startup delays differ). Rank 0's verdict is broadcast;
+                    # single-process keeps the plain clock check.
+                    timed = time.monotonic() - start > timeout
+                    if jax.process_count() > 1:
+                        from jax.experimental import multihost_utils
+
+                        timed = bool(
+                            multihost_utils.broadcast_one_to_all(
+                                np.asarray(timed)
+                            )
+                        )
+                    if timed:
+                        timed_out = True
+                        break
+            self._last_tables = _host((
+                self._carry.t_lo,
+                self._carry.t_hi,
+                self._carry.p_lo,
+                self._carry.p_hi,
+            ))
             P_ = max(len(self.props), 1)
             state_count = int(s[0, 0]) | (int(s[0, 1]) << 32)
             disc_mask = int(s[0, 4])
@@ -772,10 +817,10 @@ class ShardedSearch:
                 "no retained carry to dump: run with budget=... (chunked "
                 "dispatch) before dump_states()"
             )
-        q = np.asarray(self._carry.q_states)  # [N, Q, L]
-        ends = np.asarray(
-            self._carry.head if evaluated_only else self._carry.tail
-        )
+        q, ends = _host((
+            self._carry.q_states,  # [N, Q, L]
+            self._carry.head if evaluated_only else self._carry.tail,
+        ))
         out = []
         for i in range(self.n_chips):
             for r in q[i, : int(ends[i])]:
@@ -792,7 +837,12 @@ class ShardedSearch:
     # (the fp→owner map depends on it).
 
     def checkpoint(self, path: str) -> None:
-        """Dump the suspended per-shard search carry to `path` (.npz)."""
+        """Dump the suspended per-shard search carry to `path` (.npz).
+
+        Multi-process runs: EVERY rank must call this (the carry gather is a
+        collective), but only process 0 writes the file — N ranks writing
+        the same path on a shared filesystem would corrupt the archive. For
+        resume, `path` must be readable by every rank (shared storage)."""
         import json
 
         if self._carry is None:
@@ -803,7 +853,9 @@ class ShardedSearch:
         from ..tensor.resident import _ckpt_path
 
         c = self._carry
-        arrays = {f: np.asarray(getattr(c, f)) for f in c._fields}
+        arrays = _host(dict(zip(c._fields, c)))
+        if jax.process_index() != 0:
+            return
         arrays["meta"] = np.frombuffer(
             json.dumps(
                 {

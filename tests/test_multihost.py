@@ -1,0 +1,82 @@
+"""Two-process `jax.distributed` validation (VERDICT r3 #7): ShardedSearch
+over a mesh spanning two OS processes (4 virtual CPU devices each, gloo
+collectives) must complete with the single-process goldens, identically on
+every rank. Proves the `make_mesh` multi-host claim (parallel/sharded.py)
+with a real cross-process transport rather than a docstring."""
+
+import json
+import pathlib
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+SCRIPT = REPO / "scripts" / "multihost_sharded.py"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_sharded_search_golden():
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable,
+                str(SCRIPT),
+                "--num-processes",
+                "2",
+                "--process-id",
+                str(i),
+                "--coordinator",
+                f"127.0.0.1:{port}",
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            cwd=REPO,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=900)
+            outs.append(out)
+    finally:
+        # A hung rank (rendezvous failure, collective deadlock) must not
+        # leak gloo processes + the coordinator port into the rest of the
+        # pytest session.
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"rank failed:\n{out[-3000:]}"
+
+    results = []
+    for out in outs:
+        lines = [
+            l for l in out.splitlines() if l.startswith("MULTIHOST_RESULT ")
+        ]
+        assert len(lines) == 1, out[-3000:]
+        results.append(json.loads(lines[0].split(" ", 1)[1]))
+
+    for r in results:
+        assert r["global_devices"] == 8
+        assert r["local_devices"] == 4  # each process really owns only half
+        assert (r["generated"], r["unique"]) == (8258, 1568)
+        assert r["complete"]
+        assert r["discoveries"] == ["abort agreement", "commit agreement"]
+        assert sum(r["per_chip_unique"]) == 1568
+
+    # Every rank observed the SAME global result (counts, witnesses, balance).
+    a, b = results
+    for key in ("generated", "unique", "max_depth", "per_chip_unique"):
+        assert a[key] == b[key]
