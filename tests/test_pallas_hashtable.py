@@ -1,0 +1,101 @@
+"""Parity tests: the partitioned-VMEM Pallas insert (interpret mode on CPU)
+must match the XLA scatter-max insert (`tensor/hashtable.py`) on everything
+the engines can observe — per-call `is_new` attribution, the stored
+fingerprint set, and parent payloads. Slot layouts are allowed to differ
+(see the contract in tensor/pallas_hashtable.py)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from stateright_tpu.tensor.hashtable import HashTable
+from stateright_tpu.tensor.pallas_hashtable import PallasHashTable
+
+
+def _batches(rng, n_batches, size, pool_size):
+    """Random batches drawn from a small pool of uniformly-spread keys:
+    heavy duplication (within and across batches) without concentrating the
+    hash buckets the way a tiny key SPACE would."""
+    pool_lo = rng.integers(1, 2**32, pool_size, dtype=np.uint32)
+    pool_hi = rng.integers(0, 2**32, pool_size, dtype=np.uint32)
+    for _ in range(n_batches):
+        ix = rng.integers(0, pool_size, size)
+        parent = rng.integers(1, 2**31, size, dtype=np.uint32)
+        active = rng.random(size) < 0.9
+        yield (
+            jnp.asarray(pool_lo[ix]),
+            jnp.asarray(pool_hi[ix]),
+            jnp.asarray(parent),
+            jnp.asarray(parent + 1),
+            jnp.asarray(active),
+        )
+
+
+@pytest.mark.parametrize("pool_size", [40, 2000])
+def test_insert_parity_random_batches(pool_size):
+    # pool_size=40 forces massive duplication (the phase-3-arena stress case
+    # for the XLA table; the serial-loop-exactness case for the Pallas one).
+    from stateright_tpu.tensor.fingerprint import pack_fp
+
+    rng = np.random.default_rng(7)
+    xla = HashTable(12)
+    pls = PallasHashTable(12, n_partitions=8, interpret=True)
+    offered = {}  # key -> set of parents offered by the call that won it
+    for lo, hi, plo, phi, active in _batches(rng, 4, 256, pool_size):
+        rx = xla.insert(lo, hi, plo, phi, active)
+        rp = pls.insert(lo, hi, plo, phi, active)
+        assert not bool(rx.overflow) and not bool(rp.overflow)
+        # Identical per-call attribution: the same set of newly-won keys.
+        kx = np.asarray(rx.is_new)
+        kp = np.asarray(rp.is_new)
+        assert kx.sum() == kp.sum()
+        lo_np, hi_np = np.asarray(lo), np.asarray(hi)
+        plo_np, phi_np = np.asarray(plo), np.asarray(phi)
+        act_np = np.asarray(active)
+        keys_x = {
+            (int(l), int(h)) for l, h, n in zip(lo_np, hi_np, kx) if n
+        }
+        keys_p = {
+            (int(l), int(h)) for l, h, n in zip(lo_np, hi_np, kp) if n
+        }
+        assert keys_x == keys_p
+        for k in keys_x:
+            offered[k] = {
+                int(pack_fp(plo_np[j : j + 1], phi_np[j : j + 1])[0])
+                for j in range(len(lo_np))
+                if act_np[j] and (int(lo_np[j]), int(hi_np[j])) == k
+            }
+    # The tables agree on the fingerprint set; each stored parent is one the
+    # inserting call actually offered for that key (which-parent races are
+    # tolerated exactly as the reference tolerates DashMap insert races,
+    # ref: src/checker/bfs.rs:243).
+    dx, dp = xla.dump(), pls.dump()
+    assert dx.keys() == dp.keys()
+    for d in (dx, dp):
+        for k, parent in d.items():
+            key_pair = (k & 0xFFFFFFFF, k >> 32)
+            assert parent in offered[key_pair], (key_pair, parent)
+
+
+def test_duplicates_across_calls_are_not_new():
+    lo = jnp.asarray([5, 5, 9], dtype=jnp.uint32)
+    hi = jnp.asarray([1, 1, 2], dtype=jnp.uint32)
+    par = jnp.asarray([11, 12, 13], dtype=jnp.uint32)
+    act = jnp.ones(3, bool)
+    t = PallasHashTable(9, n_partitions=4, interpret=True)
+    r1 = t.insert(lo, hi, par, par, act)
+    # exactly one is_new for the duplicated key, one for the distinct key
+    assert int(np.asarray(r1.is_new).sum()) == 2
+    r2 = t.insert(lo, hi, par, par, act)
+    assert int(np.asarray(r2.is_new).sum()) == 0
+    assert len(t.dump()) == 2
+
+
+def test_inactive_lanes_ignored():
+    lo = jnp.asarray([5, 6], dtype=jnp.uint32)
+    hi = jnp.asarray([1, 1], dtype=jnp.uint32)
+    par = jnp.asarray([1, 1], dtype=jnp.uint32)
+    t = PallasHashTable(9, n_partitions=4, interpret=True)
+    r = t.insert(lo, hi, par, par, jnp.asarray([True, False]))
+    assert np.asarray(r.is_new).tolist() == [True, False]
+    assert len(t.dump()) == 1
