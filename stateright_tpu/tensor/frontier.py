@@ -146,6 +146,40 @@ def append_new(
     return q_states, q_lo, q_hi, q_ebits, q_depth, tail
 
 
+def append_new_dus(
+    q_states, q_lo, q_hi, q_ebits, q_depth, tail,
+    flat, slo, shi, ebits_rows, depth_rows, is_new,
+):
+    """DUS-append: compact the is_new rows to the front of an M-row block,
+    then write the block at the queue tail with ONE contiguous
+    `dynamic_update_slice` per queue array.
+
+    Why this exists next to `append_new` (whole-array scatter): XLA reliably
+    updates a DUS'd while-loop carry IN PLACE, while the equivalent scatter
+    was measured copying the multi-GB queue arrays every step (2pc-10,
+    batch 8192, table 2^27: ~77% of per-step execution time was `copy.*`
+    thunks in the round-4 CPU trace — the round-3 "staged append-DUS
+    experiment" evidence). CONTRACT: the caller must allocate Q >= max_tail
+    + M slack (the resident engine uses Q = S + K*A) so the DUS start never
+    clamps; rows [tail + new_count, tail + M) become zero scratch beyond the
+    tail, which nothing reads (pops are bounded by tail)."""
+    M, L = flat.shape
+    pos_all = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+    pos = jnp.where(is_new, pos_all, M)
+    blk = jnp.zeros((M, L), flat.dtype).at[pos].set(flat, mode="drop")
+    b_lo = jnp.zeros(M, q_lo.dtype).at[pos].set(slo, mode="drop")
+    b_hi = jnp.zeros(M, q_hi.dtype).at[pos].set(shi, mode="drop")
+    b_eb = jnp.zeros(M, q_ebits.dtype).at[pos].set(ebits_rows, mode="drop")
+    b_dp = jnp.zeros(M, q_depth.dtype).at[pos].set(depth_rows, mode="drop")
+    q_states = jax.lax.dynamic_update_slice(q_states, blk, (tail, 0))
+    q_lo = jax.lax.dynamic_update_slice(q_lo, b_lo, (tail,))
+    q_hi = jax.lax.dynamic_update_slice(q_hi, b_hi, (tail,))
+    q_ebits = jax.lax.dynamic_update_slice(q_ebits, b_eb, (tail,))
+    q_depth = jax.lax.dynamic_update_slice(q_depth, b_dp, (tail,))
+    tail = tail + is_new.sum().astype(jnp.int32)
+    return q_states, q_lo, q_hi, q_ebits, q_depth, tail
+
+
 def compact_new(flat, slo, shi, is_new):
     """Scatter-compact the is_new rows (and their fingerprints + source row
     indices) to the front — the sort-free replacement for argsort ranking.

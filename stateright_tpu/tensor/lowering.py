@@ -213,14 +213,70 @@ class LoweredActorModel(TensorModel):
 
         self.n = len(model.actors)
         self.track_history = model.init_history is not None
+        # Capacity classes (refinement mode only): vocabulary-sized array
+        # dims are rounded UP to monotonically-growing power-of-two caps so
+        # successive `extend()` rounds keep identical table SHAPES — the
+        # engines can then take the tables as kernel OPERANDS and reuse one
+        # compiled kernel across rounds instead of re-jitting per round
+        # (VERDICT r3 next #8; the recompile was the dominant per-round cost
+        # on both CPU and the TPU tunnel). Padded entries read as
+        # unexplored/undeliverable, which the POISON guard already handles.
+        self._caps: dict = {}
+        self._dyn = None  # engine-injected operand pytree (see _tbl)
         self._close()
         self._finalize()
+
+    def _dyn_cap(self, key: str, n: int, floor: int = 16) -> int:
+        """Monotone power-of-two capacity class for a vocabulary dim
+        (identity outside refinement mode, where exact sizes keep the eager
+        closure paths byte-identical to round 3)."""
+        if not self.best_effort or n == 0:
+            return n
+        c = max(self._caps.get(key, floor), floor)
+        while c < n:
+            c *= 2
+        self._caps[key] = c
+        return c
+
+    def _reg(self, name: str, arr) -> str:
+        """Register a round-varying baked array under a stable name so the
+        engines can pass it as a kernel operand (see `_tbl`)."""
+        self._dyn_host[name] = arr
+        return name
+
+    def _tbl(self, name: str):
+        """Read a baked table: the engine-injected operand when tracing
+        under an operand-aware engine, else the host array as a constant."""
+        d = self._dyn
+        if d is not None and name in d:
+            return d[name]
+        return jnp.asarray(self._dyn_host[name])
+
+    def dyn_tables(self) -> dict:
+        """The round-varying baked tables as a {name: array} pytree. An
+        engine that passes this as a kernel operand (and installs it via
+        `self._dyn` around tracing) can swap table CONTENTS between runs
+        with no retrace/recompile as long as the shapes (capacity classes)
+        are unchanged — `refine_check` relies on this."""
+        return {k: jnp.asarray(v) for k, v in self._dyn_host.items()}
 
     def _finalize(self) -> None:
         """Layout + tables + properties from the current closure contents;
         rerun by `extend()` after incremental closure growth."""
+        self._dyn_host: dict = {}
         self._layout()
         self._bake_tables()
+        for i, a in enumerate(self._D):
+            self._reg(f"D{i}", a)
+        for i, a in enumerate(self._T):
+            self._reg(f"T{i}", a)
+        if self.has_randoms:
+            for i, a in enumerate(self._R):
+                self._reg(f"R{i}", a)
+        self._reg("E_dst", self._E_dst)
+        if self.kind == ORDERED:
+            self._reg("E_flow", self._E_flow)
+        self._reg("hd", self._hd)
         self._props = self._build_properties()
         if self.has_randoms or self.max_crashes:
             # Pending random choices and crash flags are auxiliary state the
@@ -971,7 +1027,12 @@ class LoweredActorModel(TensorModel):
             )
         default = EMPTY if self.best_effort else np.uint32(0)
         self._hd = np.full(
-            (len(self.histories), max(n_events, 1)), default, np.uint32
+            (
+                self._dyn_cap("H", len(self.histories)),
+                self._dyn_cap("HE", max(n_events, 1)),
+            ),
+            default,
+            np.uint32,
         )
         for (hid, ev), nid in self._htrans.items():
             self._hd[hid, ev] = nid
@@ -980,7 +1041,7 @@ class LoweredActorModel(TensorModel):
     # -- device layout ---------------------------------------------------------
 
     def _layout(self) -> None:
-        self.E = len(self.envs)
+        self.E = self._dyn_cap("E", len(self.envs))
         self.has_timers = any(self.timers[i] for i in range(self.n))
         self.timeout_slots = [
             (i, tid)
@@ -1018,10 +1079,13 @@ class LoweredActorModel(TensorModel):
             self.flow_ids = {f: i for i, f in enumerate(self.flows)}
             self.F = len(self.flows)
             self._E_flow = np.asarray(
-                [
-                    self.flow_ids[(int(e.src), int(e.dst))]
-                    for e in self.envs
-                ]
+                (
+                    [
+                        self.flow_ids[(int(e.src), int(e.dst))]
+                        for e in self.envs
+                    ]
+                    + [0] * (self.E - len(self.envs))
+                )
                 or [0],
                 np.uint32,
             )
@@ -1056,7 +1120,7 @@ class LoweredActorModel(TensorModel):
 
     def _bake_tables(self) -> None:
         E = self.E
-        maxS = max((len(s) for s in self.states), default=1)
+        maxS = self._dyn_cap("S", max((len(s) for s in self.states), default=1))
         self.maxS = maxS
         # Deliver tables [E, maxS] flattened. D_state: 0 = unexplored (POISON
         # if reached), 1 = elided no-op, else new_sid + 2.
@@ -1079,7 +1143,13 @@ class LoweredActorModel(TensorModel):
             D_delta[eid, sid] = entry["delta"]
         self._D = (D_state, D_emits, D_tclr, D_tset, D_hev, D_delta)
         self._E_dst = np.asarray(
-            [int(e.dst) if int(e.dst) < self.n else self.n for e in self.envs]
+            (
+                [
+                    int(e.dst) if int(e.dst) < self.n else self.n
+                    for e in self.envs
+                ]
+                + [self.n] * (E - len(self.envs))  # padded: undeliverable
+            )
             or [0],
             np.uint32,
         )
@@ -1110,9 +1180,11 @@ class LoweredActorModel(TensorModel):
         self._T = (T_state, T_emits, T_tclr, T_tset, T_hev, T_delta)
 
         if self.has_randoms:
-            maxR = max(len(m) for m in self.rmaps)
-            maxD = max(len(d) for d in self.rdeltas)
-            maxC = max((len(c) for c in self.rchoices), default=1) or 1
+            maxR = self._dyn_cap("R", max(len(m) for m in self.rmaps), 4)
+            maxD = self._dyn_cap("Rd", max(len(d) for d in self.rdeltas), 4)
+            maxC = self._dyn_cap(
+                "Rc", max((len(c) for c in self.rchoices), default=1) or 1, 4
+            )
             nJ = max(self.max_rand_slots) or 1
             RAPP = np.zeros((self.n, maxR, maxD), np.uint32)
             for i in range(self.n):
@@ -1339,12 +1411,12 @@ class LoweredActorModel(TensorModel):
         n, M = self.n, self.max_actions
         u = jnp.uint32
         D_state, D_emits, D_tclr, D_tset, D_hev, D_delta = (
-            jnp.asarray(t) for t in self._D
+            self._tbl(f"D{i}") for i in range(6)
         )
         T_state, T_emits, T_tclr, T_tset, T_hev, T_delta = (
-            jnp.asarray(t) for t in self._T
+            self._tbl(f"T{i}") for i in range(6)
         )
-        E_dst = jnp.asarray(self._E_dst)
+        E_dst = self._tbl("E_dst")
         maxS = self.maxS
 
         sid_lanes = states[:, self.sid_off : self.sid_off + n]  # [B, n]
@@ -1428,12 +1500,12 @@ class LoweredActorModel(TensorModel):
             if self.track_history:
                 hid = states[:, self.hist_off]
                 nh = jnp.take(
-                    jnp.asarray(self._hd).reshape(-1),
+                    self._tbl("hd").reshape(-1),
                     (hid[:, None] * u(self._hd.shape[1]) + hev).astype(jnp.int32),
                 )
                 succ = succ.at[:, :, self.hist_off].set(nh)
             if self.has_randoms and delta is not None:
-                RAPP = jnp.asarray(self._R[0])
+                RAPP = self._tbl("R0")
                 if rid_base is None:
                     rid_base = jnp.take_along_axis(
                         rand_lanes, d_actor, axis=1
@@ -1459,7 +1531,7 @@ class LoweredActorModel(TensorModel):
             flows4: [B, S, F, Dq]; emits: [B, S, max_emit].
             Returns (flows4, overflow[B, S])."""
             F, Dq = self.F, self.flow_depth
-            flow_of = jnp.asarray(self._E_flow)
+            flow_of = self._tbl("E_flow")
             overflow = jnp.zeros(flows4.shape[:2], bool)
             for j in range(self.max_emit):
                 em = emits[:, :, j]  # [B, S]
@@ -1716,7 +1788,7 @@ class LoweredActorModel(TensorModel):
         # SelectRandom actions (ref: src/actor/model.rs:302-313, 411-426).
         if self.random_slots:
             RAPP, RSEL, RPOP, R_state, R_emits, R_tclr, R_tset, R_hev, R_delta = (
-                jnp.asarray(t) for t in self._R
+                self._tbl(f"R{i}") for i in range(9)
             )
             nR = len(self.random_slots)
             r_actor = jnp.asarray(
@@ -1978,6 +2050,11 @@ class LoweredActorModel(TensorModel):
     # -- properties ------------------------------------------------------------
 
     def _build_properties(self):
+        # View-helper tables register under counter-based names; the counter
+        # resets here so each _finalize() re-registers the SAME names in the
+        # same order (properties_fn is deterministic) and operand-aware
+        # engines see stable pytree keys across refinement rounds.
+        self._view_ct = 0
         view = LoweredView(self)
         props = list(self._properties_fn(view)) if self._properties_fn else []
         if self._boundary_fn is not None:
@@ -2019,12 +2096,13 @@ class LoweredView:
         for i in range(m.n):
             for sid, st in enumerate(m.states[i]):
                 tab[i, sid] = fn(i, st)
-        jt = jnp.asarray(tab)
+        name = m._reg(f"view{m._view_ct}", tab)
+        m._view_ct += 1
 
         def eval_(states):
             sids = states[:, m.sid_off : m.sid_off + m.n].astype(jnp.int32)
             flat = jnp.arange(m.n, dtype=jnp.int32)[None, :] * m.maxS + sids
-            return jnp.take(jt.reshape(-1), flat)
+            return jnp.take(m._tbl(name).reshape(-1), flat)
 
         return eval_
 
@@ -2033,11 +2111,14 @@ class LoweredView:
         m = self.m
         if not m.track_history:
             raise LoweringError("model has no history")
-        tab = np.asarray([bool(fn(h)) for h in m.histories], bool)
-        jt = jnp.asarray(tab)
+        tab = np.zeros(m._hd.shape[0], bool)  # padded to the hid capacity
+        for hid, h in enumerate(m.histories):
+            tab[hid] = bool(fn(h))
+        name = m._reg(f"view{m._view_ct}", tab)
+        m._view_ct += 1
 
         def eval_(states):
-            return jt[states[:, m.hist_off].astype(jnp.int32)]
+            return m._tbl(name)[states[:, m.hist_off].astype(jnp.int32)]
 
         return eval_
 
@@ -2045,13 +2126,23 @@ class LoweredView:
         """pred(envelope) -> bool over in-flight envelopes.
         Returns states -> [B] bool."""
         m = self.m
-        match = np.asarray([bool(pred(e)) for e in m.envs], bool)
+        match = np.zeros(m.E, bool)  # padded eids stay False
+        for eid, e in enumerate(m.envs):
+            match[eid] = bool(pred(e))
+        if m.kind in (UNORDERED_NONDUPLICATING, ORDERED):
+            name = m._reg(f"view{m._view_ct}", match)
+        else:
+            mask = np.zeros(m.nbits, np.uint32)
+            for e in np.nonzero(match)[0]:
+                mask[e // 32] |= np.uint32(1 << (e % 32))
+            name = m._reg(f"view{m._view_ct}", mask)
+        m._view_ct += 1
 
         def eval_(states):
             if m.kind == UNORDERED_NONDUPLICATING:
                 pool = states[:, m.net_off : m.net_off + m.pool_size]
                 safe = jnp.minimum(pool, jnp.uint32(m.E - 1)).astype(jnp.int32)
-                ok = jnp.take(jnp.asarray(match), safe) & (pool != EMPTY)
+                ok = jnp.take(m._tbl(name), safe) & (pool != EMPTY)
                 return jnp.any(ok, axis=1)
             if m.kind == ORDERED:
                 # Deliverable envelopes = flow heads (iter_deliverable
@@ -2061,13 +2152,10 @@ class LoweredView:
                 ].reshape(states.shape[0], m.F, m.flow_depth)
                 head = flows[:, :, 0]
                 safe = jnp.minimum(head, jnp.uint32(m.E - 1)).astype(jnp.int32)
-                ok = jnp.take(jnp.asarray(match), safe) & (head != EMPTY)
+                ok = jnp.take(m._tbl(name), safe) & (head != EMPTY)
                 return jnp.any(ok, axis=1)
             bits = states[:, m.net_off : m.net_off + m.nbits]
-            mask = np.zeros(m.nbits, np.uint32)
-            for e in np.nonzero(match)[0]:
-                mask[e // 32] |= np.uint32(1 << (e % 32))
-            return jnp.any(bits & jnp.asarray(mask) != 0, axis=1)
+            return jnp.any(bits & m._tbl(name) != 0, axis=1)
 
         return eval_
 
@@ -2104,7 +2192,21 @@ def refine_check(
     search actually reaches — NOT to the global state count, which is the
     difference from `closure="exact"` (one host handler call per pair vs one
     `next_state` per global edge). Rounds ≈ the protocol's reaction-dependency
-    depth; each round re-jits (table shapes grow).
+    depth. With the resident engine, rounds reuse ONE compiled kernel: the
+    baked tables are padded to capacity classes and passed as operands
+    (`set_dyn_tables`), so a round only re-jits when a capacity class
+    actually grows.
+
+    Why rounds restart the SEARCH instead of resuming the previous carry
+    (the checkpoint/resume machinery): round k's poison marker rows are
+    real entries in the visited table and queue — and claimed table slots
+    are never emptied (the lock-free claim protocol's soundness invariant,
+    tensor/hashtable.py). Carrying them across `extend()` would corrupt
+    unique counts with phantom entries and dedup newly-realized successors
+    against stale poison fingerprints; deleting them would need tombstones
+    that break the scatter-max claim argument. Restarting with reused
+    kernels keeps counts exact and makes the restart cost just the search
+    itself.
 
     Returns (final SearchResult, LoweredActorModel). Raises LoweringError on
     capacity overflows (grow pool_size/flow_depth/max_emit) or
@@ -2147,8 +2249,31 @@ def refine_check(
     )
     rkw = dict(run_kwargs or {})
     rkw.setdefault("budget", 1 << 20)
+
+    def shape_sig(m):
+        """Everything that forces a rebuild when it changes: the state/action
+        layout plus every operand-table shape. With the capacity-class
+        padding (`_dyn_cap`) this is STABLE across most extend() rounds, so
+        the resident engine's compiled kernels are reused round to round —
+        the per-round re-jit was the dominant refinement cost (VERDICT r3
+        next #8)."""
+        return (
+            m.lanes,
+            m.max_actions,
+            tuple(sorted((k, v.shape) for k, v in m._dyn_host.items())),
+        )
+
+    search = None
+    sig = None
     for rnd in range(max_rounds):
-        search = make_search(lowered)
+        if engine == "resident" and search is not None and shape_sig(lowered) == sig:
+            # Same shapes: swap table contents into the compiled kernels and
+            # restart the (cheap) search instead of re-jitting everything.
+            search.set_dyn_tables(lowered.dyn_tables())
+            search.reset()
+        else:
+            search = make_search(lowered)
+            sig = shape_sig(lowered) if engine == "resident" else None
         result = search.run(**rkw)
         gaps, capacity = set(), []
         for row in search.dump_states(decode=False):

@@ -152,12 +152,19 @@ def _validate_ckpt_meta(model, meta: dict) -> None:
         )
 
 
-def _regrow(model, fields, old_log2: int, new_log2: int, K: int) -> dict:
+def _regrow(
+    model, fields, old_log2: int, new_log2: int, K: int,
+    queue_rows: Optional[int] = None,
+) -> dict:
     """Re-hash a checkpointed visited table into a larger one and pad the
-    frontier queue to the matching capacity (queue rows live at [0, tail)).
-    Bucket slots depend on the table size, so growth is a full re-insert of
-    every occupied slot — done on device in `K`-row batches."""
+    frontier queue to `queue_rows` (default: the new table size — what the
+    sharded engine's per-shard queues use; the resident engine passes its
+    slacked capacity so the queue is padded exactly once). Queue rows live
+    at [0, tail). Bucket slots depend on the table size, so growth is a
+    full re-insert of every occupied slot — done on device in `K`-row
+    batches."""
     S_new = 1 << new_log2
+    Q_new = queue_rows if queue_rows is not None else S_new
     t_lo, t_hi = fields["t_lo"], fields["t_hi"]
     p_lo, p_hi = fields["p_lo"], fields["p_hi"]
     nz = t_lo != 0  # lo == 0 is the empty-slot sentinel (fingerprint.py)
@@ -178,11 +185,11 @@ def _regrow(model, fields, old_log2: int, new_log2: int, K: int) -> dict:
                 "table overflow while re-growing; raise table_log2 further"
             )
     out = {"t_lo": tl, "t_hi": th, "p_lo": pl, "p_hi": ph}
-    Q_old, Q_new = 1 << old_log2, S_new
     for f in ("q_states", "q_lo", "q_hi", "q_ebits", "q_depth"):
         old = fields[f]
         grown = np.zeros((Q_new,) + old.shape[1:], dtype=old.dtype)
-        grown[:Q_old] = old
+        keep = min(old.shape[0], Q_new)
+        grown[:keep] = old[:keep]
         out[f] = grown
     out["overflow"] = np.bool_(False)  # the abort reason is being fixed
     return out
@@ -196,15 +203,35 @@ class ResidentSearch:
         model: TensorModel,
         batch_size: int = 2048,
         table_log2: int = 20,
+        donate_chunks: bool = False,
     ):
+        """`donate_chunks=True` donates the carry to each chunked dispatch:
+        XLA updates the tables/queue IN PLACE instead of copying the whole
+        multi-GB carry per dispatch (measured ~280 s/dispatch at table 2^27
+        on the CPU backend — the dominant cost of chunked long-haul runs).
+        The trade: on a table/queue overflow the pre-chunk carry no longer
+        exists, so the checkpoint-then-regrow recovery is unavailable —
+        run big spaces with a right-sized table, or leave this off when
+        overflow recovery matters more than throughput."""
         self.model = model
         self.batch_size = batch_size
         self.table_log2 = table_log2
+        self.donate_chunks = donate_chunks
         self.props = model.properties()
         self._kernel, self._seed_k, self._chunk_k = self._build()
         self._last_tables = None
         self._parent_map = None
         self._seed = None
+        # Operand tables (lowered models): round-varying baked tables flow
+        # into the kernels as ARGUMENTS instead of jaxpr constants, so
+        # `set_dyn_tables` can swap their contents (same shapes) with no
+        # retrace/recompile — what makes refine_check's per-round restarts
+        # cheap (VERDICT r3 next #8).
+        self._dyn_dev = (
+            jax.device_put(model.dyn_tables())
+            if hasattr(model, "dyn_tables")
+            else {}
+        )
         # Suspended-search carry (chunked runs only): retained across run()
         # calls so budget/timeout suspensions and overflows are resumable.
         self._carry = None
@@ -215,7 +242,13 @@ class ResidentSearch:
         A = model.max_actions
         L = model.lanes
         S = 1 << self.table_log2
-        Q = S  # see capacity argument in the module docstring
+        # Queue capacity: every unique state is enqueued exactly once (<= S
+        # before the table overflows), plus K*A rows of slack so either
+        # append variant (scatter `append_new` — the default; measured
+        # faster than `append_new_dus` on CPU at 2pc-10 scale — or the DUS
+        # block) stays in bounds right up to table overflow.
+        Q = S + K * A
+        self._Q = Q
         props = self.props
         P = len(props)
         always_i = [i for i, p in enumerate(props) if p.expectation == Expectation.ALWAYS]
@@ -281,12 +314,10 @@ class ResidentSearch:
                 flat, slo, shi, ebits[src_row], depth[src_row] + 1, is_new,
             )
             new_count = tail - c.tail
-            # A nearly-full queue would make the next pop's dynamic_slice
-            # clamp mis-align with the active mask (and a full one would drop
-            # appends); stopping at Q - K fires before either can corrupt
-            # results, and the table overflows around the same occupancy
-            # anyway. Surfaced to the host as overflow.
-            q_full = tail > Q - K
+            # tail beyond S means more uniques than table slots — the table
+            # is overflowing anyway; the K*A slack above keeps the DUS and
+            # the next pop's dynamic_slice in bounds right up to that point.
+            q_full = tail > Q - K * A
 
             gen_lo, gen_hi = count_add(c.gen_lo, c.gen_hi, gen)
             return _Carry(
@@ -421,31 +452,37 @@ class ResidentSearch:
             seed_lo,  # uint32 pair: pre-dedup init count (host count parity)
             seed_hi,
             target_max_depth,  # uint32 (0 = no limit)
+            dyn={},  # operand tables for lowered models (see __init__)
         ):
-            req = jnp.uint32(required_mask)
-            anym = jnp.uint32(any_mask)
-            have_target = (target_lo | target_hi) != 0
-            carry = make_carry(
-                init_states, init_lo, init_hi, n0, seed_lo, seed_hi
-            )
-            carry = jax.lax.while_loop(
-                lambda c: should_continue(
-                    c, req, anym, have_target, target_lo, target_hi, max_steps
-                ),
-                lambda c: body(c, target_max_depth),
-                carry,
-            )
-            summary = summary_of(carry, jnp.bool_(True))
+            model._dyn = dyn
+            try:
+                req = jnp.uint32(required_mask)
+                anym = jnp.uint32(any_mask)
+                have_target = (target_lo | target_hi) != 0
+                carry = make_carry(
+                    init_states, init_lo, init_hi, n0, seed_lo, seed_hi
+                )
+                carry = jax.lax.while_loop(
+                    lambda c: should_continue(
+                        c, req, anym, have_target, target_lo, target_hi,
+                        max_steps,
+                    ),
+                    lambda c: body(c, target_max_depth),
+                    carry,
+                )
+                summary = summary_of(carry, jnp.bool_(True))
+            finally:
+                model._dyn = None
             return carry.t_lo, carry.t_hi, carry.p_lo, carry.p_hi, summary
 
         @jax.jit
         def seed_k(init_states, init_lo, init_hi, n0, seed_lo, seed_hi):
             return make_carry(init_states, init_lo, init_hi, n0, seed_lo, seed_hi)
 
-        # NOTE: deliberately NOT donated — the host keeps the pre-chunk carry
+        # NOTE: NOT donated by default — the host keeps the pre-chunk carry
         # alive so a table/queue overflow can revert to the last sound chunk
         # boundary (checkpoint-then-raise instead of discarding the run).
-        @jax.jit
+        # `donate_chunks=True` flips this trade (see __init__).
         def chunk_k(
             carry: _Carry,
             req,  # uint32 dynamic (one compiled chunk kernel per model/shape)
@@ -455,23 +492,36 @@ class ResidentSearch:
             target_max_depth,
             budget,  # int32: max loop steps THIS dispatch
             max_steps,  # int32: global step cap
+            dyn={},  # operand tables for lowered models (see __init__)
         ):
-            have_target = (target_lo | target_hi) != 0
-            start = carry.steps
+            model._dyn = dyn
+            try:
+                have_target = (target_lo | target_hi) != 0
+                start = carry.steps
 
-            def cond(c: _Carry):
-                return should_continue(
-                    c, req, anym, have_target, target_lo, target_hi, max_steps
-                ) & (c.steps < start + budget)
+                def cond(c: _Carry):
+                    return should_continue(
+                        c, req, anym, have_target, target_lo, target_hi,
+                        max_steps,
+                    ) & (c.steps < start + budget)
 
-            carry = jax.lax.while_loop(
-                cond, lambda c: body(c, target_max_depth), carry
-            )
-            stop = ~should_continue(
-                carry, req, anym, have_target, target_lo, target_hi, max_steps
-            )
-            return carry, summary_of(carry, stop)
+                carry = jax.lax.while_loop(
+                    cond, lambda c: body(c, target_max_depth), carry
+                )
+                stop = ~should_continue(
+                    carry, req, anym, have_target, target_lo, target_hi,
+                    max_steps,
+                )
+                out = carry, summary_of(carry, stop)
+            finally:
+                model._dyn = None
+            return out
 
+        chunk_k = (
+            partial(jax.jit, donate_argnums=(0,))(chunk_k)
+            if self.donate_chunks
+            else jax.jit(chunk_k)
+        )
         return search, seed_k, chunk_k
 
     # -- host entry ------------------------------------------------------------
@@ -560,6 +610,7 @@ class ResidentSearch:
                 jnp.uint32(n_raw & 0xFFFFFFFF),
                 jnp.uint32(n_raw >> 32),
                 tmd,
+                self._dyn_dev,
             )
             # ONE device->host transfer for the entire result.
             summary = np.asarray(summary)
@@ -575,6 +626,12 @@ class ResidentSearch:
                 )
             req = jnp.uint32(required_mask)
             anym = jnp.uint32(any_mask)
+            if self.donate_chunks:
+                # Donating self._carry deletes the buffers a previous run's
+                # _last_tables may alias; drop the alias now so a later
+                # reconstruct_path gets a clear "no tables" error instead of
+                # jax's "Array has been deleted".
+                self._last_tables = None
             while True:
                 carry, summary = self._chunk_k(
                     self._carry,
@@ -585,11 +642,23 @@ class ResidentSearch:
                     tmd,
                     jnp.int32(budget),
                     jnp.int32(max_steps),
+                    self._dyn_dev,
                 )
                 summary = np.asarray(summary)  # one small transfer per chunk
-                if summary[7]:  # overflow: revert to the pre-chunk carry so
-                    # checkpoint() + load_checkpoint(table_log2=bigger) can
-                    # resume exactly from the last sound chunk boundary.
+                if summary[7]:  # overflow
+                    if self.donate_chunks:
+                        # The pre-chunk carry was donated into the dispatch;
+                        # there is no sound state to recover.
+                        self._carry = None
+                        raise RuntimeError(
+                            "hash table or queue full; donate_chunks=True "
+                            "sacrificed the recovery carry — rerun with a "
+                            "larger table_log2 (or donate_chunks=False for "
+                            "checkpoint-then-regrow recovery)"
+                        )
+                    # Revert to the pre-chunk carry so checkpoint() +
+                    # load_checkpoint(table_log2=bigger) can resume exactly
+                    # from the last sound chunk boundary.
                     raise RuntimeError(
                         "hash table or queue full; the search carry was kept "
                         "at the last chunk boundary — checkpoint(path) then "
@@ -645,6 +714,12 @@ class ResidentSearch:
             duration=time.monotonic() - start,
             steps=steps,
         )
+
+    def set_dyn_tables(self, tables: dict) -> None:
+        """Swap the lowered model's operand tables. Same pytree keys and
+        shapes reuse the already-compiled kernels untouched (no retrace);
+        `refine_check` calls this between rounds after `extend()`."""
+        self._dyn_dev = jax.device_put(tables)
 
     def reset(self) -> None:
         """Drop any suspended carry so the next `run()` starts fresh."""
@@ -743,18 +818,23 @@ class ResidentSearch:
         if log2 != meta["table_log2"]:
             fields.update(
                 _regrow(
-                    model, fields, meta["table_log2"], log2, rs.batch_size
+                    model, fields, meta["table_log2"], log2, rs.batch_size,
+                    queue_rows=rs._Q,
                 )
             )
-        # The queue guard (tail <= Q - K, see body()) was enforced with the
-        # CHECKPOINT's batch size; a larger K here could let pop_batch's
-        # dynamic_slice clamp past the restored tail and re-expand rows.
-        if int(fields["tail"]) > (1 << log2) - rs.batch_size:
-            raise ValueError(
-                "batch_size too large for the restored queue occupancy "
-                f"(tail={int(fields['tail'])}, capacity={1 << log2}); use a "
-                "smaller batch_size or a larger table_log2"
-            )
+        # Normalize queue arrays to this search's capacity Q = S + K*A
+        # (covers checkpoints from the pre-slack format, changed batch
+        # sizes, and regrown tables). Live rows sit at [0, tail),
+        # tail <= S <= Q, so padding is always a pure extension.
+        for f in ("q_states", "q_lo", "q_hi", "q_ebits", "q_depth"):
+            old = fields[f]
+            if old.shape[0] != rs._Q:
+                grown = np.zeros(
+                    (rs._Q,) + old.shape[1:], dtype=old.dtype
+                )
+                keep = min(old.shape[0], rs._Q)
+                grown[:keep] = old[:keep]
+                fields[f] = grown
         rs._carry = _Carry(
             **{f: jax.device_put(jnp.asarray(v)) for f, v in fields.items()}
         )
@@ -764,6 +844,11 @@ class ResidentSearch:
         """TLC-style reconstruction from the final table contents (the logic
         is shared with the host-orchestrated engine)."""
         if self._parent_map is None:
+            if self._last_tables is None:
+                raise RuntimeError(
+                    "no table snapshot to reconstruct from: run() has not "
+                    "completed since the last reset/donated resume"
+                )
             t_lo, t_hi, p_lo, p_hi = (
                 np.asarray(x) for x in self._last_tables
             )
