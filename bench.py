@@ -274,10 +274,11 @@ def _paxos5s4c_lowered(depth: int):
 
 
 def _build_workload(model_name: str, n: int):
-    """-> (model, batch, table_log2, run_kwargs, golden (gen, unique) or
-    None, closure_sec). Lowered workloads compute their own oracle
-    (closure_stats) during the host closure."""
+    """-> (model, batch, table_log2, run_kwargs, engine_kwargs, golden
+    (gen, unique) or None, closure_sec). Lowered workloads compute their
+    own oracle (closure_stats) during the host closure."""
     t0 = time.monotonic()
+    engine_kwargs: dict = {}
     if model_name == "paxos":
         from stateright_tpu.tensor.paxos import TensorPaxos
 
@@ -291,7 +292,13 @@ def _build_workload(model_name: str, n: int):
         from stateright_tpu.tensor.models import TensorTwoPhaseSys
 
         model = TensorTwoPhaseSys(n)
-        batch, table_log2 = (512, 14) if n < 8 else (8192, 27)
+        # 2pc-10: batch 32768 amortizes the per-step table/queue traffic 4x
+        # vs 8192, and donated chunk dispatches avoid the multi-GB carry
+        # copy if the run is ever chunked (round-4 CPU A/B: ~140k gen/s
+        # sustained, full space in ~100 min on one core; ROUND4_NOTES.md).
+        batch, table_log2 = (512, 14) if n < 8 else (32768, 27)
+        if n >= 8:
+            engine_kwargs["donate_chunks"] = True
         run_kwargs, golden = {}, GOLDEN[(model_name, n)]
     elif model_name in ("inclock", "inclock-sym"):
         from stateright_tpu.tensor.models import TensorIncrementLock
@@ -313,7 +320,10 @@ def _build_workload(model_name: str, n: int):
         golden = (s["generated"], s["unique"])
     else:
         raise ValueError(f"unknown workload {model_name!r}")
-    return model, batch, table_log2, run_kwargs, golden, time.monotonic() - t0
+    return (
+        model, batch, table_log2, run_kwargs, engine_kwargs, golden,
+        time.monotonic() - t0,
+    )
 
 
 def _parity_err(model_name, n, result, golden):
@@ -356,10 +366,12 @@ def device_search(model_name: str, n: int, repeats: int = 3):
     _pin_platform()
     from stateright_tpu.tensor.resident import ResidentSearch
 
-    model, batch, table_log2, run_kwargs, golden, closure_s = _build_workload(
-        model_name, n
+    model, batch, table_log2, run_kwargs, engine_kwargs, golden, closure_s = (
+        _build_workload(model_name, n)
     )
-    search = ResidentSearch(model, batch_size=batch, table_log2=table_log2)
+    search = ResidentSearch(
+        model, batch_size=batch, table_log2=table_log2, **engine_kwargs
+    )
     best, out = _time_search(search, run_kwargs, repeats, closure_s)
     return out, _parity_err(model_name, n, best, golden)
 
@@ -373,8 +385,10 @@ def device_search_sharded(model_name: str, n: int, n_chips: int = 8):
 
     from stateright_tpu.parallel import ShardedSearch, make_mesh
 
-    model, batch, table_log2, run_kwargs, golden, closure_s = _build_workload(
-        model_name, n
+    # engine_kwargs are resident-engine options (donate_chunks); the sharded
+    # engine has no equivalent, so they are intentionally dropped here.
+    model, batch, table_log2, run_kwargs, _engine_kwargs, golden, closure_s = (
+        _build_workload(model_name, n)
     )
     n_chips = min(n_chips, len(jax.devices()))
     search = ShardedSearch(

@@ -40,7 +40,7 @@ ORDERED = "ordered"
 
 
 class Network:
-    __slots__ = ("kind", "_data", "last_msg")
+    __slots__ = ("kind", "_data", "last_msg", "_hash")
 
     def __init__(self, kind: str, data: dict, last_msg: Optional[Envelope] = None):
         self.kind = kind
@@ -240,9 +240,16 @@ class Network:
         return self._data == other._data
 
     def __hash__(self) -> int:
-        if self.kind == UNORDERED_DUPLICATING:
-            return hash((self.kind, frozenset(self._data.keys()), self.last_msg))
-        return hash((self.kind, frozenset(self._data.items())))
+        # Networks are functional (every mutation returns a new Network), so
+        # the deep hash over the frozenset is computed once and cached.
+        h = getattr(self, "_hash", None)
+        if h is None:
+            if self.kind == UNORDERED_DUPLICATING:
+                h = hash((self.kind, frozenset(self._data.keys()), self.last_msg))
+            else:
+                h = hash((self.kind, frozenset(self._data.items())))
+            self._hash = h
+        return h
 
     def __repr__(self) -> str:
         if self.kind == UNORDERED_DUPLICATING:

@@ -29,6 +29,8 @@ class LinearizabilityTester(ConsistencyTester):
         "history_by_thread",
         "in_flight_by_thread",
         "is_valid_history",
+        "_key_cache",  # lazy identity-tuple cache (testers are immutable)
+        "_hash",
     )
 
     def __init__(
@@ -120,12 +122,18 @@ class LinearizabilityTester(ConsistencyTester):
     # -- identity (the tester lives inside checker states) ---------------------
 
     def _key(self):
-        return (
-            self.init_ref_obj,
-            frozenset(self.history_by_thread.items()),
-            frozenset(self.in_flight_by_thread.items()),
-            self.is_valid_history,
-        )
+        # Testers are immutable (every recording op returns a new tester),
+        # so the identity tuple is built once and cached — `_key` dominates
+        # host hashing costs otherwise (exact-closure profile, round 4).
+        k = getattr(self, "_key_cache", None)
+        if k is None:
+            k = self._key_cache = (
+                self.init_ref_obj,
+                frozenset(self.history_by_thread.items()),
+                frozenset(self.in_flight_by_thread.items()),
+                self.is_valid_history,
+            )
+        return k
 
     def __stable_encode__(self):
         return (
@@ -140,7 +148,10 @@ class LinearizabilityTester(ConsistencyTester):
         return isinstance(other, type(self)) and self._key() == other._key()
 
     def __hash__(self) -> int:
-        return hash(self._key())
+        h = getattr(self, "_hash", None)
+        if h is None:
+            h = self._hash = hash(self._key())
+        return h
 
     def __repr__(self) -> str:
         return (
