@@ -521,6 +521,17 @@ def main() -> int:
             "JAX_PLATFORMS": "cpu",
             "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
         }
+        # BENCH_TIMEOUT_SCALE: multiply per-workload subprocess timeouts —
+        # CPU-backend rehearsals need it (the completed 2pc-10 CPU run takes
+        # ~115 min vs the 50-min TPU budget; BENCH_CPU_2PC10_r04.json).
+        # Malformed/zero/negative values fall back to 1 (never crash the
+        # bench or zero the timeouts mid-run).
+        try:
+            tscale = float(os.environ.get("BENCH_TIMEOUT_SCALE", "1"))
+        except ValueError:
+            tscale = 1.0
+        if tscale <= 0:
+            tscale = 1.0
         workloads = (
             (("2pc", 4, 600.0, "--worker", None),)
             if smoke
@@ -541,7 +552,11 @@ def main() -> int:
                 "-sharded8" if mode == "--worker-sharded" else ""
             )
             r, perr = device_search_subprocess(
-                model, n, timeout=wl_timeout, mode=mode, env_extra=env_extra
+                model,
+                n,
+                timeout=wl_timeout * tscale,
+                mode=mode,
+                env_extra=env_extra,
             )
             if r is None:
                 # No result is a failure even without an error string (e.g.
