@@ -110,9 +110,16 @@ def run_baseline(exe: str, model: str, n: int, repeats: int = 3):
 # Persistent XLA compilation cache: the resident kernels take tens of seconds
 # to compile over the device tunnel; caching them means repeat bench runs (and
 # any warm-up run done earlier in the same checkout) skip compilation
-# entirely. The cache is keyed by backend+topology, so CPU-pinned runs and
-# real-TPU runs never collide.
-_CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+# entirely. CPU-pinned rehearsals use a SEPARATE directory: XLA:CPU AOT
+# entries embed the compiling machine's CPU features, and `.jax_cache`
+# carries entries from a prior host that this machine rejects on every load
+# (ROUND4_NOTES.md); `.jax_cache_cpu` is native to the current host and
+# gitignored.
+_REPO = os.path.dirname(os.path.abspath(__file__))
+_CACHE_DIR = os.path.join(
+    _REPO,
+    ".jax_cache_cpu" if os.environ.get("JAX_PLATFORMS") == "cpu" else ".jax_cache",
+)
 
 # The image's site config re-registers the axon TPU platform and overrides a
 # plain JAX_PLATFORMS env var; applying the env var at the jax.config level
@@ -530,7 +537,7 @@ def main() -> int:
             tscale = float(os.environ.get("BENCH_TIMEOUT_SCALE", "1"))
         except ValueError:
             tscale = 1.0
-        if tscale <= 0:
+        if not (0 < tscale < float("inf")):  # rejects NaN/inf/<=0 too
             tscale = 1.0
         workloads = (
             (("2pc", 4, 600.0, "--worker", None),)
