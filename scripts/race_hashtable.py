@@ -35,7 +35,13 @@ def main() -> int:
 
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+    # Backend-split compile cache (same policy as bench.py): .jax_cache
+    # holds TPU entries; XLA:CPU AOT entries are host-specific and live in
+    # .jax_cache_cpu.
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        "/root/repo/.jax_cache_cpu" if args.cpu else "/root/repo/.jax_cache",
+    )
     import jax.numpy as jnp
     import numpy as np
 

@@ -145,8 +145,14 @@ class ShardedSearch:
         batch_size: int = 1024,
         table_log2: int = 18,
         dest_capacity: Optional[int] = None,
+        donate_chunks: bool = False,
     ):
+        """`donate_chunks=True` donates the per-shard carry to each chunked
+        dispatch so XLA updates the sharded tables/queues in place instead
+        of copying them per dispatch (same trade as the resident engine:
+        overflow loses the recovery carry — see ResidentSearch.__init__)."""
         self.model = model
+        self.donate_chunks = donate_chunks
         self.mesh = mesh if mesh is not None else make_mesh()
         (self.axis,) = self.mesh.axis_names
         self.n_chips = self.mesh.devices.size
@@ -579,9 +585,10 @@ class ShardedSearch:
             out_specs=P(ax),
             check_vma=False,
         )
-        # NOTE: deliberately NOT donated — the host keeps the pre-chunk carry
+        # NOTE: NOT donated by default — the host keeps the pre-chunk carry
         # alive so an overflow reverts to the last sound chunk boundary
         # (checkpoint-then-raise instead of discarding the run).
+        # `donate_chunks=True` flips the trade (see __init__).
         chunk_sm = jax.shard_map(
             per_chip_chunk,
             mesh=mesh,
@@ -589,7 +596,12 @@ class ShardedSearch:
             out_specs=(P(ax), P(ax)),
             check_vma=False,
         )
-        return jax.jit(sharded), jax.jit(seed_sm), jax.jit(chunk_sm)
+        chunk_jit = (
+            jax.jit(chunk_sm, donate_argnums=(0,))
+            if self.donate_chunks
+            else jax.jit(chunk_sm)
+        )
+        return jax.jit(sharded), jax.jit(seed_sm), chunk_jit
 
     # -- host entry ------------------------------------------------------------
 
@@ -722,8 +734,19 @@ class ShardedSearch:
                     jnp.int32(budget), jnp.int32(max_steps),
                 )
                 s = _host(summary)  # [N, 10 + 2*max(P,1)] — one transfer
-                if s[:, 7].any():  # overflow on any chip: the carry was kept
-                    # at the last sound chunk boundary for checkpoint+regrow.
+                if s[:, 7].any():  # overflow on any chip
+                    if self.donate_chunks:
+                        self._carry = None  # donated into the dispatch
+                        self._last_tables = None  # a prior run's snapshot
+                        # must not serve paths for states found in this one
+                        raise RuntimeError(
+                            "sharded search overflow; donate_chunks=True "
+                            "sacrificed the recovery carry — rerun with a "
+                            "larger table_log2 (or donate_chunks=False for "
+                            "checkpoint-then-regrow recovery)"
+                        )
+                    # Non-donated: the carry was kept at the last sound
+                    # chunk boundary for checkpoint+regrow.
                     raise RuntimeError(
                         "sharded search overflow; the carry was kept at the "
                         "last chunk boundary — checkpoint(path) then "
@@ -880,6 +903,7 @@ class ShardedSearch:
         mesh: Optional[Mesh] = None,
         batch_size: Optional[int] = None,
         table_log2: Optional[int] = None,
+        donate_chunks: bool = False,
     ) -> "ShardedSearch":
         """Rebuild a suspended sharded search. A larger `table_log2` re-hashes
         every shard's visited set into a bigger per-chip table (the recovery
@@ -899,6 +923,7 @@ class ShardedSearch:
             batch_size=batch_size or meta["batch_size"],
             table_log2=table_log2 or meta["table_log2"],
             dest_capacity=meta["dest_capacity"],
+            donate_chunks=donate_chunks,
         )
         if ss.n_chips != meta["n_chips"]:
             raise ValueError(

@@ -795,6 +795,7 @@ class ResidentSearch:
         path: str,
         batch_size: Optional[int] = None,
         table_log2: Optional[int] = None,
+        donate_chunks: bool = False,
     ) -> "ResidentSearch":
         """Rebuild a suspended search from a `checkpoint` file. Passing a
         larger `table_log2` re-hashes the visited set into the bigger table
@@ -813,6 +814,7 @@ class ResidentSearch:
             model,
             batch_size=batch_size or meta["batch_size"],
             table_log2=log2,
+            donate_chunks=donate_chunks,
         )
         fields = {f: data[f] for f in _Carry._fields}
         if log2 != meta["table_log2"]:

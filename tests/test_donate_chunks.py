@@ -1,0 +1,59 @@
+"""donate_chunks: the chunked-dispatch donation mode (round 4) — XLA updates
+the carry in place instead of copying the multi-GB table/queue state per
+dispatch (~300s/dispatch at table 2^27 on CPU; BENCH_CPU_2PC10_r04.json is
+the at-scale result). Contract under test: identical results to the
+non-donated engine, resumability across run() calls, and the documented
+overflow trade (no recovery carry)."""
+
+import numpy as np
+import pytest
+
+from stateright_tpu.tensor.models import TensorTwoPhaseSys
+from stateright_tpu.tensor.resident import ResidentSearch
+
+
+def test_donated_chunked_run_matches_goldens():
+    rs = ResidentSearch(TensorTwoPhaseSys(4), 256, 13, donate_chunks=True)
+    seen = []
+    r = rs.run(budget=4, progress=lambda sc, uc, md: seen.append(sc))
+    assert (r.state_count, r.unique_state_count) == (8258, 1568)
+    assert r.complete
+    assert set(r.discoveries) == {"abort agreement", "commit agreement"}
+    assert len(seen) > 1  # really ran in multiple donated dispatches
+
+
+def test_donated_run_resumes_across_run_calls():
+    rs = ResidentSearch(TensorTwoPhaseSys(4), 256, 13, donate_chunks=True)
+    r1 = rs.run(budget=3, max_steps=3)
+    assert not r1.complete
+    r2 = rs.run(budget=1 << 20)  # resume the suspended donated carry
+    assert (r2.state_count, r2.unique_state_count) == (8258, 1568)
+    assert r2.complete
+
+
+def test_sharded_donated_chunked_run_matches_goldens():
+    from stateright_tpu.parallel import ShardedSearch, make_mesh
+
+    ss = ShardedSearch(
+        TensorTwoPhaseSys(4),
+        mesh=make_mesh(8),
+        batch_size=128,
+        table_log2=11,
+        donate_chunks=True,
+    )
+    r = ss.run(budget=4)
+    assert (r.state_count, r.unique_state_count) == (8258, 1568)
+    assert r.complete
+    assert sum(r.detail["per_chip_unique"]) == 1568
+
+
+def test_donated_overflow_has_no_recovery_carry():
+    # Table far too small: overflow must raise the donate-specific message
+    # (the non-donated engine instead keeps the pre-chunk carry for
+    # checkpoint-then-regrow; tests/test_checkpoint.py covers that path).
+    rs = ResidentSearch(TensorTwoPhaseSys(5), 256, 7, donate_chunks=True)
+    with pytest.raises(RuntimeError, match="donate_chunks=True"):
+        rs.run(budget=8)
+    assert rs._carry is None
+    with pytest.raises(RuntimeError, match="no table snapshot"):
+        rs.reconstruct_path(1)
