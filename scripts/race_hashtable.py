@@ -26,22 +26,35 @@ def main() -> int:
     ap.add_argument("--repeats", type=int, default=5)
     args = ap.parse_args()
 
-    if args.cpu:
-        import os
+    import os
 
+    cpu_requested = args.cpu or os.environ.get("JAX_PLATFORMS") == "cpu"
+    if cpu_requested:
         os.environ["JAX_PLATFORMS"] = "cpu"
 
     import jax
 
-    if args.cpu:
+    if cpu_requested:
         jax.config.update("jax_platforms", "cpu")
-    # Backend-split compile cache (same policy as bench.py): .jax_cache
-    # holds TPU entries; XLA:CPU AOT entries are host-specific and live in
-    # .jax_cache_cpu.
+    # Backend-split compile cache keyed on the EFFECTIVE backend (same
+    # policy as bench.py): .jax_cache holds TPU entries; XLA:CPU AOT entries
+    # are host-specific and live in .jax_cache_cpu. Keying on the real
+    # device (not the flag) means a silent CPU fallback can't poison the
+    # TPU cache — and is called out so its timings are never mistaken for
+    # a silicon verdict.
+    on_cpu = jax.devices()[0].platform == "cpu"
     jax.config.update(
         "jax_compilation_cache_dir",
-        "/root/repo/.jax_cache_cpu" if args.cpu else "/root/repo/.jax_cache",
+        "/root/repo/.jax_cache_cpu" if on_cpu else "/root/repo/.jax_cache",
     )
+    if on_cpu and not cpu_requested:
+        print(
+            "WARNING: no accelerator reachable — running on the CPU "
+            "backend. These timings are NOT a silicon verdict; do not pick "
+            "an engine default from them.",
+            flush=True,
+        )
+    args.cpu = on_cpu  # interpret-mode Pallas + honest labels below
     import jax.numpy as jnp
     import numpy as np
 

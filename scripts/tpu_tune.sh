@@ -12,5 +12,12 @@ run() {
 run 2pc 4 512 14 2
 run paxos 3 8192 22 3
 run paxos 3 16384 22 3
+run paxos 3 32768 21 3
 run paxos 3 32768 22 3
 run paxos 3 65536 22 2
+
+# Visited-set design race on silicon (VERDICT r3 #5): XLA scatter-max vs the
+# Pallas partitioned-VMEM insert. Parity cross-check built in; the winner
+# becomes the engines' default.
+echo "== race_hashtable =="
+timeout 1200 python scripts/race_hashtable.py

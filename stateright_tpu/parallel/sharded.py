@@ -981,6 +981,11 @@ class ShardedSearch:
     def reconstruct_path(self, fp: int):
         """Union the per-chip parent maps, then reconstruct as usual."""
         if self._parent_map is None:
+            if self._last_tables is None:
+                raise RuntimeError(
+                    "no table snapshot to reconstruct from: run() has not "
+                    "completed since the last reset/donated overflow"
+                )
             t_lo, t_hi, p_lo, p_hi = (
                 x.reshape(-1) for x in self._last_tables
             )

@@ -47,6 +47,23 @@ def test_sharded_donated_chunked_run_matches_goldens():
     assert sum(r.detail["per_chip_unique"]) == 1568
 
 
+def test_sharded_donated_overflow_has_no_recovery_carry():
+    from stateright_tpu.parallel import ShardedSearch, make_mesh
+
+    ss = ShardedSearch(
+        TensorTwoPhaseSys(5),
+        mesh=make_mesh(8),
+        batch_size=128,
+        table_log2=8,  # 256 slots/chip * 8 chips << 8,832 uniques
+        donate_chunks=True,
+    )
+    with pytest.raises(RuntimeError, match="donate_chunks=True"):
+        ss.run(budget=8)
+    assert ss._carry is None
+    with pytest.raises(RuntimeError, match="no table snapshot"):
+        ss.reconstruct_path(1)
+
+
 def test_donated_overflow_has_no_recovery_carry():
     # Table far too small: overflow must raise the donate-specific message
     # (the non-donated engine instead keeps the pre-chunk carry for
