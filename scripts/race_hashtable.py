@@ -28,7 +28,10 @@ def main() -> int:
 
     import os
 
-    cpu_requested = args.cpu or os.environ.get("JAX_PLATFORMS") == "cpu"
+    cpu_requested = args.cpu or "cpu" in [
+        p.strip().lower()
+        for p in os.environ.get("JAX_PLATFORMS", "").split(",")
+    ]
     if cpu_requested:
         os.environ["JAX_PLATFORMS"] = "cpu"
 

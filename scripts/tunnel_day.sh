@@ -1,0 +1,52 @@
+#!/bin/bash
+# The ONE command to run when the axon TPU tunnel finally admits a client
+# (it has refused backend init for rounds 1-4; see ROUND4_NOTES.md).
+# Runs the full staged silicon sequence in the right order, logging each
+# step. Serialize with everything else — the tunnel is single-client: kill
+# probe loops (pkill -f tpu_probe) and any other JAX process first.
+#
+#   bash scripts/tunnel_day.sh [logdir]
+#
+# Sequence:
+#   1. probe     — one trivial jitted op in a fresh subprocess.
+#   2. tune      — scripts/tpu_tune.sh: parity-checked batch/table sweep on
+#                  paxos-3 (+ the XLA-vs-Pallas visited-set race).
+#   3. bench     — python bench.py: all BASELINE workloads with golden
+#                  parity oracles; writes the one-line JSON the driver
+#                  records as BENCH_r{N}.json.
+set -u
+cd "$(dirname "$0")/.." || exit 1
+LOG="${1:-/tmp/tunnel_day}"
+mkdir -p "$LOG"
+
+echo "[tunnel_day] probing..." | tee "$LOG/status"
+if ! timeout 240 python -c "
+import jax
+# Platform check FIRST: a silent CPU fallback must not compile anything
+# into the TPU cache (host-specific XLA:CPU AOT entries poison it for
+# other machines — ROUND4_NOTES.md).
+assert jax.devices()[0].platform != 'cpu', jax.devices()
+jax.config.update('jax_compilation_cache_dir', '/root/repo/.jax_cache')
+import jax.numpy as jnp
+x = jax.jit(lambda a: a * 2 + 1)(jnp.arange(8))
+x.block_until_ready()
+print('PROBE_OK', jax.devices())
+" > "$LOG/probe.log" 2>&1; then
+  echo "[tunnel_day] probe FAILED — tunnel still dead (see $LOG/probe.log)" | tee -a "$LOG/status"
+  exit 1
+fi
+echo "[tunnel_day] probe OK: $(tail -1 "$LOG/probe.log")" | tee -a "$LOG/status"
+
+echo "[tunnel_day] tune sweep + hashtable race..." | tee -a "$LOG/status"
+if bash scripts/tpu_tune.sh > "$LOG/tune.log" 2>&1; then
+  echo "[tunnel_day] tune done (see $LOG/tune.log); best configs go into bench.py _build_workload" | tee -a "$LOG/status"
+else
+  # A non-zero rc includes the hashtable race's PARITY MISMATCH exit —
+  # do NOT crown an engine default from this run.
+  echo "[tunnel_day] tune FAILED (rc!=0 — check $LOG/tune.log before trusting any config or race verdict)" | tee -a "$LOG/status"
+fi
+
+echo "[tunnel_day] full bench..." | tee -a "$LOG/status"
+python bench.py > "$LOG/bench.json" 2> "$LOG/bench.log"
+echo "[tunnel_day] bench JSON:" | tee -a "$LOG/status"
+cat "$LOG/bench.json" | tee -a "$LOG/status"

@@ -701,6 +701,9 @@ class ShardedSearch:
                 discovered, disc_lo, disc_hi, drained, overflow, steps,
             ))
             if bool(overflow.any()):
+                # A previous run's snapshot must not silently serve paths
+                # for states this failed run discovered.
+                self._last_tables = None
                 raise RuntimeError(
                     "sharded search overflow: raise table_log2 or "
                     "dest_capacity (or run with budget=... for a recoverable "
@@ -746,7 +749,16 @@ class ShardedSearch:
                             "checkpoint-then-regrow recovery)"
                         )
                     # Non-donated: the carry was kept at the last sound
-                    # chunk boundary for checkpoint+regrow.
+                    # chunk boundary for checkpoint+regrow. Refresh the
+                    # table snapshot to that boundary so reconstruct_path
+                    # serves THIS run's states (not a stale prior run's).
+                    self._last_tables = _host((
+                        self._carry.t_lo,
+                        self._carry.t_hi,
+                        self._carry.p_lo,
+                        self._carry.p_hi,
+                    ))
+                    self._parent_map = None
                     raise RuntimeError(
                         "sharded search overflow; the carry was kept at the "
                         "last chunk boundary — checkpoint(path) then "
