@@ -34,6 +34,12 @@ def main() -> int:
     ap.add_argument("--process-id", type=int, required=True)
     ap.add_argument("--coordinator", default="127.0.0.1:19735")
     ap.add_argument("--devices-per-process", type=int, default=4)
+    ap.add_argument(
+        "--checkpoint",
+        default=None,
+        help="suspend mid-search, checkpoint to this path (rank 0 writes), "
+        "then resume to completion",
+    )
     args = ap.parse_args()
 
     # Env must be set before jax initializes its backends. Any inherited
@@ -76,7 +82,25 @@ def main() -> int:
         batch_size=256,
         table_log2=12,
     )
-    r = search.run()
+    ckpt_exists = None
+    if args.checkpoint:
+        # Cross-process checkpoint contract: EVERY rank calls checkpoint()
+        # (the carry gather is a collective); only process 0 writes.
+        from jax.experimental import multihost_utils
+
+        from stateright_tpu.tensor.resident import _ckpt_path
+
+        r = search.run(budget=6, max_steps=6)  # suspend mid-search
+        search.checkpoint(args.checkpoint)
+        # Barrier before the existence check: rank 0 returns from
+        # checkpoint() only after writing, other ranks return right after
+        # the collective gather — without the sync their check races the
+        # write.
+        multihost_utils.sync_global_devices("ckpt-written")
+        ckpt_exists = os.path.exists(_ckpt_path(args.checkpoint))
+        r = search.run()  # then finish from the suspended carry
+    else:
+        r = search.run()
     out = {
         "process_id": args.process_id,
         "num_processes": args.num_processes,
@@ -88,6 +112,7 @@ def main() -> int:
         "complete": r.complete,
         "discoveries": sorted(r.discoveries),
         "per_chip_unique": r.detail["per_chip_unique"],
+        "checkpoint_file_exists": ckpt_exists,
     }
     print("MULTIHOST_RESULT " + json.dumps(out), flush=True)
     return 0
