@@ -614,8 +614,12 @@ class ResidentSearch:
             )
             # ONE device->host transfer for the entire result.
             summary = np.asarray(summary)
-            if not summary[7]:  # keep any previous run's tables on overflow
-                self._last_tables = (t_lo, t_hi, p_lo, p_hi)
+            # On overflow the failed run's tables are unsound AND a previous
+            # run's snapshot must not silently serve paths for states this
+            # run discovered — invalidate (matches the sharded engine).
+            self._last_tables = (
+                (t_lo, t_hi, p_lo, p_hi) if not summary[7] else None
+            )
         else:
             if self._carry is None:
                 self._carry = self._seed_k(
@@ -658,7 +662,16 @@ class ResidentSearch:
                         )
                     # Revert to the pre-chunk carry so checkpoint() +
                     # load_checkpoint(table_log2=bigger) can resume exactly
-                    # from the last sound chunk boundary.
+                    # from the last sound chunk boundary — and point the
+                    # reconstruction snapshot at that same boundary so
+                    # paths reflect THIS run, not a stale prior one.
+                    self._last_tables = (
+                        self._carry.t_lo,
+                        self._carry.t_hi,
+                        self._carry.p_lo,
+                        self._carry.p_hi,
+                    )
+                    self._parent_map = None
                     raise RuntimeError(
                         "hash table or queue full; the search carry was kept "
                         "at the last chunk boundary — checkpoint(path) then "

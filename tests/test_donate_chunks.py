@@ -47,6 +47,28 @@ def test_sharded_donated_chunked_run_matches_goldens():
     assert sum(r.detail["per_chip_unique"]) == 1568
 
 
+def test_whole_search_overflow_invalidates_snapshot():
+    # Non-donated whole-search overflow: the failed run's tables are unsound
+    # and any previous snapshot must not serve this run's paths (round-4
+    # alignment of resident with sharded overflow semantics).
+    rs = ResidentSearch(TensorTwoPhaseSys(5), 256, 7)
+    with pytest.raises(RuntimeError, match="hash table full"):
+        rs.run()
+    assert rs._last_tables is None
+    with pytest.raises(RuntimeError, match="no table snapshot"):
+        rs.reconstruct_path(1)
+
+
+def test_chunked_overflow_keeps_boundary_snapshot():
+    # Non-donated chunked overflow: the carry is kept at the last sound
+    # boundary AND the reconstruction snapshot points at that same boundary.
+    rs = ResidentSearch(TensorTwoPhaseSys(5), 256, 7)
+    with pytest.raises(RuntimeError, match="checkpoint"):
+        rs.run(budget=4)
+    assert rs._carry is not None
+    assert rs._last_tables is not None  # the boundary tables, not stale/None
+
+
 def test_sharded_donated_overflow_has_no_recovery_carry():
     from stateright_tpu.parallel import ShardedSearch, make_mesh
 
