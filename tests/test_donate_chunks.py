@@ -47,6 +47,53 @@ def test_sharded_donated_chunked_run_matches_goldens():
     assert sum(r.detail["per_chip_unique"]) == 1568
 
 
+def test_append_variants_agree():
+    """`append_new_dus` (kept for a TPU re-race; ROUND4_NOTES.md decided
+    scatter wins on CPU) must stay semantically identical to `append_new`
+    on the rows that matter: [0, tail) after any append sequence."""
+    import jax.numpy as jnp
+
+    from stateright_tpu.tensor.frontier import append_new, append_new_dus
+
+    rng = np.random.default_rng(3)
+    Q, L, M = 64, 3, 8
+
+    def run(append):
+        qs = jnp.zeros((Q, L), jnp.uint32)
+        ql = jnp.zeros(Q, jnp.uint32)
+        qh = jnp.zeros(Q, jnp.uint32)
+        qe = jnp.zeros(Q, jnp.uint32)
+        qd = jnp.zeros(Q, jnp.uint32)
+        tail = jnp.int32(0)
+        for _ in range(4):
+            flat = jnp.asarray(rng.integers(1, 99, (M, L), dtype=np.uint32))
+            lo = jnp.asarray(rng.integers(1, 99, M, dtype=np.uint32))
+            hi = lo + 1
+            eb = jnp.zeros(M, jnp.uint32)
+            dp = jnp.ones(M, jnp.uint32)
+            new = jnp.asarray(rng.random(M) < 0.5)
+            qs, ql, qh, qe, qd, tail = append(
+                qs, ql, qh, qe, qd, tail, flat, lo, hi, eb, dp, new
+            )
+        t = int(tail)
+        return (
+            np.asarray(qs)[:t],
+            np.asarray(ql)[:t],
+            np.asarray(qh)[:t],
+            np.asarray(qe)[:t],
+            np.asarray(qd)[:t],
+            t,
+        )
+
+    rng = np.random.default_rng(3)
+    a = run(append_new)
+    rng = np.random.default_rng(3)
+    b = run(append_new_dus)
+    assert a[5] == b[5]
+    for x, y in zip(a[:5], b[:5]):
+        assert np.array_equal(x, y)
+
+
 def test_whole_search_overflow_invalidates_snapshot():
     # Non-donated whole-search overflow: the failed run's tables are unsound
     # and any previous snapshot must not serve this run's paths (round-4
