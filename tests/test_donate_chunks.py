@@ -55,10 +55,9 @@ def test_append_variants_agree():
 
     from stateright_tpu.tensor.frontier import append_new, append_new_dus
 
-    rng = np.random.default_rng(3)
     Q, L, M = 64, 3, 8
 
-    def run(append):
+    def run(append, rng):
         qs = jnp.zeros((Q, L), jnp.uint32)
         ql = jnp.zeros(Q, jnp.uint32)
         qh = jnp.zeros(Q, jnp.uint32)
@@ -85,10 +84,8 @@ def test_append_variants_agree():
             t,
         )
 
-    rng = np.random.default_rng(3)
-    a = run(append_new)
-    rng = np.random.default_rng(3)
-    b = run(append_new_dus)
+    a = run(append_new, np.random.default_rng(3))
+    b = run(append_new_dus, np.random.default_rng(3))
     assert a[5] == b[5]
     for x, y in zip(a[:5], b[:5]):
         assert np.array_equal(x, y)
