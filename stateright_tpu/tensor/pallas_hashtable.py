@@ -19,17 +19,32 @@ hardware bets:
   scatter-max phases, no phase-3 arena: a batch duplicate simply hits the
   slot its twin claimed one iteration earlier.
 
+TPU-tiling layout (the round-4 lesson: interpret mode does NOT check Mosaic's
+lowering constraints — the first on-silicon run rejected (1,1)/(1,W) VMEM
+blocks, so every block here is (8,128)-tile-aligned):
+
+- a BUCKET is one full 128-lane VMEM row: tables are viewed as
+  uint32[rows, 128]; a probe loads one row and resolves hit/free with a
+  vector compare + lane-min, a claim writes the row back through a one-hot
+  mask (no sub-row scatter);
+- per-partition key/parent/verdict buffers are (W/128, 128) blocks with W a
+  multiple of 1024, so the sublane dim stays divisible by 8;
+- per-partition routed-key counts ride in SMEM as (1, 1) scalar blocks;
+- the chain-full (overflow) flag is folded into the per-key verdict code
+  (0 = not new, 1 = inserted, 2 = chain full) — no awkward scalar output.
+
 Hash-bit layout (disjoint, so routing cannot skew in-partition occupancy):
-partition id = hi mod P (low bits); in-partition bucket = (hi div P) mod
-(V/8). Compare `tensor/hashtable.py` (global bucket = hi mod n_buckets) and
-the sharded engine's chip owner (lo mod n_chips) — every level keys off
+partition id = hi mod P (low bits); in-partition bucket row = (hi div P) mod
+(V/128). Compare `tensor/hashtable.py` (global bucket = hi mod n_buckets)
+and the sharded engine's chip owner (lo mod n_chips) — every level keys off
 independent fingerprint bits.
 
-Capacity contract: a partition receiving more than W = route_factor *
-ceil(B/P) keys this batch spills the excess — spilled lanes are reported
-(`spilled` mask, never silently dropped) and the caller retries them (the
-engines re-offer unfinished lanes the same way on table overflow). With
-uniform fingerprints P(spill) is negligible for route_factor >= 4.
+Capacity contract: a partition receiving more than W keys this batch spills
+the excess — spilled lanes are reported (`spilled` mask, never silently
+dropped) and the caller retries them (the engines re-offer unfinished lanes
+the same way on table overflow). W = route_factor * ceil(B/P) rounded up to
+a multiple of 1024 (rounding only reduces spill probability). With uniform
+fingerprints P(spill) is negligible for route_factor >= 4.
 
 Parity contract (tests/test_pallas_hashtable.py): for any batch sequence the
 SET of stored fingerprints and the per-call `is_new` attributions match
@@ -37,9 +52,10 @@ SET of stored fingerprints and the per-call `is_new` attributions match
 offered for it by the call that inserted it (when one batch offers the same
 key with different parents, WHICH lane wins differs between the designs —
 the same insert race the reference tolerates in its DashMap,
-ref: src/checker/bfs.rs:243). Slot LAYOUTS differ by design (bucket chains wrap within a partition here, globally there) — both
-tables are only read through their own probe scheme and through `dump()`
-(an order-free dict), so nothing downstream can observe the layout.
+ref: src/checker/bfs.rs:243). Slot LAYOUTS differ by design (bucket chains
+wrap within a partition here, globally there) — both tables are only read
+through their own probe scheme and through `dump()` (an order-free dict), so
+nothing downstream can observe the layout.
 """
 
 from __future__ import annotations
@@ -52,7 +68,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-BUCKET = 8
+LANES = 128  # bucket width: one VMEM row
+ROW_ALIGN = 1024  # 8 sublanes x 128 lanes — min tile-aligned 1D granularity
 
 
 class PallasInsertResult(NamedTuple):
@@ -66,80 +83,105 @@ class PallasInsertResult(NamedTuple):
 
 
 def _make_kernel(V: int, W: int, P: int):
-    """Kernel over one partition: serial probe/claim in VMEM."""
+    """Kernel over one partition: serial probe/claim of VMEM bucket rows."""
     from jax.experimental import pallas as pl
 
-    n_buckets = V // BUCKET
+    n_buckets = V // LANES  # bucket rows per partition
 
     def kernel(
-        count_ref,  # int32[1, 1]   keys routed to this partition
-        tl_ref,  # uint32[V]
+        count_ref,  # int32[1, 1] in SMEM — keys routed to this partition
+        tl_ref,  # uint32[V/128, 128] table partition (aliased with *_out)
         th_ref,
         pl_ref,
         ph_ref,
-        klo_ref,  # uint32[1, W]
+        klo_ref,  # uint32[W/128, 128] routed keys
         khi_ref,
         plo_ref,
         phi_ref,
-        tl_out,  # uint32[V]
+        tl_out,  # uint32[V/128, 128]
         th_out,
         pl_out,
         ph_out,
-        new_ref,  # int32[1, W]
-        ovf_ref,  # int32[1, 1]
+        new_ref,  # int32[W/128, 128] — 0 dup / 1 inserted / 2 chain full
     ):
         tl_out[...] = tl_ref[...]
         th_out[...] = th_ref[...]
         pl_out[...] = pl_ref[...]
         ph_out[...] = ph_ref[...]
         new_ref[...] = jnp.zeros_like(new_ref)
-        ovf_ref[0, 0] = 0
+
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+        miss = jnp.int32(LANES)  # lane-min sentinel: "no lane matched"
 
         def per_key(i, _):
-            lo = klo_ref[0, i]
-            hi = khi_ref[0, i]
+            r, c = i // LANES, i % LANES
+            lo = klo_ref[r, c]
+            hi = khi_ref[r, c]
             b0 = ((hi // jnp.uint32(P)) % jnp.uint32(n_buckets)).astype(
                 jnp.int32
             )
 
             def cond(carry):
-                off, done, _slot, _new = carry
+                off, done, _row, _col, _new = carry
                 return (~done) & (off < n_buckets)
 
             def probe(carry):
-                off, done, slot, found_new = carry
+                off, done, row, col, _found_new = carry
                 b = (b0 + off) % n_buckets
-                base = b * BUCKET
-                rows_lo = tl_out[pl.ds(base, BUCKET)]
-                rows_hi = th_out[pl.ds(base, BUCKET)]
-                hit_j = (rows_lo == lo) & (rows_hi == hi)
-                hit = jnp.any(hit_j)
-                free_j = rows_lo == 0
-                has_free = jnp.any(free_j)
-                j_hit = jnp.argmax(hit_j).astype(jnp.int32)
-                j_free = jnp.argmax(free_j).astype(jnp.int32)
-                slot = jnp.where(
-                    hit,
-                    base + j_hit,
-                    jnp.where(has_free, base + j_free, slot),
+                rows_lo = tl_out[pl.ds(b, 1), :]
+                rows_hi = th_out[pl.ds(b, 1), :]
+                hit_m = (rows_lo == lo) & (rows_hi == hi)
+                free_m = rows_lo == jnp.uint32(0)
+                col_hit = jnp.min(jnp.where(hit_m, lane, miss))
+                col_free = jnp.min(jnp.where(free_m, lane, miss))
+                hit = col_hit < miss
+                has_free = col_free < miss
+                row = jnp.where(hit | has_free, b, row)
+                col = jnp.where(
+                    hit, col_hit, jnp.where(has_free, col_free, col)
                 )
-                return off + 1, hit | has_free, slot, (~hit) & has_free
+                return off + 1, hit | has_free, row, col, (~hit) & has_free
 
-            _off, done, slot, found_new = jax.lax.while_loop(
-                cond, probe, (jnp.int32(0), False, jnp.int32(0), False)
+            _off, done, row, col, found_new = jax.lax.while_loop(
+                cond,
+                probe,
+                (
+                    jnp.int32(0),
+                    jnp.bool_(False),
+                    jnp.int32(0),
+                    jnp.int32(0),
+                    jnp.bool_(False),
+                ),
             )
 
             @pl.when(found_new)
             def _claim():
-                tl_out[slot] = lo
-                th_out[slot] = hi
-                pl_out[slot] = plo_ref[0, i]
-                ph_out[slot] = phi_ref[0, i]
-                new_ref[0, i] = 1
+                onehot = lane == col
+                tl_out[pl.ds(row, 1), :] = jnp.where(
+                    onehot, lo, tl_out[pl.ds(row, 1), :]
+                )
+                th_out[pl.ds(row, 1), :] = jnp.where(
+                    onehot, hi, th_out[pl.ds(row, 1), :]
+                )
+                pl_out[pl.ds(row, 1), :] = jnp.where(
+                    onehot, plo_ref[r, c], pl_out[pl.ds(row, 1), :]
+                )
+                ph_out[pl.ds(row, 1), :] = jnp.where(
+                    onehot, phi_ref[r, c], ph_out[pl.ds(row, 1), :]
+                )
 
-            @pl.when(~done)
-            def _chain_full():
-                ovf_ref[0, 0] = 1
+            # Verdict writes go through the same one-hot masked row write as
+            # the table claims — no dynamic sub-row scalar stores.
+            verdict = jnp.where(
+                found_new, jnp.int32(1), jnp.where(~done, jnp.int32(2), 0)
+            )
+
+            @pl.when(verdict > 0)
+            def _record():
+                key_hot = lane == c
+                new_ref[pl.ds(r, 1), :] = jnp.where(
+                    key_hot, verdict, new_ref[pl.ds(r, 1), :]
+                )
 
             return 0
 
@@ -172,20 +214,22 @@ def pallas_insert(
 
     XLA routing pre-pass: one stable sort of the batch by partition id plus
     a searchsorted yields contiguous per-partition segments; each segment's
-    first W lanes are scatter-packed into dense [P, W] buffers (W =
-    route_factor * ceil(B/P)); the rest spill (see module docstring).
+    first W lanes are scatter-packed into dense per-partition rows (W as in
+    the module docstring); the rest spill and are retried by the caller.
     """
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     S = t_lo.shape[0]
     B = lo.shape[0]
     P = n_partitions
-    if S % (P * BUCKET):
+    if S % (P * ROW_ALIGN):
         raise ValueError(
-            f"table size {S} must split into {P} BUCKET-aligned partitions"
+            f"table size {S} must split into {P} partitions of a multiple "
+            f"of {ROW_ALIGN} slots (TPU tile alignment)"
         )
     V = S // P
-    W = route_factor * -(-B // P)
+    W = -(-(route_factor * -(-B // P)) // ROW_ALIGN) * ROW_ALIGN
 
     pid = jnp.where(active, (hi % jnp.uint32(P)).astype(jnp.int32), P)
     order = jnp.argsort(pid, stable=True)  # lane ids grouped by pid
@@ -207,53 +251,60 @@ def pallas_insert(
             jnp.zeros((P * W,), x.dtype)
             .at[flat_pos]
             .set(x[order], mode="drop")
-            .reshape(P, W)
+            .reshape(P * W // LANES, LANES)
         )
 
     klo, khi, plo, phi = map(route, (lo, hi, parent_lo, parent_hi))
 
-    part = pl.BlockSpec((V,), lambda p: (p,))
-    row = pl.BlockSpec((1, W), lambda p: (p, 0))
-    one = pl.BlockSpec((1, 1), lambda p: (p, 0))
+    part = pl.BlockSpec((V // LANES, LANES), lambda p: (p, 0))
+    row = pl.BlockSpec((W // LANES, LANES), lambda p: (p, 0))
+    smem_one = pl.BlockSpec(
+        (1, 1), lambda p: (p, 0), memory_space=pltpu.SMEM
+    )
 
-    tl, th, pll, phh, new_rows, ovf = pl.pallas_call(
+    def as_rows(x):
+        return x.reshape(S // LANES, LANES)
+
+    tl, th, pll, phh, new_rows = pl.pallas_call(
         _make_kernel(V, W, P),
         grid=(P,),
-        in_specs=[one, part, part, part, part, row, row, row, row],
-        out_specs=[part, part, part, part, row, one],
+        in_specs=[smem_one, part, part, part, part, row, row, row, row],
+        out_specs=[part, part, part, part, row],
         out_shape=[
-            jax.ShapeDtypeStruct((S,), jnp.uint32),
-            jax.ShapeDtypeStruct((S,), jnp.uint32),
-            jax.ShapeDtypeStruct((S,), jnp.uint32),
-            jax.ShapeDtypeStruct((S,), jnp.uint32),
-            jax.ShapeDtypeStruct((P, W), jnp.int32),
-            jax.ShapeDtypeStruct((P, 1), jnp.int32),
+            jax.ShapeDtypeStruct((S // LANES, LANES), jnp.uint32),
+            jax.ShapeDtypeStruct((S // LANES, LANES), jnp.uint32),
+            jax.ShapeDtypeStruct((S // LANES, LANES), jnp.uint32),
+            jax.ShapeDtypeStruct((S // LANES, LANES), jnp.uint32),
+            jax.ShapeDtypeStruct((P * W // LANES, LANES), jnp.int32),
         ],
+        input_output_aliases={1: 0, 2: 1, 3: 2, 4: 3},
         interpret=interpret,
     )(
         counts.reshape(P, 1),
-        t_lo,
-        t_hi,
-        p_lo,
-        p_hi,
+        as_rows(t_lo),
+        as_rows(t_hi),
+        as_rows(p_lo),
+        as_rows(p_hi),
         klo,
         khi,
         plo,
         phi,
     )
 
-    # Un-route is_new back to lane order: sorted lane k's verdict sits at
+    # Un-route verdicts back to lane order: sorted lane k's verdict sits at
     # flat_pos[k]; invert the sort with one scatter.
-    gathered = (
-        new_rows.reshape(-1)
-        .at[flat_pos]
-        .get(mode="fill", fill_value=0)
-        .astype(bool)
-    )
-    is_new = jnp.zeros(B, bool).at[order].set(gathered)
+    verdicts = new_rows.reshape(-1)
+    gathered = verdicts.at[flat_pos].get(mode="fill", fill_value=0)
+    is_new = jnp.zeros(B, bool).at[order].set(gathered == 1)
     spilled = jnp.zeros(B, bool).at[order].set(active[order] & ~in_row)
     return PallasInsertResult(
-        tl, th, pll, phh, is_new, spilled, ovf.astype(bool).any()
+        tl.reshape(S),
+        th.reshape(S),
+        pll.reshape(S),
+        phh.reshape(S),
+        is_new,
+        spilled,
+        jnp.any(verdicts == 2),
     )
 
 
@@ -273,8 +324,11 @@ class PallasHashTable:
         self.size = 1 << log2_size
         self.n_partitions = n_partitions
         self.interpret = interpret
-        if self.size % (n_partitions * BUCKET):
-            raise ValueError("table too small for the partition count")
+        if self.size % (n_partitions * ROW_ALIGN):
+            raise ValueError(
+                "table too small for the partition count: need size % "
+                f"(n_partitions * {ROW_ALIGN}) == 0"
+            )
         self.t_lo = jnp.zeros(self.size, dtype=jnp.uint32)
         self.t_hi = jnp.zeros(self.size, dtype=jnp.uint32)
         self.p_lo = jnp.zeros(self.size, dtype=jnp.uint32)

@@ -38,8 +38,8 @@ def test_insert_parity_random_batches(pool_size):
     from stateright_tpu.tensor.fingerprint import pack_fp
 
     rng = np.random.default_rng(7)
-    xla = HashTable(12)
-    pls = PallasHashTable(12, n_partitions=8, interpret=True)
+    xla = HashTable(13)
+    pls = PallasHashTable(13, n_partitions=8, interpret=True)
     offered = {}  # key -> set of parents offered by the call that won it
     for lo, hi, plo, phi, active in _batches(rng, 4, 256, pool_size):
         rx = xla.insert(lo, hi, plo, phi, active)
@@ -82,7 +82,7 @@ def test_duplicates_across_calls_are_not_new():
     hi = jnp.asarray([1, 1, 2], dtype=jnp.uint32)
     par = jnp.asarray([11, 12, 13], dtype=jnp.uint32)
     act = jnp.ones(3, bool)
-    t = PallasHashTable(9, n_partitions=4, interpret=True)
+    t = PallasHashTable(12, n_partitions=4, interpret=True)
     r1 = t.insert(lo, hi, par, par, act)
     # exactly one is_new for the duplicated key, one for the distinct key
     assert int(np.asarray(r1.is_new).sum()) == 2
@@ -95,7 +95,7 @@ def test_inactive_lanes_ignored():
     lo = jnp.asarray([5, 6], dtype=jnp.uint32)
     hi = jnp.asarray([1, 1], dtype=jnp.uint32)
     par = jnp.asarray([1, 1], dtype=jnp.uint32)
-    t = PallasHashTable(9, n_partitions=4, interpret=True)
+    t = PallasHashTable(12, n_partitions=4, interpret=True)
     r = t.insert(lo, hi, par, par, jnp.asarray([True, False]))
     assert np.asarray(r.is_new).tolist() == [True, False]
     assert len(t.dump()) == 1
