@@ -8,28 +8,34 @@ pairs), values are parent fingerprints for path reconstruction.
 
 TPU-shaped design: random HBM access is the enemy (a probe loop touching one
 slot at a time serializes; it measured ~270 ms per 128k-insert batch on a
-v5e). So slots are grouped into BUCKETS of 8 contiguous u32s — one gather
-fetches a whole 32-byte bucket row — and a round inspects 8 slots at once:
+v5e). So slots are grouped into BUCKETS of 128 contiguous u32s — one row
+gather fetches a whole 512-byte bucket — and a round inspects 128 slots at
+once. The bucket width IS the TPU lane count on purpose: a (S/128, 128)
+view of the flat table is layout-identical under T(8,128) tiling, so the
+per-round reshape inside the probe loop is a free bitcast. (The previous
+8-wide bucket view was tile-padded 16x and MATERIALIZED every probe round —
+an 8 GB HLO temp at table 2^27 that OOMed 2pc-10 on a 16 GB v5e; and a
+flat 2D-index gather of 8-slot rows measured 1.3-1.8x slower than the row
+gather it replaced.) A 128-slot bucket also makes chain overflow to the
+next bucket vanishingly rare at any sane load factor:
 
-1. gather the bucket rows for all still-unresolved keys,
-2. hit if any slot matches (lo, hi),
-3. otherwise claim the first free slot (lo == 0) in phased scatter-max
-   steps: propose `lo` (slot winner = max proposal), lo-winners propose `hi`
-   (tie-break among equal-lo distinct keys), then (lo, hi)-winners race their
-   lane index in a scratch arena so exactly ONE of several identical
-   fingerprints in the same batch wins `is_new`. Losers of phases 1-2 retry
-   next round; identical-fingerprint losers of phase 3 resolve as duplicates;
+1. sort the batch by (bucket, key) — duplicates become adjacent (one REP
+   lane per distinct key; the rest resolve immediately), same-bucket
+   claimants become contiguous,
+2. gather each lane's bucket row; a rep hits if any slot matches (lo, hi),
+3. otherwise reps claim DISTINCT free slots — the rank-th same-bucket rep
+   takes the rank-th free lane (ranks from prefix sums over the sorted
+   order) — so every table write is a race-free unique_indices scatter;
    full buckets overflow to the next bucket, wrapping modulo the table.
 
-Safety argument for the phased claim: a committed slot always has lo != 0, so
-later rounds/calls never scatter into it (free-slot claims only); within a
-round all proposals land in one scatter-max, so rivals are serialized by the
-max semantics, and losers observe a mismatched readback and retry. Claimed
-slots are never emptied, so linear bucket probing stays correct.
-
-Unlike the round-1 design, batches may contain duplicate fingerprints: the
-phase-3 arena attributes exactly one `is_new` per distinct new key (the
-engines no longer pre-sort batches — sorting 64-bit keys was a per-step tax).
+Safety argument: claim targets are unique by construction (distinct
+(bucket, rank) pairs), a committed slot always has lo != 0 and is never
+emptied, so linear bucket probing and first-non-full-bucket membership stay
+correct across rounds and calls. Batches may contain duplicate
+fingerprints: rep selection attributes exactly one `is_new` per distinct
+new key. See `_insert_impl` for why this sort-claim form replaced the
+round-1..3 phased scatter-max claim (silicon profile: ~3.9 serialized
+rounds per step and sort-based non-unique scatter lowering).
 """
 
 from __future__ import annotations
@@ -42,7 +48,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-BUCKET = 8
+BUCKET = 128
 MAX_ROUNDS = 64
 
 
@@ -61,8 +67,6 @@ class HashTable:
     def __init__(self, log2_size: int):
         self.log2_size = log2_size
         self.size = 1 << log2_size
-        if self.size < BUCKET:
-            raise ValueError(f"table must have at least {BUCKET} slots")
         self.t_lo = jnp.zeros(self.size, dtype=jnp.uint32)
         self.t_hi = jnp.zeros(self.size, dtype=jnp.uint32)
         self.p_lo = jnp.zeros(self.size, dtype=jnp.uint32)
@@ -91,78 +95,115 @@ class HashTable:
 def _insert_impl(t_lo, t_hi, p_lo, p_hi, lo, hi, parent_lo, parent_hi, active):
     """Batched insert-if-absent. Returns InsertResult; see module docstring.
 
-    The phase-3 arena reuses `p_lo` as scratch: a freshly claimed slot's
-    parent entry is still zero (parents are only written at the end, to slots
-    whose claim succeeded), so claimants race `lane_index + 1` there with
-    scatter-max and exactly one survives; the real parent value overwrites the
-    arena residue immediately after the loop.
+    Sort-claim design (round-4 silicon profile: the previous phased
+    scatter-max claim averaged ~3.9 probe rounds per engine step — colliding
+    keys raced for the SAME free slot and serialized round by round — and
+    its non-unique scatters lowered to sort-based HLO; together the insert
+    was 54% of the paxos-3 step). Here every round is race-free:
+
+    1. sort lanes by (target bucket, hi, lo) — one lax.sort; identical keys
+       become adjacent (first pending lane of a run is the REP; the rest
+       resolve as duplicates immediately), same-bucket reps are contiguous;
+    2. gather each lane's bucket row; reps hit if their key is present;
+    3. reps needing a slot get a per-bucket RANK (prefix sums over the
+       sorted order) and claim the rank-th free lane of their bucket row —
+       distinct (bucket, rank) pairs make all claim targets UNIQUE, so all
+       four table components are written with single unique_indices
+       scatters: no phases, no readbacks, no arena, and exactly one is_new
+       per distinct new key by construction;
+    4. only reps whose bucket ran out of free lanes carry to the next round
+       (off+1 — chain overflow), so the expected round count is ~1.
+
+    Resolved/inactive lanes sort to a sentinel bucket past the end, which
+    also keeps a key run's rep well-defined when some of its lanes are
+    inactive. Claimed slots are never emptied, so linear bucket probing and
+    the membership argument (a key absent from the first non-full bucket of
+    its chain is absent) stay correct.
     """
     size = t_lo.shape[0]
-    n_buckets = size // BUCKET
-    bmask = jnp.uint32(n_buckets - 1)
-    b0 = hi & bmask
-    lane_ix = jnp.arange(lo.shape[0], dtype=jnp.uint32) + jnp.uint32(1)
+    bucket = min(BUCKET, size)  # tiny tables (tests) shrink to one bucket
+    n_buckets = size // bucket
+    B = lo.shape[0]
+    bmask = jnp.int32(n_buckets - 1)
+    b0 = (hi & jnp.uint32(n_buckets - 1)).astype(jnp.int32)
+    idx = jnp.arange(B, dtype=jnp.int32)
 
     def cond(carry):
-        (_tl, _th, _pl, done, _new, _slot, _off, rounds) = carry
-        return (~jnp.all(done)) & (rounds < MAX_ROUNDS)
+        (_tl, _th, _pl, _ph, pending, _new, _off, rounds) = carry
+        return jnp.any(pending) & (rounds < MAX_ROUNDS)
 
     def body(carry):
-        t_lo, t_hi, p_lo, done, is_new, slot, off, rounds = carry
-        b = ((b0 + off) & bmask).astype(jnp.int32)
-        rows_lo = t_lo.reshape(n_buckets, BUCKET)[b]  # [B, 8] one 32B gather
-        rows_hi = t_hi.reshape(n_buckets, BUCKET)[b]
-        hit_j = (rows_lo == lo[:, None]) & (rows_hi == hi[:, None])
-        hit = (~done) & jnp.any(hit_j, axis=1)
-        hit_slot = b * BUCKET + jnp.argmax(hit_j, axis=1).astype(jnp.int32)
-
-        free = rows_lo == 0
-        has_free = jnp.any(free, axis=1)
-        cand = b * BUCKET + jnp.argmax(free, axis=1).astype(jnp.int32)
-        attempt = (~done) & (~hit) & has_free
-
-        # Phase 1: claim the slot's lo by scatter-max (winner = max lo).
-        tgt = jnp.where(attempt, cand, size)
-        t_lo = t_lo.at[tgt].max(jnp.where(attempt, lo, 0), mode="drop")
-        got_lo = attempt & (t_lo.at[cand].get(mode="fill", fill_value=0) == lo)
-        # Phase 2: lo-winners tie-break on hi (equal-lo distinct keys).
-        tgt = jnp.where(got_lo, cand, size)
-        t_hi = t_hi.at[tgt].max(jnp.where(got_lo, hi, 0), mode="drop")
-        claimed = got_lo & (
-            t_hi.at[cand].get(mode="fill", fill_value=0) == hi
+        t_lo, t_hi, p_lo, p_hi, pending, is_new, off, rounds = carry
+        b = (b0 + off) & bmask
+        bkey = jnp.where(pending, b, jnp.int32(n_buckets))
+        sb, s_hi, s_lo, perm = jax.lax.sort(
+            (bkey, hi, lo, idx), num_keys=3
         )
-        # Phase 3: identical fingerprints all pass phase 2 together; race the
-        # lane index in the arena so exactly one wins is_new.
-        tgt = jnp.where(claimed, cand, size)
-        p_lo = p_lo.at[tgt].max(jnp.where(claimed, lane_ix, 0), mode="drop")
-        winner = claimed & (
-            p_lo.at[cand].get(mode="fill", fill_value=0) == lane_ix
+        spending = sb < jnp.int32(n_buckets)
+
+        same_prev = (
+            (sb == jnp.roll(sb, 1))
+            & (s_hi == jnp.roll(s_hi, 1))
+            & (s_lo == jnp.roll(s_lo, 1))
+        ).at[0].set(False)
+        rep = spending & ~same_prev
+
+        rows_ix = jnp.minimum(sb, jnp.int32(n_buckets - 1))
+        rows_lo = t_lo.reshape(n_buckets, bucket)[rows_ix]  # free bitcast view
+        rows_hi = t_hi.reshape(n_buckets, bucket)[rows_ix]
+        hit = rep & jnp.any(
+            (rows_lo == s_lo[:, None]) & (rows_hi == s_hi[:, None]), axis=1
+        )
+        need = rep & ~hit
+
+        # Per-bucket rank of `need` lanes: exclusive prefix count within the
+        # sorted bucket segment (segment base carried forward by cummax —
+        # the exclusive prefix is non-decreasing, and lane 0 always starts a
+        # segment, so the -1 filler never wins).
+        seg_start = (sb != jnp.roll(sb, 1)).at[0].set(True)
+        excl = jnp.cumsum(need.astype(jnp.int32)) - need.astype(jnp.int32)
+        seg_base = jax.lax.cummax(jnp.where(seg_start, excl, jnp.int32(-1)))
+        rank = excl - seg_base
+
+        free_m = rows_lo == 0
+        fcum = jnp.cumsum(free_m.astype(jnp.int32), axis=1)
+        pick = free_m & (fcum == (rank + 1)[:, None])  # rank-th free lane
+        can_claim = need & jnp.any(pick, axis=1)
+        slot = rows_ix * bucket + jnp.argmax(pick, axis=1).astype(jnp.int32)
+
+        tgt = jnp.where(can_claim, slot, size)
+        t_lo = t_lo.at[tgt].set(s_lo, mode="drop", unique_indices=True)
+        t_hi = t_hi.at[tgt].set(s_hi, mode="drop", unique_indices=True)
+        p_lo = p_lo.at[tgt].set(
+            parent_lo[perm], mode="drop", unique_indices=True
+        )
+        p_hi = p_hi.at[tgt].set(
+            parent_hi[perm], mode="drop", unique_indices=True
         )
 
-        slot = jnp.where(hit | claimed, jnp.where(hit, hit_slot, cand), slot)
-        is_new = is_new | winner
-        newly_done = hit | claimed
-        # Full bucket (no free slot, no hit): overflow to the next bucket.
-        off = jnp.where((~done) & (~newly_done) & (~has_free), off + 1, off)
-        return (
-            t_lo, t_hi, p_lo, done | newly_done, is_new, slot, off, rounds + 1
+        # Unsort through the permutation (a bijection: plain unique scatters).
+        carry_on = need & ~can_claim  # bucket full -> probe the next one
+        is_new = jnp.zeros_like(is_new).at[perm].set(
+            is_new[perm] | can_claim, unique_indices=True
         )
+        pending = jnp.zeros_like(pending).at[perm].set(
+            carry_on, unique_indices=True
+        )
+        off = jnp.zeros_like(off).at[perm].set(
+            off[perm] + carry_on.astype(jnp.int32), unique_indices=True
+        )
+        return t_lo, t_hi, p_lo, p_hi, pending, is_new, off, rounds + 1
 
-    done0 = ~active
     zeros_i = jnp.zeros_like(lo, dtype=jnp.int32)
-    t_lo, t_hi, p_lo, done, is_new, slot, _off, _rounds = jax.lax.while_loop(
-        cond,
-        body,
-        (t_lo, t_hi, p_lo, done0, jnp.zeros_like(active), zeros_i, zeros_i,
-         jnp.int32(0)),
+    t_lo, t_hi, p_lo, p_hi, pending, is_new, _off, _rounds = (
+        jax.lax.while_loop(
+            cond,
+            body,
+            (t_lo, t_hi, p_lo, p_hi, active, jnp.zeros_like(active),
+             zeros_i, jnp.int32(0)),
+        )
     )
-
-    # Parents: one scatter per component, winning lanes only (unique slots),
-    # overwriting any phase-3 arena residue in p_lo.
-    ptgt = jnp.where(is_new, slot, size)
-    p_lo = p_lo.at[ptgt].set(parent_lo, mode="drop")
-    p_hi = p_hi.at[ptgt].set(parent_hi, mode="drop")
-    return InsertResult(t_lo, t_hi, p_lo, p_hi, is_new, ~jnp.all(done))
+    return InsertResult(t_lo, t_hi, p_lo, p_hi, is_new, jnp.any(pending))
 
 
 _insert = partial(jax.jit, donate_argnums=(0, 1, 2, 3))(_insert_impl)

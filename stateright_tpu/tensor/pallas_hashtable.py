@@ -7,17 +7,19 @@ This is the measured alternative to the pure-XLA scatter-max insert in
 question — batched insert-if-absent of 64-bit fingerprints — with opposite
 hardware bets:
 
-- XLA design: keep the batch parallel; resolve claim races with phased
-  scatter-max over the whole table in HBM. Every probe round re-gathers and
-  re-scatters the full still-unresolved batch (HBM-latency bound).
+- XLA design: keep the batch parallel; sort lanes by (bucket, key) so
+  duplicates and same-bucket claimants are adjacent, then claim distinct
+  free slots with race-free unique-indices scatters (see
+  `tensor/hashtable.py` — its original phased scatter-max claim lost the
+  round-4 silicon race and was replaced by the sort-claim form).
 - Pallas design (here): make the table RANDOM-ACCESS-CHEAP instead. The
   table is split into partitions sized to fit VMEM; one XLA sort routes each
   key to its partition; the kernel then pulls a whole partition into VMEM,
   probes/claims ALL its keys serially on the scalar core (VMEM random access
   is ~register-speed next to HBM), and writes the partition back.
-  Serialization within a partition makes insert-if-absent EXACT — no
-  scatter-max phases, no phase-3 arena: a batch duplicate simply hits the
-  slot its twin claimed one iteration earlier.
+  Serialization within a partition makes insert-if-absent EXACT with no
+  claim races at all: a batch duplicate simply hits the slot its twin
+  claimed one iteration earlier.
 
 TPU-tiling layout (the round-4 lesson: interpret mode does NOT check Mosaic's
 lowering constraints — the first on-silicon run rejected (1,1)/(1,W) VMEM
@@ -29,7 +31,9 @@ blocks, so every block here is (8,128)-tile-aligned):
   mask (no sub-row scatter);
 - per-partition key/parent/verdict buffers are (W/128, 128) blocks with W a
   multiple of 1024, so the sublane dim stays divisible by 8;
-- per-partition routed-key counts ride in SMEM as (1, 1) scalar blocks;
+- per-partition routed-key counts ride in SMEM as one whole-array (P, 1)
+  ref indexed by program_id (Mosaic's block validator rejects blocked
+  (1, 1) SMEM specs too);
 - the chain-full (overflow) flag is folded into the per-key verdict code
   (0 = not new, 1 = inserted, 2 = chain full) — no awkward scalar output.
 
@@ -89,7 +93,7 @@ def _make_kernel(V: int, W: int, P: int):
     n_buckets = V // LANES  # bucket rows per partition
 
     def kernel(
-        count_ref,  # int32[1, 1] in SMEM — keys routed to this partition
+        count_ref,  # int32[P, 1] whole array in SMEM (indexed by program_id)
         tl_ref,  # uint32[V/128, 128] table partition (aliased with *_out)
         th_ref,
         pl_ref,
@@ -113,10 +117,22 @@ def _make_kernel(V: int, W: int, P: int):
         lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
         miss = jnp.int32(LANES)  # lane-min sentinel: "no lane matched"
 
+        def lane_pick(sel, row_u32):
+            """Extract the single sel-lane of a (1,128) uint32 row as a
+            scalar. Mosaic has no unsigned reductions, so sum the one-hot
+            masked row as int32 (bit-exact: one nonzero lane) and bitcast
+            back."""
+            picked = jnp.where(sel, row_u32.astype(jnp.int32), 0)
+            return jnp.sum(picked).astype(jnp.uint32)
+
         def per_key(i, _):
+            # Mosaic forbids dynamic sub-row scalar access to VMEM (loads
+            # AND stores must be lane-aligned): read the key by loading its
+            # whole 128-lane row and reducing through a one-hot mask.
             r, c = i // LANES, i % LANES
-            lo = klo_ref[r, c]
-            hi = khi_ref[r, c]
+            sel = lane == c
+            lo = lane_pick(sel, klo_ref[pl.ds(r, 1), :])
+            hi = lane_pick(sel, khi_ref[pl.ds(r, 1), :])
             b0 = ((hi // jnp.uint32(P)) % jnp.uint32(n_buckets)).astype(
                 jnp.int32
             )
@@ -163,11 +179,13 @@ def _make_kernel(V: int, W: int, P: int):
                 th_out[pl.ds(row, 1), :] = jnp.where(
                     onehot, hi, th_out[pl.ds(row, 1), :]
                 )
+                p_lo_v = lane_pick(sel, plo_ref[pl.ds(r, 1), :])
+                p_hi_v = lane_pick(sel, phi_ref[pl.ds(r, 1), :])
                 pl_out[pl.ds(row, 1), :] = jnp.where(
-                    onehot, plo_ref[r, c], pl_out[pl.ds(row, 1), :]
+                    onehot, p_lo_v, pl_out[pl.ds(row, 1), :]
                 )
                 ph_out[pl.ds(row, 1), :] = jnp.where(
-                    onehot, phi_ref[r, c], ph_out[pl.ds(row, 1), :]
+                    onehot, p_hi_v, ph_out[pl.ds(row, 1), :]
                 )
 
             # Verdict writes go through the same one-hot masked row write as
@@ -178,14 +196,13 @@ def _make_kernel(V: int, W: int, P: int):
 
             @pl.when(verdict > 0)
             def _record():
-                key_hot = lane == c
                 new_ref[pl.ds(r, 1), :] = jnp.where(
-                    key_hot, verdict, new_ref[pl.ds(r, 1), :]
+                    sel, verdict, new_ref[pl.ds(r, 1), :]
                 )
 
             return 0
 
-        jax.lax.fori_loop(0, count_ref[0, 0], per_key, 0)
+        jax.lax.fori_loop(0, count_ref[pl.program_id(0), 0], per_key, 0)
 
     return kernel
 
@@ -258,9 +275,10 @@ def pallas_insert(
 
     part = pl.BlockSpec((V // LANES, LANES), lambda p: (p, 0))
     row = pl.BlockSpec((W // LANES, LANES), lambda p: (p, 0))
-    smem_one = pl.BlockSpec(
-        (1, 1), lambda p: (p, 0), memory_space=pltpu.SMEM
-    )
+    # Whole-array SMEM ref (this jax's Mosaic validator applies the
+    # (8,128) block rule even to blocked SMEM specs, so no (1,1) blocks);
+    # the kernel indexes it with program_id.
+    smem_counts = pl.BlockSpec(memory_space=pltpu.SMEM)
 
     def as_rows(x):
         return x.reshape(S // LANES, LANES)
@@ -268,7 +286,7 @@ def pallas_insert(
     tl, th, pll, phh, new_rows = pl.pallas_call(
         _make_kernel(V, W, P),
         grid=(P,),
-        in_specs=[smem_one, part, part, part, part, row, row, row, row],
+        in_specs=[smem_counts, part, part, part, part, row, row, row, row],
         out_specs=[part, part, part, part, row],
         out_shape=[
             jax.ShapeDtypeStruct((S // LANES, LANES), jnp.uint32),
