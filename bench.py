@@ -303,10 +303,21 @@ def _build_workload(model_name: str, n: int):
         # vs 8192, and donated chunk dispatches avoid the multi-GB carry
         # copy if the run is ever chunked (round-4 CPU A/B: ~140k gen/s
         # sustained, full space in ~100 min on one core; ROUND4_NOTES.md).
+        # queue_log2=26 right-sizes the frontier queue (61.5 M uniques
+        # < 2^26): at table 2^27 a table-sized queue alone is 9.1 GB and
+        # the workload crashed the 16 GB v5e worker mid-run.
         batch, table_log2 = (512, 14) if n < 8 else (32768, 27)
+        run_kwargs = {}
         if n >= 8:
             engine_kwargs["donate_chunks"] = True
-        run_kwargs, golden = {}, GOLDEN[(model_name, n)]
+            engine_kwargs["queue_log2"] = 26
+            # Chunked dispatches (donated, so near-free): the whole-search
+            # form is ONE multi-minute device program, which the tunneled
+            # TPU worker kills mid-run ("worker crashed or restarted", both
+            # round-4 attempts); ~64-step dispatches stay minutes under any
+            # watchdog.
+            run_kwargs["budget"] = 64
+        golden = GOLDEN[(model_name, n)]
     elif model_name in ("inclock", "inclock-sym"):
         from stateright_tpu.tensor.models import TensorIncrementLock
 
@@ -347,12 +358,24 @@ def _parity_err(model_name, n, result, golden):
 
 
 def _time_search(search, run_kwargs, repeats: int, closure_s: float):
-    """Shared timing protocol: one compile/warm-up run, then best-of-N."""
+    """Shared timing protocol: one compile/warm-up run, then best-of-N.
+
+    Chunked runs (a `budget` in run_kwargs) keep a carry across `run()`
+    calls — without a reset, a completed search would make every repeat a
+    no-op resume reporting near-zero duration (the 2pc-10 worker once
+    "measured" 12 billion states/s that way). Fresh-start every repeat;
+    whole-search engines ignore the reset."""
     t0 = time.monotonic()
-    search.run(**run_kwargs)  # compile + warm-up
+    warm = search.run(**run_kwargs)  # compile + warm-up
     compile_s = time.monotonic() - t0
+    # Long workloads get best-of-1: a ~15-min search repeated 3x would blow
+    # the per-workload subprocess timeout for no extra signal.
+    if warm.duration > 120:
+        repeats = 1
     best = None
     for _ in range(repeats):
+        if hasattr(search, "reset"):
+            search.reset()
         r = search.run(**run_kwargs)
         if best is None or r.duration < best.duration:
             best = r

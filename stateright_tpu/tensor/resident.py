@@ -204,6 +204,7 @@ class ResidentSearch:
         batch_size: int = 2048,
         table_log2: int = 20,
         donate_chunks: bool = False,
+        queue_log2: Optional[int] = None,
     ):
         """`donate_chunks=True` donates the carry to each chunked dispatch:
         XLA updates the tables/queue IN PLACE instead of copying the whole
@@ -212,10 +213,18 @@ class ResidentSearch:
         The trade: on a table/queue overflow the pre-chunk carry no longer
         exists, so the checkpoint-then-regrow recovery is unavailable —
         run big spaces with a right-sized table, or leave this off when
-        overflow recovery matters more than throughput."""
+        overflow recovery matters more than throughput.
+
+        `queue_log2` caps the frontier queue at 2^queue_log2 rows (default:
+        table_log2, the always-sufficient bound). The queue dominates HBM
+        when states are wide — 2pc-10 at table 2^27 needs 9.1 GB of queue
+        for at most 61.5 M uniques (< 2^26): right-sizing it is what fits
+        the workload on a 16 GB v5e. Exceeding the cap is detected as the
+        same overflow signal as a full table (never a silent drop)."""
         self.model = model
         self.batch_size = batch_size
         self.table_log2 = table_log2
+        self.queue_log2 = table_log2 if queue_log2 is None else queue_log2
         self.donate_chunks = donate_chunks
         self.props = model.properties()
         self._kernel, self._seed_k, self._chunk_k = self._build()
@@ -243,11 +252,13 @@ class ResidentSearch:
         L = model.lanes
         S = 1 << self.table_log2
         # Queue capacity: every unique state is enqueued exactly once (<= S
-        # before the table overflows), plus K*A rows of slack so either
-        # append variant (scatter `append_new` — the default; measured
-        # faster than `append_new_dus` on CPU at 2pc-10 scale — or the DUS
-        # block) stays in bounds right up to table overflow.
-        Q = S + K * A
+        # before the table overflows, and <= 2^queue_log2 when the caller
+        # right-sized the queue below the table — see __init__), plus K*A
+        # rows of slack so either append variant (scatter `append_new` —
+        # the default; measured faster than `append_new_dus` on CPU at
+        # 2pc-10 scale — or the DUS block) stays in bounds right up to the
+        # overflow signal.
+        Q = (1 << self.queue_log2) + K * A
         self._Q = Q
         props = self.props
         P = len(props)
@@ -677,7 +688,9 @@ class ResidentSearch:
                         "at the last chunk boundary — checkpoint(path) then "
                         "ResidentSearch.load_checkpoint(model, path, "
                         "table_log2=<bigger>) to continue without losing the "
-                        "run"
+                        "run (if you right-sized the queue with queue_log2, "
+                        "pass a bigger queue_log2 there too — a preserved "
+                        "too-small queue would just overflow again)"
                     )
                 self._carry = carry
                 if progress is not None:
@@ -708,7 +721,10 @@ class ResidentSearch:
             _stop,
         ) = (int(x) for x in summary[:10])
         if overflow:
-            raise RuntimeError("hash table full; raise table_log2")
+            raise RuntimeError(
+                "hash table or queue full; raise table_log2 (or queue_log2 "
+                "if the queue was right-sized below the table)"
+            )
 
         P = len(self.props)
         disc_lo = summary[10 : 10 + max(P, 1)]
@@ -794,6 +810,7 @@ class ResidentSearch:
                     "max_actions": self.model.max_actions,
                     "properties": [p.name for p in self.props],
                     "table_log2": self.table_log2,
+                    "queue_log2": self.queue_log2,
                     "batch_size": self.batch_size,
                 }
             ).encode(),
@@ -809,6 +826,7 @@ class ResidentSearch:
         batch_size: Optional[int] = None,
         table_log2: Optional[int] = None,
         donate_chunks: bool = False,
+        queue_log2: Optional[int] = None,
     ) -> "ResidentSearch":
         """Rebuild a suspended search from a `checkpoint` file. Passing a
         larger `table_log2` re-hashes the visited set into the bigger table
@@ -823,11 +841,18 @@ class ResidentSearch:
         log2 = table_log2 if table_log2 is not None else meta["table_log2"]
         if log2 < meta["table_log2"]:
             raise ValueError("cannot shrink the table on resume")
+        if queue_log2 is None:
+            # Default-sized checkpoints (queue == table) keep following the
+            # table through a regrow — the overflow-recovery path needs the
+            # bigger queue. An explicitly right-sized queue is preserved.
+            meta_q = meta.get("queue_log2", meta["table_log2"])
+            queue_log2 = log2 if meta_q == meta["table_log2"] else meta_q
         rs = cls(
             model,
             batch_size=batch_size or meta["batch_size"],
             table_log2=log2,
             donate_chunks=donate_chunks,
+            queue_log2=queue_log2,
         )
         fields = {f: data[f] for f in _Carry._fields}
         if log2 != meta["table_log2"]:
@@ -837,10 +862,18 @@ class ResidentSearch:
                     queue_rows=rs._Q,
                 )
             )
-        # Normalize queue arrays to this search's capacity Q = S + K*A
-        # (covers checkpoints from the pre-slack format, changed batch
-        # sizes, and regrown tables). Live rows sit at [0, tail),
-        # tail <= S <= Q, so padding is always a pure extension.
+        # Normalize queue arrays to this search's capacity (covers
+        # checkpoints from the pre-slack format, changed batch sizes, and
+        # regrown tables). Live rows sit at [0, tail); the guard makes the
+        # normalization a pure extension — silently dropping frontier rows
+        # would corrupt the resumed search.
+        ckpt_tail = int(fields["tail"])
+        if ckpt_tail > rs._Q - rs.batch_size * model.max_actions:
+            raise ValueError(
+                f"queue_log2={rs.queue_log2} gives {rs._Q} rows but the "
+                f"checkpointed frontier tail is {ckpt_tail}; the queue "
+                "cannot shrink below the live frontier"
+            )
         for f in ("q_states", "q_lo", "q_hi", "q_ebits", "q_depth"):
             old = fields[f]
             if old.shape[0] != rs._Q:

@@ -60,9 +60,11 @@ def test_path_reconstruction_across_shards():
     r = s.run()
     assert "solvable" in r.discoveries
     path = s.reconstruct_path(r.discoveries["solvable"])
-    # BFS shortest counterexample, same as host/single-chip engines
-    # (ref: src/checker/bfs.rs:455-476).
-    assert path.actions() == ["IncreaseX", "IncreaseX", "IncreaseY"]
+    # BFS shortest counterexample, same depth and final state as the
+    # host/single-chip engines (ref: src/checker/bfs.rs:455-476). Which
+    # equal-length path is recorded depends on parent-insertion races,
+    # exactly as in the reference's multithreaded checker (bfs.rs:243).
+    assert sorted(path.actions()) == ["IncreaseX", "IncreaseX", "IncreaseY"]
     assert path.last_state() == (2, 1)
 
 

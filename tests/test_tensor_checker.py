@@ -297,3 +297,24 @@ def test_resident_frontier_discovery_parity():
     for name, fp in rr.discoveries.items():
         assert fp == fr.discoveries[name]
         assert fp not in (0, 1)  # 1 == stop flag; 0 == empty lane
+
+
+def test_resident_queue_log2_right_sized_and_overflow():
+    # 2pc-4: 8,258 generated / 1,568 unique. A 2^11-row queue (>= uniques)
+    # must complete at exact parity despite being far below the table size;
+    # a 2^8-row queue (< uniques) must surface the same overflow signal as
+    # a full table — never a silent drop.
+    from stateright_tpu.tensor.models import TensorTwoPhaseSys
+    from stateright_tpu.tensor.resident import ResidentSearch
+
+    model = TensorTwoPhaseSys(4)
+    r = ResidentSearch(
+        model, batch_size=512, table_log2=14, queue_log2=11
+    ).run()
+    assert (int(r.state_count), int(r.unique_state_count)) == (8258, 1568)
+    assert r.complete
+
+    with pytest.raises(RuntimeError, match="table"):
+        ResidentSearch(
+            model, batch_size=512, table_log2=14, queue_log2=8
+        ).run()
