@@ -1,0 +1,96 @@
+"""Sorted-pool surgery without sorting — a MEASURED-SLOWER alternative to
+the `jnp.sort` pool rebuild in the network multiset kernels, kept for the
+record and for wider-pool models where the trade may flip.
+
+The canonical network-pool state is a SORTED vector of u32 envelope ids with
+EMPTY (0xFFFFFFFF) sentinels packed at the tail. Every Deliver successor
+drops one slot and inserts <= k emissions; the models rebuild the invariant
+with `jnp.sort` over a [B, A, M+k] tensor. Both inputs are already sorted,
+so the rank-based merge here does the same job in O(M*k) elementwise
+compares with no sort at all — but the round-4 v5e A/B measured it ~2x
+SLOWER end-to-end than the sort form it replaced (paxos-3 443k -> 228k
+states/s; lowered paxos5s4c 314k -> 140k): at pool widths ~14, XLA expands
+the small-axis sort into a fully-fused compare-exchange network, while the
+merge's take_along_axis gathers and [.., M, k] mask reductions fuse worse.
+The sort stays the production form; parity tests (tests/test_poolops.py)
+keep this alternative honest. The mechanics:
+
+- the drop is a shift-left past the dropped slot (`drop_slot`);
+- each (sorted) emission's output position is its rank in the pool plus its
+  emission index; each pool element shifts right by the number of strictly
+  smaller emissions (`merge_insert_sorted`);
+- merge positions are a permutation of 0..M+k-1 (the standard two-pointer
+  merge argument: pool elements count strictly-smaller emissions, emissions
+  count less-or-equal pool elements, so ties route pool-first and no two
+  elements share a position);
+- an element pushed past M overflows exactly when the sort-based form would
+  have left a non-EMPTY in the truncated tail — same signal, same
+  "never silently drop" contract.
+
+EMPTY emissions never place (their rank is past every slot, including the
+EMPTY pool tail), and EMPTY pool slots pushed off the end are not overflow.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+EMPTY = np.uint32(0xFFFFFFFF)
+
+
+def drop_slot(pool, d):
+    """Remove the element at index `d` from a sorted pool, shifting the tail
+    left and refilling with EMPTY.
+
+    pool: u32[..., M] sorted; d: int[...] (same leading shape) slot index.
+    """
+    M = pool.shape[-1]
+    j = jnp.arange(M, dtype=jnp.int32)
+    j = j.reshape((1,) * (pool.ndim - 1) + (M,))
+    src = j + (j >= d[..., None]).astype(jnp.int32)
+    out = jnp.take_along_axis(pool, jnp.minimum(src, M - 1), axis=-1)
+    return jnp.where(src >= M, EMPTY, out)
+
+
+def merge_insert_sorted(pool, ems):
+    """Insert up to k emissions into a sorted pool; -> (out[..., M], ovf).
+
+    pool: u32[..., M] sorted with EMPTY tail. ems: u32[..., k] in any order
+    (k small and static; EMPTY = absent). Returns the merged sorted pool and
+    an overflow mask — True where a real (non-EMPTY) element of the merged
+    multiset fell past slot M-1.
+    """
+    M = pool.shape[-1]
+    k = ems.shape[-1]
+    ems = jnp.sort(ems, axis=-1)  # k tiny: XLA expands to a compare network
+    j = jnp.arange(M, dtype=jnp.int32)
+    j = j.reshape((1,) * (pool.ndim - 1) + (M,))
+
+    # Emission ranks: pool elements <= e go first, equal emissions keep
+    # their (sorted) order.
+    pos_e = (pool[..., :, None] <= ems[..., None, :]).sum(
+        axis=-2, dtype=jnp.int32
+    ) + jnp.arange(k, dtype=jnp.int32)
+    # Pool shift: strictly smaller emissions go first.
+    cnt_lt = (ems[..., None, :] < pool[..., :, None]).sum(
+        axis=-1, dtype=jnp.int32
+    )
+
+    placed = pos_e[..., None, :] == j[..., :, None]  # [..., M, k]
+    is_em = placed.any(axis=-1)
+    em_at = jnp.where(placed, ems[..., None, :], 0).sum(
+        axis=-1, dtype=jnp.uint32
+    )
+    shift = (pos_e[..., None, :] <= j[..., :, None]).sum(
+        axis=-1, dtype=jnp.int32
+    )
+    q_idx = jnp.clip(j - shift, 0, M - 1)
+    q_shift = jnp.take_along_axis(pool, q_idx, axis=-1)
+    out = jnp.where(is_em, em_at, q_shift)
+
+    ovf = ((pos_e >= M) & (ems != EMPTY)).any(axis=-1) | (
+        ((j + cnt_lt >= M) & (pool != EMPTY)).any(axis=-1)
+    )
+    return out, ovf

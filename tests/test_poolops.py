@@ -1,0 +1,62 @@
+"""poolops parity: the rank-based drop/merge must reproduce the sort-based
+pool rebuild (drop one slot, append emissions, sort, truncate) exactly —
+including the overflow signal — on randomized sorted pools."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from stateright_tpu.tensor.poolops import EMPTY, drop_slot, merge_insert_sorted
+
+
+def _random_pool(rng, B, M, max_fill, vocab):
+    pool = np.full((B, M), EMPTY, dtype=np.uint32)
+    for b in range(B):
+        n = rng.integers(0, max_fill + 1)
+        pool[b, :n] = np.sort(rng.integers(0, vocab, n, dtype=np.uint32))
+    return pool
+
+
+def _sort_based(pool, d, ems):
+    """Reference semantics straight from the original kernels."""
+    B, M = pool.shape
+    dropped = pool.copy()
+    dropped[np.arange(B), d] = EMPTY
+    cat = np.concatenate([dropped, ems], axis=1)
+    cat.sort(axis=1)
+    return cat[:, :M], (cat[:, M:] != EMPTY).any(axis=1)
+
+
+def test_drop_then_merge_matches_sort_rebuild():
+    rng = np.random.default_rng(11)
+    B, M, k = 512, 14, 3
+    for vocab in (6, 2**31):  # heavy duplication and spread-out ids
+        pool = _random_pool(rng, B, M, M, vocab)
+        d = rng.integers(0, M, B)
+        # only drop occupied slots half the time; EMPTY drops are no-ops in
+        # the sorted form and must match too
+        ems = np.where(
+            rng.random((B, k)) < 0.6,
+            rng.integers(0, vocab, (B, k), dtype=np.uint32),
+            EMPTY,
+        ).astype(np.uint32)
+
+        want, want_ovf = _sort_based(pool, d, ems)
+
+        q = drop_slot(jnp.asarray(pool), jnp.asarray(d, dtype=jnp.int32))
+        got, got_ovf = merge_insert_sorted(q, jnp.asarray(ems))
+        np.testing.assert_array_equal(np.asarray(got), want)
+        np.testing.assert_array_equal(np.asarray(got_ovf), want_ovf)
+
+
+def test_merge_overflow_flags_real_spill_only():
+    # A full pool plus one real emission overflows; plus EMPTY does not.
+    pool = jnp.asarray(np.arange(1, 9, dtype=np.uint32)[None, :])
+    out, ovf = merge_insert_sorted(
+        pool, jnp.asarray([[5, EMPTY]], dtype=jnp.uint32)
+    )
+    assert bool(ovf[0])
+    out, ovf = merge_insert_sorted(
+        pool, jnp.asarray([[EMPTY, EMPTY]], dtype=jnp.uint32)
+    )
+    assert not bool(ovf[0])
+    np.testing.assert_array_equal(np.asarray(out)[0], np.arange(1, 9))
