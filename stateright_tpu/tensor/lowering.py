@@ -1448,6 +1448,16 @@ class LoweredActorModel(TensorModel):
 
         deliver_stash = {}  # st/hev/sid reused by the poison-payload block
 
+        def gated_take(tbl, flat, flag):
+            """Gather a reaction table, or skip the gather entirely when the
+            model cannot populate it (the table is all-zero by construction
+            and TPU gathers pay per element). The apply paths are gated on
+            the same feature flags."""
+            t = tbl.reshape(-1)
+            return (
+                jnp.take(t, flat) if flag else jnp.zeros(flat.shape, t.dtype)
+            )
+
         def lookup_deliver(eid, deliverable):
             """eid: [B, S] delivered envelope per slot; -> per-slot updates."""
             S = eid.shape[1]
@@ -1464,10 +1474,10 @@ class LoweredActorModel(TensorModel):
             emits = jnp.take(
                 D_emits.reshape(-1, self.max_emit), flat, axis=0
             )  # [B, S, max_emit]
-            tclr = jnp.take(D_tclr.reshape(-1), flat)
-            tset = jnp.take(D_tset.reshape(-1), flat)
-            hev = jnp.take(D_hev.reshape(-1), flat)
-            delta = jnp.take(D_delta.reshape(-1), flat)
+            tclr = gated_take(D_tclr, flat, self.has_timers)
+            tset = gated_take(D_tset, flat, self.has_timers)
+            hev = gated_take(D_hev, flat, self.track_history)
+            delta = gated_take(D_delta, flat, self.has_randoms)
             # Delivery to a crashed actor is not a transition
             # (ref: src/actor/model.rs:332-337).
             alive = not_crashed(d_srv)
@@ -1720,10 +1730,11 @@ class LoweredActorModel(TensorModel):
             is_txn = st >= _VALID0
             new_sid = jnp.where(is_txn, st - u(_VALID0), sid)
             emits = jnp.take(T_emits.reshape(-1, self.max_emit), flat, axis=0)
+            # Timers are live here by construction; the rest stay gated.
             tclr = jnp.take(T_tclr.reshape(-1), flat)
             tset = jnp.take(T_tset.reshape(-1), flat)
-            hev = jnp.take(T_hev.reshape(-1), flat)
-            delta = jnp.take(T_delta.reshape(-1), flat)
+            hev = gated_take(T_hev, flat, self.track_history)
+            delta = gated_take(T_delta, flat, self.has_randoms)
             alive = not_crashed(t_actor_b)
             valid = armed & is_txn & alive
             poison = armed & ~explored & alive
@@ -1818,9 +1829,9 @@ class LoweredActorModel(TensorModel):
             is_txn = st >= _VALID0
             new_sid = jnp.where(is_txn, st - u(_VALID0), sid)
             emits = jnp.take(R_emits.reshape(-1, self.max_emit), flat_rr, axis=0)
-            tclr = jnp.take(R_tclr.reshape(-1), flat_rr)
-            tset = jnp.take(R_tset.reshape(-1), flat_rr)
-            hev = jnp.take(R_hev.reshape(-1), flat_rr)
+            tclr = gated_take(R_tclr, flat_rr, self.has_timers)
+            tset = gated_take(R_tset, flat_rr, self.has_timers)
+            hev = gated_take(R_hev, flat_rr, self.track_history)
             delta = jnp.take(R_delta.reshape(-1), flat_rr)
             alive = not_crashed(r_actor_b)
             valid = has_choice & is_txn & alive
@@ -1989,7 +2000,7 @@ class LoweredActorModel(TensorModel):
                 tkind = jnp.where(
                     tst != _UNEXPLORED, u(17), u(1)
                 )
-                thev = jnp.take(T_hev.reshape(-1), tflat)
+                thev = gated_take(T_hev, tflat, self.track_history)
                 segs.append((tkind, ta, tt, t_sid_stash, thev))
             if self.random_slots:
                 nR = len(self.random_slots)
@@ -2006,8 +2017,8 @@ class LoweredActorModel(TensorModel):
                     + r_sid_stash.astype(jnp.int32)
                 )
                 rst = jnp.take(jnp.asarray(self._R[3]).reshape(-1), rflat)
-                rhev = jnp.take(
-                    jnp.asarray(self._R[7]).reshape(-1), rflat
+                rhev = gated_take(
+                    jnp.asarray(self._R[7]), rflat, self.track_history
                 )
                 # Covered pair + poison = capacity overflow (kind 2 | 16),
                 # same convention as the deliver/timeout segments.
