@@ -110,6 +110,7 @@ from ..actor.network import (
     UNORDERED_NONDUPLICATING,
 )
 from .model import TensorModel, TensorProperty
+from .poolops import rank_sort, rank_sort_pool
 
 EMPTY = np.uint32(0xFFFFFFFF)
 _UNEXPLORED = 0  # D_state value marking an uncovered (eid, sid) combo
@@ -1624,16 +1625,22 @@ class LoweredActorModel(TensorModel):
             succ = apply_common(
                 d_actor, new_sid, emits, tclr, tset, hev, base, delta=delta
             )
-            # Pool: drop the delivered slot, add emissions, re-sort.
+            # Pool: drop the delivered slot, add emissions, restore the
+            # sorted-multiset invariant with the unrolled rank-sort
+            # (tensor/poolops.py — a minor-axis jnp.sort pays cross-lane
+            # shuffles on TPU).
             P = self.pool_size
-            drop = jnp.arange(P)[None, :, None] == jnp.arange(P)[None, None, :]
-            npool = jnp.where(drop, EMPTY, pool[:, None, :])  # [B, P, P]
-            npool = jnp.concatenate([npool, emits], axis=2)
-            npool = jnp.sort(npool, axis=2)
-            overflow = jnp.any(npool[:, :, P:] != EMPTY, axis=2)
-            succ = succ.at[:, :, self.net_off : self.net_off + P].set(
-                npool[:, :, :P]
+            act = jnp.arange(P, dtype=jnp.uint32)[None, :]
+            dropped_parts = [
+                jnp.where(act == i, EMPTY, pool[:, i : i + 1])
+                for i in range(P)
+            ]
+            npool, overflow = rank_sort(
+                dropped_parts
+                + [emits[:, :, j] for j in range(self.max_emit)],
+                P,
             )
+            succ = succ.at[:, :, self.net_off : self.net_off + P].set(npool)
             poison = poison | (valid & overflow)
             succ_parts.append(succ)
             valid_parts.append((valid | poison, poison))
@@ -1642,8 +1649,7 @@ class LoweredActorModel(TensorModel):
                 dbase = jnp.broadcast_to(
                     states[:, None, :], (B, P, self.lanes)
                 )
-                dpool = jnp.where(drop, EMPTY, pool[:, None, :])
-                dpool = jnp.sort(dpool, axis=2)
+                dpool, _ = rank_sort(dropped_parts, P)
                 dsucc = dbase.at[:, :, self.net_off : self.net_off + P].set(
                     dpool
                 )
@@ -1760,14 +1766,9 @@ class LoweredActorModel(TensorModel):
             elif self.kind == UNORDERED_NONDUPLICATING:
                 pool = states[:, self.net_off : self.net_off + self.pool_size]
                 P = self.pool_size
-                npool = jnp.concatenate(
-                    [jnp.broadcast_to(pool[:, None, :], (B, nT, P)), emits],
-                    axis=2,
-                )
-                npool = jnp.sort(npool, axis=2)
-                overflow = jnp.any(npool[:, :, P:] != EMPTY, axis=2)
+                npool, overflow = rank_sort_pool(pool, emits, nT)
                 succ = succ.at[:, :, self.net_off : self.net_off + P].set(
-                    npool[:, :, :P]
+                    npool
                 )
                 poison = poison | (valid & overflow)
             else:
@@ -1862,14 +1863,9 @@ class LoweredActorModel(TensorModel):
             elif self.kind == UNORDERED_NONDUPLICATING:
                 pool = states[:, self.net_off : self.net_off + self.pool_size]
                 P = self.pool_size
-                npool = jnp.concatenate(
-                    [jnp.broadcast_to(pool[:, None, :], (B, nR, P)), emits],
-                    axis=2,
-                )
-                npool = jnp.sort(npool, axis=2)
-                overflow = jnp.any(npool[:, :, P:] != EMPTY, axis=2)
+                npool, overflow = rank_sort_pool(pool, emits, nR)
                 succ = succ.at[:, :, self.net_off : self.net_off + P].set(
-                    npool[:, :, :P]
+                    npool
                 )
                 poison = poison | (valid & overflow)
             else:

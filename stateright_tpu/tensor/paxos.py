@@ -42,6 +42,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from .model import TensorModel, TensorProperty
+from .poolops import rank_sort
 
 EMPTY = np.uint32(0xFFFFFFFF)
 
@@ -549,20 +550,21 @@ class TensorPaxos(TensorModel):
         ncl = jnp.where(is_server_msg, clients[:, None], ncl)
         succ = succ.at[:, :, self.client_lane].set(ncl)
 
-        # Pool: drop the delivered slot, add emissions, re-sort (canonical
-        # multiset form). pool_size has slack over the measured max in-flight;
-        # if a successor would exceed it anyway, the row becomes the reserved
-        # all-ones POISON state (terminal — its pool is all EMPTY) and the
-        # "pool capacity" property below reports it as a discovery instead of
-        # silently truncating the state space.
-        drop = jnp.arange(M)[None, :, None] == jnp.arange(M)[None, None, :]
-        npool = jnp.where(drop, EMPTY, pool[:, None, :])  # [B, M, M]
-        npool = jnp.concatenate(
-            [npool, em1[:, :, None], em2[:, :, None], em3[:, :, None]], axis=2
-        )
-        npool = jnp.sort(npool, axis=2)
-        overflow = jnp.any(npool[:, :, M:] != EMPTY, axis=2)  # [B, M]
-        succ = succ.at[:, :, self.pool_off :].set(npool[:, :, :M])
+        # Pool: drop the delivered slot, add emissions, restore the
+        # canonical sorted-multiset form via the unrolled rank-sort
+        # (tensor/poolops.py — a jnp.sort along the minor axis was the
+        # single largest slice of this kernel's fusion on v5e). pool_size
+        # has slack over the measured max in-flight; if a successor would
+        # exceed it anyway, the row becomes the reserved all-ones POISON
+        # state (terminal — its pool is all EMPTY) and the "pool capacity"
+        # property below reports it as a discovery instead of silently
+        # truncating the state space.
+        act = jnp.arange(M, dtype=jnp.uint32)[None, :]
+        parts = [
+            jnp.where(act == i, EMPTY, pool[:, i : i + 1]) for i in range(M)
+        ] + [em1, em2, em3]
+        npool, overflow = rank_sort(parts, M)
+        succ = succ.at[:, :, self.pool_off :].set(npool)
         succ = jnp.where(overflow[:, :, None], jnp.uint32(EMPTY), succ)
 
         return succ, valid

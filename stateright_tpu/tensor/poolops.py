@@ -1,19 +1,20 @@
-"""Sorted-pool surgery without sorting — a MEASURED-SLOWER alternative to
-the `jnp.sort` pool rebuild in the network multiset kernels, kept for the
-record and for wider-pool models where the trade may flip.
+"""Sorted-pool surgery without `jnp.sort`.
+
+PRODUCTION: `rank_sort` / `rank_sort_pool` — the unrolled rank-by-counting
+rebuild the network multiset kernels use (a minor-axis jnp.sort pays
+cross-lane shuffles over the 128-padded lane dim on TPU; the unrolled form
+measured paxos-3 568k -> 616k states/s and abd-ordered +18% on v5e).
+
+RECORD: `drop_slot` / `merge_insert_sorted` — a rank-based MERGE that was
+measured ~2x SLOWER end-to-end than the sort it replaced (paxos-3 443k ->
+228k; gather-heavy), reverted, and kept parity-tested for the record and
+for wider-pool models where the trade may flip.
 
 The canonical network-pool state is a SORTED vector of u32 envelope ids with
 EMPTY (0xFFFFFFFF) sentinels packed at the tail. Every Deliver successor
-drops one slot and inserts <= k emissions; the models rebuild the invariant
-with `jnp.sort` over a [B, A, M+k] tensor. Both inputs are already sorted,
-so the rank-based merge here does the same job in O(M*k) elementwise
-compares with no sort at all — but the round-4 v5e A/B measured it ~2x
-SLOWER end-to-end than the sort form it replaced (paxos-3 443k -> 228k
-states/s; lowered paxos5s4c 314k -> 140k): at pool widths ~14, XLA expands
-the small-axis sort into a fully-fused compare-exchange network, while the
-merge's take_along_axis gathers and [.., M, k] mask reductions fuse worse.
-The sort stays the production form; parity tests (tests/test_poolops.py)
-keep this alternative honest. The mechanics:
+drops one slot and inserts <= k emissions, then restores the invariant.
+Parity tests (tests/test_poolops.py) pin every form here against a
+plain-sort reference. Mechanics of the record-only merge:
 
 - the drop is a shift-left past the dropped slot (`drop_slot`);
 - each (sorted) emission's output position is its rank in the pool plus its
@@ -38,6 +39,59 @@ import numpy as np
 import jax.numpy as jnp
 
 EMPTY = np.uint32(0xFFFFFFFF)
+
+
+def rank_sort(parts, keep):
+    """Sort a small multiset given as K separate element arrays; return the
+    ascending `keep`-prefix stacked on a new minor axis plus an overflow
+    mask (a real element ranked past `keep`).
+
+    parts: list of K uint32[...] arrays (identical shapes) — the elements
+    of one multiset per row. The sort is an unrolled rank-by-counting
+    network: one compare per unordered pair assigns each element its exact
+    output position (ties broken by part index, so it is stable), then a
+    one-hot select builds each kept slot. Every op is ELEMENTWISE over the
+    part arrays — unlike `jnp.sort` along a minor axis, which on TPU pays
+    cross-lane shuffles over the 128-padded lane dim (measured 3.6 ms for
+    a [4096,14,17] pool sort vs ~0.3 ms for this form — the single
+    largest slice of the paxos-3 expand fusion). The graph grows O(K^2 +
+    K*keep) HLO ops — fine for the <= 30-wide pools the models use (it
+    did raise paxos5s4c's cold compile 52 s -> 231 s), unsuitable for
+    hundreds."""
+    K = len(parts)
+    if not 0 < keep <= K:
+        # keep > K would silently pad with 0x0 (a phantom id-0 envelope,
+        # NOT the EMPTY sentinel); keep == 0 has no meaning here.
+        raise ValueError(f"keep must be in 1..{K}, got {keep}")
+    i32 = jnp.int32
+    ranks = [jnp.zeros(parts[0].shape, i32) for _ in range(K)]
+    for i in range(K):
+        for j in range(i + 1, K):
+            le = parts[i] <= parts[j]  # ties: earlier part sorts first
+            ranks[j] = ranks[j] + le.astype(i32)
+            ranks[i] = ranks[i] + (~le).astype(i32)
+    zero_u = jnp.uint32(0)
+    outs = []
+    for j in range(keep):
+        acc = jnp.zeros(parts[0].shape, jnp.uint32)
+        for i in range(K):
+            acc = acc | jnp.where(ranks[i] == j, parts[i], zero_u)
+        outs.append(acc)
+    ovf = jnp.zeros(parts[0].shape, bool)
+    for i in range(K):
+        ovf = ovf | ((ranks[i] >= keep) & (parts[i] != EMPTY))
+    return jnp.stack(outs, axis=-1), ovf
+
+
+def rank_sort_pool(pool, emits, n_slots):
+    """Insert per-slot emissions into an (unchanged) sorted pool: the
+    timeout/random lowering form. pool: u32[B, P]; emits: u32[B, n, k];
+    -> (u32[B, n, P], overflow[B, n])."""
+    B, P = pool.shape
+    parts = [
+        jnp.broadcast_to(pool[:, i : i + 1], (B, n_slots)) for i in range(P)
+    ] + [emits[:, :, j] for j in range(emits.shape[2])]
+    return rank_sort(parts, P)
 
 
 def drop_slot(pool, d):

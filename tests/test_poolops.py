@@ -60,3 +60,24 @@ def test_merge_overflow_flags_real_spill_only():
     )
     assert not bool(ovf[0])
     np.testing.assert_array_equal(np.asarray(out)[0], np.arange(1, 9))
+
+
+def test_rank_sort_matches_jnp_sort():
+    from stateright_tpu.tensor.poolops import rank_sort
+
+    rng = np.random.default_rng(3)
+    B, K, keep = 256, 17, 14
+    for vocab in (6, 2**31):
+        vals = np.where(
+            rng.random((B, K)) < 0.7,
+            rng.integers(0, vocab, (B, K), dtype=np.uint32),
+            EMPTY,
+        ).astype(np.uint32)
+        got, ovf = rank_sort(
+            [jnp.asarray(vals[:, i]) for i in range(K)], keep
+        )
+        want = np.sort(vals, axis=1)
+        np.testing.assert_array_equal(np.asarray(got), want[:, :keep])
+        np.testing.assert_array_equal(
+            np.asarray(ovf), (want[:, keep:] != EMPTY).any(axis=1)
+        )
