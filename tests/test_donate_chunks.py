@@ -96,7 +96,7 @@ def test_whole_search_overflow_invalidates_snapshot():
     # and any previous snapshot must not serve this run's paths (round-4
     # alignment of resident with sharded overflow semantics).
     rs = ResidentSearch(TensorTwoPhaseSys(5), 256, 7)
-    with pytest.raises(RuntimeError, match="hash table full"):
+    with pytest.raises(RuntimeError, match="hash table or queue full"):
         rs.run()
     assert rs._last_tables is None
     with pytest.raises(RuntimeError, match="no table snapshot"):
