@@ -290,17 +290,18 @@ def _build_workload(model_name: str, n: int):
 
     # The two backends want opposite batch sizes (v5e sweep vs CPU sweep,
     # both in ROUND4_NOTES.md): on TPU step cost is near-linear in batch
-    # while the frontier is often sub-batch, so small batches win (paxos-3
-    # 443k/s @4096 vs 280k @32768); the 1-core CPU backend amortizes
-    # per-step overhead with big batches (101k @32768 vs 53k @65536,
-    # smaller is worse). Key off the EFFECTIVE backend so CPU rehearsals
-    # stay comparable round over round.
+    # while the frontier is often sub-batch, so small batches win — final
+    # v5e bracket at session-end kernels: 627k/s @3072 vs 616k @4096,
+    # 599k @2560, 572k @6144, 280k @32768; the 1-core CPU backend
+    # amortizes per-step overhead with big batches (101k @32768 vs 53k
+    # @65536, smaller is worse). Key off the EFFECTIVE backend so CPU
+    # rehearsals stay comparable round over round.
     on_cpu = jax.default_backend() == "cpu"
     if model_name == "paxos":
         from stateright_tpu.tensor.paxos import TensorPaxos
 
         model = TensorPaxos(client_count=n)
-        big = (32768, 22) if on_cpu else (4096, 22)
+        big = (32768, 22) if on_cpu else (3072, 22)
         batch, table_log2 = (2048, 16) if n <= 2 else big
         run_kwargs, golden = {}, GOLDEN[(model_name, n)]
     elif model_name == "2pc":
