@@ -51,6 +51,8 @@ from ..core.model import Expectation
 from ..tensor.frontier import (
     SearchResult,
     append_new,
+    append_new_dus,
+    resolve_append,
     count_add,
     count_ge,
     pop_batch,
@@ -146,16 +148,22 @@ class ShardedSearch:
         table_log2: int = 18,
         dest_capacity: Optional[int] = None,
         donate_chunks: bool = False,
+        append: Optional[str] = None,
     ):
         """`donate_chunks=True` donates the per-shard carry to each chunked
         dispatch so XLA updates the sharded tables/queues in place instead
         of copying them per dispatch (same trade as the resident engine:
-        overflow loses the recovery carry — see ResidentSearch.__init__)."""
+        overflow loses the recovery carry — see ResidentSearch.__init__).
+        `append` picks the queue-append variant exactly as on
+        ResidentSearch (backend-informed default; "scatter" or "dus")."""
         self.model = model
         self.donate_chunks = donate_chunks
         self.mesh = mesh if mesh is not None else make_mesh()
         (self.axis,) = self.mesh.axis_names
         self.n_chips = self.mesh.devices.size
+        self.append = resolve_append(
+            append, self.mesh.devices.flat[0].platform
+        )
         self.batch_size = batch_size
         self.table_log2 = table_log2
         # Per-destination all-to-all capacity; default is sound (see module
@@ -184,8 +192,12 @@ class ShardedSearch:
         A = model.max_actions
         L = model.lanes
         S = 1 << self.table_log2
-        Q = S
         C = self.dest_capacity
+        # N*C rows of slack beyond the per-shard table size: the append
+        # block is N*C rows, and the DUS variant's contract requires the
+        # start never to clamp (append_new_dus docstring) — without the
+        # slack a near-full queue would silently overwrite live rows.
+        Q = S + N * C
         props = self.props
         P_ = len(props)
         always_i = [i for i, p in enumerate(props) if p.expectation == Expectation.ALWAYS]
@@ -415,16 +427,24 @@ class ShardedSearch:
                     r_lo, r_hi, r_plo, r_phi, r_valid,
                 )
                 # -- append fresh states to the local queue (cumsum) -----------
-                q_states, q_lo, q_hi, q_ebits, q_depth, tail = append_new(
+                q_states, q_lo, q_hi, q_ebits, q_depth, tail = (
+                    append_new if self.append == "scatter" else append_new_dus
+                )(
                     c.q_states, c.q_lo, c.q_hi, c.q_ebits, c.q_depth, c.tail,
                     r_states, r_lo, r_hi, r_ebits, r_depth, is_new,
                 )
                 new_count = tail - c.tail
 
                 unique_count = c.unique_count + new_count
-                # tail > Q - K: see the resident engine's queue-full guard.
+                # Queue-full guard: the N*C append-block slack keeps both
+                # append variants in bounds, and pop_batch's K-row
+                # dynamic_slice must never clamp either (dest_capacity may
+                # be set below K), so the bound is the stricter of the two.
                 overflow = (
-                    c.overflow | route_ovf | ins_ovf | (tail > Q - K)
+                    c.overflow
+                    | route_ovf
+                    | ins_ovf
+                    | (tail > Q - max(N * C, K))
                 )
 
                 # -- global sync: discovery OR, counters, termination ----------
@@ -946,6 +966,11 @@ class ShardedSearch:
         log2 = table_log2 if table_log2 is not None else meta["table_log2"]
         if log2 < meta["table_log2"]:
             raise ValueError("cannot shrink the table on resume")
+        # This engine's compiled kernel closes over the slacked per-shard
+        # capacity Q = S + N*C (append-block slack); checkpoints from other
+        # configs (or the pre-slack format) carry different queue shapes,
+        # so regrow/normalize everything to ss's capacity.
+        ss_Q = (1 << log2) + ss.n_chips * ss.dest_capacity
         fields = {f: data[f] for f in _Carry._fields}
         if log2 != meta["table_log2"]:
             grown = [
@@ -961,6 +986,7 @@ class ShardedSearch:
                     meta["table_log2"],
                     log2,
                     ss.batch_size,
+                    queue_rows=ss_Q,
                 )
                 for i in range(ss.n_chips)
             ]
@@ -971,14 +997,23 @@ class ShardedSearch:
                     fields[k] = np.stack(
                         [np.asarray(g[k]) for g in grown]
                     )
-        # The per-shard queue guard (tail <= Q - K) was enforced with the
-        # CHECKPOINT's batch size; a larger K here could let pop_batch's
-        # dynamic_slice clamp past a shard's restored tail.
+        for f in ("q_states", "q_lo", "q_hi", "q_ebits", "q_depth"):
+            old = fields[f]
+            if old.shape[1] != ss_Q:
+                padded = np.zeros(
+                    (old.shape[0], ss_Q) + old.shape[2:], dtype=old.dtype
+                )
+                keep = min(old.shape[1], ss_Q)
+                padded[:, :keep] = old[:, :keep]
+                fields[f] = padded
+        # The per-shard queue guard was enforced with the CHECKPOINT's
+        # batch size; a larger K here could let pop_batch's dynamic_slice
+        # clamp past a shard's restored tail.
         max_tail = int(np.max(fields["tail"]))
-        if max_tail > (1 << log2) - ss.batch_size:
+        if max_tail > ss_Q - ss.batch_size:
             raise ValueError(
                 "batch_size too large for the restored queue occupancy "
-                f"(max per-shard tail={max_tail}, capacity={1 << log2}); "
+                f"(max per-shard tail={max_tail}, capacity={ss_Q}); "
                 "use a smaller batch_size or a larger table_log2"
             )
         sh = NamedSharding(ss.mesh, P(ss.axis))

@@ -146,6 +146,20 @@ def append_new(
     return q_states, q_lo, q_hi, q_ebits, q_depth, tail
 
 
+def resolve_append(append, platform: str) -> str:
+    """One source of truth for the queue-append variant default: the
+    row-scatter append is pathological on TPU (column-major queue layout;
+    44.7% of the paxos-3 step — round-4 silicon profile) while the
+    compact+dynamic_update_slice form measured ~5x slower on the 1-core
+    CPU backend at 2pc-10 scale, so the default follows the platform the
+    engine will actually run on."""
+    if append is None:
+        return "scatter" if platform == "cpu" else "dus"
+    if append not in ("scatter", "dus"):
+        raise ValueError(f"append must be 'scatter' or 'dus', got {append!r}")
+    return append
+
+
 def append_new_dus(
     q_states, q_lo, q_hi, q_ebits, q_depth, tail,
     flat, slo, shi, ebits_rows, depth_rows, is_new,

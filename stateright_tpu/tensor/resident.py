@@ -40,11 +40,13 @@ from .fingerprint import pack_fp
 from .frontier import (
     SearchResult,
     append_new,
+    append_new_dus,
     count_add,
     count_ge,
     expand_insert,
     pop_batch,
     reconstruct_path,
+    resolve_append,
     record_discovery as _record,
     seed_init,
 )
@@ -205,6 +207,7 @@ class ResidentSearch:
         table_log2: int = 20,
         donate_chunks: bool = False,
         queue_log2: Optional[int] = None,
+        append: Optional[str] = None,
     ):
         """`donate_chunks=True` donates the carry to each chunked dispatch:
         XLA updates the tables/queue IN PLACE instead of copying the whole
@@ -226,6 +229,16 @@ class ResidentSearch:
         self.table_log2 = table_log2
         self.queue_log2 = table_log2 if queue_log2 is None else queue_log2
         self.donate_chunks = donate_chunks
+        # Queue-append variant: XLA lays the queue out column-major (fast
+        # per-lane reads for the model kernels), which makes the row-scatter
+        # append pathological on TPU — the round-4 silicon profile measured
+        # it at 44.7% of the paxos-3 step (2.4 GiB/s effective); the
+        # compact-then-dynamic_update_slice form writes 21 contiguous
+        # column runs instead (paxos-3 627k -> 1.06M states/s). The 1-core
+        # CPU backend measured the OPPOSITE at 2pc-10 scale (DUS ~5x
+        # slower), so the default follows the effective backend; pass
+        # append="scatter"|"dus" to pin it.
+        self.append = resolve_append(append, jax.default_backend())
         self.props = model.properties()
         self._kernel, self._seed_k, self._chunk_k = self._build()
         self._last_tables = None
@@ -250,6 +263,7 @@ class ResidentSearch:
         K = self.batch_size
         A = model.max_actions
         L = model.lanes
+        _append = append_new if self.append == "scatter" else append_new_dus
         S = 1 << self.table_log2
         # Queue capacity: every unique state is enqueued exactly once (<= S
         # before the table overflows, and <= 2^queue_log2 when the caller
@@ -320,7 +334,7 @@ class ResidentSearch:
 
             # -- append new states to the queue tail (cumsum compaction) -------
             src_row = jnp.arange(K * A, dtype=jnp.int32) // A
-            q_states, q_lo, q_hi, q_ebits, q_depth, tail = append_new(
+            q_states, q_lo, q_hi, q_ebits, q_depth, tail = _append(
                 c.q_states, c.q_lo, c.q_hi, c.q_ebits, c.q_depth, c.tail,
                 flat, slo, shi, ebits[src_row], depth[src_row] + 1, is_new,
             )

@@ -140,3 +140,25 @@ def test_donated_overflow_has_no_recovery_carry():
     assert rs._carry is None
     with pytest.raises(RuntimeError, match="no table snapshot"):
         rs.reconstruct_path(1)
+
+
+def test_append_variants_identical_results():
+    # The backend-informed default picks scatter on CPU; pin both variants
+    # explicitly and require identical counts, discoveries, and completion
+    # (the DUS path is the TPU default — round-4: 627k -> 1.06M states/s).
+    from stateright_tpu.tensor.resident import ResidentSearch
+
+    runs = {
+        v: ResidentSearch(
+            TensorTwoPhaseSys(4), 256, 14, append=v
+        ).run()
+        for v in ("scatter", "dus")
+    }
+    a, b = runs["scatter"], runs["dus"]
+    assert (a.state_count, a.unique_state_count, a.max_depth) == (
+        b.state_count,
+        b.unique_state_count,
+        b.max_depth,
+    )
+    assert a.discoveries.keys() == b.discoveries.keys()
+    assert a.complete and b.complete
