@@ -240,3 +240,27 @@ def test_refine_check_over_sharded_engine():
     assert r.complete
     assert r.unique_state_count == host.unique_state_count() == 7
     assert r.state_count == host.state_count()
+
+
+def test_sharded_append_variants_identical_results():
+    # The mesh-platform default picks scatter on CPU meshes; pin the DUS
+    # variant explicitly so its slack/guard path (queue rows = S + N*C,
+    # DUS start never clamps) is exercised on the virtual mesh too.
+    runs = {
+        v: ShardedSearch(
+            TensorTwoPhaseSys(4),
+            mesh=make_mesh(4),
+            batch_size=128,
+            table_log2=12,
+            append=v,
+        ).run()
+        for v in ("scatter", "dus")
+    }
+    a, b = runs["scatter"], runs["dus"]
+    assert (a.state_count, a.unique_state_count) == (8258, 1568)
+    assert (a.state_count, a.unique_state_count) == (
+        b.state_count,
+        b.unique_state_count,
+    )
+    assert a.discoveries.keys() == b.discoveries.keys()
+    assert a.complete and b.complete
