@@ -159,22 +159,41 @@ def probe_device(attempts: int = 6, delay: float = 20.0):
     the probe retries patiently — and in a fresh subprocess each time, because
     a failed backend init can be cached for the life of a process, which would
     make in-process retries (and the real run afterwards) futile.
+
+    Failure-mode triage (round-4 postmortem: three 180 s probe TIMEOUTS
+    burned 9+ min of driver budget on a tunnel that was wedged, not busy):
+    a busy tunnel FAILS FAST with an UNAVAILABLE error — retrying with a
+    delay is right; a wedged tunnel HANGS until the timeout — two
+    consecutive hangs have never been followed by a recovery within the
+    bench's time horizon, so the probe gives up after the second timeout
+    instead of burning attempts x 180 s.
     """
     last = "unknown"
+    consecutive_timeouts = 0
     for i in range(attempts):
         try:
             proc = subprocess.run(
                 [sys.executable, "-c", _PROBE_SNIPPET],
                 capture_output=True,
                 text=True,
-                timeout=180,
+                timeout=120,
             )
+        except subprocess.TimeoutExpired as e:
+            consecutive_timeouts += 1
+            last = f"probe subprocess timed out: {e}"
+            log(last)
+            if consecutive_timeouts >= 2:
+                log("two consecutive probe timeouts: tunnel wedged, giving up")
+                return False, last
+            continue  # a hung tunnel needs no inter-attempt delay
         except Exception as e:  # noqa: BLE001
+            consecutive_timeouts = 0
             last = f"probe subprocess failed: {e}"
             log(last)
             if i + 1 < attempts:
                 time.sleep(delay)
             continue
+        consecutive_timeouts = 0
         if proc.returncode == 0 and "PROBE_OK" in proc.stdout:
             log(f"device probe ok: {proc.stdout.strip()}")
             return True, ""

@@ -92,6 +92,14 @@ class HashTable:
         return dict(zip(keys.tolist(), parents.tolist()))
 
 
+def _rotr(x: jnp.ndarray, k: int) -> jnp.ndarray:
+    """uint32 rotate-right by a static 0 <= k < 32 (k=0 is the identity —
+    guarded because a shift by 32 is undefined in HLO)."""
+    if k == 0:
+        return x
+    return (x >> jnp.uint32(k)) | (x << jnp.uint32(32 - k))
+
+
 def _insert_impl(t_lo, t_hi, p_lo, p_hi, lo, hi, parent_lo, parent_hi, active):
     """Batched insert-if-absent. Returns InsertResult; see module docstring.
 
@@ -114,6 +122,22 @@ def _insert_impl(t_lo, t_hi, p_lo, p_hi, lo, hi, parent_lo, parent_hi, active):
     4. only reps whose bucket ran out of free lanes carry to the next round
        (off+1 — chain overflow), so the expected round count is ~1.
 
+    Round-5 shape: the first round is HOISTED out of the while loop as a
+    3-operand sort (the loop's 4-operand sort was the single largest op of
+    the paxos-3 step — `while.95`, 4.75 of 12.9 ms in the round-4 silicon
+    profile). In round 1 every pending lane probes bucket `hi & mask`, so
+    the (bucket, hi) sort pair collapses into ONE key: `rotr(hi, log2_nb)`
+    moves the bucket bits to the top — sorting by it IS sorting by
+    (bucket, rest-of-hi), and it is a bijection of hi, so run detection and
+    bucket recovery read the sorted operand directly (no gathers). Inactive
+    lanes sort to the unique sentinel pair (0xFFFFFFFF, lo=0) — a real key
+    never has lo == 0 (tensor/fingerprint.py forces lo nonzero) — which
+    also keeps equal-key runs contiguous in the one tie block. The unsort
+    is one iota scatter (the inverse permutation) + cheap gathers instead
+    of three scatters. The while loop below only runs for bucket-overflow
+    carries (per-lane probe offsets diverge there, so it keeps the general
+    4-operand sort) — at sane load factors it executes ZERO iterations.
+
     Resolved/inactive lanes sort to a sentinel bucket past the end, which
     also keeps a key run's rep well-defined when some of its lanes are
     inactive. Claimed slots are never emptied, so linear bucket probing and
@@ -123,10 +147,64 @@ def _insert_impl(t_lo, t_hi, p_lo, p_hi, lo, hi, parent_lo, parent_hi, active):
     size = t_lo.shape[0]
     bucket = min(BUCKET, size)  # tiny tables (tests) shrink to one bucket
     n_buckets = size // bucket
+    log2_nb = n_buckets.bit_length() - 1  # size and bucket are powers of 2
     B = lo.shape[0]
     bmask = jnp.int32(n_buckets - 1)
     b0 = (hi & jnp.uint32(n_buckets - 1)).astype(jnp.int32)
     idx = jnp.arange(B, dtype=jnp.int32)
+
+    # -- round 1, hoisted: 3-operand sort-claim at probe offset 0 --------------
+    key0 = jnp.where(active, _rotr(hi, log2_nb), jnp.uint32(0xFFFFFFFF))
+    lo_m = jnp.where(active, lo, jnp.uint32(0))
+    s_key0, s_lo, perm = jax.lax.sort((key0, lo_m, idx), num_keys=2)
+    s_active = ~((s_key0 == jnp.uint32(0xFFFFFFFF)) & (s_lo == 0))
+    s_hi = _rotr(s_key0, (32 - log2_nb) % 32)  # rotate back: bijection
+    sb = (
+        (s_key0 >> jnp.uint32(32 - log2_nb)).astype(jnp.int32)
+        if log2_nb
+        else jnp.zeros(B, jnp.int32)
+    )
+
+    same_prev = (
+        (s_key0 == jnp.roll(s_key0, 1)) & (s_lo == jnp.roll(s_lo, 1))
+    ).at[0].set(False)
+    rep = s_active & ~same_prev
+
+    rows_lo = t_lo.reshape(n_buckets, bucket)[sb]  # free bitcast view
+    rows_hi = t_hi.reshape(n_buckets, bucket)[sb]
+    hit = rep & jnp.any(
+        (rows_lo == s_lo[:, None]) & (rows_hi == s_hi[:, None]), axis=1
+    )
+    need = rep & ~hit
+
+    seg_start = (sb != jnp.roll(sb, 1)).at[0].set(True)
+    excl = jnp.cumsum(need.astype(jnp.int32)) - need.astype(jnp.int32)
+    seg_base = jax.lax.cummax(jnp.where(seg_start, excl, jnp.int32(-1)))
+    rank = excl - seg_base
+
+    free_m = rows_lo == 0
+    tri = jnp.triu(jnp.ones((bucket, bucket), jnp.bfloat16))
+    fcum = (
+        jnp.dot(
+            free_m.astype(jnp.bfloat16), tri,
+            preferred_element_type=jnp.float32,
+        )
+        .astype(jnp.int32)
+    )
+    pick = free_m & (fcum == (rank + 1)[:, None])
+    can_claim = need & jnp.any(pick, axis=1)
+    slot = sb * bucket + jnp.argmax(pick, axis=1).astype(jnp.int32)
+
+    tgt = jnp.where(can_claim, slot, size)
+    t_lo = t_lo.at[tgt].set(s_lo, mode="drop", unique_indices=True)
+    t_hi = t_hi.at[tgt].set(s_hi, mode="drop", unique_indices=True)
+    p_lo = p_lo.at[tgt].set(parent_lo[perm], mode="drop", unique_indices=True)
+    p_hi = p_hi.at[tgt].set(parent_hi[perm], mode="drop", unique_indices=True)
+
+    inv_perm = jnp.zeros(B, jnp.int32).at[perm].set(idx, unique_indices=True)
+    is_new0 = can_claim[inv_perm]
+    carry0 = (need & ~can_claim)[inv_perm]  # bucket full -> probe bucket +1
+    off0 = carry0.astype(jnp.int32)
 
     def cond(carry):
         (_tl, _th, _pl, _ph, pending, _new, _off, rounds) = carry
@@ -207,13 +285,11 @@ def _insert_impl(t_lo, t_hi, p_lo, p_hi, lo, hi, parent_lo, parent_hi, active):
         )
         return t_lo, t_hi, p_lo, p_hi, pending, is_new, off, rounds + 1
 
-    zeros_i = jnp.zeros_like(lo, dtype=jnp.int32)
     t_lo, t_hi, p_lo, p_hi, pending, is_new, _off, _rounds = (
         jax.lax.while_loop(
             cond,
             body,
-            (t_lo, t_hi, p_lo, p_hi, active, jnp.zeros_like(active),
-             zeros_i, jnp.int32(0)),
+            (t_lo, t_hi, p_lo, p_hi, carry0, is_new0, off0, jnp.int32(1)),
         )
     )
     return InsertResult(t_lo, t_hi, p_lo, p_hi, is_new, jnp.any(pending))

@@ -318,3 +318,64 @@ def test_resident_queue_log2_right_sized_and_overflow():
         ResidentSearch(
             model, batch_size=512, table_log2=14, queue_log2=8
         ).run()
+
+
+def test_hashtable_fastpath_sentinel_adjacent_keys():
+    # Round-5 fast path: inactive lanes sort to (key0=0xFFFFFFFF, lo=0).
+    # Keys with hi == 0xFFFFFFFF land in the same tie block (rotr of all-ones
+    # is all-ones); lo >= 1 must keep them distinct from the sentinel and
+    # their runs contiguous even with inactive lanes interleaved.
+    ht = HashTable(8)
+    hi_ones = 0xFFFFFFFF << 32
+    keys = [hi_ones | 3, hi_ones | 3, 0, hi_ones | 3, hi_ones | 7, 0]
+    active = jnp.asarray([True, True, False, True, True, False])
+    lo, hi = _pairs(keys)
+    z = jnp.zeros(len(keys), dtype=jnp.uint32)
+    res = ht.insert(lo, hi, z, z, active)
+    assert np.asarray(res.is_new).sum() == 2  # {hi|3, hi|7}, once each
+    assert not bool(res.overflow)
+    assert set(ht.dump()) == {hi_ones | 3, hi_ones | 7}
+    # Re-insert: all duplicates.
+    res = ht.insert(lo, hi, z, z, active)
+    assert np.asarray(res.is_new).sum() == 0
+
+
+def test_hashtable_bucket_overflow_carries_to_next_bucket():
+    # Force the rare multi-round path: 2 buckets of 8 slots (table 2^4);
+    # 12 keys all hashing to bucket 0 must spill 4 into bucket 1 and stay
+    # findable (membership via linear bucket chain).
+    ht = HashTable(4)
+    n_buckets = 2
+    # hi even -> bucket 0 (bucket = hi & (n_buckets-1)).
+    keys = [(2 * k << 32) | (k + 1) for k in range(12)]
+    lo, hi = _pairs(keys)
+    z = jnp.zeros(len(keys), dtype=jnp.uint32)
+    act = jnp.ones(len(keys), dtype=bool)
+    res = ht.insert(lo, hi, z, z, act)
+    assert np.asarray(res.is_new).sum() == 12
+    assert not bool(res.overflow)
+    assert set(ht.dump()) == set(keys)
+    res = ht.insert(lo, hi, z, z, act)
+    assert np.asarray(res.is_new).sum() == 0  # spilled keys are still found
+
+
+def test_hashtable_randomized_parity_vs_dict():
+    # Randomized end-to-end parity of insert-if-absent against a host dict,
+    # exercising duplicates within and across batches and inactive lanes.
+    rng = np.random.default_rng(7)
+    ht = HashTable(10)
+    seen = set()
+    for _ in range(6):
+        lo = rng.integers(1, 40, size=256).astype(np.uint32)
+        hi = rng.integers(0, 7, size=256).astype(np.uint32)
+        active = rng.random(256) < 0.8
+        res = ht.insert(
+            jnp.asarray(lo), jnp.asarray(hi),
+            jnp.zeros(256, jnp.uint32), jnp.zeros(256, jnp.uint32),
+            jnp.asarray(active),
+        )
+        keys = (hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64)
+        fresh = {int(k) for k, a in zip(keys, active) if a} - seen
+        assert int(np.asarray(res.is_new).sum()) == len(fresh)
+        seen |= fresh
+    assert set(ht.dump()) == seen
