@@ -1,0 +1,21 @@
+#!/bin/bash
+# Continuous tunnel probe: one fresh subprocess every ~5 min, logging to
+# /tmp/tpu_probe_r5.log. Exits (leaving PROBE_OK as the last line) the
+# moment a probe succeeds so a watcher can react.
+LOG=/tmp/tpu_probe_r5.log
+while true; do
+  echo "$(date -u +%FT%TZ) probing..." >> "$LOG"
+  if timeout 150 python -c "
+import jax
+jax.config.update('jax_compilation_cache_dir', '/root/repo/.jax_cache')
+import jax.numpy as jnp
+x = jax.jit(lambda a: a*2+1)(jnp.arange(8)); x.block_until_ready()
+print('PROBE_OK', jax.devices())
+" >> "$LOG" 2>&1; then
+    if tail -3 "$LOG" | grep -q PROBE_OK; then
+      echo "$(date -u +%FT%TZ) TUNNEL ALIVE" >> "$LOG"
+      exit 0
+    fi
+  fi
+  sleep 300
+done

@@ -71,12 +71,21 @@ class TensorTwoPhaseSys(TensorModel):
     """
 
     rm_count: int
-    symmetry: bool = False  # opt-in like the host builder's .symmetry()
+    # Opt-in like the host builder's .symmetry(). True selects the full-key
+    # orbit invariant (the device default — traversal-order-independent,
+    # 2PC-5: 314); "value" selects the reference's value-only sort
+    # (ref: src/checker/rewrite_plan.rs:81-107), whose reduced count is
+    # traversal-order-DEPENDENT — it reproduces the published 665 golden
+    # only under reference DFS order (see tensor/symmetry.py
+    # device_dfs_unique_count and the module docstring's measured table).
+    symmetry: "bool | str" = False
 
     def __post_init__(self):
         self.lanes = self.rm_count + 3
         self.max_actions = 2 + 5 * self.rm_count
-        if self.symmetry:
+        if self.symmetry == "value":
+            self.representative = self._representative_value_sort
+        elif self.symmetry:
             self.representative = self._representative
 
     def init_states(self):
@@ -185,8 +194,6 @@ class TensorTwoPhaseSys(TensorModel):
         behavior for parity, while the device models take the stronger
         reduction (cross-validated against host DFS with the same full-key
         canonicalization)."""
-        from .symmetry import gather_entities, permute_mask_bits, stable_argsort
-
         n = self.rm_count
         rm = states[:, :n]
         prepared_mask = states[:, n + 1]
@@ -194,9 +201,28 @@ class TensorTwoPhaseSys(TensorModel):
         lanes = jnp.arange(n, dtype=jnp.uint32)
         prep_bits = (prepared_mask[:, None] >> lanes) & jnp.uint32(1)
         msg_bits = (msgs[:, None] >> lanes) & jnp.uint32(1)
-        perm = stable_argsort(
-            rm * jnp.uint32(4) + prep_bits * jnp.uint32(2) + msg_bits
-        )
+        keys = rm * jnp.uint32(4) + prep_bits * jnp.uint32(2) + msg_bits
+        return self._permute_rms(states, keys)
+
+    def _representative_value_sort(self, states):
+        """The reference's value-only sort (ref: examples/2pc.rs:163-168 via
+        src/checker/rewrite_plan.rs:81-107): RMs sort on their state value
+        alone, ties broken by original index (stable). Satellite-bit ties
+        split orbits, so the reduced count depends on traversal order —
+        opt-in for reference-golden parity (2PC-5 = 665 under DFS order),
+        not the device default."""
+        return self._permute_rms(states, states[:, : self.rm_count])
+
+    def _permute_rms(self, states, keys):
+        """Apply the RM permutation given per-RM sort keys: sort RM lanes and
+        permute the prepared/message bit positions to match."""
+        from .symmetry import gather_entities, permute_mask_bits, stable_argsort
+
+        n = self.rm_count
+        rm = states[:, :n]
+        prepared_mask = states[:, n + 1]
+        msgs = states[:, n + 2]
+        perm = stable_argsort(keys)
         rm_new = gather_entities(rm, perm)
         prep_new = permute_mask_bits(prepared_mask, perm)
         rm_bits_new = permute_mask_bits(msgs, perm)

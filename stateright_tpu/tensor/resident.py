@@ -771,7 +771,8 @@ class ResidentSearch:
         self._last_tables = None
 
     def dump_states(
-        self, decode: bool = True, evaluated_only: bool = False
+        self, decode: bool = True, evaluated_only: bool = False,
+        raw: bool = False, start: int = 0,
     ) -> list:
         """Batched state dump: every unique state the search reached, pulled
         from the frontier queue in ONE device transfer (the queue never
@@ -792,6 +793,12 @@ class ResidentSearch:
                 "dispatch) before dump_states()"
             )
         end = int(self._carry.head if evaluated_only else self._carry.tail)
+        if raw:
+            # The bulk form: uint32[n, lanes]. refine_check's per-round
+            # poison scan works on millions of rows — python tuple-building
+            # dominated the round cost before this. `start` slices on device
+            # so incremental callers transfer only the delta.
+            return np.asarray(self._carry.q_states[start:end])
         rows = np.asarray(self._carry.q_states[:end])
         if not decode:
             return [tuple(int(x) for x in r) for r in rows]

@@ -72,3 +72,59 @@ def permute_mask_bits(mask: jnp.ndarray, perm: jnp.ndarray) -> jnp.ndarray:
     bits = (mask[:, None] >> perm.astype(mask.dtype)) & mask.dtype.type(1)
     weights = (mask.dtype.type(1) << jnp.arange(n, dtype=mask.dtype))[None, :]
     return (bits * weights).sum(axis=1, dtype=mask.dtype)
+
+
+def device_dfs_unique_count(model, max_pops: int = 1 << 20) -> int:
+    """Sequential DFS driven by the DEVICE kernels (expand + canonicalize +
+    fingerprint all run on the jax backend; only the stack lives on host).
+
+    This exists for one purpose: value-sort canonicalization
+    (`TensorTwoPhaseSys(symmetry="value")`) is traversal-order-dependent, so
+    its published golden (2PC-5 = 665, ref: examples/2pc.rs:163-168) is only
+    reproducible under the reference DFS's order — push successors in action
+    order, pop last-first, insert the representative's fingerprint, continue
+    from the ORIGINAL state (ref: src/checker/dfs.rs:309-334). The batched
+    engines are level-synchronous and cannot pin that golden (symmetry
+    module docstring); this driver runs the same device kernels one state at
+    a time in exactly that order, closing the count-parity gap as an opt-in.
+    """
+    import numpy as np
+
+    from .fingerprint import pack_fp
+    from .frontier import state_fingerprint
+
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(row):
+        succs, valid = model.expand(row[None])
+        lo, hi = state_fingerprint(model, succs[0])
+        return succs[0], valid[0], lo, hi
+
+    init = np.asarray(model.init_states(), dtype=np.uint32)
+    ilo, ihi = (
+        np.asarray(x)
+        for x in state_fingerprint(model, jnp.asarray(init))
+    )
+    init_fps = pack_fp(ilo, ihi)
+    seen = set()
+    stack = []
+    for row, fp in zip(init, init_fps):
+        if int(fp) not in seen:
+            seen.add(int(fp))
+            stack.append(row)
+    pops = 0
+    while stack:
+        if pops >= max_pops:
+            raise RuntimeError(f"exceeded max_pops={max_pops}")
+        pops += 1
+        row = stack.pop()
+        succs, valid, lo, hi = step(jnp.asarray(row))
+        succs, valid = np.asarray(succs), np.asarray(valid)
+        fps = pack_fp(np.asarray(lo), np.asarray(hi))
+        for a in range(valid.shape[0]):
+            if valid[a] and int(fps[a]) not in seen:
+                seen.add(int(fps[a]))
+                stack.append(succs[a])
+    return len(seen)

@@ -272,3 +272,16 @@ def test_increment_lock_6_sym_golden():
     sym = FrontierSearch(TensorIncrementLock(6, symmetry=True), 1024, 12).run()
     assert full.unique_state_count == 7825
     assert sym.unique_state_count == 25
+
+
+def test_value_sort_device_dfs_reproduces_reference_665():
+    """Opt-in reference-parity symmetry ON DEVICE (VERDICT r4 next #8): the
+    device value-sort canonicalization kernel, driven in reference DFS
+    order, reproduces the published 2PC-5 golden of 665
+    (ref: examples/2pc.rs:163-168) — alongside the engines' default
+    order-independent full-key 314."""
+    from stateright_tpu.tensor.models import TensorTwoPhaseSys
+    from stateright_tpu.tensor.symmetry import device_dfs_unique_count
+
+    assert device_dfs_unique_count(TensorTwoPhaseSys(5, symmetry="value")) == 665
+    assert device_dfs_unique_count(TensorTwoPhaseSys(5, symmetry=True)) == 314
