@@ -645,6 +645,17 @@ def main() -> int:
         }
         for k, v in dev.items()
     }
+    # Sharding overhead ratio (VERDICT r4 next #4): sharded-N vs the
+    # single-device engine on the SAME workload — <1 means the sharded
+    # engine's per-step machinery (send-buffer scatters, all-to-all,
+    # N-fold insert width) costs more than it parallelizes on this mesh.
+    for k, v in dev.items():
+        if k.endswith("-sharded8") and k[: -len("-sharded8")] in dev:
+            single = dev[k[: -len("-sharded8")]]["states_per_sec"]
+            if single > 0:
+                detail["device"][k]["vs_single_device"] = round(
+                    v["states_per_sec"] / single, 3
+                )
     if dev_errors:
         detail["device_errors"] = dev_errors
 

@@ -6,7 +6,8 @@ TPU when the tunnel is up), asserting golden parity every time and printing
 states/sec per config. One workload config per subprocess invocation keeps a
 wedged tunnel from eating the whole sweep — run via scripts/tpu_tune.sh.
 
-Usage: python scripts/tpu_tune.py MODEL N BATCH TABLE_LOG2 [REPEATS]
+Usage: python scripts/tpu_tune.py MODEL N BATCH TABLE_LOG2 [REPEATS] [LAYOUT]
+LAYOUT: split (default) | kv — the visited-table layout to race.
 Set TPU_TUNE_TRACE=/path to capture a jax.profiler trace of the timed runs
 (inspect with tensorboard or xprof to see the per-step op breakdown).
 """
@@ -34,6 +35,7 @@ def main() -> int:
         int(sys.argv[4]),
     )
     repeats = max(1, int(sys.argv[5])) if len(sys.argv) > 5 else 3
+    layout = sys.argv[6] if len(sys.argv) > 6 else "split"
 
     from stateright_tpu.tensor.resident import ResidentSearch
 
@@ -48,10 +50,12 @@ def main() -> int:
 
     print(
         f"devices={jax.devices()} workload={model_name}-{n} "
-        f"batch={batch} table=2^{table_log2}",
+        f"batch={batch} table=2^{table_log2} layout={layout}",
         flush=True,
     )
-    search = ResidentSearch(model, batch_size=batch, table_log2=table_log2)
+    search = ResidentSearch(
+        model, batch_size=batch, table_log2=table_log2, table_layout=layout
+    )
     t0 = time.monotonic()
     r = search.run()
     compile_s = time.monotonic() - t0

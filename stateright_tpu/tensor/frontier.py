@@ -77,14 +77,21 @@ def count_ge(clo, chi, tlo, thi):
     return (chi > thi) | ((chi == thi) & (clo >= tlo))
 
 
-def expand_insert(model, t_lo, t_hi, p_lo, p_hi, states, lo, hi, active):
+def expand_insert(
+    model, t_lo, t_hi, p_lo, p_hi, states, lo, hi, active,
+    insert=_insert_impl,
+):
     """The traced core of one frontier step, shared by the host-orchestrated
     and device-resident engines: expand, boundary-mask, fingerprint, visited-
     set insert with parent tracking (the insert also dedups within the batch).
 
     Returns (t_lo, t_hi, p_lo, p_hi, flat_states, succ_lo, succ_hi, is_new,
     gen_count, has_succ, overflow); row i of the flattened successor arrays
-    came from input row i // max_actions.
+    came from input row i // max_actions. `insert` swaps the visited-set
+    implementation (same 9-arg signature/6-tuple result as
+    hashtable._insert_impl) — the engines use it for the interleaved-kv
+    table layout, where t_lo is the uint32[2S] kv array and t_hi is a
+    zero-length placeholder.
     """
     K = states.shape[0]
     A = model.max_actions
@@ -101,7 +108,7 @@ def expand_insert(model, t_lo, t_hi, p_lo, p_hi, states, lo, hi, active):
     slo, shi = state_fingerprint(model, flat)
     par_lo = jnp.repeat(lo, A)
     par_hi = jnp.repeat(hi, A)
-    t_lo, t_hi, p_lo, p_hi, is_new, ovf = _insert_impl(
+    t_lo, t_hi, p_lo, p_hi, is_new, ovf = insert(
         t_lo, t_hi, p_lo, p_hi, slo, shi, par_lo, par_hi, validf
     )
     return (

@@ -50,7 +50,7 @@ from .frontier import (
     record_discovery as _record,
     seed_init,
 )
-from .hashtable import _insert_impl
+from .hashtable import KV_BUCKET, _insert_impl, _insert_impl_kv
 from .model import TensorModel
 
 
@@ -208,6 +208,7 @@ class ResidentSearch:
         donate_chunks: bool = False,
         queue_log2: Optional[int] = None,
         append: Optional[str] = None,
+        table_layout: str = "split",
     ):
         """`donate_chunks=True` donates the carry to each chunked dispatch:
         XLA updates the tables/queue IN PLACE instead of copying the whole
@@ -239,6 +240,15 @@ class ResidentSearch:
         # slower), so the default follows the effective backend; pass
         # append="scatter"|"dus" to pin it.
         self.append = resolve_append(append, jax.default_backend())
+        # table_layout="kv": interleaved 64-slot lo|hi buckets — one probe
+        # gather fetches half the bytes of the split layout (see
+        # hashtable._insert_impl_kv). Carry convention: t_lo holds the
+        # uint32[2S] kv array and t_hi a zero-length placeholder.
+        # Flag-gated pending the silicon race; checkpoint regrow is
+        # split-only for now.
+        if table_layout not in ("split", "kv"):
+            raise ValueError("table_layout must be 'split' or 'kv'")
+        self.table_layout = table_layout
         self.props = model.properties()
         self._kernel, self._seed_k, self._chunk_k = self._build()
         self._last_tables = None
@@ -258,11 +268,22 @@ class ResidentSearch:
         # calls so budget/timeout suspensions and overflows are resumable.
         self._carry = None
 
+    def _insert_fn(self):
+        if self.table_layout == "split":
+            return _insert_impl
+
+        def kv_adapter(t_kv, t_empty, p_lo, p_hi, lo, hi, plo, phi, active):
+            r = _insert_impl_kv(t_kv, p_lo, p_hi, lo, hi, plo, phi, active)
+            return r.t_kv, t_empty, r.p_lo, r.p_hi, r.is_new, r.overflow
+
+        return kv_adapter
+
     def _build(self):
         model = self.model
         K = self.batch_size
         A = model.max_actions
         L = model.lanes
+        insert = self._insert_fn()
         _append = append_new if self.append == "scatter" else append_new_dus
         S = 1 << self.table_log2
         # Queue capacity: every unique state is enqueued exactly once (<= S
@@ -320,7 +341,8 @@ class ResidentSearch:
                 flat, slo, shi, is_new,
                 gen, has_succ, ovf,
             ) = expand_insert(
-                model, c.t_lo, c.t_hi, c.p_lo, c.p_hi, states, lo, hi, active
+                model, c.t_lo, c.t_hi, c.p_lo, c.p_hi, states, lo, hi,
+                active, insert=insert,
             )
 
             # -- eventually counterexamples at terminal states -----------------
@@ -391,12 +413,16 @@ class ResidentSearch:
         def make_carry(init_states, init_lo, init_hi, n0, seed_lo, seed_hi):
             # Tables are allocated in-trace: a fresh search per dispatch, and
             # no host-side zero-fill round trip over the device tunnel.
-            t_lo = jnp.zeros(S, dtype=jnp.uint32)
-            t_hi = jnp.zeros(S, dtype=jnp.uint32)
+            if self.table_layout == "kv":
+                t_lo = jnp.zeros(2 * S, dtype=jnp.uint32)  # the kv array
+                t_hi = jnp.zeros(0, dtype=jnp.uint32)  # placeholder
+            else:
+                t_lo = jnp.zeros(S, dtype=jnp.uint32)
+                t_hi = jnp.zeros(S, dtype=jnp.uint32)
             p_lo = jnp.zeros(S, dtype=jnp.uint32)
             p_hi = jnp.zeros(S, dtype=jnp.uint32)
             init_active = jnp.arange(K, dtype=jnp.int32) < n0
-            t_lo, t_hi, p_lo, p_hi, is_new, ovf = _insert_impl(
+            t_lo, t_hi, p_lo, p_hi, is_new, ovf = insert(
                 t_lo, t_hi, p_lo, p_hi,
                 init_lo, init_hi,
                 jnp.zeros(K, dtype=jnp.uint32), jnp.zeros(K, dtype=jnp.uint32),
@@ -605,7 +631,12 @@ class ResidentSearch:
         # is_awaiting_discoveries early-out (ref: bfs.rs:278-280).
         if finish_when.matches(self.props, set()) or not self.props:
             z = np.zeros(1 << self.table_log2, dtype=np.uint32)
-            self._last_tables = (z, z, z, z)
+            self._last_tables = (
+                (np.zeros(2 << self.table_log2, np.uint32),
+                 np.zeros(0, np.uint32), z, z)
+                if self.table_layout == "kv"
+                else (z, z, z, z)
+            )
             return SearchResult(
                 state_count=n_raw,
                 unique_state_count=n0,
@@ -833,6 +864,7 @@ class ResidentSearch:
                     "table_log2": self.table_log2,
                     "queue_log2": self.queue_log2,
                     "batch_size": self.batch_size,
+                    "table_layout": self.table_layout,
                 }
             ).encode(),
             dtype=np.uint8,
@@ -859,6 +891,12 @@ class ResidentSearch:
         data = np.load(_ckpt_path(path))
         meta = json.loads(bytes(data["meta"].tobytes()).decode())
         _validate_ckpt_meta(model, meta)
+        if meta.get("table_layout", "split") != "split":
+            raise NotImplementedError(
+                "checkpoint resume is split-layout-only for now; rerun the "
+                "search with table_layout='split' (default) if you need "
+                "checkpoint/regrow"
+            )
         log2 = table_log2 if table_log2 is not None else meta["table_log2"]
         if log2 < meta["table_log2"]:
             raise ValueError("cannot shrink the table on resume")
@@ -921,6 +959,11 @@ class ResidentSearch:
             t_lo, t_hi, p_lo, p_hi = (
                 np.asarray(x) for x in self._last_tables
             )
+            if self.table_layout == "kv":
+                b = min(KV_BUCKET, 1 << self.table_log2)
+                kv = t_lo.reshape(-1, 2 * b)
+                t_lo = kv[:, :b].reshape(-1)
+                t_hi = kv[:, b:].reshape(-1)
             nz = t_lo != 0
             keys = pack_fp(t_lo[nz], t_hi[nz])
             parents = pack_fp(p_lo[nz], p_hi[nz])

@@ -379,3 +379,57 @@ def test_hashtable_randomized_parity_vs_dict():
         assert int(np.asarray(res.is_new).sum()) == len(fresh)
         seen |= fresh
     assert set(ht.dump()) == seen
+
+
+def test_hashtable_kv_parity_with_split_layout():
+    # The interleaved-bucket (kv) insert must agree with the split-layout
+    # insert on membership, is_new attribution, parents, and overflow.
+    from stateright_tpu.tensor.hashtable import HashTableKV
+
+    rng = np.random.default_rng(11)
+    split, kv = HashTable(10), HashTableKV(10)
+    for _ in range(5):
+        lo = rng.integers(1, 60, size=192).astype(np.uint32)
+        hi = rng.integers(0, 9, size=192).astype(np.uint32)
+        act = rng.random(192) < 0.85
+        plo = rng.integers(1, 1000, size=192).astype(np.uint32)
+        phi = rng.integers(0, 1000, size=192).astype(np.uint32)
+        a = split.insert(jnp.asarray(lo), jnp.asarray(hi), jnp.asarray(plo),
+                         jnp.asarray(phi), jnp.asarray(act))
+        b = kv.insert(jnp.asarray(lo), jnp.asarray(hi), jnp.asarray(plo),
+                      jnp.asarray(phi), jnp.asarray(act))
+        assert (np.asarray(a.is_new) == np.asarray(b.is_new)).all()
+        assert bool(a.overflow) == bool(b.overflow) == False  # noqa: E712
+    assert split.dump() == kv.dump()  # same keys AND same parents
+
+
+def test_hashtable_kv_bucket_overflow_carries():
+    from stateright_tpu.tensor.hashtable import HashTableKV
+
+    ht = HashTableKV(7)  # 128 slots = 2 buckets of 64
+    keys = [(2 * k << 32) | (k + 1) for k in range(80)]  # all bucket 0
+    lo = jnp.asarray(np.array([v & 0xFFFFFFFF for v in keys], np.uint32))
+    hi = jnp.asarray(np.array([v >> 32 for v in keys], np.uint32))
+    z = jnp.zeros(len(keys), jnp.uint32)
+    act = jnp.ones(len(keys), bool)
+    res = ht.insert(lo, hi, z, z, act)
+    assert int(np.asarray(res.is_new).sum()) == 80
+    assert not bool(res.overflow)
+    assert set(ht.dump()) == set(keys)
+    res = ht.insert(lo, hi, z, z, act)
+    assert int(np.asarray(res.is_new).sum()) == 0
+
+
+def test_resident_kv_layout_matches_split_goldens():
+    # End-to-end search parity for the interleaved-kv table layout,
+    # including path reconstruction through the kv-aware parent map.
+    from stateright_tpu.tensor.resident import ResidentSearch
+
+    a = ResidentSearch(TensorTwoPhaseSys(4), 256, 14).run()
+    rs = ResidentSearch(TensorTwoPhaseSys(4), 256, 14, table_layout="kv")
+    b = rs.run()
+    assert (a.state_count, a.unique_state_count) == (8258, 1568)
+    assert (b.state_count, b.unique_state_count) == (8258, 1568)
+    assert set(a.discoveries) == set(b.discoveries)
+    path = rs.reconstruct_path(b.discoveries["commit agreement"])
+    assert len(path.actions()) >= 1  # replays through kv parent pointers
