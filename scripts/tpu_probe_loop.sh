@@ -1,19 +1,25 @@
 #!/bin/bash
-# Continuous tunnel probe: one fresh subprocess every ~5 min, logging to
-# /tmp/tpu_probe_r5.log. Exits (leaving PROBE_OK as the last line) the
-# moment a probe succeeds so a watcher can react.
+# Continuous tunnel probe; on the FIRST successful probe it immediately
+# runs the staged tunnel-day sequence (scripts/tunnel_day.sh: tune sweep +
+# hashtable/kv races + full bench) so even a transient tunnel window turns
+# into silicon numbers. Log: /tmp/tpu_probe_r5.log; tunnel-day output under
+# /tmp/tunnel_day.
 LOG=/tmp/tpu_probe_r5.log
+cd /root/repo || exit 1
 while true; do
   echo "$(date -u +%FT%TZ) probing..." >> "$LOG"
   if timeout 150 python -c "
 import jax
+assert jax.devices()[0].platform != 'cpu', jax.devices()
 jax.config.update('jax_compilation_cache_dir', '/root/repo/.jax_cache')
 import jax.numpy as jnp
 x = jax.jit(lambda a: a*2+1)(jnp.arange(8)); x.block_until_ready()
 print('PROBE_OK', jax.devices())
 " >> "$LOG" 2>&1; then
     if tail -3 "$LOG" | grep -q PROBE_OK; then
-      echo "$(date -u +%FT%TZ) TUNNEL ALIVE" >> "$LOG"
+      echo "$(date -u +%FT%TZ) TUNNEL ALIVE - launching tunnel_day.sh" >> "$LOG"
+      bash scripts/tunnel_day.sh /tmp/tunnel_day >> "$LOG" 2>&1
+      echo "$(date -u +%FT%TZ) tunnel_day.sh finished rc=$?" >> "$LOG"
       exit 0
     fi
   fi

@@ -404,34 +404,42 @@ class ShardedSearch:
                 ebits_rep = jnp.repeat(ebits, A)
                 depth_rep = jnp.repeat(depth + 1, A)
 
-                def scatter(zero, vals):
-                    return zero.at[dest].set(vals, mode="drop")
-
-                zero_nc = jnp.zeros(N * C, dtype=jnp.uint32)
-                s_states = scatter(
-                    jnp.zeros((N * C, L), dtype=jnp.uint32), flat
+                # ONE packed send buffer [N*C, L+7]: state lanes then
+                # (lo, hi, parent_lo, parent_hi, ebits, depth, valid-as-u32)
+                # — one zero-fill, one row scatter, ONE all_to_all instead
+                # of eight of each (per-collective launch overhead was a
+                # visible slice of the virtual-mesh step after the
+                # dest_capacity cut; on ICI, one large message also beats
+                # eight small ones).
+                packed = jnp.concatenate(
+                    [
+                        flat,
+                        jnp.stack(
+                            [
+                                slo, shi, parent_lo, parent_hi,
+                                ebits_rep, depth_rep,
+                                live.astype(jnp.uint32),
+                            ],
+                            axis=1,
+                        ),
+                    ],
+                    axis=1,
                 )
-                s_lo = scatter(zero_nc, slo)
-                s_hi = scatter(zero_nc, shi)
-                s_plo = scatter(zero_nc, parent_lo)
-                s_phi = scatter(zero_nc, parent_hi)
-                s_ebits = scatter(zero_nc, ebits_rep)
-                s_depth = scatter(zero_nc, depth_rep)
-                s_valid = scatter(jnp.zeros(N * C, dtype=bool), live)
-
-                def shuffle(x):
-                    return jax.lax.all_to_all(
-                        x.reshape(N, C, *x.shape[1:]), ax, 0, 0
-                    ).reshape(N * C, *x.shape[1:])
-
-                r_states = shuffle(s_states)
-                r_lo = shuffle(s_lo)
-                r_hi = shuffle(s_hi)
-                r_plo = shuffle(s_plo)
-                r_phi = shuffle(s_phi)
-                r_ebits = shuffle(s_ebits)
-                r_depth = shuffle(s_depth)
-                r_valid = shuffle(s_valid)
+                s_packed = (
+                    jnp.zeros((N * C, L + 7), dtype=jnp.uint32)
+                    .at[dest].set(packed, mode="drop")
+                )
+                r_packed = jax.lax.all_to_all(
+                    s_packed.reshape(N, C, L + 7), ax, 0, 0
+                ).reshape(N * C, L + 7)
+                r_states = r_packed[:, :L]
+                r_lo = r_packed[:, L]
+                r_hi = r_packed[:, L + 1]
+                r_plo = r_packed[:, L + 2]
+                r_phi = r_packed[:, L + 3]
+                r_ebits = r_packed[:, L + 4]
+                r_depth = r_packed[:, L + 5]
+                r_valid = r_packed[:, L + 6].astype(bool)
 
                 # -- insert into the local shard (handles duplicates) ----------
                 t_lo2, t_hi2, p_lo2, p_hi2, is_new, ins_ovf = _insert_impl(

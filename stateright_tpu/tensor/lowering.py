@@ -2410,18 +2410,25 @@ def refine_check(
     (`set_dyn_tables`), so a round only re-jits when a capacity class
     actually grows.
 
-    Resident-engine rounds are WARM (round 5): the search carry is kept
-    across `extend()` and only the already-popped rows that could realize a
-    newly-covered pair are re-enqueued (`affected_rows_mask`), in small
-    budgeted slabs with a poison scan between. Poison marker rows stay in
-    the carried table as phantom entries — sound here because intermediate
-    rounds exist only to FIND gaps; their counts and discoveries are never
-    returned. The EXACT result always comes from a fresh full verification
-    search once the warm rounds stop surfacing new gaps (claimed table
-    slots are never emptied — tensor/hashtable.py — so a carried table can
-    never serve exact counts; that part of the round-4 argument still
-    holds, which is why the final run restarts). The sharded engine keeps
-    the round-4 behavior: full restart per round.
+    Round structure (round 5): intermediate rounds are GAP-FINDING
+    restarts that stop at the first POPPED poison row
+    (finish_when=any_of(["lowering coverage"])) — by then a whole frontier
+    layer of poison rows already sits in the queue for the vectorized scan,
+    so exploring further only re-walks space the next round re-walks
+    anyway. The EXACT result comes from a full verification search under
+    the caller's own finish semantics once gaps stop surfacing (skipped
+    when the terminal gap-finding round already exhausted the space and no
+    finish policy would have stopped it earlier — finish policies are
+    monotone in the discovery set). `warm=True` (resident engine only)
+    instead CARRIES one search across extend() rounds, re-enqueueing only
+    the already-popped rows that could realize a newly-covered pair
+    (`affected_rows_mask`) in small budgeted slabs: poison rows stay in the
+    carried table as phantom entries, which is sound because warm rounds
+    exist only to find gaps — their counts are never returned. Measured on
+    paxos-3 (ROUND5_NOTES.md): restart+coverage-exit 478 s vs warm >900 s
+    (the affected-cone re-expansion loses once gap layers number in the
+    thousands); warm wins on models with few layers relative to the
+    space.
 
     Returns (final SearchResult, LoweredActorModel). Raises LoweringError on
     capacity overflows (grow pool_size/flow_depth/max_emit) or
@@ -2505,7 +2512,11 @@ def refine_check(
     # have different fingerprints from the poison markers that announced
     # them. (VERDICT r4 next #6; the per-round full re-search was the
     # dominant refinement cost after the re-jit fix.)
-    warm = warm and engine == "resident"
+    if warm and engine != "resident":
+        raise ValueError(
+            "warm=True requires engine='resident' (the sharded engine has "
+            "no carried-search injection path)"
+        )
     dbg = os.environ.get("REFINE_DEBUG")
     # Warm rounds run in SMALL budgeted slabs: a gap's poison row is visible
     # to the dump scan the moment it is GENERATED (enqueued), not when it is
@@ -2593,6 +2604,21 @@ def refine_check(
             )
         new_gaps = gaps - done
         if not new_gaps:
+            if not warm and not full_run and result.complete:
+                # The terminal gap-finding round exhausted the space with
+                # no poison pop — its ONLY semantic difference from the
+                # verification run is the finish_when override, and finish
+                # policies are monotone in the discovery set: if the
+                # final set would not have stopped the user's run, it never
+                # matched mid-run either, so this result already IS the
+                # exact answer and the full re-search can be skipped.
+                fw = rkw.get("finish_when", HasDiscoveries.ALL)
+                props_now = lowered.properties()
+                names = set(result.discoveries)
+                if not fw.matches(props_now, names) and len(names) < len(
+                    props_now
+                ):
+                    return result, lowered
             if full_run:
                 if "lowering coverage" in result.discoveries:
                     raise LoweringError(

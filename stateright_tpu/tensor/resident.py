@@ -853,6 +853,14 @@ class ResidentSearch:
                 "nothing to checkpoint: no suspended carry (run with "
                 "budget=... to enable chunked dispatch)"
             )
+        if self.table_layout != "split":
+            # load_checkpoint refuses kv checkpoints (regrow is split-only);
+            # fail at SAVE time rather than handing back a file that can
+            # never be restored.
+            raise NotImplementedError(
+                "checkpointing is split-layout-only for now; use "
+                "table_layout='split' (default) for checkpoint/resume runs"
+            )
         c = self._carry
         arrays = {f: np.asarray(getattr(c, f)) for f in c._fields}
         arrays["meta"] = np.frombuffer(
