@@ -41,28 +41,27 @@ class TpuChecker(Checker):
                 "canonicalization kernel instead (see tensor/symmetry.py), "
                 "which every device engine honors automatically"
             )
-        from ..core.visitor import StateRecorder
-
         self._recorder = None
         if options.visitor_ is not None:
-            if isinstance(options.visitor_, StateRecorder):
-                # State-set recording maps to the engines' batched queue dump
-                # (every unique state, one transfer) — the visitor pattern the
-                # reference's tests lean on (ref: src/checker/visitor.rs:
-                # 75-111). Path-carrying visitors stay host-only.
-                if resident is False:
-                    raise NotImplementedError(
-                        "StateRecorder on spawn_tpu requires the resident "
-                        "engine (the default); drop resident=False"
-                    )
-                self._recorder = options.visitor_
-            else:
+            # Visitors run POST-SEARCH over the retained carry: a
+            # StateRecorder gets the batched queue dump (every evaluated
+            # state, one transfer — ref: src/checker/visitor.rs:75-111);
+            # any other CheckerVisitor gets a full parent-pointer Path per
+            # evaluated state, rebuilt incrementally in BFS queue order
+            # (parents always precede children, so each path is its
+            # parent's path plus one replayed step — batched expands, one
+            # device call per parent chunk). Path building costs
+            # O(states x depth) host memory/time: it serves the
+            # reference's visitor use case (test-scale assertions), not
+            # flagship-scale spaces. The reference calls visitors DURING
+            # the search; here results are identical for recorders since
+            # the search always runs to its finish policy first.
+            if resident is False:
                 raise NotImplementedError(
-                    "visitors other than StateRecorder require a "
-                    "per-evaluated-state host callback with a full Path — "
-                    "incompatible with batched device search; use "
-                    "spawn_bfs/spawn_dfs for visitor-driven runs"
+                    "visitors on spawn_tpu require the resident engine "
+                    "(the default); drop resident=False"
                 )
+            self._recorder = options.visitor_
         super().__init__(model)
         # The resident engine runs the whole search in one device dispatch —
         # the default. A timeout makes it run in chunked dispatches (the
@@ -118,15 +117,113 @@ class TpuChecker(Checker):
         try:
             self._result = self._search.run(**kwargs)
             if self._recorder is not None:
-                from ..core.path import Path as _Path
+                from ..core.visitor import StateRecorder
 
-                # evaluated_only: rows the search actually popped — on an
-                # early exit the queue tail also holds never-evaluated
-                # frontier rows, which the reference's visitor never sees.
-                for s in self._search.dump_states(evaluated_only=True):
-                    self._recorder.visit(self._model, _Path([(s, None)]))
+                if isinstance(self._recorder, StateRecorder):
+                    from ..core.path import Path as _Path
+
+                    # evaluated_only: rows the search actually popped — on
+                    # an early exit the queue tail also holds
+                    # never-evaluated frontier rows, which the reference's
+                    # visitor never sees.
+                    for s in self._search.dump_states(evaluated_only=True):
+                        self._recorder.visit(self._model, _Path([(s, None)]))
+                else:
+                    self._visit_paths()
         except BaseException as e:  # noqa: BLE001 — surfaced by join()
             self._panic = e
+
+    def _visit_paths(self) -> None:
+        """Call the visitor with a full Path for every evaluated state.
+
+        Paths rebuild incrementally in queue order: a child's path is its
+        parent's path plus the one step that produced it, found by
+        expanding each parent once (batched over chunks of unique parents)
+        and matching child fingerprints against the successor table."""
+        import numpy as np
+
+        from ..core.path import Path as _Path
+        from ..tensor.fingerprint import pack_fp
+        from ..tensor.frontier import state_fingerprint
+
+        import jax.numpy as jnp
+
+        search = self._search
+        c = search._carry
+        if c is None:
+            return  # vacuous-finish early exit: nothing was evaluated
+        head = int(c.head)
+        if head == 0:
+            return
+        rows = np.asarray(c.q_states[:head])
+        fps = pack_fp(np.asarray(c.q_lo[:head]), np.asarray(c.q_hi[:head]))
+        parent_of = search.build_parent_map()  # layout-aware, cached
+        idx_of = {int(f): i for i, f in enumerate(fps)}
+        model = self._model
+
+        # One batched expand per chunk of unique parents; per parent, map
+        # successor fingerprint -> action slot.
+        action_cache: dict[int, dict[int, int]] = {}
+
+        def succ_actions(parent_idxs: list[int]) -> None:
+            batch = jnp.asarray(rows[parent_idxs])
+            succs, valid = model.expand(batch)
+            B, A = valid.shape
+            flat = succs.reshape(B * A, model.lanes)
+            # Boundary-mask exactly like the search itself
+            # (frontier.expand_insert): a boundary-excluded action is not a
+            # transition and must never label a path step.
+            validn = (
+                np.asarray(valid.reshape(-1) & model.within_boundary(flat))
+                .reshape(B, A)
+            )
+            slo, shi = state_fingerprint(model, flat)
+            sfps = pack_fp(np.asarray(slo), np.asarray(shi)).reshape(B, A)
+            for j, pi in enumerate(parent_idxs):
+                # First matching slot wins (reversed dict build keeps the
+                # LOWEST action index on fingerprint ties), matching the
+                # insert's first-writer semantics closely enough for replay.
+                action_cache[pi] = {
+                    int(sfps[j, a]): a
+                    for a in reversed(range(A))
+                    if validn[j, a]
+                }
+
+        CHUNK = 512
+        need: list[int] = []
+        seen_parents = set()
+        for i in range(head):
+            pfp = parent_of.get(int(fps[i]), 0)
+            pi = idx_of.get(pfp)
+            if pi is not None and pi not in seen_parents:
+                seen_parents.add(pi)
+                need.append(pi)
+        for k in range(0, len(need), CHUNK):
+            succ_actions(need[k : k + CHUNK])
+
+        paths: list[Optional[list]] = [None] * head
+        for i in range(head):
+            state = model.decode(rows[i])
+            pfp = parent_of.get(int(fps[i]), 0)
+            pi = idx_of.get(pfp)
+            if pi is None or paths[pi] is None:
+                pairs = [(state, None)]
+            else:
+                a = action_cache[pi].get(int(fps[i]))
+                label = (
+                    model.action_label(rows[pi], a) if a is not None else None
+                )
+                parent_pairs = paths[pi]
+                pairs = (
+                    parent_pairs[:-1]
+                    + [(parent_pairs[-1][0], label), (state, None)]
+                )
+            paths[i] = pairs
+            if self._recorder.should_visit():
+                # The visitor API's rate-limit hook: honored AFTER the path
+                # list is extended (cheap) but gating the Path build + call,
+                # like the host checkers (e.g. checker/bfs.py).
+                self._recorder.visit(model, _Path(list(pairs)))
 
     # -- Checker interface -----------------------------------------------------
 

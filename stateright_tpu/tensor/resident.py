@@ -955,9 +955,10 @@ class ResidentSearch:
         )
         return rs
 
-    def reconstruct_path(self, fp: int):
-        """TLC-style reconstruction from the final table contents (the logic
-        is shared with the host-orchestrated engine)."""
+    def build_parent_map(self) -> dict:
+        """{fingerprint: parent fingerprint (0 = init)} decoded from the
+        last run's table snapshot — layout-aware (split vs kv) and cached;
+        shared by path reconstruction and the TPU checker's visitors."""
         if self._parent_map is None:
             if self._last_tables is None:
                 raise RuntimeError(
@@ -976,4 +977,10 @@ class ResidentSearch:
             keys = pack_fp(t_lo[nz], t_hi[nz])
             parents = pack_fp(p_lo[nz], p_hi[nz])
             self._parent_map = dict(zip(keys.tolist(), parents.tolist()))
+        return self._parent_map
+
+    def reconstruct_path(self, fp: int):
+        """TLC-style reconstruction from the final table contents (the logic
+        is shared with the host-orchestrated engine)."""
+        self.build_parent_map()
         return reconstruct_path(self.model, self._parent_map, fp)

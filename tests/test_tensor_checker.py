@@ -242,10 +242,11 @@ def test_sharded_target_max_depth_matches_host():
     assert r.state_count == host.state_count()
 
 
-def test_tpu_checker_rejects_path_visitors():
-    # Path-carrying visitors need a per-evaluated-state host callback —
-    # still host-only. (StateRecorder IS supported via the batched queue
-    # dump; see tests/test_tensor_adapter.py.)
+def test_tpu_checker_visitors_require_resident_engine():
+    # Generic visitors (round 5: full parent-pointer Paths rebuilt from the
+    # retained carry — tests/test_tensor_adapter.py covers the semantics)
+    # need the resident engine's carry; the host-orchestrated engine has
+    # none to rebuild from.
     from stateright_tpu.core.visitor import PathRecorder
 
     with pytest.raises(NotImplementedError):
@@ -253,7 +254,7 @@ def test_tpu_checker_rejects_path_visitors():
             TensorTwoPhaseSys(3)
             .checker()
             .visitor(PathRecorder())
-            .spawn_tpu(batch_size=64, table_log2=10)
+            .spawn_tpu(batch_size=64, table_log2=10, resident=False)
         )
 
 
