@@ -139,8 +139,8 @@ class LoweredActorModel(TensorModel):
         self,
         model: ActorModel,
         *,
-        pool_size: int = 16,
-        flow_depth: int = 8,
+        pool_size: Optional[int] = None,
+        flow_depth: Optional[int] = None,
         max_emit: int = 4,
         local_boundary: Optional[Callable] = None,
         max_local_states: int = 1 << 12,
@@ -157,8 +157,14 @@ class LoweredActorModel(TensorModel):
         if model.max_crashes and len(model.actors) > 32:
             raise LoweringError("crash lowering supports at most 32 actors")
         self.max_crashes = model.max_crashes
-        self.pool_size = pool_size
-        self.flow_depth = flow_depth
+        # None = default capacity (16/8), which exact mode auto-sizes to
+        # the PROVEN maximum (see the exact-closure walk); an explicit
+        # value is always respected — it is the documented remedy knob for
+        # capacity overflows.
+        self._pool_size_arg = pool_size
+        self._flow_depth_arg = flow_depth
+        self.pool_size = 16 if pool_size is None else pool_size
+        self.flow_depth = 8 if flow_depth is None else flow_depth
         self.max_emit = max_emit
         self.local_boundary = local_boundary or (lambda i, s: True)
         self.max_local_states = max_local_states
@@ -647,6 +653,28 @@ class LoweredActorModel(TensorModel):
             seen_max_depth = 1 if init else 0
             seen = set(init)
             work = deque((s, 1) for s in set(init))
+
+            # Exact mode PROVES the network-capacity bound: track the max
+            # in-flight occupancy over every GENERATED successor — measured
+            # PRE-boundary, because the device expand generates successors
+            # before boundary masking and the rings must hold them without
+            # tripping the capacity-poison guard — and auto-size the
+            # ring/pool lanes to it below. The default flow_depth=8 /
+            # pool_size=16 lanes made abd-ordered rows 118 lanes wide when
+            # the protocol never holds more than a few messages per flow,
+            # taxing every expand/fingerprint/queue byte (VERDICT r4
+            # next #5 groundwork).
+            def net_use(st) -> int:
+                net = st.network
+                if net.kind == ORDERED:
+                    return max(
+                        (len(v) for v in net._data.values()), default=0
+                    )
+                if net.kind == UNORDERED_NONDUPLICATING:
+                    return sum(net._data.values())
+                return 0  # duplicating: bitmask lanes, no capacity dim
+
+            max_net = max((net_use(s) for s in seen), default=0)
             while work:
                 st, depth = work.popleft()
                 if tmd is not None and depth >= tmd:
@@ -681,7 +709,13 @@ class LoweredActorModel(TensorModel):
                             entry["env"], entry["emits"]
                         )
                     nxt = model.next_state(st, a)
-                    if nxt is None or not model.within_boundary(nxt):
+                    if nxt is None:
+                        continue
+                    # Pre-boundary occupancy: the device generates this
+                    # successor (and needs ring/pool room for it) even when
+                    # the boundary then masks it out.
+                    max_net = max(max_net, net_use(nxt))
+                    if not model.within_boundary(nxt):
                         continue
                     generated += 1
                     if track and entry is not None:
@@ -700,10 +734,25 @@ class LoweredActorModel(TensorModel):
                         seen.add(nxt)
                         work.append((nxt, depth + 1))
                         seen_max_depth = max(seen_max_depth, depth + 1)
+            # Auto-size the network lanes to the PROVEN bound (sound for
+            # any device run within this closure's coverage, i.e. the same
+            # target_max_depth contract that already applies to exact mode;
+            # anything that somehow escapes still hits the detected
+            # capacity-poison guard, never silent truncation). Explicit
+            # constructor values are never overridden — they remain the
+            # remedy knob for capacity overflows.
+            if self.kind == ORDERED and self._flow_depth_arg is None:
+                self.flow_depth = max(1, max_net)
+            elif (
+                self.kind == UNORDERED_NONDUPLICATING
+                and self._pool_size_arg is None
+            ):
+                self.pool_size = max(1, max_net)
             self.closure_stats = {
                 "generated": generated,
                 "unique": len(seen),
                 "max_depth": seen_max_depth,
+                "max_net": max_net,
             }
             if track:
                 self._hd = np.zeros(
