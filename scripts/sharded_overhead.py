@@ -3,7 +3,7 @@ next #4): run the SAME workload on the single-device resident engine and on
 the N-device sharded engine, print states/s for both and the ratio.
 
 Usage: python scripts/sharded_overhead.py [workload=2pc7] [n_chips=8]
-Workloads: 2pc7 | 2pc5 | paxos2-lowered
+Workloads: 2pc7 | 2pc5 | paxos2-lowered | paxos5s4c-10
 """
 import math
 import os, sys, time
@@ -34,6 +34,15 @@ if wl in ("2pc7", "2pc5"):
     model = TensorTwoPhaseSys(n)
     batch, table = (4096, 20) if n == 7 else (1024, 16)
     golden = {7: (2_744_706, 296_448), 5: (58_146, 8_832)}[n]
+elif wl == "paxos5s4c-10":
+    from bench import _paxos5s4c_lowered
+
+    t0 = time.monotonic()
+    model = _paxos5s4c_lowered(10)
+    print(f"closure: {time.monotonic()-t0:.1f}s", flush=True)
+    batch, table = 4096, 19
+    st = model.closure_stats
+    golden = (st["generated"], st["unique"])
 elif wl == "paxos2-lowered":
     from stateright_tpu.actor import Network
     from stateright_tpu.actor.register import GetOk
@@ -69,12 +78,15 @@ else:
     raise SystemExit(f"unknown workload {wl}")
 
 
+RUN_KW = {"target_max_depth": 10} if wl == "paxos5s4c-10" else {}
+
+
 def best_of(mk, runs=2):
     s = mk()
-    r = s.run()  # compile + first
+    r = s.run(**RUN_KW)  # compile + first
     best = r
     for _ in range(runs):
-        r = s.run()
+        r = s.run(**RUN_KW)
         if r.duration < best.duration:
             best = r
     return best
