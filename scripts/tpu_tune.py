@@ -7,7 +7,8 @@ states/sec per config. One workload config per subprocess invocation keeps a
 wedged tunnel from eating the whole sweep — run via scripts/tpu_tune.sh.
 
 Usage: python scripts/tpu_tune.py MODEL N BATCH TABLE_LOG2 [REPEATS] [LAYOUT]
-LAYOUT: split (default) | kv — the visited-table layout to race.
+LAYOUT: split (default) | kv | phased — the visited-table design to race
+(kv = interleaved buckets; phased = pre-sort-claim scatter-max insert).
 Set TPU_TUNE_TRACE=/path to capture a jax.profiler trace of the timed runs
 (inspect with tensorboard or xprof to see the per-step op breakdown).
 """
@@ -36,6 +37,9 @@ def main() -> int:
     )
     repeats = max(1, int(sys.argv[5])) if len(sys.argv) > 5 else 3
     layout = sys.argv[6] if len(sys.argv) > 6 else "split"
+    if layout not in ("split", "kv", "phased"):
+        print(f"unknown LAYOUT {layout!r} (split | kv | phased)")
+        return 2
 
     from stateright_tpu.tensor.resident import ResidentSearch
 
@@ -54,7 +58,11 @@ def main() -> int:
         flush=True,
     )
     search = ResidentSearch(
-        model, batch_size=batch, table_log2=table_log2, table_layout=layout
+        model,
+        batch_size=batch,
+        table_log2=table_log2,
+        table_layout="kv" if layout == "kv" else "split",
+        insert_variant="phased" if layout == "phased" else "sort",
     )
     t0 = time.monotonic()
     r = search.run()

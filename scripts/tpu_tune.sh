@@ -26,6 +26,10 @@ run paxos 2 2048 18 3
 # Interleaved-kv table race (halved probe-gather bytes; round-5 staging)
 run paxos 3 3072 22 3 kv
 run paxos 2 2048 18 3 kv
+# Phased scatter-max race for tiny-frontier fixed costs (VERDICT r4 #7)
+run paxos 2 2048 18 3 phased
+run paxos 2 1024 18 3 phased
+run paxos 3 3072 22 2 phased
 
 # Visited-set design race on silicon (VERDICT r3 #5): XLA scatter-max vs the
 # Pallas partitioned-VMEM insert. Parity cross-check built in; the winner

@@ -471,3 +471,94 @@ class HashTableKV:
 
 
 _insert_kv = partial(jax.jit, donate_argnums=(0, 1, 2))(_insert_impl_kv)
+
+
+def _insert_impl_phased(
+    t_lo, t_hi, p_lo, p_hi, lo, hi, parent_lo, parent_hi, active
+):
+    """The round-1..3 PHASED scatter-max insert, revived as a raceable
+    variant (VERDICT r4 next #7): at paxos-2 scale the sort-claim insert's
+    fixed sort cost dominated tiny frontiers (162k -> 94k states/s at
+    b=2048 on v5e) while the phased design's ~few serialized probe rounds
+    are cheap when batches are small and collisions rare. The engines race
+    it per-workload via `ResidentSearch(insert_variant="phased")` /
+    scripts/tpu_tune.py; the sort-claim stays the at-scale default (2.5-3.7x
+    faster at paxos-3 scale — the 54%-of-step profile that retired this
+    design, now with the round-5 128-lane buckets it never had).
+
+    Claim protocol per probe round (all races resolved by scatter-max):
+    phase 1 races `lo` into the bucket's first free slot (winner = max lo),
+    phase 2 tie-breaks equal-lo distinct keys on `hi`, phase 3 races the
+    lane index into the parent slot (still zero for a fresh claim) so
+    exactly one duplicate lane wins `is_new`; real parents overwrite the
+    arena residue after the loop. Losers re-probe next round; full buckets
+    overflow to the next bucket.
+    """
+    size = t_lo.shape[0]
+    bucket = min(BUCKET, size)
+    n_buckets = size // bucket
+    bmask = jnp.uint32(n_buckets - 1)
+    b0 = hi & bmask
+    lane_ix = jnp.arange(lo.shape[0], dtype=jnp.uint32) + jnp.uint32(1)
+
+    def cond(carry):
+        (_tl, _th, _pl, done, _new, _slot, _off, rounds) = carry
+        return (~jnp.all(done)) & (rounds < MAX_ROUNDS)
+
+    def body(carry):
+        t_lo, t_hi, p_lo, done, is_new, slot, off, rounds = carry
+        b = ((b0 + off) & bmask).astype(jnp.int32)
+        rows_lo = t_lo.reshape(n_buckets, bucket)[b]  # free bitcast view
+        rows_hi = t_hi.reshape(n_buckets, bucket)[b]
+        hit_j = (rows_lo == lo[:, None]) & (rows_hi == hi[:, None])
+        hit = (~done) & jnp.any(hit_j, axis=1)
+        hit_slot = b * bucket + jnp.argmax(hit_j, axis=1).astype(jnp.int32)
+
+        free = rows_lo == 0
+        has_free = jnp.any(free, axis=1)
+        cand = b * bucket + jnp.argmax(free, axis=1).astype(jnp.int32)
+        attempt = (~done) & (~hit) & has_free
+
+        tgt = jnp.where(attempt, cand, size)
+        t_lo = t_lo.at[tgt].max(jnp.where(attempt, lo, 0), mode="drop")
+        got_lo = attempt & (
+            t_lo.at[cand].get(mode="fill", fill_value=0) == lo
+        )
+        tgt = jnp.where(got_lo, cand, size)
+        t_hi = t_hi.at[tgt].max(jnp.where(got_lo, hi, 0), mode="drop")
+        claimed = got_lo & (
+            t_hi.at[cand].get(mode="fill", fill_value=0) == hi
+        )
+        tgt = jnp.where(claimed, cand, size)
+        p_lo = p_lo.at[tgt].max(jnp.where(claimed, lane_ix, 0), mode="drop")
+        winner = claimed & (
+            p_lo.at[cand].get(mode="fill", fill_value=0) == lane_ix
+        )
+
+        slot = jnp.where(
+            hit | claimed, jnp.where(hit, hit_slot, cand), slot
+        )
+        is_new = is_new | winner
+        newly_done = hit | claimed
+        off = jnp.where(
+            (~done) & (~newly_done) & (~has_free), off + 1, off
+        )
+        return (
+            t_lo, t_hi, p_lo, done | newly_done, is_new, slot, off,
+            rounds + 1,
+        )
+
+    done0 = ~active
+    zeros_i = jnp.zeros_like(lo, dtype=jnp.int32)
+    t_lo, t_hi, p_lo, done, is_new, slot, _off, _rounds = (
+        jax.lax.while_loop(
+            cond,
+            body,
+            (t_lo, t_hi, p_lo, done0, jnp.zeros_like(active), zeros_i,
+             zeros_i, jnp.int32(0)),
+        )
+    )
+    ptgt = jnp.where(is_new, slot, size)
+    p_lo = p_lo.at[ptgt].set(parent_lo, mode="drop")
+    p_hi = p_hi.at[ptgt].set(parent_hi, mode="drop")
+    return InsertResult(t_lo, t_hi, p_lo, p_hi, is_new, ~jnp.all(done))

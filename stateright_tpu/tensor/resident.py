@@ -50,7 +50,12 @@ from .frontier import (
     record_discovery as _record,
     seed_init,
 )
-from .hashtable import KV_BUCKET, _insert_impl, _insert_impl_kv
+from .hashtable import (
+    KV_BUCKET,
+    _insert_impl,
+    _insert_impl_kv,
+    _insert_impl_phased,
+)
 from .model import TensorModel
 
 
@@ -209,6 +214,7 @@ class ResidentSearch:
         queue_log2: Optional[int] = None,
         append: Optional[str] = None,
         table_layout: str = "split",
+        insert_variant: str = "sort",
     ):
         """`donate_chunks=True` donates the carry to each chunked dispatch:
         XLA updates the tables/queue IN PLACE instead of copying the whole
@@ -249,6 +255,18 @@ class ResidentSearch:
         if table_layout not in ("split", "kv"):
             raise ValueError("table_layout must be 'split' or 'kv'")
         self.table_layout = table_layout
+        # insert_variant="phased": the pre-sort-claim scatter-max insert,
+        # raceable per workload — its fixed costs win on tiny frontiers
+        # (paxos-2 class) while the sort-claim wins at scale (see
+        # hashtable._insert_impl_phased).
+        if insert_variant not in ("sort", "phased"):
+            raise ValueError("insert_variant must be 'sort' or 'phased'")
+        if insert_variant == "phased" and table_layout == "kv":
+            raise ValueError(
+                "insert_variant='phased' supports the split table layout "
+                "only"
+            )
+        self.insert_variant = insert_variant
         self.props = model.properties()
         self._kernel, self._seed_k, self._chunk_k = self._build()
         self._last_tables = None
@@ -270,7 +288,11 @@ class ResidentSearch:
 
     def _insert_fn(self):
         if self.table_layout == "split":
-            return _insert_impl
+            return (
+                _insert_impl_phased
+                if self.insert_variant == "phased"
+                else _insert_impl
+            )
 
         def kv_adapter(t_kv, t_empty, p_lo, p_hi, lo, hi, plo, phi, active):
             r = _insert_impl_kv(t_kv, p_lo, p_hi, lo, hi, plo, phi, active)

@@ -434,3 +434,15 @@ def test_resident_kv_layout_matches_split_goldens():
     assert set(a.discoveries) == set(b.discoveries)
     path = rs.reconstruct_path(b.discoveries["commit agreement"])
     assert len(path.actions()) >= 1  # replays through kv parent pointers
+
+
+def test_resident_phased_insert_variant_matches_goldens():
+    # The revived scatter-max insert (raceable for tiny-frontier workloads)
+    # must agree with the sort-claim on end-to-end counts and discoveries.
+    from stateright_tpu.tensor.resident import ResidentSearch
+
+    r = ResidentSearch(
+        TensorTwoPhaseSys(4), 256, 14, insert_variant="phased"
+    ).run()
+    assert (r.state_count, r.unique_state_count) == (8258, 1568)
+    assert set(r.discoveries) == {"abort agreement", "commit agreement"}
