@@ -153,82 +153,20 @@ def _insert_impl(t_lo, t_hi, p_lo, p_hi, lo, hi, parent_lo, parent_hi, active):
     b0 = (hi & jnp.uint32(n_buckets - 1)).astype(jnp.int32)
     idx = jnp.arange(B, dtype=jnp.int32)
 
-    # -- round 1, hoisted: 3-operand sort-claim at probe offset 0 --------------
-    key0 = jnp.where(active, _rotr(hi, log2_nb), jnp.uint32(0xFFFFFFFF))
-    lo_m = jnp.where(active, lo, jnp.uint32(0))
-    s_key0, s_lo, perm = jax.lax.sort((key0, lo_m, idx), num_keys=2)
-    s_active = ~((s_key0 == jnp.uint32(0xFFFFFFFF)) & (s_lo == 0))
-    s_hi = _rotr(s_key0, (32 - log2_nb) % 32)  # rotate back: bijection
-    sb = (
-        (s_key0 >> jnp.uint32(32 - log2_nb)).astype(jnp.int32)
-        if log2_nb
-        else jnp.zeros(B, jnp.int32)
-    )
-
-    same_prev = (
-        (s_key0 == jnp.roll(s_key0, 1)) & (s_lo == jnp.roll(s_lo, 1))
-    ).at[0].set(False)
-    rep = s_active & ~same_prev
-
-    rows_lo = t_lo.reshape(n_buckets, bucket)[sb]  # free bitcast view
-    rows_hi = t_hi.reshape(n_buckets, bucket)[sb]
-    hit = rep & jnp.any(
-        (rows_lo == s_lo[:, None]) & (rows_hi == s_hi[:, None]), axis=1
-    )
-    need = rep & ~hit
-
-    seg_start = (sb != jnp.roll(sb, 1)).at[0].set(True)
-    excl = jnp.cumsum(need.astype(jnp.int32)) - need.astype(jnp.int32)
-    seg_base = jax.lax.cummax(jnp.where(seg_start, excl, jnp.int32(-1)))
-    rank = excl - seg_base
-
-    free_m = rows_lo == 0
-    tri = jnp.triu(jnp.ones((bucket, bucket), jnp.bfloat16))
-    fcum = (
-        jnp.dot(
-            free_m.astype(jnp.bfloat16), tri,
-            preferred_element_type=jnp.float32,
-        )
-        .astype(jnp.int32)
-    )
-    pick = free_m & (fcum == (rank + 1)[:, None])
-    can_claim = need & jnp.any(pick, axis=1)
-    slot = sb * bucket + jnp.argmax(pick, axis=1).astype(jnp.int32)
-
-    tgt = jnp.where(can_claim, slot, size)
-    t_lo = t_lo.at[tgt].set(s_lo, mode="drop", unique_indices=True)
-    t_hi = t_hi.at[tgt].set(s_hi, mode="drop", unique_indices=True)
-    p_lo = p_lo.at[tgt].set(parent_lo[perm], mode="drop", unique_indices=True)
-    p_hi = p_hi.at[tgt].set(parent_hi[perm], mode="drop", unique_indices=True)
-
-    inv_perm = jnp.zeros(B, jnp.int32).at[perm].set(idx, unique_indices=True)
-    is_new0 = can_claim[inv_perm]
-    carry0 = (need & ~can_claim)[inv_perm]  # bucket full -> probe bucket +1
-    off0 = carry0.astype(jnp.int32)
-
-    def cond(carry):
-        (_tl, _th, _pl, _ph, pending, _new, _off, rounds) = carry
-        return jnp.any(pending) & (rounds < MAX_ROUNDS)
-
-    def body(carry):
-        t_lo, t_hi, p_lo, p_hi, pending, is_new, off, rounds = carry
-        b = (b0 + off) & bmask
-        bkey = jnp.where(pending, b, jnp.int32(n_buckets))
-        sb, s_hi, s_lo, perm = jax.lax.sort(
-            (bkey, hi, lo, idx), num_keys=3
-        )
-        spending = sb < jnp.int32(n_buckets)
-
+    def claim(t_lo, t_hi, p_lo, p_hi, is_new_in, sb, s_hi, s_lo, s_active,
+              perm):
+        """One race-free claim round over pre-sorted lanes (shared by the
+        hoisted fast path and the overflow loop; see the kv variant for the
+        same shape). Returns carry_on in ORIGINAL lane order."""
         same_prev = (
             (sb == jnp.roll(sb, 1))
             & (s_hi == jnp.roll(s_hi, 1))
             & (s_lo == jnp.roll(s_lo, 1))
         ).at[0].set(False)
-        rep = spending & ~same_prev
+        rep = s_active & ~same_prev
 
-        rows_ix = jnp.minimum(sb, jnp.int32(n_buckets - 1))
-        rows_lo = t_lo.reshape(n_buckets, bucket)[rows_ix]  # free bitcast view
-        rows_hi = t_hi.reshape(n_buckets, bucket)[rows_ix]
+        rows_lo = t_lo.reshape(n_buckets, bucket)[sb]  # free bitcast view
+        rows_hi = t_hi.reshape(n_buckets, bucket)[sb]
         hit = rep & jnp.any(
             (rows_lo == s_lo[:, None]) & (rows_hi == s_hi[:, None]), axis=1
         )
@@ -260,7 +198,7 @@ def _insert_impl(t_lo, t_hi, p_lo, p_hi, lo, hi, parent_lo, parent_hi, active):
         )
         pick = free_m & (fcum == (rank + 1)[:, None])  # rank-th free lane
         can_claim = need & jnp.any(pick, axis=1)
-        slot = rows_ix * bucket + jnp.argmax(pick, axis=1).astype(jnp.int32)
+        slot = sb * bucket + jnp.argmax(pick, axis=1).astype(jnp.int32)
 
         tgt = jnp.where(can_claim, slot, size)
         t_lo = t_lo.at[tgt].set(s_lo, mode="drop", unique_indices=True)
@@ -272,18 +210,49 @@ def _insert_impl(t_lo, t_hi, p_lo, p_hi, lo, hi, parent_lo, parent_hi, active):
             parent_hi[perm], mode="drop", unique_indices=True
         )
 
-        # Unsort through the permutation (a bijection: plain unique scatters).
-        carry_on = need & ~can_claim  # bucket full -> probe the next one
-        is_new = jnp.zeros_like(is_new).at[perm].set(
-            is_new[perm] | can_claim, unique_indices=True
+        # Unsort via the inverse permutation: one iota scatter + gathers.
+        inv_perm = jnp.zeros(B, jnp.int32).at[perm].set(
+            idx, unique_indices=True
         )
-        pending = jnp.zeros_like(pending).at[perm].set(
-            carry_on, unique_indices=True
+        is_new = is_new_in | can_claim[inv_perm]
+        carry_on = (need & ~can_claim)[inv_perm]  # full -> probe bucket +1
+        return t_lo, t_hi, p_lo, p_hi, is_new, carry_on
+
+    # -- round 1, hoisted: 3-operand sort-claim at probe offset 0 --------------
+    key0 = jnp.where(active, _rotr(hi, log2_nb), jnp.uint32(0xFFFFFFFF))
+    lo_m = jnp.where(active, lo, jnp.uint32(0))
+    s_key0, s_lo, perm = jax.lax.sort((key0, lo_m, idx), num_keys=2)
+    s_active = ~((s_key0 == jnp.uint32(0xFFFFFFFF)) & (s_lo == 0))
+    s_hi = _rotr(s_key0, (32 - log2_nb) % 32)  # rotate back: bijection
+    sb = (
+        (s_key0 >> jnp.uint32(32 - log2_nb)).astype(jnp.int32)
+        if log2_nb
+        else jnp.zeros(B, jnp.int32)
+    )
+    t_lo, t_hi, p_lo, p_hi, is_new0, carry0 = claim(
+        t_lo, t_hi, p_lo, p_hi, jnp.zeros_like(active), sb, s_hi, s_lo,
+        s_active, perm,
+    )
+    off0 = carry0.astype(jnp.int32)
+
+    def cond(carry):
+        (_tl, _th, _pl, _ph, pending, _new, _off, rounds) = carry
+        return jnp.any(pending) & (rounds < MAX_ROUNDS)
+
+    def body(carry):
+        t_lo, t_hi, p_lo, p_hi, pending, is_new, off, rounds = carry
+        b = (b0 + off) & bmask
+        bkey = jnp.where(pending, b, jnp.int32(n_buckets))
+        sb, s_hi, s_lo, perm = jax.lax.sort(
+            (bkey, hi, lo, idx), num_keys=3
         )
-        off = jnp.zeros_like(off).at[perm].set(
-            off[perm] + carry_on.astype(jnp.int32), unique_indices=True
+        s_active = sb < jnp.int32(n_buckets)
+        sb_c = jnp.minimum(sb, jnp.int32(n_buckets - 1))
+        t_lo, t_hi, p_lo, p_hi, is_new, carry_on = claim(
+            t_lo, t_hi, p_lo, p_hi, is_new, sb_c, s_hi, s_lo, s_active, perm
         )
-        return t_lo, t_hi, p_lo, p_hi, pending, is_new, off, rounds + 1
+        off = off + carry_on.astype(jnp.int32)
+        return t_lo, t_hi, p_lo, p_hi, carry_on, is_new, off, rounds + 1
 
     t_lo, t_hi, p_lo, p_hi, pending, is_new, _off, _rounds = (
         jax.lax.while_loop(

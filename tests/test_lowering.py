@@ -975,3 +975,34 @@ def test_exact_autosized_network_lanes_with_boundary():
         bare(), boundary=boundary, closure="exact", pool_size=7
     )
     assert pinned.pool_size == 7  # explicit arg always wins
+
+
+def test_poison_scan_matches_per_row_payload_decode():
+    """poison_scan (vectorized) and poison_payload (scalar) encode the same
+    bit layout twice; this pins them together so a payload-format change
+    cannot silently desynchronize the refinement scanner."""
+    import numpy as np
+
+    from stateright_tpu.tensor.lowering import EMPTY, lower_actor_model
+    from stateright_tpu.actor.test_util import PingPongCfg
+
+    m = lower_actor_model(
+        PingPongCfg(max_nat=2, maintains_history=False).into_model(),
+        local_boundary=lambda i, s: s <= 2,
+    )
+    rng = np.random.default_rng(3)
+    rows = rng.integers(0, 1 << 32, size=(64, max(m.lanes, 3)), dtype=np.uint32)
+    rows[::2, 0] = int(EMPTY)  # half the rows are poison markers
+    rows[::4, 1] = (16 | 7) << 24 | 5  # capacity-flagged payloads (bit 16)
+    rows[1::2, 0] = 1  # real rows
+    gaps, capacity, narrow = m.poison_scan(rows)
+    ref_gaps, ref_cap = set(), []
+    for r in rows:
+        p = m.poison_payload(r)
+        if p is None:
+            continue
+        assert p[0] >= 0  # rows were built wide enough to carry payloads
+        (ref_cap.append if p[0] & 16 else ref_gaps.add)(p)
+    assert gaps == ref_gaps
+    assert sorted(capacity) == sorted(ref_cap)
+    assert not narrow
