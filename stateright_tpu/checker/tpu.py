@@ -20,7 +20,13 @@ class TpuChecker(Checker):
         batch_size: int = 1024,
         table_log2: int = 20,
         resident: bool = None,
+        **engine_kwargs,
     ):
+        # engine_kwargs pass through to the underlying engine —
+        # ResidentSearch options like table_layout ("split"/"kv"),
+        # insert_variant ("sort"/"phased"), append ("scatter"/"dus"),
+        # queue_log2, and donate_chunks — so builder-API users can reach
+        # the same design knobs the tuner races.
         from ..tensor.frontier import FrontierSearch
         from ..tensor.model import TensorModel
         from ..tensor.resident import ResidentSearch
@@ -70,8 +76,13 @@ class TpuChecker(Checker):
         # finer-grained (per-device-step) progress instead.
         if resident is None:
             resident = True
+        if not resident and engine_kwargs:
+            raise ValueError(
+                f"engine options {sorted(engine_kwargs)} require the "
+                "resident engine (drop resident=False)"
+            )
         self._search = (
-            ResidentSearch(model, batch_size, table_log2)
+            ResidentSearch(model, batch_size, table_log2, **engine_kwargs)
             if resident
             else FrontierSearch(model, batch_size, table_log2)
         )

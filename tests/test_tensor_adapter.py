@@ -132,3 +132,22 @@ def test_tpu_checker_path_recorder_visitor():
         assert all(a is not None for _, a in pairs[:-1])
         lens.add(len(pairs))
     assert max(lens) == 11  # max_depth golden for 2pc-3
+
+
+def test_spawn_tpu_passes_engine_options_through():
+    c = (
+        TensorTwoPhaseSys(3)
+        .checker()
+        .spawn_tpu(
+            batch_size=64, table_log2=12,
+            table_layout="kv", append="scatter",
+        )
+        .join()
+    )
+    assert c.unique_state_count() == 288
+    import pytest
+
+    with pytest.raises(ValueError, match="resident"):
+        TensorTwoPhaseSys(3).checker().spawn_tpu(
+            batch_size=64, table_log2=12, resident=False, insert_variant="phased"
+        )
