@@ -2704,7 +2704,15 @@ def refine_check(
             continue
         if extends >= max_rounds:
             raise LoweringError(
-                f"refinement did not converge in {max_rounds} rounds"
+                f"refinement did not converge in {max_rounds} rounds "
+                f"(vocabulary at exit: {len(lowered.envs)} envelopes, "
+                f"{[len(x) for x in lowered.states]} local states per "
+                "actor). If these grew every round, the model's state space "
+                "is likely UNBOUNDED from the search's point of view — "
+                "refinement only bounds host work, not reachability; pass "
+                "boundary= (a device-evaluable state bound) the way the "
+                "search itself would need one, or use closure='exact' with "
+                "closure_max_depth"
             )
         extends += 1
         if progress is not None:

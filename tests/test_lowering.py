@@ -23,6 +23,17 @@ from stateright_tpu.tensor.lowering import (
 )
 
 
+def _counters_le_boundary(cap):
+    """Shared tensor boundary: every actor counter <= cap (the standard
+    bound for ping-pong refinement tests)."""
+
+    def boundary(view):
+        counters = view.actor_feature(lambda i, s: s)
+        return lambda s: (counters(s) <= cap).all(1)
+
+    return boundary
+
+
 def _ping_pong_lowered(max_nat, lossy, network=None):
     cfg = PingPongCfg(max_nat=max_nat, maintains_history=False)
     model = cfg.into_model().with_lossy_network(lossy)
@@ -1006,3 +1017,29 @@ def test_poison_scan_matches_per_row_payload_decode():
     assert gaps == ref_gaps
     assert sorted(capacity) == sorted(ref_cap)
     assert not narrow
+
+
+def test_refine_check_warm_mode_matches_restart():
+    """warm=True (carried-search refinement) must land on the same exact
+    result as the default restart mode — it wins on few-layer models like
+    this one, and this is its only guard now that restart is the default."""
+    from stateright_tpu.tensor.lowering import refine_check
+
+    cfg = PingPongCfg(max_nat=3, maintains_history=False)
+
+    def run(**kw):
+        r, _ = refine_check(
+            cfg.into_model().with_lossy_network(False),
+            batch_size=32,
+            table_log2=10,
+            seed_states=2,
+            boundary=_counters_le_boundary(3),
+            **kw,
+        )
+        return r
+
+    a, b = run(), run(warm=True)
+    assert (a.state_count, a.unique_state_count) == (
+        b.state_count, b.unique_state_count,
+    )
+    assert a.complete and b.complete
