@@ -1,0 +1,110 @@
+"""Per-op time attribution from a jax.profiler trace — the one-command form
+of the analysis that cracked round 4's biggest win (the DUS queue append:
+trace-viewer totals hid the row-scatter cost inside a mega-fusion; the
+xplane op stats named it).
+
+Usage:
+  TPU_TUNE_TRACE=/tmp/tr python scripts/tpu_tune.py paxos 3 3072 22 3
+  python scripts/xplane_ops.py /tmp/tr [top_n] [tool]
+
+tool: hlo_stats (default) | framework_op_stats | op_profile — whatever the
+installed xprof converter supports; output is the tool's JSON/CSV reduced to
+the top-N self-time rows.
+"""
+import csv
+import glob
+import io
+import json
+import os
+import sys
+
+
+def main() -> int:
+    if len(sys.argv) < 2:
+        print(__doc__)
+        return 2
+    trace_dir = sys.argv[1]
+    top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 25
+    tool = sys.argv[3] if len(sys.argv) > 3 else "hlo_stats"
+    paths = sorted(
+        glob.glob(
+            os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True
+        )
+    )
+    if not paths:
+        print(f"no *.xplane.pb under {trace_dir}")
+        return 1
+    print(f"xplane: {paths[-1]}", file=sys.stderr)
+
+    from xprof.convert import raw_to_tool_data as r
+
+    data, ctype = r.xspace_to_tool_data([paths[-1]], tool, {})
+    if isinstance(data, bytes):
+        data = data.decode("utf-8", "replace")
+
+    def gviz_rows(payload):
+        """GViz table(s) -> list of dict rows (first table that has any)."""
+        tables = payload if isinstance(payload, list) else [payload]
+        for t in tables:
+            if isinstance(t, dict) and t.get("rows"):
+                cols = [c.get("label") or c.get("id") for c in t["cols"]]
+                return [
+                    dict(zip(cols, [c.get("v") for c in row["c"]]))
+                    for row in t["rows"]
+                ]
+        return []
+
+    rows = None
+    if "json" in ctype:
+        payload = json.loads(data)
+        rows = gviz_rows(payload)
+        if not rows:
+            print(json.dumps(payload)[:4000])
+            return 0
+    else:  # CSV
+        rows = list(csv.DictReader(io.StringIO(data)))
+    if not rows:
+        print("no rows")
+        return 1
+
+    # Find a self-time-like column to rank by.
+    keys = rows[0].keys()
+    rank_key = next(
+        (
+            k
+            for k in keys
+            if k and "self" in k.lower() and "time" in k.lower()
+        ),
+        None,
+    ) or next((k for k in keys if k and "time" in k.lower()), None)
+    if rank_key is None:
+        print(f"columns: {sorted(keys)}")
+        return 1
+
+    def num(v):
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return 0.0
+
+    rows.sort(key=lambda x: num(x.get(rank_key)), reverse=True)
+    total = sum(num(x.get(rank_key)) for x in rows)
+    name_key = next(
+        (
+            k
+            for pref in ("hlo op name", "operation", "name", "op")
+            for k in keys
+            if k and pref in k.lower() and "type" not in k.lower()
+        ),
+        list(keys)[0],
+    )
+    print(f"rank by {rank_key!r} (total {total:,.0f}); name {name_key!r}")
+    for x in rows[:top_n]:
+        t = num(x.get(rank_key))
+        pct = 100 * t / total if total else 0
+        print(f"{t:>14,.0f} {pct:5.1f}%  {str(x.get(name_key))[:90]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
