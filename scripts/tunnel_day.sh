@@ -46,6 +46,9 @@ else
   echo "[tunnel_day] tune FAILED (rc!=0 — check $LOG/tune.log before trusting any config or race verdict)" | tee -a "$LOG/status"
 fi
 
+echo "[tunnel_day] profiled paxos-3 run + per-op attribution..." | tee -a "$LOG/status"
+TPU_TUNE_TRACE="$LOG/trace" timeout 900 python scripts/tpu_tune.py paxos 3 3072 22 2   > "$LOG/trace_run.log" 2>&1   && python scripts/xplane_ops.py "$LOG/trace" 30 > "$LOG/op_stats.txt" 2>&1   && echo "[tunnel_day] op stats in $LOG/op_stats.txt" | tee -a "$LOG/status"   || echo "[tunnel_day] profiling step failed (non-fatal)" | tee -a "$LOG/status"
+
 echo "[tunnel_day] full bench..." | tee -a "$LOG/status"
 python bench.py > "$LOG/bench.json" 2> "$LOG/bench.log"
 echo "[tunnel_day] bench JSON:" | tee -a "$LOG/status"
