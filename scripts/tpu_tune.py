@@ -47,6 +47,10 @@ def main() -> int:
         from stateright_tpu.tensor.paxos import TensorPaxos
 
         model = TensorPaxos(client_count=n)
+    elif model_name in ("inclock", "inclock-sym"):
+        from stateright_tpu.tensor.models import TensorIncrementLock
+
+        model = TensorIncrementLock(n, symmetry=model_name == "inclock-sym")
     else:
         from stateright_tpu.tensor.models import TensorTwoPhaseSys
 

@@ -30,6 +30,10 @@ run paxos 2 2048 18 3 kv
 run paxos 2 2048 18 3 phased
 run paxos 2 1024 18 3 phased
 run paxos 3 3072 22 2 phased
+# Tiniest spaces (r4: inclock-sym-6 ran at 475/s — pure fixed cost)
+run inclock-sym 6 512 10 3
+run inclock-sym 6 512 10 3 phased
+run inclock 6 1024 14 3 phased
 
 # Visited-set design race on silicon (VERDICT r3 #5): XLA scatter-max vs the
 # Pallas partitioned-VMEM insert. Parity cross-check built in; the winner
