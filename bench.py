@@ -65,14 +65,20 @@ def compile_baseline() -> str | None:
         return None
 
 
-def run_baseline(exe: str, model: str, n: int, repeats: int = 3):
+def run_baseline(exe: str, model: str, n: int, repeats: int = 3,
+                 threads: int | None = None):
     """Best-of-N run of the compiled checker. Returns dict or None; keeps the
-    best run that *succeeded* even if later repeats fail."""
+    best run that *succeeded* even if later repeats fail. `threads` pins the
+    checker's thread count (baseline_bfs.cpp argv[3]); None lets it default
+    to hardware_concurrency."""
+    cmd = [exe, model, str(n)]
+    if threads is not None:
+        cmd.append(str(threads))
     best = None
     for _ in range(repeats):
         try:
             proc = subprocess.run(
-                [exe, model, str(n)],
+                cmd,
                 check=True,
                 capture_output=True,
                 text=True,
@@ -421,6 +427,45 @@ def _time_search(search, run_kwargs, repeats: int, closure_s: float):
     return best, out
 
 
+def _attach_roofline(out: dict, best, model, batch: int, table_log2: int,
+                     search) -> None:
+    """Cost-model utilization fields (VERDICT r5 #6): bytes touched per
+    generated state from tensor/costmodel.py, and the effective-HBM
+    fraction (the MFU analogue) when the run was on real accelerator HBM.
+    CPU-backend rehearsals get the byte count as `cpu_bytes_per_state`
+    instead — the model's CPU *times* are low-confidence, its bytes exact.
+    """
+    try:
+        import jax
+
+        from stateright_tpu.tensor import costmodel as cm
+
+        layout = getattr(search, "table_layout", "split")
+        insert_variant = getattr(search, "insert_variant", "sort")
+        variant = cm.ENGINE_VARIANTS.get((layout, insert_variant), "split")
+        states_per_step = best.state_count / max(best.steps, 1)
+        # new_frac: populated-lane fraction of B = generated-per-step over
+        # the flat successor lane count — what the capped path tiles over.
+        B = batch * model.max_actions
+        new_frac = min(states_per_step / B, 1.0)
+        bps = cm.bytes_per_state(
+            model.lanes, model.max_actions, batch, table_log2,
+            states_per_step,
+            variant=variant,
+            append=getattr(search, "append", "dus"),
+            new_frac=new_frac,
+        )
+        out["bytes_per_state"] = round(bps, 1)
+        if jax.default_backend() == "cpu":
+            out["cpu_bytes_per_state"] = out["bytes_per_state"]
+        else:
+            out["hbm_frac"] = round(
+                cm.hbm_frac(out["states_per_sec"], bps, cm.V5E), 5
+            )
+    except Exception as e:  # noqa: BLE001 — reporting must never kill a run
+        log(f"roofline annotation failed: {e}")
+
+
 def device_search(model_name: str, n: int, repeats: int = 3):
     """Run the resident engine; returns (result dict, parity error or None)."""
     _pin_platform()
@@ -433,6 +478,7 @@ def device_search(model_name: str, n: int, repeats: int = 3):
         model, batch_size=batch, table_log2=table_log2, **engine_kwargs
     )
     best, out = _time_search(search, run_kwargs, repeats, closure_s)
+    _attach_roofline(out, best, model, batch, table_log2, search)
     return out, _parity_err(model_name, n, best, golden)
 
 
@@ -506,9 +552,29 @@ def headline_summary(dev: dict, base: dict, smoke: bool = False):
     return metric, round(value, 1) if value is not None else None, vs_baseline
 
 
-def main() -> int:
+def main(argv: list | None = None) -> int:
     detail: dict = {}
     errors: list[str] = []
+
+    # --baseline-threads N: additionally run every C++ baseline workload
+    # with an explicit N-thread row (VERDICT r5 #5 — the north-star
+    # denominator is the MULTITHREADED reference checker; the default row
+    # keeps baseline_bfs's own hardware_concurrency default). Malformed
+    # values are ignored rather than killing the bench.
+    args = list(sys.argv[1:] if argv is None else argv)
+    baseline_threads = None
+    if "--baseline-threads" in args:
+        i = args.index("--baseline-threads")
+        try:
+            baseline_threads = max(1, int(args[i + 1]))
+        except (IndexError, ValueError):
+            log("ignoring malformed --baseline-threads")
+    for a in args:
+        # A typo'd flag silently dropped on tunnel day would cost the
+        # multithread rows the flag exists for — say so loudly.
+        if a.startswith("--") and a != "--baseline-threads":
+            log(f"unknown bench.py flag {a!r} ignored "
+                "(known: --baseline-threads N)")
 
     # BENCH_SMOKE=1: harness smoke mode — smallest baseline + device
     # workloads only, so the full pipeline (C++ baseline, device probe,
@@ -534,23 +600,33 @@ def main() -> int:
     base = {}
     if exe:
         for model, n, repeats in baseline_cfgs:
-            r = run_baseline(exe, model, n, repeats=repeats)
-            if r:
+            runs = [(f"{model}-{n}", None)]
+            if baseline_threads is not None:
+                # Always emit the pinned row when asked — -t1 is meaningful
+                # on a multicore host, where the default row runs at
+                # hardware_concurrency.
+                runs.append(
+                    (f"{model}-{n}-t{baseline_threads}", baseline_threads)
+                )
+            for key, threads in runs:
+                r = run_baseline(exe, model, n, repeats=repeats, threads=threads)
+                if not r:
+                    continue
                 gen_gold, uniq_gold = GOLDEN[(model, n)]
                 if (r["states"], r["unique"]) != (gen_gold, uniq_gold):
                     errors.append(
-                        f"baseline {model}-{n} golden mismatch: "
+                        f"baseline {key} golden mismatch: "
                         f"(gen={r['states']}, unique={r['unique']}) != "
                         f"(gen={gen_gold}, unique={uniq_gold})"
                     )
                 if r["violations"]:
                     errors.append(
-                        f"baseline {model}-{n} reported {r['violations']} "
+                        f"baseline {key} reported {r['violations']} "
                         "property violations (expected none)"
                     )
-                base[f"{model}-{n}"] = r
+                base[key] = r
                 log(
-                    f"baseline {model}-{n}: {r['states']} states in "
+                    f"baseline {key}: {r['states']} states in "
                     f"{r['sec']}s ({r['states_per_sec']:.0f}/s, "
                     f"{r['threads']} threads)"
                 )
@@ -641,7 +717,11 @@ def main() -> int:
             "sec": v["sec"],
             **{
                 f: v[f]
-                for f in ("virtual_mesh", "n_chips", "per_chip_unique", "closure_sec")
+                for f in (
+                    "virtual_mesh", "n_chips", "per_chip_unique",
+                    "closure_sec", "bytes_per_state", "cpu_bytes_per_state",
+                    "hbm_frac",
+                )
                 if f in v
             },
         }

@@ -1,18 +1,32 @@
 """Real-TPU tuning sweep for the resident engine on the north-star workload.
 
-Runs paxos-3 (and optionally 2pc-4 as a smoke test) across a grid of
-(batch_size, table_log2) configs on the DEFAULT jax backend (i.e. the axon
-TPU when the tunnel is up), asserting golden parity every time and printing
-states/sec per config. One workload config per subprocess invocation keeps a
-wedged tunnel from eating the whole sweep — run via scripts/tpu_tune.sh.
+Single-config mode runs one (workload, batch, table, layout) on the DEFAULT
+jax backend (i.e. the axon TPU when the tunnel is up), asserting golden
+parity and printing states/sec — one config per invocation so a wedged
+tunnel can't eat a whole sweep (scripts/tpu_tune.sh drives it that way).
 
-Usage: python scripts/tpu_tune.py MODEL N BATCH TABLE_LOG2 [REPEATS] [LAYOUT]
-LAYOUT: split (default) | kv | phased — the visited-table design to race
-(kv = interleaved buckets; phased = pre-sort-claim scatter-max insert).
+Sweep mode makes tunnel day a single command: it races
+insert_variant x batch in subprocess-isolated single-config runs, collects
+the machine-readable RESULT_JSON line each prints, joins the measurements
+with the cost model's committed predictions (tensor/costmodel.py), and
+dumps a ranking JSON.
+
+Usage:
+  python scripts/tpu_tune.py MODEL N BATCH TABLE_LOG2 [REPEATS] [LAYOUT]
+  python scripts/tpu_tune.py --sweep MODEL N TABLE_LOG2 \
+      [--batches 2048,4096,8192] [--variants split,kv,phased,capped] \
+      [--repeats R] [--timeout SEC] [--out tune_ranking.json]
+
+LAYOUT / --variants values: split (default) | kv | phased | capped |
+capped-kv | capped-phased — the visited-table designs to race (kv =
+interleaved buckets; phased = pre-sort-claim scatter-max insert; capped =
+batch-monotonic claim-tile insert, see hashtable.make_capped_insert).
 Set TPU_TUNE_TRACE=/path to capture a jax.profiler trace of the timed runs
 (inspect with tensorboard or xprof to see the per-step op breakdown).
 """
+import json
 import os
+import subprocess
 import sys
 import time
 
@@ -24,38 +38,42 @@ from bench import GOLDEN, _pin_platform  # one golden table, one platform pin
 
 _pin_platform()
 
+# LAYOUT name -> (table_layout, insert_variant) engine options. The
+# costmodel variant for predicted_ms comes from the shared
+# costmodel.ENGINE_VARIANTS mapping (one source of truth with bench.py).
+LAYOUTS = {
+    "split": ("split", "sort"),
+    "kv": ("kv", "sort"),
+    "phased": ("split", "phased"),
+    "capped": ("split", "capped"),
+    "capped-kv": ("kv", "capped"),
+    "capped-phased": ("split", "capped-phased"),
+}
 
-def main() -> int:
-    if len(sys.argv) < 5:
-        print(__doc__)
-        return 2
-    model_name, n, batch, table_log2 = (
-        sys.argv[1],
-        int(sys.argv[2]),
-        int(sys.argv[3]),
-        int(sys.argv[4]),
-    )
-    repeats = max(1, int(sys.argv[5])) if len(sys.argv) > 5 else 3
-    layout = sys.argv[6] if len(sys.argv) > 6 else "split"
-    if layout not in ("split", "kv", "phased"):
-        print(f"unknown LAYOUT {layout!r} (split | kv | phased)")
-        return 2
 
-    from stateright_tpu.tensor.resident import ResidentSearch
-
+def _build_model(model_name: str, n: int):
     if model_name == "paxos":
         from stateright_tpu.tensor.paxos import TensorPaxos
 
-        model = TensorPaxos(client_count=n)
-    elif model_name in ("inclock", "inclock-sym"):
+        return TensorPaxos(client_count=n)
+    if model_name in ("inclock", "inclock-sym"):
         from stateright_tpu.tensor.models import TensorIncrementLock
 
-        model = TensorIncrementLock(n, symmetry=model_name == "inclock-sym")
-    else:
-        from stateright_tpu.tensor.models import TensorTwoPhaseSys
+        return TensorIncrementLock(n, symmetry=model_name == "inclock-sym")
+    from stateright_tpu.tensor.models import TensorTwoPhaseSys
 
-        model = TensorTwoPhaseSys(n)
+    return TensorTwoPhaseSys(n)
 
+
+def run_single(model_name, n, batch, table_log2, repeats, layout) -> int:
+    if layout not in LAYOUTS:
+        print(f"unknown LAYOUT {layout!r} ({' | '.join(LAYOUTS)})")
+        return 2
+    table_layout, insert_variant = LAYOUTS[layout]
+
+    from stateright_tpu.tensor.resident import ResidentSearch
+
+    model = _build_model(model_name, n)
     print(
         f"devices={jax.devices()} workload={model_name}-{n} "
         f"batch={batch} table=2^{table_log2} layout={layout}",
@@ -65,8 +83,8 @@ def main() -> int:
         model,
         batch_size=batch,
         table_log2=table_log2,
-        table_layout="kv" if layout == "kv" else "split",
-        insert_variant="phased" if layout == "phased" else "sort",
+        table_layout=table_layout,
+        insert_variant=insert_variant,
     )
     t0 = time.monotonic()
     r = search.run()
@@ -94,14 +112,198 @@ def main() -> int:
             jax.profiler.stop_trace()
             print(f"profiler trace written to {trace_dir}", flush=True)
     gold = GOLDEN.get((model_name, n))
-    if gold and (best.state_count, best.unique_state_count) != gold:
-        print(f"PARITY FAIL: {best.state_count}/{best.unique_state_count} != {gold}")
+    parity_ok = gold is None or (
+        (best.state_count, best.unique_state_count) == gold
+    )
+    sps = best.state_count / max(best.duration, 1e-9)
+    # Machine-readable line the sweep driver parses.
+    print(
+        "RESULT_JSON "
+        + json.dumps(
+            {
+                "workload": f"{model_name}-{n}",
+                "batch": batch,
+                "table_log2": table_log2,
+                "layout": layout,
+                "sec": round(best.duration, 4),
+                "states_per_sec": round(sps, 1),
+                "steps": best.steps,
+                "compile_sec": round(compile_s, 1),
+                "parity_ok": parity_ok,
+            }
+        ),
+        flush=True,
+    )
+    if not parity_ok:
+        print(
+            f"PARITY FAIL: {best.state_count}/{best.unique_state_count} "
+            f"!= {gold}"
+        )
         return 1
     print(
         f"BEST {model_name}-{n} b={batch} t={table_log2}: "
-        f"{best.duration:.4f}s {best.state_count / max(best.duration, 1e-9):,.0f}/s"
+        f"{best.duration:.4f}s {sps:,.0f}/s"
     )
     return 0
+
+
+def run_sweep(argv: list) -> int:
+    def opt(name, default):
+        if name in argv:
+            i = argv.index(name)
+            if i + 1 >= len(argv):
+                raise SystemExit(f"missing value for {name} (see --help)")
+            v = argv[i + 1]
+            del argv[i : i + 2]
+            return v
+        return default
+
+    batches = [int(b) for b in opt("--batches", "2048,4096,8192").split(",")]
+    variants = opt("--variants", "split,kv,phased,capped").split(",")
+    repeats = int(opt("--repeats", "3"))
+    timeout = float(opt("--timeout", "900"))
+    out_path = opt("--out", "tune_ranking.json")
+    if len(argv) < 3:  # re-check arity AFTER option pairs are stripped
+        print(__doc__)
+        return 2
+    model_name, n, table_log2 = argv[0], int(argv[1]), int(argv[2])
+
+    bad = [v for v in variants if v not in LAYOUTS]
+    if bad:
+        print(f"unknown variants {bad} ({' | '.join(LAYOUTS)})")
+        return 2
+
+    model = _build_model(model_name, n)
+    from stateright_tpu.tensor import costmodel as cm
+
+    configs = []
+
+    def flush() -> list:
+        """Rewrite the ranking JSON after EVERY config: a wedged tunnel (or
+        the driver's outer timeout) killing the sweep mid-way must not
+        discard the configs that already measured."""
+        measured = [c for c in configs if "states_per_sec" in c]
+        ranking = sorted(
+            measured, key=lambda c: c["states_per_sec"], reverse=True
+        )
+        result = {
+            "workload": f"{model_name}-{n}",
+            "table_log2": table_log2,
+            "backend": jax.default_backend(),
+            "model": {
+                "lanes": model.lanes, "max_actions": model.max_actions,
+            },
+            "configs": configs,
+            "ranking": [
+                {
+                    "layout": c["layout"],
+                    "batch": c["batch"],
+                    "states_per_sec": c["states_per_sec"],
+                    "predicted_ms": round(c.get("predicted_ms", 0.0), 3),
+                    "parity_ok": c["parity_ok"],
+                }
+                for c in ranking
+            ],
+        }
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=1)
+        return ranking
+
+    for batch in batches:
+        for layout in variants:
+            print(f"== {model_name}-{n} b={batch} layout={layout}", flush=True)
+            rec = {
+                "workload": f"{model_name}-{n}",
+                "batch": batch,
+                "table_log2": table_log2,
+                "layout": layout,
+            }
+            try:
+                proc = subprocess.run(
+                    [
+                        sys.executable,
+                        os.path.abspath(__file__),
+                        model_name,
+                        str(n),
+                        str(batch),
+                        str(table_log2),
+                        str(repeats),
+                        layout,
+                    ],
+                    capture_output=True,
+                    text=True,
+                    timeout=timeout,
+                )
+            except subprocess.TimeoutExpired:
+                rec["error"] = f"timed out after {timeout:.0f}s"
+                configs.append(rec)
+                flush()
+                print("   TIMEOUT", flush=True)
+                continue
+            sys.stderr.write(proc.stderr)
+            line = next(
+                (
+                    ln[len("RESULT_JSON "):]
+                    for ln in proc.stdout.splitlines()
+                    if ln.startswith("RESULT_JSON ")
+                ),
+                None,
+            )
+            if line is None:
+                tail = proc.stdout.strip().splitlines()
+                rec["error"] = tail[-1] if tail else f"rc={proc.returncode}"
+                configs.append(rec)
+                flush()
+                print(f"   FAILED: {rec['error']}", flush=True)
+                continue
+            rec.update(json.loads(line))
+            rec["predicted_ms"] = cm.step_cost(
+                model.lanes,
+                model.max_actions,
+                batch,
+                table_log2,
+                variant=cm.ENGINE_VARIANTS[LAYOUTS[layout]],
+            ).total_ms
+            configs.append(rec)
+            flush()
+            print(
+                f"   {rec['states_per_sec']:,.0f}/s "
+                f"(predicted {rec['predicted_ms']:.2f} ms/step, "
+                f"parity_ok={rec['parity_ok']})",
+                flush=True,
+            )
+
+    ranking = flush()
+    measured = [c for c in configs if "states_per_sec" in c]
+    print(f"ranking written to {out_path}")
+    if ranking:
+        best = ranking[0]
+        print(
+            f"WINNER {best['layout']} b={best['batch']}: "
+            f"{best['states_per_sec']:,.0f}/s"
+        )
+    # Parity failures or wholly-failed sweeps are errors.
+    if not measured or not all(c["parity_ok"] for c in measured):
+        return 1
+    return 0
+
+
+def main() -> int:
+    argv = sys.argv[1:]
+    if argv and argv[0] == "--sweep":
+        if len(argv) < 4:
+            print(__doc__)
+            return 2
+        return run_sweep(argv[1:])
+    if len(argv) < 4:
+        print(__doc__)
+        return 2
+    model_name, n, batch, table_log2 = (
+        argv[0], int(argv[1]), int(argv[2]), int(argv[3])
+    )
+    repeats = max(1, int(argv[4])) if len(argv) > 4 else 3
+    layout = argv[5] if len(argv) > 5 else "split"
+    return run_single(model_name, n, batch, table_log2, repeats, layout)
 
 
 if __name__ == "__main__":

@@ -30,6 +30,20 @@ run paxos 2 2048 18 3 kv
 run paxos 2 2048 18 3 phased
 run paxos 2 1024 18 3 phased
 run paxos 3 3072 22 2 phased
+# Round-6 capped insert (batch-monotonic claim tiles): the cost model
+# predicts capped-kv wins every steady-state config (ROUND6_NOTES.md);
+# this is the decisive race, dumped as a machine-readable ranking.
+run paxos 3 3072 22 3 capped
+run paxos 3 3072 22 3 capped-kv
+run paxos 3 32768 22 2 capped
+run paxos 2 2048 18 3 capped
+echo "== sweep ranking (variants x batches -> tune_ranking.json) =="
+# Outer timeout sized to the worst case (15 configs x 900 s per-config
+# subprocess timeout + slack); the sweep also rewrites tune_ranking.json
+# after every config, so even a killed sweep keeps what it measured.
+timeout 14400 python scripts/tpu_tune.py --sweep paxos 3 22 \
+  --batches 3072,8192,32768 --variants split,kv,phased,capped,capped-kv \
+  --repeats 2 --out tune_ranking.json
 # Tiniest spaces (r4: inclock-sym-6 ran at 475/s — pure fixed cost)
 run inclock-sym 6 512 10 3
 run inclock-sym 6 512 10 3 phased

@@ -24,9 +24,11 @@ class TpuChecker(Checker):
     ):
         # engine_kwargs pass through to the underlying engine —
         # ResidentSearch options like table_layout ("split"/"kv"),
-        # insert_variant ("sort"/"phased"), append ("scatter"/"dus"),
-        # queue_log2, and donate_chunks — so builder-API users can reach
-        # the same design knobs the tuner races.
+        # insert_variant ("sort"/"phased"/"capped"/"capped-phased"),
+        # append ("scatter"/"dus"), queue_log2, and donate_chunks — so
+        # builder-API users can reach the same design knobs the tuner
+        # races. With resident=False only insert_variant applies (the
+        # host-orchestrated engine races the same visited-set designs).
         from ..tensor.frontier import FrontierSearch
         from ..tensor.model import TensorModel
         from ..tensor.resident import ResidentSearch
@@ -76,15 +78,17 @@ class TpuChecker(Checker):
         # finer-grained (per-device-step) progress instead.
         if resident is None:
             resident = True
-        if not resident and engine_kwargs:
-            raise ValueError(
-                f"engine options {sorted(engine_kwargs)} require the "
-                "resident engine (drop resident=False)"
-            )
+        if not resident:
+            unsupported = set(engine_kwargs) - {"insert_variant"}
+            if unsupported:
+                raise ValueError(
+                    f"engine options {sorted(unsupported)} require the "
+                    "resident engine (drop resident=False)"
+                )
         self._search = (
             ResidentSearch(model, batch_size, table_log2, **engine_kwargs)
             if resident
-            else FrontierSearch(model, batch_size, table_log2)
+            else FrontierSearch(model, batch_size, table_log2, **engine_kwargs)
         )
         self._options = options
         self._result = None

@@ -1031,12 +1031,10 @@ class ShardedSearch:
                 for i in range(ss.n_chips)
             ]
             for k in grown[0]:
-                if k == "overflow":
-                    fields[k] = np.zeros(ss.n_chips, dtype=bool)
-                else:
-                    fields[k] = np.stack(
-                        [np.asarray(g[k]) for g in grown]
-                    )
+                fields[k] = np.stack([np.asarray(g[k]) for g in grown])
+            # The overflow that prompted this regrow is resolved by the
+            # bigger tables; a stale flag would re-abort the resumed run.
+            fields["overflow"] = np.zeros(ss.n_chips, dtype=bool)
         for f in ("q_states", "q_lo", "q_hi", "q_ebits", "q_depth"):
             old = fields[f]
             if old.shape[1] != ss_Q:

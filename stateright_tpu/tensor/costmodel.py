@@ -1,0 +1,294 @@
+"""Roofline cost model for the device engine step (VERDICT r5 #2).
+
+Purpose: decide the split / kv / phased / capped insert race from COMMITTED
+predictions instead of blind staging — the TPU tunnel admits a client a few
+hours per round at best, so every silicon hour must race designs the model
+already ranked, and every surprise must become a calibration update.
+
+Anchor measurement (round-4 silicon, v5e, paxos-3: lanes=21, max_actions=14,
+batch 3072, table 2^22, split sort-claim insert + DUS append): 12.9 ms/step
+at 627k states/s, with the xplane attribution (ROUND4_NOTES.md "Round-5
+perf breadcrumbs"):
+
+    fusion.1137 (expand + fingerprint + props + append)   5.77 ms
+    while.95    (insert: 4-op sort + bucket gathers + claim)  4.75 ms
+    everything else (pop, compact, counters, masks)       ~2.4 ms
+
+The per-op-class achieved bandwidths below are FIT to that attribution and
+sit far below the v5e's 819 GB/s peak on purpose: rounds 4-5 measured the
+engine at 1-2% effective HBM bandwidth, and the model's job is to
+extrapolate from the machine that was measured, not the machine the spec
+sheet promises. The VALUE of the model is the scaling structure — how each
+term moves with batch, table size, lane count, and the new-candidate
+fraction — which is what ranks the variants; absolute times are anchored
+but soft.
+
+This module is deliberately pure Python (no jax import): it must be usable
+from bench.py's host side, the tuner, and tests without touching a backend.
+Keep the layout constants in sync with tensor/hashtable.py (asserted by
+tests/test_costmodel.py).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import NamedTuple, Optional
+
+# Mirrors of tensor/hashtable.py layout constants (pinned by test).
+BUCKET = 128
+KV_BUCKET = 64
+CLAIM_TILE = 4096
+CAP_MAX_TILES = 64
+BUCKET_ROW_BYTES = BUCKET * 4  # one gathered bucket row (512 B)
+
+# Sort operand counts: the hoisted round-1 sort is 3 u32 operands
+# (rotr-packed key, lo, iota — hashtable._insert_impl round-5 shape); the
+# overflow-loop sort is 4 but runs ~zero iterations at sane load factors.
+SORT_OPERANDS = 3
+
+INSERT_VARIANTS = ("split", "kv", "phased", "capped", "capped-kv")
+
+# (table_layout, insert_variant) engine options -> cost-model variant name.
+# The single source of truth for this mapping: bench.py's roofline
+# annotation and scripts/tpu_tune.py's predicted_ms both read it, so a new
+# engine variant only needs a row here to be costed everywhere.
+ENGINE_VARIANTS = {
+    ("split", "sort"): "split",
+    ("kv", "sort"): "kv",
+    ("split", "phased"): "phased",
+    ("split", "capped"): "capped",
+    ("kv", "capped"): "capped-kv",
+    ("split", "capped-phased"): "capped",
+}
+
+
+@dataclass(frozen=True)
+class DeviceSpec:
+    """Peak numbers plus ACHIEVED per-op-class rates (calibrated, see module
+    docstring). `hbm_gbps` is the roofline peak used for hbm_frac; the
+    gbps_* rates are what this engine actually sustains per op class."""
+
+    name: str
+    hbm_gbps: float  # peak HBM bandwidth (roofline denominator)
+    gbps_gather: float  # [B, 128] bucket-row gathers
+    gbps_sort: float  # lax.sort, per operand-byte per pass-equivalent
+    gbps_scatter: float  # claim/unsort scatters + readbacks
+    gbps_stream: float  # contiguous DUS/compaction traffic
+    ns_expand_elem: float  # expand+fingerprint+props fusion, per succ lane
+    ns_other_lane: float  # pop/masks/counters residue, per flat succ lane
+    ms_dispatch: float  # per serialized probe round / claim tile
+
+
+# Fit to the r4 anchor (see module docstring); the split prediction for the
+# anchor config must stay within ~20% of 12.9 ms (tests/test_costmodel.py).
+V5E = DeviceSpec(
+    name="tpu-v5e",
+    hbm_gbps=819.0,
+    gbps_gather=15.0,
+    gbps_sort=8.0,
+    gbps_scatter=3.0,
+    gbps_stream=20.0,
+    ns_expand_elem=6.15,
+    ns_other_lane=55.8,
+    ms_dispatch=0.01,
+)
+
+# Round-4 silicon: the row-scatter queue append moved ~2.4 GiB/s effective
+# (44.7% of the paxos-3 step before the DUS form replaced it).
+GBPS_APPEND_SCATTER = 2.6
+
+# One CPU core of the rehearsal box, roughed in from the r4 CPU sweeps
+# (paxos-3 b=32768 ~101k gen/s; no per-op attribution exists, so treat CPU
+# *times* as low-confidence — CPU *bytes* are exact and are what
+# cpu_bytes_per_state reports).
+CPU1 = DeviceSpec(
+    name="cpu-1core",
+    hbm_gbps=12.0,
+    gbps_gather=4.0,
+    gbps_sort=0.8,
+    gbps_scatter=2.0,
+    gbps_stream=6.0,
+    ns_expand_elem=15.0,
+    ns_other_lane=80.0,
+    ms_dispatch=0.05,
+)
+
+
+class OpCost(NamedTuple):
+    name: str
+    bytes: float  # HBM bytes touched
+    ms: float  # predicted time at the calibrated achieved rate
+
+
+class StepCost(NamedTuple):
+    total_ms: float
+    total_bytes: float  # roofline numerator for hbm_frac
+    ops: tuple  # OpCost rows, the per-op breakdown
+
+
+def _ms(nbytes: float, gbps: float) -> float:
+    return nbytes / (gbps * 1e9) * 1e3
+
+
+def step_cost(
+    lanes: int,
+    max_actions: int,
+    batch: int,
+    table_log2: int,
+    *,
+    variant: str = "split",
+    append: str = "dus",
+    new_frac: float = 0.5,
+    phased_rounds: float = 3.9,
+    tile: int = CLAIM_TILE,
+    device: DeviceSpec = V5E,
+) -> StepCost:
+    """Predict one engine step for an insert `variant` (INSERT_VARIANTS).
+
+    `new_frac` is the fraction of the B = batch x max_actions flat successor
+    lanes the capped path must tile over — the POPULATED lanes (active and
+    in-boundary; padding on sub-batch frontiers is compacted away before
+    any tile runs). Estimate it as generated-states-per-step / B from a
+    run, or 1.0 for a frontier that fills the batch. It only moves the
+    capped variants.
+
+    `phased_rounds` is the average serialized probe-round count of the
+    phased scatter-max insert (r4 silicon measured ~3.9 on paxos-3).
+
+    `table_log2` is DELIBERATELY inert today: per-lane probe traffic is one
+    fixed 512-byte bucket row regardless of table size, and chain-overflow
+    rounds are ~zero at sane load factors, so table size only matters
+    through load factor — a term the r4 anchor cannot calibrate. It stays
+    in the signature because every caller naturally has it and a future
+    load-factor term will need it.
+    """
+    if variant not in INSERT_VARIANTS:
+        raise ValueError(
+            f"variant must be one of {INSERT_VARIANTS}, got {variant!r}"
+        )
+    K, A, L = batch, max_actions, lanes
+    B = K * A
+    ops = []
+
+    # -- expand + fingerprint + property masks (the mega-fusion) ---------------
+    expand_bytes = 4 * (K * L + 2 * B * L)
+    ops.append(OpCost("expand_fuse", expand_bytes, B * L * device.ns_expand_elem * 1e-6))
+
+    # -- visited-set insert, per variant ---------------------------------------
+    log2_b = math.log2(max(B, 2))
+    sort_bytes_full = SORT_OPERANDS * 4 * B * log2_b
+    gather_lanes = 1 if variant in ("kv", "capped-kv") else 2
+    gathers_full = gather_lanes * B * BUCKET_ROW_BYTES
+    claim_misc_full = 8 * B * 4  # table scatters + unsort iota + readbacks
+
+    if variant in ("split", "kv"):
+        ops.append(OpCost("insert_sort", sort_bytes_full, _ms(sort_bytes_full, device.gbps_sort)))
+        ops.append(OpCost("insert_gather", gathers_full, _ms(gathers_full, device.gbps_gather)))
+        ops.append(OpCost("insert_claim", claim_misc_full, _ms(claim_misc_full, device.gbps_scatter) + device.ms_dispatch))
+    elif variant == "phased":
+        # No sort; `phased_rounds` serialized rounds, each a full-width
+        # bucket gather + 3 scatter-max phases with readback gets.
+        per_round_scatter = 16 * B * 4
+        ops.append(OpCost(
+            "insert_gather",
+            phased_rounds * gathers_full,
+            phased_rounds * _ms(gathers_full, device.gbps_gather),
+        ))
+        ops.append(OpCost(
+            "insert_claim",
+            phased_rounds * per_round_scatter,
+            phased_rounds * (_ms(per_round_scatter, device.gbps_scatter) + device.ms_dispatch),
+        ))
+    else:  # capped / capped-kv: active-compaction + claim tiles
+        pow2_b = 1 << max(int(B) - 1, 1).bit_length()
+        T = min(pow2_b, max(tile, pow2_b // CAP_MAX_TILES))
+        n_tiles = max(math.ceil(new_frac * B / T), 0)
+        compact = 10 * B * 4  # 5 u32 arrays, read+write, cumsum-scatter
+        tile_sort = n_tiles * SORT_OPERANDS * 4 * T * math.log2(max(T, 2))
+        tile_gather = n_tiles * gather_lanes * T * BUCKET_ROW_BYTES
+        tile_claim = n_tiles * 8 * T * 4
+        ops.append(OpCost("insert_compact", compact, _ms(compact, device.gbps_stream)))
+        ops.append(OpCost("insert_sort", tile_sort, _ms(tile_sort, device.gbps_sort)))
+        ops.append(OpCost("insert_gather", tile_gather, _ms(tile_gather, device.gbps_gather)))
+        ops.append(OpCost(
+            "insert_claim", tile_claim,
+            _ms(tile_claim, device.gbps_scatter) + n_tiles * device.ms_dispatch,
+        ))
+
+    # -- queue append ----------------------------------------------------------
+    append_bytes = 2 * 4 * (L + 4) * B  # compaction build + block write
+    append_gbps = device.gbps_stream if append == "dus" else GBPS_APPEND_SCATTER
+    ops.append(OpCost("append", append_bytes, _ms(append_bytes, append_gbps)))
+
+    # -- pop / counters / discovery residue ------------------------------------
+    other_bytes = 4 * (L + 4) * B
+    ops.append(OpCost("other", other_bytes, B * device.ns_other_lane * 1e-6))
+
+    return StepCost(
+        total_ms=sum(o.ms for o in ops),
+        total_bytes=sum(o.bytes for o in ops),
+        ops=tuple(ops),
+    )
+
+
+def bytes_per_state(
+    lanes: int,
+    max_actions: int,
+    batch: int,
+    table_log2: int,
+    states_per_step: float,
+    *,
+    variant: str = "split",
+    append: str = "dus",
+    new_frac: float = 0.5,
+    device: DeviceSpec = V5E,
+) -> float:
+    """HBM bytes touched per GENERATED state: the step's modeled byte total
+    over the measured states-per-step (state_count / steps from a run)."""
+    sc = step_cost(
+        lanes, max_actions, batch, table_log2,
+        variant=variant, append=append, new_frac=new_frac, device=device,
+    )
+    return sc.total_bytes / max(states_per_step, 1e-9)
+
+
+def hbm_frac(
+    states_per_sec: float,
+    bytes_per_state_: float,
+    device: DeviceSpec = V5E,
+) -> float:
+    """Effective HBM fraction — the MFU analogue this engine is judged on
+    (VERDICT r4/r5: ~1-2%): modeled bytes moved per second over peak."""
+    return states_per_sec * bytes_per_state_ / (device.hbm_gbps * 1e9)
+
+
+def predict_ranking(
+    lanes: int,
+    max_actions: int,
+    batch: int,
+    table_log2: int,
+    *,
+    new_frac: float = 0.5,
+    append: str = "dus",
+    device: DeviceSpec = V5E,
+    variants: Optional[tuple] = None,
+) -> list:
+    """Rank insert variants by predicted step time (fastest first). Returns
+    [{"variant", "total_ms", "insert_ms", "bytes"}...] — the committed
+    prediction format ROUND6_NOTES.md and the tuner's ranking JSON use."""
+    out = []
+    for v in variants or INSERT_VARIANTS:
+        sc = step_cost(
+            lanes, max_actions, batch, table_log2,
+            variant=v, append=append, new_frac=new_frac, device=device,
+        )
+        out.append({
+            "variant": v,
+            "total_ms": round(sc.total_ms, 3),
+            "insert_ms": round(
+                sum(o.ms for o in sc.ops if o.name.startswith("insert_")), 3
+            ),
+            "bytes": int(sc.total_bytes),
+        })
+    return sorted(out, key=lambda r: r["total_ms"])

@@ -34,7 +34,13 @@ from ..core.discovery import HasDiscoveries
 from ..core.model import Expectation
 from ..core.path import Path
 from .fingerprint import device_fingerprint, pack_fp
-from .hashtable import HashTable, _insert_impl
+from .hashtable import (
+    HashTable,
+    _insert_impl,
+    _insert_impl_capped,
+    _insert_impl_phased,
+    _insert_impl_phased_capped,
+)
 from .model import TensorModel
 
 
@@ -302,15 +308,32 @@ class _Chunk:
 
 
 class FrontierSearch:
+    # Same variant names/semantics as ResidentSearch.insert_variant (the
+    # host-orchestrated engine races the same visited-set designs; the
+    # table layout here is always split).
+    INSERT_VARIANTS = {
+        "sort": _insert_impl,
+        "phased": _insert_impl_phased,
+        "capped": _insert_impl_capped,
+        "capped-phased": _insert_impl_phased_capped,
+    }
+
     def __init__(
         self,
         model: TensorModel,
         batch_size: int = 1024,
         table_log2: int = 20,
+        insert_variant: str = "sort",
     ):
         self.model = model
         self.batch_size = batch_size
         self.table = HashTable(table_log2)
+        if insert_variant not in self.INSERT_VARIANTS:
+            raise ValueError(
+                f"insert_variant must be one of "
+                f"{sorted(self.INSERT_VARIANTS)}, got {insert_variant!r}"
+            )
+        self.insert_variant = insert_variant
         self.properties = model.properties()
         self._step = self._build_step()
         # Resumable search state (seeded lazily by run(); see _seed).
@@ -324,6 +347,7 @@ class FrontierSearch:
         model = self.model
         K = self.batch_size
         props = self.properties
+        insert = self.INSERT_VARIANTS[self.insert_variant]
 
         @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
         def step(t_lo, t_hi, p_lo, p_hi, states, lo, hi, active):
@@ -337,7 +361,10 @@ class FrontierSearch:
                 t_lo, t_hi, p_lo, p_hi,
                 flat, slo, shi, is_new,
                 gen_count, has_succ, ovf,
-            ) = expand_insert(model, t_lo, t_hi, p_lo, p_hi, states, lo, hi, active)
+            ) = expand_insert(
+                model, t_lo, t_hi, p_lo, p_hi, states, lo, hi, active,
+                insert=insert,
+            )
             out_states, out_lo, out_hi, out_src, new_count = compact_new(
                 flat, slo, shi, is_new
             )
@@ -651,6 +678,7 @@ class FrontierSearch:
                         "max_actions": self.model.max_actions,
                         "properties": [p.name for p in self.properties],
                         "table_log2": self.table.log2_size,
+                        "insert_variant": self.insert_variant,
                     }
                 ).encode(),
                 dtype=np.uint8,
@@ -684,7 +712,12 @@ class FrontierSearch:
                 "checkpoint was taken with a different property list "
                 f"({meta['properties']} != {prop_names})"
             )
-        fs = cls(model, batch_size=batch_size, table_log2=meta["table_log2"])
+        fs = cls(
+            model,
+            batch_size=batch_size,
+            table_log2=meta["table_log2"],
+            insert_variant=meta.get("insert_variant", "sort"),
+        )
         fs.table.t_lo = jnp.asarray(data["t_lo"])
         fs.table.t_hi = jnp.asarray(data["t_hi"])
         fs.table.p_lo = jnp.asarray(data["p_lo"])

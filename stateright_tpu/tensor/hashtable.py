@@ -51,6 +51,13 @@ import jax.numpy as jnp
 BUCKET = 128
 MAX_ROUNDS = 64
 
+# Capped insert path (see make_capped_insert): claim tiles are at least
+# this many lanes (power of two — keeps tile shapes static under
+# jit/while_loop); CAP_MAX_TILES bounds the serialized tile count by
+# growing the tile for very large batches.
+CLAIM_TILE = 4096
+CAP_MAX_TILES = 64
+
 
 class InsertResult(NamedTuple):
     t_lo: jnp.ndarray  # uint32[S]
@@ -531,3 +538,109 @@ def _insert_impl_phased(
     p_lo = p_lo.at[ptgt].set(parent_lo, mode="drop")
     p_hi = p_hi.at[ptgt].set(parent_hi, mode="drop")
     return InsertResult(t_lo, t_hi, p_lo, p_hi, is_new, ~jnp.all(done))
+
+
+# -- batch-monotonic capped insert ---------------------------------------------
+#
+# The sort-claim inserts above pay a FULL-BATCH sort per call — B·log(B)
+# regardless of how many lanes actually need attention. At engine scale B
+# is batch × max_actions, many of those lanes are padding (sub-full
+# frontiers pop fixed-size batches) or duplicates of already-visited
+# states, and the sort volume is why measured states/s FALLS with batch
+# size (b=32768 was 1.6x slower than b=4096 on paxos-3 — ROUND4_NOTES;
+# same super-linear term on the CPU backend, so it is algorithmic). The
+# capped path makes per-call probe AND sort cost scale with the POPULATED
+# lanes instead:
+#
+# 1. active lanes are cumsum-compacted into a dense prefix (the
+#    compact_new technique from tensor/frontier.py — O(B) elementwise, no
+#    128-wide gathers, no sort);
+# 2. fixed-size power-of-two CLAIM TILES of that prefix run the underlying
+#    insert — tile shapes are static, so the whole thing lives happily
+#    inside jit / lax.while_loop. Each tile's own bucket-row probe IS the
+#    membership filter: lanes whose key is already committed resolve as
+#    hits, so the duplicate-claim sort never exceeds T·log(T) per tile and
+#    total tile work is ~n_active/T tiles, not B/T. Duplicates that
+#    straddle tiles are resolved because a later tile's probe simply hits
+#    the earlier tile's committed slot.
+#
+# (A variant with a SEPARATE up-front membership probe — gather all B
+# home-bucket rows, then tile only the missing lanes — was measured and
+# cost-modeled: the extra full-width gather re-reads rows the claim tiles
+# gather again, and loses to this fused form at every candidate fraction;
+# see tensor/costmodel.py and ROUND6_NOTES.md.)
+#
+# Correctness rides entirely on the underlying insert: the wrapper only
+# compacts and re-batches the active lanes, each original lane lands in
+# exactly one tile, and tile order is deterministic — so per-call `is_new`
+# attribution (one per distinct new key) is inherited unchanged.
+
+
+def make_capped_insert(inner, n_state, result_cls, tile=CLAIM_TILE):
+    """Wrap an insert impl (`inner`, taking `n_state` table arrays followed
+    by lo/hi/parent_lo/parent_hi/active and returning `result_cls`) in the
+    active-compaction + claim-tile structure described above."""
+
+    def capped(*args):
+        state = args[:n_state]
+        lo, hi, parent_lo, parent_hi, active = args[n_state:]
+        B = lo.shape[0]
+        pow2_B = 1 << max(B - 1, 1).bit_length()
+        # Tile size: at least CLAIM_TILE lanes, growing for huge batches so
+        # the serialized tile count never exceeds CAP_MAX_TILES.
+        T = min(pow2_B, max(tile, pow2_B // CAP_MAX_TILES))
+        P = -(-B // T) * T  # padded prefix length: dynamic_slice never clamps
+
+        n_act = active.sum().astype(jnp.int32)
+
+        # Dense-prefix compaction (sort-free cumsum scatter); invalid lanes
+        # land at P / map back to the out-of-range index B ("drop").
+        pos_all = jnp.cumsum(active.astype(jnp.int32)) - 1
+        pos = jnp.where(active, pos_all, P)
+        c_lo = jnp.zeros(P, jnp.uint32).at[pos].set(lo, mode="drop")
+        c_hi = jnp.zeros(P, jnp.uint32).at[pos].set(hi, mode="drop")
+        c_plo = jnp.zeros(P, jnp.uint32).at[pos].set(parent_lo, mode="drop")
+        c_phi = jnp.zeros(P, jnp.uint32).at[pos].set(parent_hi, mode="drop")
+        c_src = jnp.full(P, B, jnp.int32).at[pos].set(
+            jnp.arange(B, dtype=jnp.int32), mode="drop"
+        )
+
+        tix = jnp.arange(T, dtype=jnp.int32)
+        n_tiles = (n_act + (T - 1)) // T
+
+        def cond_f(carry):
+            return carry[-1] < n_tiles
+
+        def body_f(carry):
+            st = carry[:n_state]
+            is_new, ovf, t = carry[n_state:]
+            start = t * T
+            res = inner(
+                *st,
+                jax.lax.dynamic_slice(c_lo, (start,), (T,)),
+                jax.lax.dynamic_slice(c_hi, (start,), (T,)),
+                jax.lax.dynamic_slice(c_plo, (start,), (T,)),
+                jax.lax.dynamic_slice(c_phi, (start,), (T,)),
+                (start + tix) < n_act,
+            )
+            src = jax.lax.dynamic_slice(c_src, (start,), (T,))
+            is_new = is_new.at[src].set(
+                res[n_state], mode="drop", unique_indices=True
+            )
+            return (*res[:n_state], is_new, ovf | res[n_state + 1], t + 1)
+
+        out = jax.lax.while_loop(
+            cond_f,
+            body_f,
+            (*state, jnp.zeros(B, dtype=bool), jnp.bool_(False), jnp.int32(0)),
+        )
+        return result_cls(*out[: n_state + 2])
+
+    return capped
+
+
+_insert_impl_capped = make_capped_insert(_insert_impl, 4, InsertResult)
+_insert_impl_kv_capped = make_capped_insert(_insert_impl_kv, 3, InsertKvResult)
+_insert_impl_phased_capped = make_capped_insert(
+    _insert_impl_phased, 4, InsertResult
+)

@@ -53,8 +53,11 @@ from .frontier import (
 from .hashtable import (
     KV_BUCKET,
     _insert_impl,
+    _insert_impl_capped,
     _insert_impl_kv,
+    _insert_impl_kv_capped,
     _insert_impl_phased,
+    _insert_impl_phased_capped,
 )
 from .model import TensorModel
 
@@ -86,6 +89,23 @@ def _finish_masks(finish_when: HasDiscoveries, props) -> tuple[int, int]:
     raise ValueError(f"unknown HasDiscoveries kind {k!r}")
 
 
+# Abort-code bits carried in _Carry.overflow (uint32): nonzero aborts the
+# loop; the bits name the resource that actually ran out, so overflow
+# recovery (checkpoint + load_checkpoint into bigger arrays) can grow the
+# RIGHT one instead of guessing.
+ABORT_TABLE = 1  # hash-table insert exhausted MAX_ROUNDS (table full)
+ABORT_QUEUE = 2  # frontier queue tail crossed its capacity
+
+
+def _abort_reason(code: int) -> str:
+    parts = []
+    if code & ABORT_TABLE:
+        parts.append("hash table full (raise table_log2)")
+    if code & ABORT_QUEUE:
+        parts.append("frontier queue full (raise queue_log2)")
+    return " and ".join(parts) if parts else "overflow"
+
+
 class _Carry(NamedTuple):
     t_lo: jnp.ndarray  # uint32[S] visited-table key halves
     t_hi: jnp.ndarray  # uint32[S]
@@ -105,7 +125,7 @@ class _Carry(NamedTuple):
     discovered: jnp.ndarray  # uint32 bitmask
     disc_lo: jnp.ndarray  # uint32[P]
     disc_hi: jnp.ndarray  # uint32[P]
-    overflow: jnp.ndarray  # bool
+    overflow: jnp.ndarray  # uint32 abort code (0 ok; ABORT_TABLE|ABORT_QUEUE)
     steps: jnp.ndarray  # int32
 
 
@@ -198,7 +218,11 @@ def _regrow(
         keep = min(old.shape[0], Q_new)
         grown[:keep] = old[:keep]
         out[f] = grown
-    out["overflow"] = np.bool_(False)  # the abort reason is being fixed
+    # The carry's abort code is NOT touched here: a checkpointed carry sits
+    # at the last sound chunk boundary (code 0), and the reason for the
+    # abort that prompted the regrow travels in checkpoint meta
+    # ("abort_reason"), where load_checkpoint enforces that the overflowed
+    # resource actually grew.
     return out
 
 
@@ -255,16 +279,26 @@ class ResidentSearch:
         if table_layout not in ("split", "kv"):
             raise ValueError("table_layout must be 'split' or 'kv'")
         self.table_layout = table_layout
-        # insert_variant="phased": the pre-sort-claim scatter-max insert,
-        # raceable per workload — its fixed costs win on tiny frontiers
-        # (paxos-2 class) while the sort-claim wins at scale (see
-        # hashtable._insert_impl_phased).
-        if insert_variant not in ("sort", "phased"):
-            raise ValueError("insert_variant must be 'sort' or 'phased'")
-        if insert_variant == "phased" and table_layout == "kv":
+        # insert_variant selects the visited-set insert design:
+        #   "sort"   — full-batch sort-claim (the at-scale default);
+        #   "phased" — pre-sort-claim scatter-max insert, raceable per
+        #              workload — its fixed costs win on tiny frontiers
+        #              (paxos-2 class; see hashtable._insert_impl_phased);
+        #   "capped" — batch-monotonic path: active-compaction + fixed-size
+        #              claim tiles, so per-step probe AND sort cost scale
+        #              with the populated lanes instead of the full
+        #              expanded batch (hashtable.make_capped_insert);
+        #              composes with table_layout="kv";
+        #   "capped-phased" — the same cap around the phased insert.
+        if insert_variant not in ("sort", "phased", "capped", "capped-phased"):
             raise ValueError(
-                "insert_variant='phased' supports the split table layout "
-                "only"
+                "insert_variant must be 'sort', 'phased', 'capped', or "
+                "'capped-phased'"
+            )
+        if insert_variant in ("phased", "capped-phased") and table_layout == "kv":
+            raise ValueError(
+                f"insert_variant={insert_variant!r} supports the split "
+                "table layout only"
             )
         self.insert_variant = insert_variant
         self.props = model.properties()
@@ -285,17 +319,27 @@ class ResidentSearch:
         # Suspended-search carry (chunked runs only): retained across run()
         # calls so budget/timeout suspensions and overflows are resumable.
         self._carry = None
+        # Abort code of the last overflow (ABORT_TABLE | ABORT_QUEUE bits);
+        # written into checkpoint meta so recovery grows the right resource.
+        self._last_abort = 0
 
     def _insert_fn(self):
         if self.table_layout == "split":
-            return (
-                _insert_impl_phased
-                if self.insert_variant == "phased"
-                else _insert_impl
-            )
+            return {
+                "sort": _insert_impl,
+                "phased": _insert_impl_phased,
+                "capped": _insert_impl_capped,
+                "capped-phased": _insert_impl_phased_capped,
+            }[self.insert_variant]
+
+        kv_insert = (
+            _insert_impl_kv_capped
+            if self.insert_variant == "capped"
+            else _insert_impl_kv
+        )
 
         def kv_adapter(t_kv, t_empty, p_lo, p_hi, lo, hi, plo, phi, active):
-            r = _insert_impl_kv(t_kv, p_lo, p_hi, lo, hi, plo, phi, active)
+            r = kv_insert(t_kv, p_lo, p_hi, lo, hi, plo, phi, active)
             return r.t_kv, t_empty, r.p_lo, r.p_hi, r.is_new, r.overflow
 
         return kv_adapter
@@ -408,7 +452,9 @@ class ResidentSearch:
                 discovered=discovered,
                 disc_lo=disc_lo,
                 disc_hi=disc_hi,
-                overflow=c.overflow | ovf | q_full,
+                overflow=c.overflow
+                | (ovf.astype(jnp.uint32) * jnp.uint32(ABORT_TABLE))
+                | (q_full.astype(jnp.uint32) * jnp.uint32(ABORT_QUEUE)),
                 steps=c.steps + 1,
             )
 
@@ -428,7 +474,7 @@ class ResidentSearch:
                 & (~all_found)
                 & (~policy)
                 & (~count_hit)
-                & (~c.overflow)
+                & (c.overflow == 0)
                 & (c.steps < max_steps)
             )
 
@@ -482,7 +528,7 @@ class ResidentSearch:
                 discovered=jnp.uint32(0),
                 disc_lo=jnp.zeros(max(P, 1), dtype=jnp.uint32),
                 disc_hi=jnp.zeros(max(P, 1), dtype=jnp.uint32),
-                overflow=ovf,
+                overflow=ovf.astype(jnp.uint32) * jnp.uint32(ABORT_TABLE),
                 steps=jnp.int32(0),
             )
 
@@ -727,16 +773,19 @@ class ResidentSearch:
                     self._dyn_dev,
                 )
                 summary = np.asarray(summary)  # one small transfer per chunk
-                if summary[7]:  # overflow
+                if summary[7]:  # overflow (abort code)
+                    self._last_abort = int(summary[7])
+                    reason = _abort_reason(self._last_abort)
                     if self.donate_chunks:
                         # The pre-chunk carry was donated into the dispatch;
                         # there is no sound state to recover.
                         self._carry = None
                         raise RuntimeError(
-                            "hash table or queue full; donate_chunks=True "
-                            "sacrificed the recovery carry — rerun with a "
-                            "larger table_log2 (or donate_chunks=False for "
-                            "checkpoint-then-regrow recovery)"
+                            f"hash table or queue full — {reason}; "
+                            "donate_chunks=True sacrificed the recovery "
+                            "carry — rerun with the larger size (or "
+                            "donate_chunks=False for checkpoint-then-regrow "
+                            "recovery)"
                         )
                     # Revert to the pre-chunk carry so checkpoint() +
                     # load_checkpoint(table_log2=bigger) can resume exactly
@@ -751,13 +800,14 @@ class ResidentSearch:
                     )
                     self._parent_map = None
                     raise RuntimeError(
-                        "hash table or queue full; the search carry was kept "
-                        "at the last chunk boundary — checkpoint(path) then "
-                        "ResidentSearch.load_checkpoint(model, path, "
-                        "table_log2=<bigger>) to continue without losing the "
-                        "run (if you right-sized the queue with queue_log2, "
-                        "pass a bigger queue_log2 there too — a preserved "
-                        "too-small queue would just overflow again)"
+                        f"hash table or queue full — {reason}; the search "
+                        "carry was kept at the last chunk boundary — "
+                        "checkpoint(path) then "
+                        "ResidentSearch.load_checkpoint(model, path, ...) "
+                        "with the named size raised to continue without "
+                        "losing the run (the abort reason is preserved in "
+                        "the checkpoint and load_checkpoint enforces the "
+                        "growth)"
                     )
                 self._carry = carry
                 if progress is not None:
@@ -788,9 +838,9 @@ class ResidentSearch:
             _stop,
         ) = (int(x) for x in summary[:10])
         if overflow:
+            self._last_abort = overflow
             raise RuntimeError(
-                "hash table or queue full; raise table_log2 (or queue_log2 "
-                "if the queue was right-sized below the table)"
+                f"hash table or queue full — {_abort_reason(overflow)}"
             )
 
         P = len(self.props)
@@ -822,6 +872,7 @@ class ResidentSearch:
         self._carry = None
         self._parent_map = None
         self._last_tables = None
+        self._last_abort = 0  # a fresh run owes nothing to an old overflow
 
     def dump_states(
         self, decode: bool = True, evaluated_only: bool = False,
@@ -895,6 +946,11 @@ class ResidentSearch:
                     "queue_log2": self.queue_log2,
                     "batch_size": self.batch_size,
                     "table_layout": self.table_layout,
+                    "insert_variant": self.insert_variant,
+                    # Why the run aborted (0 = clean suspension): lets
+                    # load_checkpoint refuse a resume that would hit the
+                    # same wall again.
+                    "abort_reason": self._last_abort,
                 }
             ).encode(),
             dtype=np.uint8,
@@ -930,20 +986,51 @@ class ResidentSearch:
         log2 = table_log2 if table_log2 is not None else meta["table_log2"]
         if log2 < meta["table_log2"]:
             raise ValueError("cannot shrink the table on resume")
+        meta_q = meta.get("queue_log2", meta["table_log2"])
         if queue_log2 is None:
             # Default-sized checkpoints (queue == table) keep following the
             # table through a regrow — the overflow-recovery path needs the
             # bigger queue. An explicitly right-sized queue is preserved.
-            meta_q = meta.get("queue_log2", meta["table_log2"])
             queue_log2 = log2 if meta_q == meta["table_log2"] else meta_q
+        # Enforce that the resource the aborted run actually ran out of
+        # (preserved in meta by checkpoint()) grew — a same-size resume
+        # would hit the identical wall and lose the recovery attempt.
+        abort = int(meta.get("abort_reason", 0))
+        if abort & ABORT_TABLE and log2 <= meta["table_log2"]:
+            raise ValueError(
+                "this checkpoint was taken after a hash-table overflow "
+                f"(table_log2={meta['table_log2']}); pass a larger "
+                "table_log2 to load_checkpoint to regrow the table"
+            )
+        if abort & ABORT_QUEUE and queue_log2 <= meta_q:
+            raise ValueError(
+                "this checkpoint was taken after a frontier-queue overflow "
+                f"(queue_log2={meta_q}); pass a larger queue_log2 to "
+                "load_checkpoint to regrow the queue"
+            )
         rs = cls(
             model,
             batch_size=batch_size or meta["batch_size"],
             table_log2=log2,
             donate_chunks=donate_chunks,
             queue_log2=queue_log2,
+            # A capped/phased run must resume on the same insert design —
+            # overflow recovery happens exactly on the long at-scale runs
+            # where silently falling back to the full-batch sort would
+            # reintroduce the cost the variant was chosen to avoid.
+            insert_variant=meta.get("insert_variant", "sort"),
         )
         fields = {f: data[f] for f in _Carry._fields}
+        # Pre-abort-code checkpoints stored overflow as a bool; the carry
+        # now holds a uint32 abort bitmask. Clear it on resume: a chunked
+        # checkpoint sits at a sound boundary (code 0) already, but a
+        # SEED-insert overflow leaves its code in the carry itself — and
+        # the guards above have just enforced that whatever resource
+        # aborted has grown, so carrying the old code forward would only
+        # re-abort the recovered run on its first step.
+        fields["overflow"] = np.zeros_like(
+            np.asarray(fields["overflow"]), dtype=np.uint32
+        )
         if log2 != meta["table_log2"]:
             fields.update(
                 _regrow(

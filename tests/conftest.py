@@ -22,5 +22,15 @@ try:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # NOTE: do NOT point jax_compilation_cache_dir at a persistent cache
+    # here. It was tried (round 6) to cut the suite's compile-dominated
+    # wall clock, and on this jax build cache-DESERIALIZED executables
+    # mishandle donated buffers (donate_argnums): the frontier engine's
+    # step kernel read stale visited tables until the table "overflowed",
+    # and a partially-warm cache segfaulted the process outright
+    # (tests/test_checkpoint.py::test_multiple_suspensions reproduced
+    # both). bench.py's subprocess workers still use their own cache dirs
+    # — single dispatch per process, where the aliasing bug has not been
+    # observed — but the in-process multi-kernel suite must compile fresh.
 except ImportError:  # host-only test environments
     pass

@@ -39,10 +39,15 @@ def test_kill_and_resume_reproduces_exact_counts(tmp_path):
 
 
 def test_multiple_suspensions(tmp_path):
+    # Each load_checkpoint builds a fresh engine whose step kernel
+    # RECOMPILES (~1.7 s per round trip on the CI box), so the round-trip
+    # count is the whole cost of this test; a dozen suspensions exercise
+    # the repeated dump/restore path as thoroughly as the original 50 at a
+    # quarter of the wall clock.
     full = FrontierSearch(TensorLinearEquation(2, 4, 7), 256, 18).run()
     fs = FrontierSearch(TensorLinearEquation(2, 4, 7), 256, 18)
     ckpt = str(tmp_path / "s.npz")
-    for _ in range(50):
+    for _ in range(12):
         r = fs.run(max_steps=3)
         fs.checkpoint(ckpt)
         fs = FrontierSearch.load_checkpoint(
@@ -179,6 +184,41 @@ def test_resident_overflow_checkpoints_then_regrows(tmp_path):
     assert r.state_count == full.state_count
     assert r.unique_state_count == full.unique_state_count
     assert r.discoveries == full.discoveries
+
+
+def test_resident_queue_overflow_abort_reason_preserved(tmp_path):
+    # A queue-only overflow (table plenty big, queue right-sized too small)
+    # must name the queue in the abort, preserve that reason through
+    # checkpoint, refuse a resume that does not grow the queue, and
+    # complete at exact parity once it does grow (the satellite fix for the
+    # old regrow behavior that silently cleared the abort reason).
+    from stateright_tpu.tensor.resident import (
+        ABORT_QUEUE,
+        ABORT_TABLE,
+        ResidentSearch,
+    )
+
+    rs = ResidentSearch(TensorTwoPhaseSys(4), 256, 14, queue_log2=8)
+    with pytest.raises(RuntimeError, match="frontier queue full"):
+        rs.run(budget=2)
+    assert rs._last_abort & ABORT_QUEUE
+    assert not rs._last_abort & ABORT_TABLE  # 2^14 table never filled
+    ckpt = str(tmp_path / "queue_overflowed.npz")
+    rs.checkpoint(ckpt)
+    del rs
+
+    # Not growing the queue must be refused — it is what overflowed.
+    with pytest.raises(ValueError, match="queue"):
+        ResidentSearch.load_checkpoint(TensorTwoPhaseSys(4), ckpt)
+
+    grown = ResidentSearch.load_checkpoint(
+        TensorTwoPhaseSys(4), ckpt, queue_log2=12
+    )
+    r = grown.run()
+    assert r.complete
+    # 2pc-4 golden (the uninterrupted-run oracle, pinned repo-wide).
+    assert (r.state_count, r.unique_state_count) == (8258, 1568)
+    assert "commit agreement" in r.discoveries
 
 
 def test_resident_timeout_suspends_not_raises():

@@ -147,7 +147,20 @@ def test_spawn_tpu_passes_engine_options_through():
     assert c.unique_state_count() == 288
     import pytest
 
+    # Resident-only knobs still require the resident engine...
     with pytest.raises(ValueError, match="resident"):
         TensorTwoPhaseSys(3).checker().spawn_tpu(
-            batch_size=64, table_log2=12, resident=False, insert_variant="phased"
+            batch_size=64, table_log2=12, resident=False, table_layout="kv"
         )
+    # ...but insert_variant reaches the host-orchestrated engine too (round
+    # 6: FrontierSearch races the same visited-set designs).
+    c2 = (
+        TensorTwoPhaseSys(3)
+        .checker()
+        .spawn_tpu(
+            batch_size=64, table_log2=12,
+            resident=False, insert_variant="capped",
+        )
+        .join()
+    )
+    assert c2.unique_state_count() == 288
