@@ -374,6 +374,31 @@ def _build_workload(model_name: str, n: int):
         golden = (s["generated"], s["unique"])
     else:
         raise ValueError(f"unknown workload {model_name!r}")
+    # BENCH_STORE=tiered: race the two-tier state store (device hot set +
+    # host spill tier) on every workload; BENCH_HIGH_WATER /
+    # BENCH_SUMMARY_LOG2 tune it. Malformed values fall back to the store
+    # defaults — an observability knob must never kill the bench — but an
+    # unknown store NAME is called out loudly: a typo'd value silently
+    # benching the device store would cost tunnel day exactly the spill
+    # rows the env var exists for (same policy as unknown bench flags).
+    bench_store = os.environ.get("BENCH_STORE", "")
+    if bench_store and bench_store not in ("device", "tiered"):
+        log(f"unknown BENCH_STORE {bench_store!r} ignored "
+            "(known: device | tiered)")
+    if bench_store == "tiered":
+        engine_kwargs["store"] = "tiered"
+        try:
+            engine_kwargs["high_water"] = float(
+                os.environ.get("BENCH_HIGH_WATER", "0.85")
+            )
+        except ValueError:
+            pass
+        try:
+            engine_kwargs["summary_log2"] = int(
+                os.environ.get("BENCH_SUMMARY_LOG2", "20")
+            )
+        except ValueError:
+            pass
     return (
         model, batch, table_log2, run_kwargs, engine_kwargs, golden,
         time.monotonic() - t0,
@@ -479,7 +504,20 @@ def device_search(model_name: str, n: int, repeats: int = 3):
     )
     best, out = _time_search(search, run_kwargs, repeats, closure_s)
     _attach_roofline(out, best, model, batch, table_log2, search)
+    _attach_store_stats(out, search)
     return out, _parity_err(model_name, n, best, golden)
+
+
+def _attach_store_stats(out: dict, search) -> None:
+    """Per-tier occupancy counters in every artifact of a tiered run (the
+    DEVICE_DETAIL_FIELDS tail); no-op on the plain device store."""
+    try:
+        stats = getattr(search, "store_stats", lambda: None)()
+        if stats:
+            for f in ("hot_fill", "spilled_states", "spill_events"):
+                out[f] = stats[f]
+    except Exception as e:  # noqa: BLE001 — reporting must never kill a run
+        log(f"store-stats annotation failed: {e}")
 
 
 def device_search_sharded(model_name: str, n: int, n_chips: int = 8):
@@ -491,17 +529,25 @@ def device_search_sharded(model_name: str, n: int, n_chips: int = 8):
 
     from stateright_tpu.parallel import ShardedSearch, make_mesh
 
-    # engine_kwargs are resident-engine options (donate_chunks); the sharded
-    # engine has no equivalent, so they are intentionally dropped here.
-    model, batch, table_log2, run_kwargs, _engine_kwargs, golden, closure_s = (
+    # engine_kwargs are mostly resident-engine options (donate_chunks) with
+    # no sharded equivalent — intentionally dropped — except the tiered
+    # store, which the sharded engine supports as per-shard rank-local
+    # spill.
+    model, batch, table_log2, run_kwargs, engine_kwargs, golden, closure_s = (
         _build_workload(model_name, n)
     )
+    store_kwargs = {
+        k: engine_kwargs[k]
+        for k in ("store", "high_water", "low_water", "summary_log2")
+        if k in engine_kwargs
+    }
     n_chips = min(n_chips, len(jax.devices()))
     search = ShardedSearch(
         model,
         mesh=make_mesh(n_chips),
         batch_size=batch // 2,
         table_log2=max(table_log2 - 2, 10),
+        **store_kwargs,
     )
     best, out = _time_search(search, run_kwargs, repeats=2, closure_s=closure_s)
     out.update(
@@ -509,10 +555,32 @@ def device_search_sharded(model_name: str, n: int, n_chips: int = 8):
         virtual_mesh=jax.devices()[0].platform == "cpu",
         per_chip_unique=best.detail["per_chip_unique"],
     )
+    _attach_store_stats(out, search)
     return out, _parity_err(model_name, n, best, golden)
 
 
 # -- main ----------------------------------------------------------------------
+
+# Per-workload fields copied into detail.device verbatim when present. The
+# last three are the tiered store's per-tier occupancy counters (hot-tier
+# fill fraction, states spilled to the host tier, spill-event count) —
+# degradation past HBM is observable in every artifact
+# (tests/test_bench_contract.py pins the keys).
+DEVICE_DETAIL_FIELDS = (
+    "virtual_mesh", "n_chips", "per_chip_unique",
+    "closure_sec", "bytes_per_state", "cpu_bytes_per_state", "hbm_frac",
+    "hot_fill", "spilled_states", "spill_events",
+)
+
+
+def device_detail(v: dict) -> dict:
+    """One workload's detail.device row (shape pinned by the bench-contract
+    tests): headline rate + the optional DEVICE_DETAIL_FIELDS."""
+    return {
+        "states_per_sec": round(v["states_per_sec"], 1),
+        "sec": v["sec"],
+        **{f: v[f] for f in DEVICE_DETAIL_FIELDS if f in v},
+    }
 
 
 def headline_summary(dev: dict, base: dict, smoke: bool = False):
@@ -569,6 +637,14 @@ def main(argv: list | None = None) -> int:
             baseline_threads = max(1, int(args[i + 1]))
         except (IndexError, ValueError):
             log("ignoring malformed --baseline-threads")
+    if baseline_threads is None and (os.cpu_count() or 1) > 1:
+        # Multicore host: record the pinned threads=N multithreaded row by
+        # DEFAULT (VERDICT r5 #5 residue — every artifact to date carried
+        # only threads:1 denominators because the flag was opt-in and the
+        # TPU box reports one core). --baseline-threads still overrides.
+        baseline_threads = os.cpu_count()
+        log(f"multicore host: recording threads={baseline_threads} "
+            "baseline rows by default")
     for a in args:
         # A typo'd flag silently dropped on tunnel day would cost the
         # multithread rows the flag exists for — say so loudly.
@@ -711,22 +787,7 @@ def main(argv: list | None = None) -> int:
             device_error = "; ".join(
                 f"{k}: {v}" for k, v in dev_errors.items()
             )
-    detail["device"] = {
-        k: {
-            "states_per_sec": round(v["states_per_sec"], 1),
-            "sec": v["sec"],
-            **{
-                f: v[f]
-                for f in (
-                    "virtual_mesh", "n_chips", "per_chip_unique",
-                    "closure_sec", "bytes_per_state", "cpu_bytes_per_state",
-                    "hbm_frac",
-                )
-                if f in v
-            },
-        }
-        for k, v in dev.items()
-    }
+    detail["device"] = {k: device_detail(v) for k, v in dev.items()}
     # Sharding overhead ratio (VERDICT r4 next #4): sharded-N vs the
     # single-device engine on the SAME workload — <1 means the sharded
     # engine's per-step machinery (send-buffer scatters, all-to-all,

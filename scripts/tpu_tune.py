@@ -12,15 +12,25 @@ with the cost model's committed predictions (tensor/costmodel.py), and
 dumps a ranking JSON.
 
 Usage:
-  python scripts/tpu_tune.py MODEL N BATCH TABLE_LOG2 [REPEATS] [LAYOUT]
+  python scripts/tpu_tune.py MODEL N BATCH TABLE_LOG2 [REPEATS] [LAYOUT] \
+      [STORE] [HIGH_WATER] [SUMMARY_LOG2]
   python scripts/tpu_tune.py --sweep MODEL N TABLE_LOG2 \
       [--batches 2048,4096,8192] [--variants split,kv,phased,capped] \
+      [--stores device,tiered] [--high-waters 0.85] [--summary-bits 20] \
       [--repeats R] [--timeout SEC] [--out tune_ranking.json]
 
 LAYOUT / --variants values: split (default) | kv | phased | capped |
 capped-kv | capped-phased — the visited-table designs to race (kv =
 interleaved buckets; phased = pre-sort-claim scatter-max insert; capped =
 batch-monotonic claim-tile insert, see hashtable.make_capped_insert).
+
+STORE / --stores values: device (default) | tiered — the two-tier state
+store (stateright_tpu/store/: device hot set + host spill tier). With
+--stores including "tiered", the sweep races every water-mark x summary-bit
+combination from --high-waters / --summary-bits alongside the insert
+variants, so tunnel day prices the spill machinery with one command.
+(tiered composes with the split-layout insert variants only.)
+
 Set TPU_TUNE_TRACE=/path to capture a jax.profiler trace of the timed runs
 (inspect with tensorboard or xprof to see the per-step op breakdown).
 """
@@ -65,7 +75,8 @@ def _build_model(model_name: str, n: int):
     return TensorTwoPhaseSys(n)
 
 
-def run_single(model_name, n, batch, table_log2, repeats, layout) -> int:
+def run_single(model_name, n, batch, table_log2, repeats, layout,
+               store="device", high_water=0.85, summary_log2=20) -> int:
     if layout not in LAYOUTS:
         print(f"unknown LAYOUT {layout!r} ({' | '.join(LAYOUTS)})")
         return 2
@@ -74,9 +85,14 @@ def run_single(model_name, n, batch, table_log2, repeats, layout) -> int:
     from stateright_tpu.tensor.resident import ResidentSearch
 
     model = _build_model(model_name, n)
+    store_desc = (
+        f" store=tiered(hw={high_water},sb={summary_log2})"
+        if store == "tiered"
+        else ""
+    )
     print(
         f"devices={jax.devices()} workload={model_name}-{n} "
-        f"batch={batch} table=2^{table_log2} layout={layout}",
+        f"batch={batch} table=2^{table_log2} layout={layout}{store_desc}",
         flush=True,
     )
     search = ResidentSearch(
@@ -85,6 +101,9 @@ def run_single(model_name, n, batch, table_log2, repeats, layout) -> int:
         table_log2=table_log2,
         table_layout=table_layout,
         insert_variant=insert_variant,
+        store=store,
+        high_water=high_water,
+        summary_log2=summary_log2,
     )
     t0 = time.monotonic()
     r = search.run()
@@ -96,6 +115,11 @@ def run_single(model_name, n, batch, table_log2, repeats, layout) -> int:
     best = None
     try:
         for i in range(repeats):
+            # Tiered runs are chunked and retain a carry across run()
+            # calls; without the reset every repeat would be a no-op
+            # resume "measuring" near-zero durations (the 2pc-10 bench
+            # lesson). Whole-search engines start fresh regardless.
+            search.reset()
             r = search.run()
             print(
                 f"  run {i}: {r.duration:.4f}s "
@@ -117,23 +141,30 @@ def run_single(model_name, n, batch, table_log2, repeats, layout) -> int:
     )
     sps = best.state_count / max(best.duration, 1e-9)
     # Machine-readable line the sweep driver parses.
-    print(
-        "RESULT_JSON "
-        + json.dumps(
-            {
-                "workload": f"{model_name}-{n}",
-                "batch": batch,
-                "table_log2": table_log2,
-                "layout": layout,
-                "sec": round(best.duration, 4),
-                "states_per_sec": round(sps, 1),
-                "steps": best.steps,
-                "compile_sec": round(compile_s, 1),
-                "parity_ok": parity_ok,
-            }
-        ),
-        flush=True,
-    )
+    rec = {
+        "workload": f"{model_name}-{n}",
+        "batch": batch,
+        "table_log2": table_log2,
+        "layout": layout,
+        "store": store,
+        "sec": round(best.duration, 4),
+        "states_per_sec": round(sps, 1),
+        "steps": best.steps,
+        "compile_sec": round(compile_s, 1),
+        "parity_ok": parity_ok,
+    }
+    if store == "tiered":
+        rec["high_water"] = high_water
+        rec["summary_log2"] = summary_log2
+        stats = search.store_stats()
+        if stats:
+            rec.update(
+                {
+                    k: stats[k]
+                    for k in ("hot_fill", "spilled_states", "spill_events")
+                }
+            )
+    print("RESULT_JSON " + json.dumps(rec), flush=True)
     if not parity_ok:
         print(
             f"PARITY FAIL: {best.state_count}/{best.unique_state_count} "
@@ -160,6 +191,9 @@ def run_sweep(argv: list) -> int:
 
     batches = [int(b) for b in opt("--batches", "2048,4096,8192").split(",")]
     variants = opt("--variants", "split,kv,phased,capped").split(",")
+    stores = opt("--stores", "device").split(",")
+    high_waters = [float(x) for x in opt("--high-waters", "0.85").split(",")]
+    summary_bits = [int(x) for x in opt("--summary-bits", "20").split(",")]
     repeats = int(opt("--repeats", "3"))
     timeout = float(opt("--timeout", "900"))
     out_path = opt("--out", "tune_ranking.json")
@@ -172,6 +206,17 @@ def run_sweep(argv: list) -> int:
     if bad:
         print(f"unknown variants {bad} ({' | '.join(LAYOUTS)})")
         return 2
+    bad = [s for s in stores if s not in ("device", "tiered")]
+    if bad:
+        print(f"unknown stores {bad} (device | tiered)")
+        return 2
+    # Store axis: the plain device store plus every requested
+    # water-mark x summary-bit combination of the tiered store.
+    store_cfgs = [("device", None, None)] if "device" in stores else []
+    if "tiered" in stores:
+        store_cfgs += [
+            ("tiered", hw, sb) for hw in high_waters for sb in summary_bits
+        ]
 
     model = _build_model(model_name, n)
     from stateright_tpu.tensor import costmodel as cm
@@ -198,6 +243,15 @@ def run_sweep(argv: list) -> int:
                 {
                     "layout": c["layout"],
                     "batch": c["batch"],
+                    "store": c.get("store", "device"),
+                    **(
+                        {
+                            "high_water": c["high_water"],
+                            "summary_log2": c["summary_log2"],
+                        }
+                        if c.get("store") == "tiered"
+                        else {}
+                    ),
                     "states_per_sec": c["states_per_sec"],
                     "predicted_ms": round(c.get("predicted_ms", 0.0), 3),
                     "parity_ok": c["parity_ok"],
@@ -211,67 +265,90 @@ def run_sweep(argv: list) -> int:
 
     for batch in batches:
         for layout in variants:
-            print(f"== {model_name}-{n} b={batch} layout={layout}", flush=True)
-            rec = {
-                "workload": f"{model_name}-{n}",
-                "batch": batch,
-                "table_log2": table_log2,
-                "layout": layout,
-            }
-            try:
-                proc = subprocess.run(
-                    [
-                        sys.executable,
-                        os.path.abspath(__file__),
-                        model_name,
-                        str(n),
-                        str(batch),
-                        str(table_log2),
-                        str(repeats),
-                        layout,
-                    ],
-                    capture_output=True,
-                    text=True,
-                    timeout=timeout,
+            for store, hw, sb in store_cfgs:
+                if store == "tiered" and LAYOUTS[layout][0] != "split":
+                    continue  # tiered eviction is split-bucket-layout only
+                tag = (
+                    f" store=tiered(hw={hw},sb={sb})"
+                    if store == "tiered"
+                    else ""
                 )
-            except subprocess.TimeoutExpired:
-                rec["error"] = f"timed out after {timeout:.0f}s"
+                print(
+                    f"== {model_name}-{n} b={batch} layout={layout}{tag}",
+                    flush=True,
+                )
+                rec = {
+                    "workload": f"{model_name}-{n}",
+                    "batch": batch,
+                    "table_log2": table_log2,
+                    "layout": layout,
+                    "store": store,
+                }
+                cmd = [
+                    sys.executable,
+                    os.path.abspath(__file__),
+                    model_name,
+                    str(n),
+                    str(batch),
+                    str(table_log2),
+                    str(repeats),
+                    layout,
+                ]
+                if store == "tiered":
+                    rec["high_water"] = hw
+                    rec["summary_log2"] = sb
+                    cmd += [store, str(hw), str(sb)]
+                try:
+                    proc = subprocess.run(
+                        cmd,
+                        capture_output=True,
+                        text=True,
+                        timeout=timeout,
+                    )
+                except subprocess.TimeoutExpired:
+                    rec["error"] = f"timed out after {timeout:.0f}s"
+                    configs.append(rec)
+                    flush()
+                    print("   TIMEOUT", flush=True)
+                    continue
+                sys.stderr.write(proc.stderr)
+                line = next(
+                    (
+                        ln[len("RESULT_JSON "):]
+                        for ln in proc.stdout.splitlines()
+                        if ln.startswith("RESULT_JSON ")
+                    ),
+                    None,
+                )
+                if line is None:
+                    tail = proc.stdout.strip().splitlines()
+                    rec["error"] = (
+                        tail[-1] if tail else f"rc={proc.returncode}"
+                    )
+                    configs.append(rec)
+                    flush()
+                    print(f"   FAILED: {rec['error']}", flush=True)
+                    continue
+                rec.update(json.loads(line))
+                rec["predicted_ms"] = cm.step_cost(
+                    model.lanes,
+                    model.max_actions,
+                    batch,
+                    table_log2,
+                    variant=cm.ENGINE_VARIANTS[LAYOUTS[layout]],
+                    # Probe-only spill term: per-step eviction volume is
+                    # workload-dependent and unknown pre-run; the measured
+                    # spill_events in the RESULT_JSON calibrate it later.
+                    spill={"summary_hashes": 4} if store == "tiered" else None,
+                ).total_ms
                 configs.append(rec)
                 flush()
-                print("   TIMEOUT", flush=True)
-                continue
-            sys.stderr.write(proc.stderr)
-            line = next(
-                (
-                    ln[len("RESULT_JSON "):]
-                    for ln in proc.stdout.splitlines()
-                    if ln.startswith("RESULT_JSON ")
-                ),
-                None,
-            )
-            if line is None:
-                tail = proc.stdout.strip().splitlines()
-                rec["error"] = tail[-1] if tail else f"rc={proc.returncode}"
-                configs.append(rec)
-                flush()
-                print(f"   FAILED: {rec['error']}", flush=True)
-                continue
-            rec.update(json.loads(line))
-            rec["predicted_ms"] = cm.step_cost(
-                model.lanes,
-                model.max_actions,
-                batch,
-                table_log2,
-                variant=cm.ENGINE_VARIANTS[LAYOUTS[layout]],
-            ).total_ms
-            configs.append(rec)
-            flush()
-            print(
-                f"   {rec['states_per_sec']:,.0f}/s "
-                f"(predicted {rec['predicted_ms']:.2f} ms/step, "
-                f"parity_ok={rec['parity_ok']})",
-                flush=True,
-            )
+                print(
+                    f"   {rec['states_per_sec']:,.0f}/s "
+                    f"(predicted {rec['predicted_ms']:.2f} ms/step, "
+                    f"parity_ok={rec['parity_ok']})",
+                    flush=True,
+                )
 
     ranking = flush()
     measured = [c for c in configs if "states_per_sec" in c]
@@ -303,7 +380,13 @@ def main() -> int:
     )
     repeats = max(1, int(argv[4])) if len(argv) > 4 else 3
     layout = argv[5] if len(argv) > 5 else "split"
-    return run_single(model_name, n, batch, table_log2, repeats, layout)
+    store = argv[6] if len(argv) > 6 else "device"
+    high_water = float(argv[7]) if len(argv) > 7 else 0.85
+    summary_log2 = int(argv[8]) if len(argv) > 8 else 20
+    return run_single(
+        model_name, n, batch, table_log2, repeats, layout,
+        store=store, high_water=high_water, summary_log2=summary_log2,
+    )
 
 
 if __name__ == "__main__":

@@ -64,6 +64,14 @@ class Checker:
     def run_to_completion(self) -> None:
         pass
 
+    # -- state-store introspection ---------------------------------------------
+
+    def store_stats(self) -> Optional[dict]:
+        """Per-tier occupancy counters of the checker's state store (the
+        TPU engines' tiered store reports hot_fill / spilled_states /
+        spill_events here); None for single-tier checkers."""
+        return None
+
     # -- conveniences ----------------------------------------------------------
 
     def discovery(self, name: str) -> Optional[Path]:

@@ -25,10 +25,12 @@ class TpuChecker(Checker):
         # engine_kwargs pass through to the underlying engine —
         # ResidentSearch options like table_layout ("split"/"kv"),
         # insert_variant ("sort"/"phased"/"capped"/"capped-phased"),
-        # append ("scatter"/"dus"), queue_log2, and donate_chunks — so
-        # builder-API users can reach the same design knobs the tuner
-        # races. With resident=False only insert_variant applies (the
-        # host-orchestrated engine races the same visited-set designs).
+        # append ("scatter"/"dus"), queue_log2, donate_chunks, and the
+        # tiered-store knobs (store="tiered", high_water, low_water,
+        # summary_log2 — stateright_tpu/store/) — so builder-API users can
+        # reach the same design knobs the tuner races. With resident=False
+        # the host-orchestrated engine accepts insert_variant and the
+        # tiered-store knobs (it races the same visited-set designs).
         from ..tensor.frontier import FrontierSearch
         from ..tensor.model import TensorModel
         from ..tensor.resident import ResidentSearch
@@ -69,6 +71,12 @@ class TpuChecker(Checker):
                     "visitors on spawn_tpu require the resident engine "
                     "(the default); drop resident=False"
                 )
+            if engine_kwargs.get("store") == "tiered":
+                raise NotImplementedError(
+                    "visitors on spawn_tpu require the device store (the "
+                    "tiered store compacts the frontier queue the visitor "
+                    "dump reads); drop store='tiered'"
+                )
             self._recorder = options.visitor_
         super().__init__(model)
         # The resident engine runs the whole search in one device dispatch —
@@ -79,7 +87,10 @@ class TpuChecker(Checker):
         if resident is None:
             resident = True
         if not resident:
-            unsupported = set(engine_kwargs) - {"insert_variant"}
+            unsupported = set(engine_kwargs) - {
+                "insert_variant", "store", "high_water", "low_water",
+                "summary_log2",
+            }
             if unsupported:
                 raise ValueError(
                     f"engine options {sorted(unsupported)} require the "
@@ -253,6 +264,12 @@ class TpuChecker(Checker):
     def max_depth(self) -> int:
         r = self._result
         return r.max_depth if r is not None else self._live["depth"]
+
+    def store_stats(self) -> Optional[dict]:
+        """Per-tier occupancy of the engine's state store (None unless the
+        engine runs store="tiered") — surfaced in the Explorer `/.status`."""
+        stats = getattr(self._search, "store_stats", None)
+        return stats() if stats is not None else None
 
     def discoveries(self) -> dict[str, Path]:
         if self._result is None:

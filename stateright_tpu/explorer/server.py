@@ -167,6 +167,7 @@ def status_view(checker, recent: Optional[RecentPathSnapshot] = None) -> dict:
                 ),
             }
         )
+    store = getattr(checker, "store_stats", None)
     return {
         "model": type(model).__name__,
         "state_count": checker.state_count(),
@@ -177,6 +178,10 @@ def status_view(checker, recent: Optional[RecentPathSnapshot] = None) -> dict:
         # A recently-evaluated path (fp1/fp2/... form) for live-activity
         # display (ref: src/checker/explorer.rs:61-94).
         "recent_path": None if recent is None else recent.encoded,
+        # Per-tier state-store occupancy (hot_fill / spilled_states /
+        # spill_events) when the checker runs the tiered store; None for
+        # single-tier checkers — degradation past HBM is observable live.
+        "store": store() if store is not None else None,
     }
 
 

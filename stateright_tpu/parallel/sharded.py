@@ -54,6 +54,17 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+# jax.shard_map landed as a top-level API after 0.4.x (with check_vma
+# replacing check_rep); fall back to the experimental home so the engine
+# runs on both sides of the rename.
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+    _SHARD_MAP_KW = {"check_vma": False}
+else:  # pragma: no cover - exercised on jax 0.4.x images
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _SHARD_MAP_KW = {"check_rep": False}
+
 from ..tensor.fingerprint import pack_fp
 from ..core.discovery import HasDiscoveries
 from ..core.model import Expectation
@@ -72,7 +83,18 @@ from ..tensor.frontier import (
 )
 from ..tensor.hashtable import _insert_impl
 from ..tensor.model import TensorModel
-from ..tensor.resident import _finish_masks, _resolve_chunking
+from ..tensor.resident import (
+    ABORT_QUEUE,
+    ABORT_TABLE,
+    EXIT_SERVICE,
+    _finish_masks,
+    _resolve_chunking,
+)
+
+# Sharded-only abort bit (on top of the resident engine's codes): the
+# all-to-all send buffer's per-destination capacity overflowed — wants a
+# fresh run with a larger dest_capacity, not a table regrow.
+ABORT_ROUTE = 8
 
 
 def _host(x):
@@ -142,8 +164,17 @@ class _Carry(NamedTuple):
     disc_lo: jnp.ndarray  # uint32[P] locally-witnessed discovery fps
     disc_hi: jnp.ndarray  # uint32[P]
     cont: jnp.ndarray  # bool global continue flag
-    overflow: jnp.ndarray  # bool (local table/routing overflow)
+    overflow: jnp.ndarray  # uint32 abort code (ABORT_*|EXIT_SERVICE bits)
     steps: jnp.ndarray  # int32
+    # -- tiered store (store="tiered"; zero-sized placeholders otherwise) ------
+    hot_claims: jnp.ndarray  # int32: occupied local-table slots
+    s_states: jnp.ndarray  # uint32[SQ, L] per-shard suspect buffer
+    s_lo: jnp.ndarray  # uint32[SQ]
+    s_hi: jnp.ndarray  # uint32[SQ]
+    s_ebits: jnp.ndarray  # uint32[SQ]
+    s_depth: jnp.ndarray  # uint32[SQ]
+    s_tail: jnp.ndarray  # int32
+    summary: jnp.ndarray  # uint32[W] per-shard Bloom words (read-only in-loop)
 
 
 class ShardedSearch:
@@ -158,13 +189,23 @@ class ShardedSearch:
         dest_capacity: Optional[int] = None,
         donate_chunks: bool = False,
         append: Optional[str] = None,
+        store: str = "device",
+        high_water: float = 0.85,
+        low_water: Optional[float] = None,
+        summary_log2: int = 20,
     ):
         """`donate_chunks=True` donates the per-shard carry to each chunked
         dispatch so XLA updates the sharded tables/queues in place instead
         of copying them per dispatch (same trade as the resident engine:
         overflow loses the recovery carry — see ResidentSearch.__init__).
         `append` picks the queue-append variant exactly as on
-        ResidentSearch (backend-informed default; "scatter" or "dus")."""
+        ResidentSearch (backend-informed default; "scatter" or "dus").
+        `store="tiered"` gives each shard its own spill tier: a RANK-LOCAL
+        host fingerprint store plus a per-shard device Bloom summary, with
+        the same water-mark semantics as the single-device engines — every
+        shard spills the states it owns, so the fingerprint→owner map and
+        the all-to-all routing are untouched (single-process meshes only:
+        servicing needs every shard addressable)."""
         self.model = model
         self.donate_chunks = donate_chunks
         self.mesh = mesh if mesh is not None else make_mesh()
@@ -175,6 +216,17 @@ class ShardedSearch:
         )
         self.batch_size = batch_size
         self.table_log2 = table_log2
+        if store not in ("device", "tiered"):
+            raise ValueError(f"store must be 'device' or 'tiered', got {store!r}")
+        if store == "tiered" and jax.process_count() > 1:
+            raise NotImplementedError(
+                "store='tiered' on the sharded engine requires a "
+                "single-process mesh (the host service must address every "
+                "shard's carry)"
+            )
+        self.store = store
+        self._store_args = (high_water, low_water, summary_log2)
+        self._stores = None  # rank-local TieredStore per shard (tiered only)
         # Per-destination all-to-all capacity (see module docstring): default
         # 2x the binomial mean + 64 slack, tile-rounded, capped at the
         # absolute bound K*A. Overflow is detected and surfaced as a
@@ -186,6 +238,27 @@ class ShardedSearch:
             if dest_capacity is not None
             else min(ka, -(-(2 * mean + 64) // 128) * 128)
         )
+        if store == "tiered":
+            self._fresh_stores()
+            # Per-shard per-step claims are bounded by the all-to-all
+            # receive width N*C; the spill trigger keeps that much headroom
+            # (eviction only runs between dispatches).
+            nc = self.n_chips * self.dest_capacity
+            self._spill_trigger = min(
+                self._stores[0].high_slots, (1 << table_log2) - nc
+            )
+            if self._spill_trigger <= self._stores[0].low_slots:
+                raise ValueError(
+                    "per-shard table too small for tiered spilling: table "
+                    f"2^{table_log2} minus one receive batch ({nc}) leaves "
+                    "no room above the low-water mark "
+                    f"({self._stores[0].low_slots} slots); raise table_log2 "
+                    "or lower batch_size/dest_capacity/low_water"
+                )
+            self._SQ = 3 * nc
+        else:
+            self._spill_trigger = 0
+            self._SQ = 0
         self.props = model.properties()
         self._kernel, self._seed_k, self._chunk_k = self._build()
         self._last_tables = None
@@ -194,6 +267,46 @@ class ShardedSearch:
         # Suspended-search carry (chunked runs only): retained across run()
         # calls so budget/timeout suspensions and overflows are resumable.
         self._carry = None
+        self._q_compacted = False
+
+    def _fresh_stores(self) -> None:
+        """(Re)build the rank-local spill tiers, one per shard."""
+        from ..store.tiered import TieredConfig, TieredStore
+
+        if self._stores is not None:
+            for s in self._stores:
+                s.close()  # stop the old spill tiers' compactors
+        high_water, low_water, summary_log2 = self._store_args
+        cfg = TieredConfig(
+            high_water=high_water,
+            low_water=low_water,
+            summary_log2=summary_log2,
+        )
+        self._stores = [
+            TieredStore(1 << self.table_log2, cfg)
+            for _ in range(self.n_chips)
+        ]
+
+    def store_stats(self) -> Optional[dict]:
+        """Aggregated per-tier counters across shards (None with the plain
+        device store); `per_shard_spilled` exposes the rank-local split."""
+        if self._stores is None:
+            return None
+        hot = (
+            [int(x) for x in np.asarray(self._carry.hot_claims)]
+            if self._carry is not None
+            else [0] * self.n_chips
+        )
+        per = [s.stats(h) for s, h in zip(self._stores, hot)]
+        return {
+            "store": "tiered",
+            "hot_fill": round(max(p["hot_fill"] for p in per), 4),
+            "spilled_states": sum(p["spilled_states"] for p in per),
+            "spill_events": sum(p["spill_events"] for p in per),
+            "suspects_checked": sum(p["suspects_checked"] for p in per),
+            "suspects_dup": sum(p["suspects_dup"] for p in per),
+            "per_shard_spilled": [p["spilled_states"] for p in per],
+        }
 
     def _build(self):
         model = self.model
@@ -205,11 +318,26 @@ class ShardedSearch:
         L = model.lanes
         S = 1 << self.table_log2
         C = self.dest_capacity
+        tiered = self._stores is not None
+        if tiered:
+            from ..store.summary import maybe_contains, summary_words
+
+            slog2 = self._stores[0].config.summary_log2
+            khash = self._stores[0].config.summary_hashes
+            W = summary_words(slog2)
+            TRIGGER = jnp.int32(self._spill_trigger)
+        else:
+            W = 1
+        SQ = self._SQ
         # N*C rows of slack beyond the per-shard table size: the append
         # block is N*C rows, and the DUS variant's contract requires the
         # start never to clamp (append_new_dus docstring) — without the
         # slack a near-full queue would silently overwrite live rows.
-        Q = S + N * C
+        # Tiered runs add SQ more rows of slack for the host's
+        # suspect-injection block (the live frontier stays bounded by S:
+        # the tail is host-compacted at every service exit).
+        Q = S + N * C + (SQ if tiered else 0)
+        self._Q = Q
         props = self.props
         P_ = len(props)
         always_i = [i for i, p in enumerate(props) if p.expectation == Expectation.ALWAYS]
@@ -323,8 +451,16 @@ class ShardedSearch:
                 disc_lo=jnp.zeros(max(P_, 1), dtype=jnp.uint32),
                 disc_hi=jnp.zeros(max(P_, 1), dtype=jnp.uint32),
                 cont=cont0,
-                overflow=ovf0,
+                overflow=ovf0.astype(jnp.uint32) * jnp.uint32(ABORT_TABLE),
                 steps=jnp.int32(0),
+                hot_claims=is_new0.sum().astype(jnp.int32),
+                s_states=jnp.zeros((SQ, L), dtype=jnp.uint32),
+                s_lo=jnp.zeros(SQ, dtype=jnp.uint32),
+                s_hi=jnp.zeros(SQ, dtype=jnp.uint32),
+                s_ebits=jnp.zeros(SQ, dtype=jnp.uint32),
+                s_depth=jnp.zeros(SQ, dtype=jnp.uint32),
+                s_tail=jnp.int32(0),
+                summary=jnp.zeros(W, dtype=jnp.uint32),
             )
 
         def make_body(
@@ -446,25 +582,60 @@ class ShardedSearch:
                     c.t_lo, c.t_hi, c.p_lo, c.p_hi,
                     r_lo, r_hi, r_plo, r_phi, r_valid,
                 )
+                # -- tiered store: split claims into enqueue vs suspect --------
+                # (same protocol as the resident engine: a Bloom-positive
+                # fresh claim is buffered for exact host resolution against
+                # this shard's rank-local spill store; a miss proves
+                # novelty on-device.)
+                if tiered:
+                    suspect = is_new & maybe_contains(
+                        c.summary, r_lo, r_hi, slog2, khash
+                    )
+                    enq = is_new & ~suspect
+                else:
+                    enq = is_new
                 # -- append fresh states to the local queue (cumsum) -----------
-                q_states, q_lo, q_hi, q_ebits, q_depth, tail = (
+                _append = (
                     append_new if self.append == "scatter" else append_new_dus
-                )(
+                )
+                q_states, q_lo, q_hi, q_ebits, q_depth, tail = _append(
                     c.q_states, c.q_lo, c.q_hi, c.q_ebits, c.q_depth, c.tail,
-                    r_states, r_lo, r_hi, r_ebits, r_depth, is_new,
+                    r_states, r_lo, r_hi, r_ebits, r_depth, enq,
                 )
                 new_count = tail - c.tail
+                hot_claims = c.hot_claims + is_new.sum().astype(jnp.int32)
+                if tiered:
+                    (
+                        s_states, s_lo, s_hi, s_ebits, s_depth, s_tail,
+                    ) = _append(
+                        c.s_states, c.s_lo, c.s_hi, c.s_ebits, c.s_depth,
+                        c.s_tail,
+                        r_states, r_lo, r_hi, r_ebits, r_depth, suspect,
+                    )
+                    service = (
+                        (hot_claims >= TRIGGER)
+                        | (s_tail > SQ - N * C)
+                        | (tail > S)
+                    )
+                    q_fatal = jnp.bool_(False)  # host decides after compaction
+                else:
+                    s_states, s_lo, s_hi = c.s_states, c.s_lo, c.s_hi
+                    s_ebits, s_depth, s_tail = c.s_ebits, c.s_depth, c.s_tail
+                    service = jnp.bool_(False)
+                    # Queue-full guard: the N*C append-block slack keeps
+                    # both append variants in bounds, and pop_batch's K-row
+                    # dynamic_slice must never clamp either (dest_capacity
+                    # may be set below K), so the bound is the stricter of
+                    # the two.
+                    q_fatal = tail > Q - max(N * C, K)
 
                 unique_count = c.unique_count + new_count
-                # Queue-full guard: the N*C append-block slack keeps both
-                # append variants in bounds, and pop_batch's K-row
-                # dynamic_slice must never clamp either (dest_capacity may
-                # be set below K), so the bound is the stricter of the two.
                 overflow = (
                     c.overflow
-                    | route_ovf
-                    | ins_ovf
-                    | (tail > Q - max(N * C, K))
+                    | (route_ovf.astype(jnp.uint32) * jnp.uint32(ABORT_ROUTE))
+                    | (ins_ovf.astype(jnp.uint32) * jnp.uint32(ABORT_TABLE))
+                    | (q_fatal.astype(jnp.uint32) * jnp.uint32(ABORT_QUEUE))
+                    | (service.astype(jnp.uint32) * jnp.uint32(EXIT_SERVICE))
                 )
 
                 # -- global sync: discovery OR, counters, termination ----------
@@ -506,6 +677,14 @@ class ShardedSearch:
                     cont=cont,
                     overflow=overflow,
                     steps=steps,
+                    hot_claims=hot_claims,
+                    s_states=s_states,
+                    s_lo=s_lo,
+                    s_hi=s_hi,
+                    s_ebits=s_ebits,
+                    s_depth=s_depth,
+                    s_tail=s_tail,
+                    summary=c.summary,
                 )
 
             return body
@@ -602,6 +781,8 @@ class ShardedSearch:
                             c.overflow.astype(jnp.uint32),
                             c.steps.astype(jnp.uint32),
                             (~c.cont).astype(jnp.uint32),
+                            c.hot_claims.astype(jnp.uint32),
+                            c.s_tail.astype(jnp.uint32),
                         ]
                     ),
                     c.disc_lo,
@@ -611,30 +792,30 @@ class ShardedSearch:
             out = jax.tree.map(lambda x: jnp.asarray(x)[None], c)
             return out, shard(summary)
 
-        sharded = jax.shard_map(
+        sharded = _shard_map(
             per_chip,
             mesh=mesh,
             in_specs=(P(),) * 12,
             out_specs=P(ax),
-            check_vma=False,
+            **_SHARD_MAP_KW,
         )
-        seed_sm = jax.shard_map(
+        seed_sm = _shard_map(
             per_chip_seed,
             mesh=mesh,
             in_specs=(P(),) * 9,
             out_specs=P(ax),
-            check_vma=False,
+            **_SHARD_MAP_KW,
         )
         # NOTE: NOT donated by default — the host keeps the pre-chunk carry
         # alive so an overflow reverts to the last sound chunk boundary
         # (checkpoint-then-raise instead of discarding the run).
         # `donate_chunks=True` flips the trade (see __init__).
-        chunk_sm = jax.shard_map(
+        chunk_sm = _shard_map(
             per_chip_chunk,
             mesh=mesh,
             in_specs=(P(ax),) + (P(),) * 7,
             out_specs=(P(ax), P(ax)),
-            check_vma=False,
+            **_SHARD_MAP_KW,
         )
         chunk_jit = (
             jax.jit(chunk_sm, donate_argnums=(0,))
@@ -661,6 +842,10 @@ class ShardedSearch:
         `progress`, `timeout` (polled between chunks), `checkpoint()`/resume,
         and recoverable overflow (the carry reverts to the last chunk
         boundary; see `load_checkpoint(table_log2=...)`)."""
+        # Tiered runs are always chunked: the host must regain control for
+        # spill eviction and suspect resolution.
+        if self._stores is not None and budget is None and timeout is None:
+            budget = 1 << 20
         chunked, budget = _resolve_chunking(
             budget, timeout, progress, self._carry
         )
@@ -776,8 +961,18 @@ class ShardedSearch:
                     self._carry, req, anym, *t32, tmd,
                     jnp.int32(budget), jnp.int32(max_steps),
                 )
-                s = _host(summary)  # [N, 10 + 2*max(P,1)] — one transfer
-                if s[:, 7].any():  # overflow on any chip
+                s = _host(summary)  # [N, 12 + 2*max(P,1)] — one transfer
+                codes = s[:, 7].astype(np.uint32)
+                if (codes & EXIT_SERVICE).any() and not (
+                    codes & (ABORT_TABLE | ABORT_QUEUE | ABORT_ROUTE)
+                ).any():
+                    # Non-fatal tiered-store service: every shard drains its
+                    # suspect buffer / evicts / compacts, then the loop
+                    # resumes the same carry.
+                    self._carry = carry
+                    self._service()
+                    continue
+                if codes.any():  # fatal overflow on any chip
                     if self.donate_chunks:
                         self._carry = None  # donated into the dispatch
                         self._last_tables = None  # a prior run's snapshot
@@ -816,6 +1011,13 @@ class ShardedSearch:
                         int(s[:, 3].max()),
                     )
                 if s[0, 9]:  # stop flag (globally synced)
+                    if self._stores is not None and s[:, 11].any():
+                        # Queues drained with suspects still buffered on
+                        # some shard: resolve them — confirmed-new rows
+                        # reopen the frontier; the next chunk re-evaluates
+                        # the stop with empty buffers (cannot loop).
+                        self._service()
+                        continue
                     break
                 if timeout is not None:
                     # Multi-process: every rank must take the SAME branch or
@@ -843,8 +1045,8 @@ class ShardedSearch:
             P_ = max(len(self.props), 1)
             state_count = int(s[0, 0]) | (int(s[0, 1]) << 32)
             disc_mask = int(s[0, 4])
-            disc_lo = s[:, 10 : 10 + P_]
-            disc_hi = s[:, 10 + P_ : 10 + 2 * P_]
+            disc_lo = s[:, 12 : 12 + P_]
+            disc_hi = s[:, 12 + P_ : 12 + 2 * P_]
             unique_counts = s[:, 2]
             result_max_depth = int(s[:, 3].max())
             result_steps = int(s[:, 8].max())
@@ -870,7 +1072,82 @@ class ShardedSearch:
             detail={
                 # fp-sharding balance evidence (task: per-chip spread).
                 "per_chip_unique": [int(x) for x in unique_counts],
+                **(self.store_stats() or {}),
             },
+        )
+
+    def _service(self) -> None:
+        """Host half of the tiered store for the sharded engine: gather the
+        per-shard carry (the same full round-trip a checkpoint pays —
+        service events are water-mark-rare), then per shard: compact the
+        queue, drain the suspect buffer against that shard's RANK-LOCAL
+        spill store, evict past-high-water buckets, and push the carry
+        back sharded. Single-process meshes only (enforced in __init__)."""
+        c = self._carry
+        f = {k: np.array(v) for k, v in zip(c._fields, _host(c))}
+        N = self.n_chips
+        S = 1 << self.table_log2
+        for i in range(N):
+            head, tail = int(f["head"][i]), int(f["tail"][i])
+            if head > 0:
+                live = tail - head
+                for k in ("q_states", "q_lo", "q_hi", "q_ebits", "q_depth"):
+                    f[k][i][:live] = f[k][i][head:tail].copy()
+                tail = live
+                f["head"][i] = 0
+            if tail > S:
+                f["tail"][i] = tail
+                self._carry = self._put_carry(f)
+                raise RuntimeError(
+                    f"sharded tiered store: shard {i}'s live frontier "
+                    f"({tail} rows) exceeds the compacted queue — raise "
+                    "table_log2 (the per-shard queue is table-sized)"
+                )
+            s_tail = int(f["s_tail"][i])
+            if s_tail > 0:
+                sus_lo = f["s_lo"][i][:s_tail]
+                sus_hi = f["s_hi"][i][:s_tail]
+                dup = self._stores[i].resolve_suspects(sus_lo, sus_hi)
+                keep = ~dup
+                n_conf = int(keep.sum())
+                if n_conf:
+                    sl = slice(tail, tail + n_conf)
+                    f["q_states"][i][sl] = f["s_states"][i][:s_tail][keep]
+                    f["q_lo"][i][sl] = sus_lo[keep]
+                    f["q_hi"][i][sl] = sus_hi[keep]
+                    f["q_ebits"][i][sl] = f["s_ebits"][i][:s_tail][keep]
+                    f["q_depth"][i][sl] = f["s_depth"][i][:s_tail][keep]
+                    tail += n_conf
+                    f["unique_count"][i] += n_conf
+                f["s_tail"][i] = 0
+            f["tail"][i] = tail
+            hot = int(f["hot_claims"][i])
+            if hot >= self._spill_trigger:
+                freed = self._stores[i].evict_host(
+                    f["t_lo"][i], f["t_hi"][i],
+                    f["p_lo"][i], f["p_hi"][i], hot,
+                )
+                if freed == 0:
+                    raise RuntimeError(
+                        f"sharded tiered store: shard {i} could not free "
+                        "any bucket (every bucket full and pinned); raise "
+                        "table_log2 or lower high_water"
+                    )
+                f["hot_claims"][i] = hot - freed
+            f["summary"][i] = self._stores[i].summary_np
+            f["overflow"][i] = 0
+        self._q_compacted = True
+        self._carry = self._put_carry(f)
+
+    def _put_carry(self, fields: dict) -> "_Carry":
+        from jax.sharding import NamedSharding
+
+        sh = NamedSharding(self.mesh, P(self.axis))
+        return _Carry(
+            **{
+                k: jax.device_put(jnp.asarray(v), sh)
+                for k, v in fields.items()
+            }
         )
 
     def reset(self) -> None:
@@ -878,6 +1155,9 @@ class ShardedSearch:
         self._carry = None
         self._parent_map = None
         self._last_tables = None
+        self._q_compacted = False
+        if self._stores is not None:
+            self._fresh_stores()  # spill tiers + summaries start empty
 
     def dump_states(
         self, decode: bool = True, evaluated_only: bool = False,
@@ -894,6 +1174,13 @@ class ShardedSearch:
             raise RuntimeError(
                 "no retained carry to dump: run with budget=... (chunked "
                 "dispatch) before dump_states()"
+            )
+        if self._q_compacted:
+            raise RuntimeError(
+                "dump_states is unavailable once the tiered store has "
+                "compacted a shard's frontier queue (rows [0, tail) no "
+                "longer cover every unique state) — use store='device' for "
+                "exact state-set dumps"
             )
         q, ends = _host((
             self._carry.q_states,  # [N, Q, L]
@@ -951,6 +1238,15 @@ class ShardedSearch:
         arrays = _host(dict(zip(c._fields, c)))
         if jax.process_index() != 0:
             return
+        store_meta = None
+        if self._stores is not None:
+            # Rank-local spill tiers ride along, one pair of arrays per
+            # shard (shards spill independently, so lengths differ).
+            store_meta = [s.meta() for s in self._stores]
+            for i, s in enumerate(self._stores):
+                ck = s.to_checkpoint()
+                arrays[f"spill_fps_{i}"] = ck["spill_fps"]
+                arrays[f"spill_parents_{i}"] = ck["spill_parents"]
         arrays["meta"] = np.frombuffer(
             json.dumps(
                 {
@@ -961,6 +1257,8 @@ class ShardedSearch:
                     "batch_size": self.batch_size,
                     "n_chips": self.n_chips,
                     "dest_capacity": self.dest_capacity,
+                    "store": store_meta,
+                    "q_compacted": self._q_compacted,
                 }
             ).encode(),
             dtype=np.uint8,
@@ -989,6 +1287,7 @@ class ShardedSearch:
         data = np.load(_ckpt_path(path))
         meta = json.loads(bytes(data["meta"].tobytes()).decode())
         _validate_ckpt_meta(model, meta)
+        store_meta = meta.get("store")
         ss = cls(
             model,
             mesh=mesh,
@@ -996,6 +1295,16 @@ class ShardedSearch:
             table_log2=table_log2 or meta["table_log2"],
             dest_capacity=meta["dest_capacity"],
             donate_chunks=donate_chunks,
+            store="tiered" if store_meta else "device",
+            **(
+                {
+                    "high_water": store_meta[0]["high_water"],
+                    "low_water": store_meta[0]["low_water"],
+                    "summary_log2": store_meta[0]["summary_log2"],
+                }
+                if store_meta
+                else {}
+            ),
         )
         if ss.n_chips != meta["n_chips"]:
             raise ValueError(
@@ -1007,11 +1316,49 @@ class ShardedSearch:
         if log2 < meta["table_log2"]:
             raise ValueError("cannot shrink the table on resume")
         # This engine's compiled kernel closes over the slacked per-shard
-        # capacity Q = S + N*C (append-block slack); checkpoints from other
-        # configs (or the pre-slack format) carry different queue shapes,
-        # so regrow/normalize everything to ss's capacity.
-        ss_Q = (1 << log2) + ss.n_chips * ss.dest_capacity
-        fields = {f: data[f] for f in _Carry._fields}
+        # capacity Q = S + N*C (+ the tiered suspect-injection slack);
+        # checkpoints from other configs (or the pre-slack format) carry
+        # different queue shapes, so regrow/normalize everything to ss's
+        # capacity.
+        ss_Q = ss._Q
+        N_ = ss.n_chips
+        # Pre-tiered checkpoints lack the suspect-buffer/summary fields;
+        # default them to this engine's (empty) shapes.
+        defaults = {
+            "hot_claims": np.asarray(
+                [(np.asarray(data["t_lo"][i]) != 0).sum() for i in range(N_)],
+                dtype=np.int32,
+            ),
+            "s_states": np.zeros((N_, ss._SQ, model.lanes), np.uint32),
+            "s_lo": np.zeros((N_, ss._SQ), np.uint32),
+            "s_hi": np.zeros((N_, ss._SQ), np.uint32),
+            "s_ebits": np.zeros((N_, ss._SQ), np.uint32),
+            "s_depth": np.zeros((N_, ss._SQ), np.uint32),
+            "s_tail": np.zeros(N_, np.int32),
+            "summary": np.zeros((N_, 1), np.uint32),
+        }
+        fields = {
+            f: data[f] if f in data else defaults[f] for f in _Carry._fields
+        }
+        fields["overflow"] = np.asarray(fields["overflow"], np.uint32)
+        if store_meta:
+            from ..store.tiered import TieredStore
+
+            for s in ss._stores:
+                s.close()  # replaced by the checkpointed tiers
+            ss._stores = [
+                TieredStore.from_checkpoint(
+                    1 << log2, store_meta[i],
+                    data[f"spill_fps_{i}"], data[f"spill_parents_{i}"],
+                )
+                for i in range(N_)
+            ]
+            ss._q_compacted = bool(meta.get("q_compacted", False))
+            # The summary is a pure function of each shard's spilled set —
+            # always use the freshly rebuilt words (covers regrown tables).
+            fields["summary"] = np.stack(
+                [s.summary_np for s in ss._stores]
+            )
         if log2 != meta["table_log2"]:
             grown = [
                 _regrow(
@@ -1034,7 +1381,15 @@ class ShardedSearch:
                 fields[k] = np.stack([np.asarray(g[k]) for g in grown])
             # The overflow that prompted this regrow is resolved by the
             # bigger tables; a stale flag would re-abort the resumed run.
-            fields["overflow"] = np.zeros(ss.n_chips, dtype=bool)
+            fields["overflow"] = np.zeros(ss.n_chips, dtype=np.uint32)
+            # Bucket residency changed wholesale; recount occupied slots.
+            fields["hot_claims"] = np.asarray(
+                [
+                    (np.asarray(fields["t_lo"][i]) != 0).sum()
+                    for i in range(N_)
+                ],
+                dtype=np.int32,
+            )
         for f in ("q_states", "q_lo", "q_hi", "q_ebits", "q_depth"):
             old = fields[f]
             if old.shape[1] != ss_Q:
@@ -1078,4 +1433,9 @@ class ShardedSearch:
             keys = pack_fp(t_lo[nz], t_hi[nz])
             parents = pack_fp(p_lo[nz], p_hi[nz])
             self._parent_map = dict(zip(keys.tolist(), parents.tolist()))
+            if self._stores is not None:
+                # Rank-local spill entries win on keys in both tiers (the
+                # original BFS-discovery parent keeps chains acyclic).
+                for s in self._stores:
+                    self._parent_map.update(s.parent_map())
         return reconstruct_path(self.model, self._parent_map, fp)

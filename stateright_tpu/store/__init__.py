@@ -1,0 +1,42 @@
+"""Tiered state store: device-resident hot set + host spill tier.
+
+The device engines' visited set is an HBM hash table (tensor/hashtable.py);
+any state space bigger than the table used to end in an overflow abort. This
+package converts that hard wall into graceful degradation, the
+memory-hierarchy move every at-scale explicit-state checker makes (Stern &
+Dill's disk-based Murphi, TLC's disk fingerprint sets) translated to the TPU
+hierarchy: HBM stays the hot tier, host RAM is the cold tier, and a
+device-resident Bloom-style summary of the spilled set keeps the common
+probe path on device.
+
+Pieces:
+
+- `summary` — the Bloom summary: uint32 bit words probed inside the jitted
+  engine step (`maybe_contains`), populated on host at eviction time
+  (`host_insert`; no false negatives, tunable false-positive rate via
+  `summary_log2`).
+- `host` — `HostSpillStore`: the cold tier. Packed uint64 fingerprint +
+  parent arrays, appended at eviction, merge-compacted (sorted, first-writer
+  dedup) on a background thread; exact membership via binary search.
+- `tiered` — `TieredStore`: the orchestration the engines call between
+  device dispatches: high/low-water eviction of COLD, NON-FULL buckets
+  (full buckets anchor probe chains and are never evicted — see
+  tiered.py for the safety argument), suspect resolution, per-tier
+  counters, checkpoint serialization.
+
+Engines opt in with `store="tiered"` (FrontierSearch / ResidentSearch /
+ShardedSearch, and through `spawn_tpu(store="tiered", ...)`).
+"""
+
+from .host import HostSpillStore
+from .summary import host_insert, maybe_contains, summary_words
+from .tiered import TieredConfig, TieredStore
+
+__all__ = [
+    "HostSpillStore",
+    "TieredConfig",
+    "TieredStore",
+    "host_insert",
+    "maybe_contains",
+    "summary_words",
+]

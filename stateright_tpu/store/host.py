@@ -1,0 +1,176 @@
+"""Host spill tier: the cold half of the tiered state store.
+
+Holds every fingerprint evicted from the device hash table as packed uint64
+arrays (fingerprint + parent fingerprint, aligned), the host analogue of
+disk-based Murphi's state file. Two-zone layout for O(log n) membership with
+O(1) appends:
+
+- a SORTED zone (deduped, binary-searchable), and
+- PENDING append chunks in arrival order, merged into the sorted zone by a
+  background compaction thread once they pile past a threshold (or inline
+  when `background=False` — deterministic for tests).
+
+Dedup keeps the FIRST-appended entry per fingerprint: eviction can re-spill
+a key that was re-claimed on device after an earlier spill, and the first
+entry carries the ORIGINAL parent — the one the BFS discovery wrote — which
+is what keeps reconstructed paths acyclic (a later re-claim's parent can sit
+deeper than the state itself).
+
+All public methods are thread-safe (one lock shared with the compactor);
+`contains` is the hot host-side operation — it runs once per SUSPECT batch,
+not per state, so a searchsorted over the sorted zone plus an isin over the
+small pending tail is plenty.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Optional
+
+import numpy as np
+
+
+def _compactor_loop(store_ref, wake: threading.Event) -> None:
+    """Background compactor body. Holds only a WEAKREF to the store: a
+    dropped store's fingerprint arrays stay collectable (the spill tier is
+    by design the thing that can outgrow HBM — a parked thread must not
+    pin it), and the thread reaps itself once the store is gone or
+    closed. Module-level so the thread closure captures no `self`."""
+    while True:
+        wake.wait(timeout=30.0)
+        wake.clear()
+        store = store_ref()
+        if store is None or store._stop:
+            return
+        store.compact()
+        del store
+
+
+class HostSpillStore:
+    def __init__(
+        self,
+        compact_threshold: int = 1 << 15,
+        background: bool = True,
+    ):
+        self._lock = threading.RLock()
+        self._sorted_fps = np.zeros(0, dtype=np.uint64)
+        self._sorted_parents = np.zeros(0, dtype=np.uint64)
+        self._pending: list[tuple[np.ndarray, np.ndarray]] = []
+        self._pending_len = 0
+        self._compact_threshold = compact_threshold
+        self._wake: Optional[threading.Event] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+        if background:
+            self._wake = threading.Event()
+            self._thread = threading.Thread(
+                target=_compactor_loop,
+                args=(weakref.ref(self), self._wake),
+                daemon=True,
+            )
+            self._thread.start()
+
+    def close(self) -> None:
+        """Stop the background compactor. MUST be called when a store is
+        replaced (engine reset / checkpoint restore): the parked thread
+        holds a reference to this store, so without it every reset would
+        leak a thread plus a full copy of the spilled fingerprint set —
+        the one array designed to outgrow HBM."""
+        if self._thread is not None:
+            self._stop = True
+            self._wake.set()
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # -- writes ---------------------------------------------------------------
+
+    def append(self, fps: np.ndarray, parents: np.ndarray) -> None:
+        """Append one eviction batch (packed uint64, aligned)."""
+        fps = np.asarray(fps, dtype=np.uint64)
+        parents = np.asarray(parents, dtype=np.uint64)
+        if fps.size == 0:
+            return
+        with self._lock:
+            self._pending.append((fps.copy(), parents.copy()))
+            self._pending_len += fps.size
+            if self._pending_len >= self._compact_threshold:
+                if self._wake is not None:
+                    self._wake.set()
+                else:
+                    self._compact_locked()
+
+    def compact(self) -> None:
+        """Merge pending chunks into the sorted zone (first-writer dedup)."""
+        with self._lock:
+            self._compact_locked()
+
+    def _compact_locked(self) -> None:
+        if not self._pending:
+            return
+        # Concatenation order = append order (sorted zone predates every
+        # pending chunk), so np.unique's first-occurrence index implements
+        # exactly the first-writer-wins parent rule.
+        all_fps = np.concatenate(
+            [self._sorted_fps] + [f for f, _ in self._pending]
+        )
+        all_parents = np.concatenate(
+            [self._sorted_parents] + [p for _, p in self._pending]
+        )
+        uniq, first = np.unique(all_fps, return_index=True)
+        self._sorted_fps = uniq
+        self._sorted_parents = all_parents[first]
+        self._pending = []
+        self._pending_len = 0
+
+
+    # -- reads ----------------------------------------------------------------
+
+    def contains(self, fps: np.ndarray) -> np.ndarray:
+        """bool[n]: exact membership for packed fingerprints."""
+        fps = np.asarray(fps, dtype=np.uint64)
+        with self._lock:
+            pos = np.searchsorted(self._sorted_fps, fps)
+            pos = np.minimum(pos, max(self._sorted_fps.size - 1, 0))
+            hit = (
+                self._sorted_fps[pos] == fps
+                if self._sorted_fps.size
+                else np.zeros(fps.shape, dtype=bool)
+            )
+            for chunk, _ in self._pending:
+                hit |= np.isin(fps, chunk)
+            return hit
+
+    def __len__(self) -> int:
+        """Deduped spilled-state count (compacts to make it exact)."""
+        with self._lock:
+            self._compact_locked()
+            return int(self._sorted_fps.size)
+
+    def parent_map(self) -> dict:
+        """{fingerprint: parent fingerprint} for path reconstruction."""
+        with self._lock:
+            self._compact_locked()
+            return dict(
+                zip(
+                    self._sorted_fps.tolist(),
+                    self._sorted_parents.tolist(),
+                )
+            )
+
+    # -- checkpoint -----------------------------------------------------------
+
+    def to_arrays(self) -> tuple[np.ndarray, np.ndarray]:
+        """(fps, parents) snapshot for checkpointing (compacted)."""
+        with self._lock:
+            self._compact_locked()
+            return self._sorted_fps.copy(), self._sorted_parents.copy()
+
+    @classmethod
+    def from_arrays(
+        cls, fps: np.ndarray, parents: np.ndarray, background: bool = True
+    ) -> "HostSpillStore":
+        s = cls(background=background)
+        s._sorted_fps = np.asarray(fps, dtype=np.uint64)
+        s._sorted_parents = np.asarray(parents, dtype=np.uint64)
+        return s

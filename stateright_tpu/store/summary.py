@@ -1,0 +1,88 @@
+"""Bloom-style device summary of the spilled fingerprint set.
+
+The hot path stays on device: after the visited-table insert claims a slot
+for a first-seen key, the engine tests the claim against this summary in the
+same jitted step. A miss proves the key was never spilled (Bloom filters
+have no false negatives), so the state is new and is enqueued with zero host
+involvement — the overwhelmingly common case. A hit makes the key a SUSPECT:
+possibly a duplicate of a spilled state, resolved exactly by the host
+against `HostSpillStore` between dispatches.
+
+The bit array is uint32 words. Only the host ever SETS bits (at eviction,
+`host_insert` — numpy, outside any trace); the device only reads
+(`maybe_contains`, k gathers + bit tests), so there is no scatter-OR race to
+lower and the engines can carry the words through a `lax.while_loop`
+untouched.
+
+Hashing: Kirsch-Mitzenmacher double hashing — two murmur-style mixes of the
+(lo, hi) fingerprint pair give h1, h2; probe i tests bit (h1 + i*h2) mod m.
+The arithmetic is written against plain uint32 array ops so the SAME helper
+serves numpy (host insert, tests) and jax.numpy (device probe).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# murmur3 fmix32 constants (public domain) — numpy scalars, not jnp, so
+# importing this module never initializes a device backend.
+_M1 = np.uint32(0x85EBCA6B)
+_M2 = np.uint32(0xC2B2AE35)
+_C1 = np.uint32(0x9E3779B9)
+_C2 = np.uint32(0x7F4A7C15)
+
+DEFAULT_HASHES = 4
+
+
+def summary_words(summary_log2: int) -> int:
+    """Word count of a 2^summary_log2-bit summary (>= 1 word)."""
+    if summary_log2 < 5:
+        raise ValueError("summary_log2 must be >= 5 (one uint32 word)")
+    return 1 << (summary_log2 - 5)
+
+
+def _mix(h):
+    """fmix32 over uint32 arrays; works for numpy and jax.numpy inputs."""
+    h = (h ^ (h >> 16)) * _M1
+    h = (h ^ (h >> 13)) * _M2
+    return h ^ (h >> 16)
+
+
+def _h1h2(lo, hi):
+    """The double-hash pair. h2 is forced odd so the probe stride is
+    coprime with the power-of-two bit count (all k probes distinct)."""
+    h1 = _mix(lo ^ _C1)
+    h2 = _mix(hi ^ _C2) | np.uint32(1)
+    return h1, h2
+
+
+def maybe_contains(bits, lo, hi, summary_log2: int, hashes: int = DEFAULT_HASHES):
+    """bool[B]: True iff every probe bit is set (possible member); False is
+    a PROOF of absence. Traceable (pure gathers + bit ops) — `bits` may be a
+    device array inside a jitted step — and equally valid on numpy inputs."""
+    mask = np.uint32((1 << summary_log2) - 1)
+    h1, h2 = _h1h2(lo, hi)
+    hit = None
+    for i in range(hashes):
+        pos = (h1 + np.uint32(i) * h2) & mask
+        word = bits[(pos >> 5).astype(np.int32)]
+        bit = ((word >> (pos & np.uint32(31))) & np.uint32(1)).astype(bool)
+        hit = bit if hit is None else (hit & bit)
+    return hit
+
+
+def host_insert(
+    bits: np.ndarray, lo: np.ndarray, hi: np.ndarray,
+    summary_log2: int, hashes: int = DEFAULT_HASHES,
+) -> None:
+    """Set the probe bits for a batch of fingerprints IN PLACE (numpy only;
+    called at eviction time, never inside a trace)."""
+    mask = np.uint32((1 << summary_log2) - 1)
+    lo = np.asarray(lo, dtype=np.uint32)
+    hi = np.asarray(hi, dtype=np.uint32)
+    h1, h2 = _h1h2(lo, hi)
+    for i in range(hashes):
+        pos = (h1 + np.uint32(i) * h2) & mask
+        np.bitwise_or.at(
+            bits, (pos >> 5).astype(np.int64), np.uint32(1) << (pos & np.uint32(31))
+        )

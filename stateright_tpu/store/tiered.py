@@ -1,0 +1,324 @@
+"""Tiered-store orchestration: high/low-water eviction, suspect resolution.
+
+`TieredStore` is the piece the engines talk to between device dispatches. It
+owns the host spill tier (`HostSpillStore`), the Bloom summary words (numpy
+master copy; `device_summary()` hands the engines a device-resident mirror),
+a sweep pointer, and the per-tier counters the bench/Explorer surface.
+
+Eviction policy — the part that must not break the insert kernel:
+
+The visited-table insert (tensor/hashtable.py) resolves bucket overflow by
+linear probing to the next bucket, and its membership argument is "a key
+absent from the first NON-FULL bucket of its chain is absent". A bucket
+only ever sends a key onward when it has no free slot — i.e. when it is
+full — and, outside eviction, slots are never emptied; so a bucket that
+ever overflowed a key is full at that moment and stays full unless eviction
+empties it. Therefore: **eviction only ever empties buckets that are
+currently non-full**. Such a bucket never overflowed anything, no probe
+chain passes THROUGH it relying on its fullness, and emptying it merely
+moves its keys' membership duty to the spill tier — where the Bloom summary
+(no false negatives) plus the host store's exact check pick it up. Full
+buckets are pinned on device forever; at sane water marks they are a thin
+binomial tail of the table.
+
+The sweep is a clock hand over buckets: each spill event walks windows from
+the pointer, evicting every non-full, non-empty bucket, until occupancy is
+back under the LOW water mark (hysteresis — one eviction buys many steps of
+headroom) or a full cycle found nothing more to free (every remaining
+bucket full: the caller surfaces that as a real capacity error instead of
+spinning).
+
+Two eviction entry points share the same per-window core: `evict` takes
+device arrays and pulls only window-sized slices over PCIe (async
+device-to-host copies, one contiguous dynamic_update_slice write-back per
+array), for the single-device engines; `evict_host` takes whole numpy
+tables, for the sharded engine's service path (which has already gathered
+the carry to host) and for tests.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ..tensor.fingerprint import pack_fp
+from ..tensor.hashtable import BUCKET
+from .host import HostSpillStore
+from .summary import DEFAULT_HASHES, host_insert, summary_words
+
+
+_WRITE4 = None
+
+
+def _window_writeback():
+    """Module-cached jitted window write-back (one contiguous
+    dynamic_update_slice per table array) — built lazily so importing the
+    store never initializes a device backend."""
+    global _WRITE4
+    if _WRITE4 is None:
+        import jax
+
+        @jax.jit
+        def write4(tl, th, pl, ph, wl, wh, wpl, wph, start):
+            upd = lambda t, w: jax.lax.dynamic_update_slice(t, w, (start,))
+            return upd(tl, wl), upd(th, wh), upd(pl, wpl), upd(ph, wph)
+
+        _WRITE4 = write4
+    return _WRITE4
+
+
+@dataclass(frozen=True)
+class TieredConfig:
+    """Knobs for the tiered store (reachable via engine kwargs and
+    `spawn_tpu(store="tiered", high_water=..., summary_log2=...)`).
+
+    high_water: hot-tier fill fraction (claimed slots / table slots) that
+        triggers a spill event.
+    low_water: eviction target fill; defaults to high_water - 0.25
+        (floored at 0.1) — the hysteresis band that keeps spill events rare.
+    summary_log2: log2 of the Bloom summary BIT count. False-positive rate
+        with k hashes and n spilled states is ~(1 - e^(-kn/m))^k; at the
+        default k=4, m = 64x the spilled count gives ~0.24% — size it ~6
+        bits per expected spilled state.
+    summary_hashes: Bloom probe count k.
+    sweep_buckets: eviction window size in buckets (per device round-trip);
+        defaults to n_buckets/8 (>= 1).
+    """
+
+    high_water: float = 0.85
+    low_water: Optional[float] = None
+    summary_log2: int = 20
+    summary_hashes: int = DEFAULT_HASHES
+    sweep_buckets: Optional[int] = None
+
+    def resolved_low_water(self) -> float:
+        if self.low_water is not None:
+            if not 0.0 < self.low_water < self.high_water:
+                raise ValueError(
+                    "low_water must be in (0, high_water) "
+                    f"(got {self.low_water} vs high {self.high_water})"
+                )
+            return self.low_water
+        return max(0.1, self.high_water - 0.25)
+
+    def validate(self) -> None:
+        if not 0.0 < self.high_water <= 1.0:
+            raise ValueError(f"high_water must be in (0, 1], got {self.high_water}")
+        self.resolved_low_water()
+        summary_words(self.summary_log2)  # raises on < 5
+
+
+class TieredStore:
+    def __init__(
+        self,
+        table_size: int,
+        config: TieredConfig = TieredConfig(),
+        background: bool = True,
+    ):
+        config.validate()
+        self.config = config
+        self.size = table_size
+        self.bucket = min(BUCKET, table_size)
+        self.n_buckets = table_size // self.bucket
+        self.high_slots = max(int(config.high_water * table_size), 1)
+        self.low_slots = int(config.resolved_low_water() * table_size)
+        self.window = config.sweep_buckets or max(self.n_buckets // 8, 1)
+        self.summary_np = np.zeros(
+            summary_words(config.summary_log2), dtype=np.uint32
+        )
+        self.store = HostSpillStore(background=background)
+        self.sweep = 0
+        self.spill_events = 0
+        self.suspects_checked = 0
+        self.suspects_dup = 0
+        self._summary_dev = None
+
+    # -- device summary mirror -------------------------------------------------
+
+    def device_summary(self):
+        """The Bloom words as a device array (cached; refreshed after each
+        spill event). Engines pass it into their jitted step."""
+        if self._summary_dev is None:
+            import jax.numpy as jnp
+
+            self._summary_dev = jnp.asarray(self.summary_np)
+        return self._summary_dev
+
+    # -- eviction --------------------------------------------------------------
+
+    def _evict_window(self, win_lo, win_hi, win_plo, win_phi):
+        """Core shared by both entry points: given one window of bucket rows
+        ([w, bucket] numpy views), empty every non-full, non-empty bucket.
+        Mutates the window arrays in place; returns the evicted count."""
+        full = (win_lo != 0).all(axis=1)
+        occupied = win_lo != 0
+        evictable = (~full)[:, None] & occupied
+        n = int(evictable.sum())
+        if n == 0:
+            return 0
+        ev_lo = win_lo[evictable]
+        ev_hi = win_hi[evictable]
+        ev_plo = win_plo[evictable]
+        ev_phi = win_phi[evictable]
+        host_insert(
+            self.summary_np, ev_lo, ev_hi,
+            self.config.summary_log2, self.config.summary_hashes,
+        )
+        self.store.append(pack_fp(ev_lo, ev_hi), pack_fp(ev_plo, ev_phi))
+        for w in (win_lo, win_hi, win_plo, win_phi):
+            w[evictable] = 0
+        return n
+
+    def evict_host(self, t_lo, t_hi, p_lo, p_hi, hot_claims: int) -> int:
+        """Numpy-table eviction (sharded service path + tests): sweep until
+        occupancy <= low water or a full cycle frees nothing. Mutates the
+        arrays in place; returns the evicted slot count."""
+        target = hot_claims - self.low_slots
+        if target <= 0:
+            return 0
+        b = self.bucket
+        freed = 0
+        scanned = 0
+        while freed < target and scanned < self.n_buckets:
+            w = min(self.window, self.n_buckets - self.sweep)
+            s0 = self.sweep * b
+            s1 = s0 + w * b
+            freed += self._evict_window(
+                t_lo[s0:s1].reshape(w, b),
+                t_hi[s0:s1].reshape(w, b),
+                p_lo[s0:s1].reshape(w, b),
+                p_hi[s0:s1].reshape(w, b),
+            )
+            scanned += w
+            self.sweep = (self.sweep + w) % self.n_buckets
+        if freed:
+            self.spill_events += 1
+            self._summary_dev = None
+        return freed
+
+    def evict(self, t_lo, t_hi, p_lo, p_hi, hot_claims: int):
+        """Device-array eviction: pull window slices host-side (async
+        copies), run the shared core, write kept rows back with one
+        contiguous dynamic_update_slice per array. Returns
+        (t_lo, t_hi, p_lo, p_hi, evicted_count) with fresh device arrays."""
+        import jax.numpy as jnp
+
+        target = hot_claims - self.low_slots
+        if target <= 0:
+            return t_lo, t_hi, p_lo, p_hi, 0
+
+        write4 = _window_writeback()
+        b = self.bucket
+        freed = 0
+        scanned = 0
+        while freed < target and scanned < self.n_buckets:
+            w = min(self.window, self.n_buckets - self.sweep)
+            s0 = self.sweep * b
+            s1 = s0 + w * b
+            slices = [a[s0:s1] for a in (t_lo, t_hi, p_lo, p_hi)]
+            for s in slices:
+                s.copy_to_host_async()
+            # np.array (not asarray): device buffers surface as read-only
+            # views and the window core mutates in place.
+            wins = [np.array(s).reshape(w, b) for s in slices]
+            n = self._evict_window(*wins)
+            if n:
+                t_lo, t_hi, p_lo, p_hi = write4(
+                    t_lo, t_hi, p_lo, p_hi,
+                    *(jnp.asarray(x.reshape(-1)) for x in wins),
+                    jnp.int32(s0),
+                )
+                freed += n
+            scanned += w
+            self.sweep = (self.sweep + w) % self.n_buckets
+        if freed:
+            self.spill_events += 1
+            self._summary_dev = None
+        return t_lo, t_hi, p_lo, p_hi, freed
+
+    # -- suspect resolution ----------------------------------------------------
+
+    def resolve_suspects(self, lo, hi) -> np.ndarray:
+        """bool[n]: True where the suspect fingerprint IS a spilled
+        duplicate (drop it); False where the Bloom hit was a false positive
+        (the state is genuinely new — enqueue it)."""
+        lo = np.asarray(lo)
+        hi = np.asarray(hi)
+        dup = self.store.contains(pack_fp(lo, hi))
+        self.suspects_checked += int(lo.size)
+        self.suspects_dup += int(dup.sum())
+        return dup
+
+    def close(self) -> None:
+        """Release the spill tier's background compactor (see
+        HostSpillStore.close) — called whenever an engine replaces its
+        store (reset / checkpoint restore)."""
+        self.store.close()
+
+    # -- reporting / reconstruction -------------------------------------------
+
+    def stats(self, hot_claims: int) -> dict:
+        """The per-tier counters the bench detail and Explorer surface."""
+        return {
+            "store": "tiered",
+            "hot_fill": round(hot_claims / max(self.size, 1), 4),
+            "spilled_states": len(self.store),
+            "spill_events": self.spill_events,
+            "suspects_checked": self.suspects_checked,
+            "suspects_dup": self.suspects_dup,
+        }
+
+    def parent_map(self) -> dict:
+        return self.store.parent_map()
+
+    # -- checkpoint ------------------------------------------------------------
+
+    def to_checkpoint(self) -> dict:
+        """Arrays for the engine checkpoint (the summary is NOT serialized:
+        it is a pure function of the spilled fingerprints and is rebuilt on
+        load — smaller files, and summary_log2 can even change on resume)."""
+        fps, parents = self.store.to_arrays()
+        return {"spill_fps": fps, "spill_parents": parents}
+
+    def meta(self) -> dict:
+        c = self.config
+        return {
+            "high_water": c.high_water,
+            "low_water": c.resolved_low_water(),
+            "summary_log2": c.summary_log2,
+            "summary_hashes": c.summary_hashes,
+            "spill_events": self.spill_events,
+        }
+
+    @classmethod
+    def from_checkpoint(
+        cls,
+        table_size: int,
+        meta: dict,
+        spill_fps: np.ndarray,
+        spill_parents: np.ndarray,
+        background: bool = True,
+    ) -> "TieredStore":
+        cfg = TieredConfig(
+            high_water=meta["high_water"],
+            low_water=meta["low_water"],
+            summary_log2=meta["summary_log2"],
+            summary_hashes=meta["summary_hashes"],
+        )
+        ts = cls(table_size, cfg, background=background)
+        fps = np.asarray(spill_fps, dtype=np.uint64)
+        ts.store.close()  # replaced wholesale below
+        ts.store = HostSpillStore.from_arrays(
+            fps, spill_parents, background=background
+        )
+        ts.spill_events = int(meta.get("spill_events", 0))
+        host_insert(
+            ts.summary_np,
+            (fps & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+            (fps >> np.uint64(32)).astype(np.uint32),
+            cfg.summary_log2,
+            cfg.summary_hashes,
+        )
+        return ts

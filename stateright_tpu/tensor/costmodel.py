@@ -78,6 +78,10 @@ class DeviceSpec:
     ns_expand_elem: float  # expand+fingerprint+props fusion, per succ lane
     ns_other_lane: float  # pop/masks/counters residue, per flat succ lane
     ms_dispatch: float  # per serialized probe round / claim tile
+    # Host link for the tiered store's eviction traffic (device-to-host
+    # window pulls + spilled fingerprints). Uncalibrated default: no spill
+    # event has run on silicon yet; the first tiered tunnel day anchors it.
+    pcie_gbps: float = 12.0
 
 
 # Fit to the r4 anchor (see module docstring); the split prediction for the
@@ -131,6 +135,9 @@ def _ms(nbytes: float, gbps: float) -> float:
     return nbytes / (gbps * 1e9) * 1e3
 
 
+SPILL_ENTRY_BYTES = 16  # (lo, hi, parent_lo, parent_hi) per evicted slot
+
+
 def step_cost(
     lanes: int,
     max_actions: int,
@@ -143,6 +150,7 @@ def step_cost(
     phased_rounds: float = 3.9,
     tile: int = CLAIM_TILE,
     device: DeviceSpec = V5E,
+    spill: Optional[dict] = None,
 ) -> StepCost:
     """Predict one engine step for an insert `variant` (INSERT_VARIANTS).
 
@@ -162,6 +170,18 @@ def step_cost(
     through load factor — a term the r4 anchor cannot calibrate. It stays
     in the signature because every caller naturally has it and a future
     load-factor term will need it.
+
+    `spill` (None = plain device store; the None path is byte- and
+    ms-identical to the pre-tiered model, pinned by the 1% anchor
+    regression in tests/test_costmodel.py) models the tiered store's two
+    device-side costs:
+    - the per-step Bloom SUMMARY PROBE: `summary_hashes` (default 4) word
+      gathers per flat successor lane, at the gather rate;
+    - amortized EVICTION traffic: `evict_per_step` states/step crossing
+      PCIe (window pull + spilled entries, 2x SPILL_ENTRY_BYTES each) plus
+      the zeroed-window write-back at the stream rate.
+    Host-side suspect resolution is deliberately NOT a device term: it
+    overlaps the next dispatch on the host thread.
     """
     if variant not in INSERT_VARIANTS:
         raise ValueError(
@@ -221,6 +241,24 @@ def step_cost(
     append_gbps = device.gbps_stream if append == "dus" else GBPS_APPEND_SCATTER
     ops.append(OpCost("append", append_bytes, _ms(append_bytes, append_gbps)))
 
+    # -- tiered store: summary probe + amortized eviction ----------------------
+    if spill is not None:
+        hashes = int(spill.get("summary_hashes", 4))
+        probe_bytes = hashes * B * 4  # k word gathers per flat lane
+        ops.append(OpCost(
+            "spill_probe", probe_bytes, _ms(probe_bytes, device.gbps_gather)
+        ))
+        evict_per_step = float(spill.get("evict_per_step", 0.0))
+        if evict_per_step > 0:
+            pcie_bytes = evict_per_step * 2 * SPILL_ENTRY_BYTES
+            wb_bytes = evict_per_step * SPILL_ENTRY_BYTES
+            ops.append(OpCost(
+                "spill_evict",
+                pcie_bytes + wb_bytes,
+                _ms(pcie_bytes, device.pcie_gbps)
+                + _ms(wb_bytes, device.gbps_stream),
+            ))
+
     # -- pop / counters / discovery residue ------------------------------------
     other_bytes = 4 * (L + 4) * B
     ops.append(OpCost("other", other_bytes, B * device.ns_other_lane * 1e-6))
@@ -243,12 +281,14 @@ def bytes_per_state(
     append: str = "dus",
     new_frac: float = 0.5,
     device: DeviceSpec = V5E,
+    spill: Optional[dict] = None,
 ) -> float:
     """HBM bytes touched per GENERATED state: the step's modeled byte total
     over the measured states-per-step (state_count / steps from a run)."""
     sc = step_cost(
         lanes, max_actions, batch, table_log2,
         variant=variant, append=append, new_frac=new_frac, device=device,
+        spill=spill,
     )
     return sc.total_bytes / max(states_per_step, 1e-9)
 
@@ -273,6 +313,7 @@ def predict_ranking(
     append: str = "dus",
     device: DeviceSpec = V5E,
     variants: Optional[tuple] = None,
+    spill: Optional[dict] = None,
 ) -> list:
     """Rank insert variants by predicted step time (fastest first). Returns
     [{"variant", "total_ms", "insert_ms", "bytes"}...] — the committed
@@ -282,6 +323,7 @@ def predict_ranking(
         sc = step_cost(
             lanes, max_actions, batch, table_log2,
             variant=v, append=append, new_frac=new_frac, device=device,
+            spill=spill,
         )
         out.append({
             "variant": v,

@@ -222,6 +222,16 @@ def compact_new(flat, slo, shi, is_new):
     return out_states, out_lo, out_hi, out_src, is_new.sum()
 
 
+def compact_flags(flags, is_new):
+    """Compact a per-lane flag column with the SAME positions compact_new
+    assigns its rows, so flag i annotates compacted row i (used for the
+    tiered store's suspect bits)."""
+    M = flags.shape[0]
+    pos_all = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+    pos = jnp.where(is_new, pos_all, M)
+    return jnp.zeros(M, dtype=bool).at[pos].set(flags, mode="drop")
+
+
 def record_discovery(discovered, disc_lo, disc_hi, i, hit, lo, hi):
     """First-witness discovery recording for property bit `i` inside a traced
     search body (shared by the resident and sharded engines). Keeps the first
@@ -324,7 +334,18 @@ class FrontierSearch:
         batch_size: int = 1024,
         table_log2: int = 20,
         insert_variant: str = "sort",
+        store: str = "device",
+        high_water: float = 0.85,
+        low_water: Optional[float] = None,
+        summary_log2: int = 20,
     ):
+        """`store="tiered"` enables the two-tier state store
+        (stateright_tpu/store/): when device-table occupancy crosses
+        `high_water`, cold non-full buckets are evicted to a host spill
+        tier and a device Bloom summary (2^summary_log2 bits) filters
+        re-probes — searches whose unique-state count exceeds the table
+        degrade gracefully instead of aborting. With the default
+        `store="device"` behavior is byte-identical to before."""
         self.model = model
         self.batch_size = batch_size
         self.table = HashTable(table_log2)
@@ -334,6 +355,41 @@ class FrontierSearch:
                 f"{sorted(self.INSERT_VARIANTS)}, got {insert_variant!r}"
             )
         self.insert_variant = insert_variant
+        if store not in ("device", "tiered"):
+            raise ValueError(f"store must be 'device' or 'tiered', got {store!r}")
+        self.store = store
+        self._store = None
+        if store == "tiered":
+            from ..store.tiered import TieredConfig, TieredStore
+
+            self._store = TieredStore(
+                self.table.size,
+                TieredConfig(
+                    high_water=high_water,
+                    low_water=low_water,
+                    summary_log2=summary_log2,
+                ),
+            )
+            # Spill trigger with one-batch headroom: a single step can claim
+            # up to batch x max_actions slots, and eviction only runs
+            # between steps — without the headroom a near-high-water table
+            # can blow straight through to a hard insert overflow.
+            ka = batch_size * model.max_actions
+            self._spill_trigger = min(
+                self._store.high_slots, self.table.size - ka
+            )
+            if self._spill_trigger <= self._store.low_slots:
+                raise ValueError(
+                    "table too small for tiered spilling at this batch: "
+                    f"table 2^{table_log2} minus one batch of claims "
+                    f"({ka}) leaves no room above the low-water mark "
+                    f"({self._store.low_slots} slots); raise table_log2 or "
+                    "lower batch_size/low_water"
+                )
+        self._hot_claims = 0  # occupied device-table slots (claims - evictions)
+        # Placeholder summary operand for store="device" (the step signature
+        # is uniform so both modes share one code path).
+        self._no_summary = jnp.zeros(1, dtype=jnp.uint32)
         self.properties = model.properties()
         self._step = self._build_step()
         # Resumable search state (seeded lazily by run(); see _seed).
@@ -348,9 +404,15 @@ class FrontierSearch:
         K = self.batch_size
         props = self.properties
         insert = self.INSERT_VARIANTS[self.insert_variant]
+        tiered = self._store is not None
+        if tiered:
+            from ..store.summary import maybe_contains
+
+            slog2 = self._store.config.summary_log2
+            khash = self._store.config.summary_hashes
 
         @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
-        def step(t_lo, t_hi, p_lo, p_hi, states, lo, hi, active):
+        def step(t_lo, t_hi, p_lo, p_hi, states, lo, hi, active, summary):
             # Property masks on the input states (ref: bfs.rs:230-280).
             prop_masks = (
                 jnp.stack([p.condition(model, states) for p in props])
@@ -368,9 +430,20 @@ class FrontierSearch:
             out_states, out_lo, out_hi, out_src, new_count = compact_new(
                 flat, slo, shi, is_new
             )
+            # Tiered store: a fresh device claim whose fingerprint hits the
+            # Bloom summary of the spilled set is a SUSPECT — possibly a
+            # revisit of an evicted state. The host resolves suspects
+            # exactly (store/host.py); a summary miss PROVES novelty, so
+            # the common path never leaves the device.
+            suspect = (
+                is_new & maybe_contains(summary, slo, shi, slog2, khash)
+                if tiered
+                else jnp.zeros_like(is_new)
+            )
+            out_sus = compact_flags(suspect, is_new)
             return (
                 t_lo, t_hi, p_lo, p_hi,
-                out_states, out_lo, out_hi, out_src,
+                out_states, out_lo, out_hi, out_src, out_sus,
                 new_count, gen_count, has_succ, ovf, prop_masks,
             )
 
@@ -400,6 +473,7 @@ class FrontierSearch:
             early_exit=False,
         )
         self._disc = {}
+        self._hot_claims = 0
 
         # Insert init states (chunked to batch size).
         for b0 in range(0, n0, K):
@@ -418,7 +492,9 @@ class FrontierSearch:
             )
             if bool(res.overflow):
                 raise RuntimeError("hash table full; raise table_log2")
-            self._counts["unique_count"] += int(np.asarray(res.is_new).sum())
+            n_new = int(np.asarray(res.is_new).sum())
+            self._counts["unique_count"] += n_new
+            self._hot_claims += n_new
 
         ebits0 = np.zeros((n0, P), dtype=bool)
         for i in eventually_i:
@@ -493,7 +569,7 @@ class FrontierSearch:
 
                 (
                     t_lo, t_hi, p_lo, p_hi,
-                    out_states, out_lo, out_hi, out_src,
+                    out_states, out_lo, out_hi, out_src, out_sus,
                     new_count, gen_count, has_succ, overflow, prop_masks,
                 ) = self._step(
                     self.table.t_lo,
@@ -504,6 +580,9 @@ class FrontierSearch:
                     jnp.asarray(lo),
                     jnp.asarray(hi),
                     jnp.asarray(active),
+                    self._store.device_summary()
+                    if self._store is not None
+                    else self._no_summary,
                 )
                 self.table.t_lo, self.table.t_hi = t_lo, t_hi
                 self.table.p_lo, self.table.p_hi = p_lo, p_hi
@@ -563,12 +642,32 @@ class FrontierSearch:
 
                 state_count += int(gen_count)
                 nc = int(new_count)
-                unique_count += nc
+                self._hot_claims += nc  # device slot claims (incl. suspects)
                 if nc:
                     out_states = np.asarray(out_states[:nc])
                     out_lo = np.asarray(out_lo[:nc])
                     out_hi = np.asarray(out_hi[:nc])
                     parent_rows = np.asarray(out_src[:nc]) // A
+                    if self._store is not None:
+                        sus = np.asarray(out_sus[:nc])
+                        if sus.any():
+                            # Exact membership check against the spill tier:
+                            # confirmed duplicates of spilled states are
+                            # dropped (not unique, not re-enqueued); Bloom
+                            # false positives stay.
+                            dup = self._store.resolve_suspects(
+                                out_lo[sus], out_hi[sus]
+                            )
+                            if dup.any():
+                                keep = np.ones(nc, dtype=bool)
+                                keep[np.nonzero(sus)[0][dup]] = False
+                                out_states = out_states[keep]
+                                out_lo = out_lo[keep]
+                                out_hi = out_hi[keep]
+                                parent_rows = parent_rows[keep]
+                                nc = int(keep.sum())
+                unique_count += nc
+                if nc:
                     child_ebits = (
                         ebits[parent_rows]
                         if P
@@ -580,6 +679,24 @@ class FrontierSearch:
                             chunk.depth + 1,
                         )
                     )
+                if (
+                    self._store is not None
+                    and self._hot_claims >= self._spill_trigger
+                ):
+                    tl, th, pl, ph, n_ev = self._store.evict(
+                        self.table.t_lo, self.table.t_hi,
+                        self.table.p_lo, self.table.p_hi,
+                        self._hot_claims,
+                    )
+                    if n_ev == 0:
+                        raise RuntimeError(
+                            "tiered store could not free any bucket (every "
+                            "bucket is full and pinned); raise table_log2 "
+                            "or lower high_water"
+                        )
+                    self.table.t_lo, self.table.t_hi = tl, th
+                    self.table.p_lo, self.table.p_hi = pl, ph
+                    self._hot_claims -= n_ev
                 if (
                     target_state_count is not None
                     and state_count >= target_state_count
@@ -625,7 +742,15 @@ class FrontierSearch:
             and not counts.get("early_exit", False),
             duration=time.monotonic() - start,
             steps=steps,
+            detail=self.store_stats(),
         )
+
+    def store_stats(self) -> Optional[dict]:
+        """Per-tier occupancy counters (None with the plain device store) —
+        surfaced in SearchResult.detail, the bench JSON, and `/.status`."""
+        if self._store is None:
+            return None
+        return self._store.stats(self._hot_claims)
 
     # -- checkpoint / resume ---------------------------------------------------
     # SURVEY.md §5: the reference has no partial-search checkpointing; with
@@ -641,8 +766,12 @@ class FrontierSearch:
         if self._q is None:
             raise RuntimeError("nothing to checkpoint: run() has not started")
         chunks = list(self._q)
+        # Tiered runs serialize the spill tier alongside the device table
+        # (the Bloom summary is rebuilt from the fingerprints on load).
+        spill = self._store.to_checkpoint() if self._store is not None else {}
         np.savez_compressed(
             path,
+            **spill,
             t_lo=np.asarray(self.table.t_lo),
             t_hi=np.asarray(self.table.t_hi),
             p_lo=np.asarray(self.table.p_lo),
@@ -679,6 +808,12 @@ class FrontierSearch:
                         "properties": [p.name for p in self.properties],
                         "table_log2": self.table.log2_size,
                         "insert_variant": self.insert_variant,
+                        "hot_claims": self._hot_claims,
+                        "store": (
+                            self._store.meta()
+                            if self._store is not None
+                            else None
+                        ),
                     }
                 ).encode(),
                 dtype=np.uint8,
@@ -712,18 +847,38 @@ class FrontierSearch:
                 "checkpoint was taken with a different property list "
                 f"({meta['properties']} != {prop_names})"
             )
+        store_meta = meta.get("store")
         fs = cls(
             model,
             batch_size=batch_size,
             table_log2=meta["table_log2"],
             insert_variant=meta.get("insert_variant", "sort"),
+            store="tiered" if store_meta else "device",
+            **(
+                {
+                    "high_water": store_meta["high_water"],
+                    "low_water": store_meta["low_water"],
+                    "summary_log2": store_meta["summary_log2"],
+                }
+                if store_meta
+                else {}
+            ),
         )
+        if store_meta:
+            from ..store.tiered import TieredStore
+
+            fs._store.close()  # replaced by the checkpointed tier
+            fs._store = TieredStore.from_checkpoint(
+                fs.table.size, store_meta,
+                data["spill_fps"], data["spill_parents"],
+            )
         fs.table.t_lo = jnp.asarray(data["t_lo"])
         fs.table.t_hi = jnp.asarray(data["t_hi"])
         fs.table.p_lo = jnp.asarray(data["p_lo"])
         fs.table.p_hi = jnp.asarray(data["p_hi"])
         fs._counts = meta["counts"]
         fs._disc = dict(meta["discoveries"])
+        fs._hot_claims = int(meta.get("hot_claims", 0))
         fs._q = deque()
         off = 0
         for ln, depth in zip(data["q_lens"], data["q_depths"]):
@@ -743,4 +898,11 @@ class FrontierSearch:
     # -- path reconstruction ---------------------------------------------------
 
     def reconstruct_path(self, fp: int) -> Path:
-        return reconstruct_path(self.model, self.table.dump(), fp)
+        parent_map = self.table.dump()
+        if self._store is not None:
+            # Spill entries win on keys present in both tiers: they carry
+            # the ORIGINAL (BFS-discovery) parent, which keeps the walked
+            # chain acyclic; a post-spill re-claim's parent can sit deeper
+            # than the state itself.
+            parent_map.update(self._store.parent_map())
+        return reconstruct_path(self.model, parent_map, fp)

@@ -95,6 +95,12 @@ def _finish_masks(finish_when: HasDiscoveries, props) -> tuple[int, int]:
 # RIGHT one instead of guessing.
 ABORT_TABLE = 1  # hash-table insert exhausted MAX_ROUNDS (table full)
 ABORT_QUEUE = 2  # frontier queue tail crossed its capacity
+# Non-fatal exit (tiered store only): the loop hands control back to the
+# host — occupancy crossed the spill trigger, the suspect buffer is near
+# capacity, or the queue tail needs compaction. The host services the
+# condition (store/tiered.py) and resumes the same carry; it is never
+# surfaced as an error.
+EXIT_SERVICE = 4
 
 
 def _abort_reason(code: int) -> str:
@@ -125,8 +131,17 @@ class _Carry(NamedTuple):
     discovered: jnp.ndarray  # uint32 bitmask
     disc_lo: jnp.ndarray  # uint32[P]
     disc_hi: jnp.ndarray  # uint32[P]
-    overflow: jnp.ndarray  # uint32 abort code (0 ok; ABORT_TABLE|ABORT_QUEUE)
+    overflow: jnp.ndarray  # uint32 abort code (0 ok; ABORT_*|EXIT_SERVICE)
     steps: jnp.ndarray  # int32
+    # -- tiered store (store="tiered"; zero-sized placeholders otherwise) ------
+    hot_claims: jnp.ndarray  # int32: occupied device-table slots
+    s_states: jnp.ndarray  # uint32[SQ, L] suspect buffer (Bloom-positive claims
+    s_lo: jnp.ndarray  # uint32[SQ]       awaiting exact host resolution)
+    s_hi: jnp.ndarray  # uint32[SQ]
+    s_ebits: jnp.ndarray  # uint32[SQ]
+    s_depth: jnp.ndarray  # uint32[SQ]
+    s_tail: jnp.ndarray  # int32
+    summary: jnp.ndarray  # uint32[W] Bloom words (read-only in-loop)
 
 
 def _resolve_chunking(budget, timeout, progress, carry):
@@ -226,6 +241,38 @@ def _regrow(
     return out
 
 
+@jax.jit
+def _compact_queue(q_states, q_lo, q_hi, q_ebits, q_depth, head):
+    """Shift live queue rows [head, tail) to the front (one gather per
+    array) — the tiered store's answer to the append-only tail growing past
+    capacity once uniques exceed the table. Static shapes: the out-of-range
+    tail of the gather fills with zeros, which nothing past the new tail
+    reads."""
+    idx = head + jnp.arange(q_lo.shape[0], dtype=jnp.int32)
+    one = lambda a: jnp.take(a, idx, mode="fill", fill_value=0)
+    return (
+        jnp.take(q_states, idx, axis=0, mode="fill", fill_value=0),
+        one(q_lo), one(q_hi), one(q_ebits), one(q_depth),
+    )
+
+
+@jax.jit
+def _inject_rows(
+    q_states, q_lo, q_hi, q_ebits, q_depth, tail,
+    b_states, b_lo, b_hi, b_eb, b_dp,
+):
+    """Write a host-built block of confirmed-new suspect rows at the queue
+    tail (one contiguous dynamic_update_slice per array; rows past the
+    caller's real count are scratch beyond the new tail). The caller
+    guarantees tail + block_rows <= Q via the tiered queue slack."""
+    upd2 = jax.lax.dynamic_update_slice(q_states, b_states, (tail, 0))
+    one = lambda q, b: jax.lax.dynamic_update_slice(q, b, (tail,))
+    return (
+        upd2, one(q_lo, b_lo), one(q_hi, b_hi),
+        one(q_ebits, b_eb), one(q_depth, b_dp),
+    )
+
+
 class ResidentSearch:
     """One-dispatch whole-search engine for a `TensorModel`."""
 
@@ -239,6 +286,10 @@ class ResidentSearch:
         append: Optional[str] = None,
         table_layout: str = "split",
         insert_variant: str = "sort",
+        store: str = "device",
+        high_water: float = 0.85,
+        low_water: Optional[float] = None,
+        summary_log2: int = 20,
     ):
         """`donate_chunks=True` donates the carry to each chunked dispatch:
         XLA updates the tables/queue IN PLACE instead of copying the whole
@@ -301,6 +352,43 @@ class ResidentSearch:
                 "table layout only"
             )
         self.insert_variant = insert_variant
+        # store="tiered": two-tier state store (stateright_tpu/store/) —
+        # past `high_water` fill, cold non-full buckets spill to a host
+        # fingerprint store over PCIe and a device Bloom summary
+        # (2^summary_log2 bits) filters re-probes. The while_loop exits to
+        # the host (EXIT_SERVICE) instead of aborting, so spaces bigger
+        # than the table degrade gracefully; tiered runs are always
+        # chunked (the host must get control between dispatches).
+        if store not in ("device", "tiered"):
+            raise ValueError(f"store must be 'device' or 'tiered', got {store!r}")
+        if store == "tiered" and table_layout != "split":
+            raise ValueError("store='tiered' supports the split table layout only")
+        self.store = store
+        self._store = None
+        self._store_args = (high_water, low_water, summary_log2)
+        ka = batch_size * model.max_actions
+        if store == "tiered":
+            self._fresh_store()
+            # One-batch headroom: a single step can claim up to K*A slots
+            # and eviction only runs between dispatches.
+            self._spill_trigger = min(
+                self._store.high_slots, (1 << table_log2) - ka
+            )
+            if self._spill_trigger <= self._store.low_slots:
+                raise ValueError(
+                    "table too small for tiered spilling at this batch: "
+                    f"table 2^{table_log2} minus one batch of claims ({ka}) "
+                    "leaves no room above the low-water mark "
+                    f"({self._store.low_slots} slots); raise table_log2 or "
+                    "lower batch_size/low_water"
+                )
+            # Suspect buffer: 2 batches of accumulation + 1 batch of append
+            # slack before a service exit is forced.
+            self._SQ = 3 * ka
+        else:
+            self._spill_trigger = 0
+            self._SQ = 0
+        self._q_compacted = False
         self.props = model.properties()
         self._kernel, self._seed_k, self._chunk_k = self._build()
         self._last_tables = None
@@ -322,6 +410,31 @@ class ResidentSearch:
         # Abort code of the last overflow (ABORT_TABLE | ABORT_QUEUE bits);
         # written into checkpoint meta so recovery grows the right resource.
         self._last_abort = 0
+
+    def _fresh_store(self) -> None:
+        """(Re)build the tiered store — a fresh search owes nothing to a
+        previous run's spill tier or Bloom summary."""
+        from ..store.tiered import TieredConfig, TieredStore
+
+        if self._store is not None:
+            self._store.close()  # stop the old spill tier's compactor
+        high_water, low_water, summary_log2 = self._store_args
+        self._store = TieredStore(
+            1 << self.table_log2,
+            TieredConfig(
+                high_water=high_water,
+                low_water=low_water,
+                summary_log2=summary_log2,
+            ),
+        )
+
+    def store_stats(self) -> Optional[dict]:
+        """Per-tier occupancy counters (None with the plain device store) —
+        surfaced in SearchResult.detail, the bench JSON, and `/.status`."""
+        if self._store is None:
+            return None
+        hot = int(self._carry.hot_claims) if self._carry is not None else 0
+        return self._store.stats(hot)
 
     def _insert_fn(self):
         if self.table_layout == "split":
@@ -352,14 +465,29 @@ class ResidentSearch:
         insert = self._insert_fn()
         _append = append_new if self.append == "scatter" else append_new_dus
         S = 1 << self.table_log2
+        tiered = self._store is not None
+        if tiered:
+            from ..store.summary import maybe_contains, summary_words
+
+            slog2 = self._store.config.summary_log2
+            khash = self._store.config.summary_hashes
+            W = summary_words(slog2)
+        else:
+            W = 1
+        SQ = self._SQ
+        TRIGGER = jnp.int32(self._spill_trigger) if tiered else None
         # Queue capacity: every unique state is enqueued exactly once (<= S
         # before the table overflows, and <= 2^queue_log2 when the caller
         # right-sized the queue below the table — see __init__), plus K*A
         # rows of slack so either append variant (scatter `append_new` —
         # the default; measured faster than `append_new_dus` on CPU at
         # 2pc-10 scale — or the DUS block) stays in bounds right up to the
-        # overflow signal.
-        Q = (1 << self.queue_log2) + K * A
+        # overflow signal. Tiered runs add SQ more rows: the live frontier
+        # is still bounded by 2^queue_log2 (uniques beyond the table spill,
+        # and the tail is host-compacted at each service exit), and the
+        # extra slack guarantees the suspect-injection block always fits.
+        QL = 1 << self.queue_log2
+        Q = QL + K * A + (SQ if tiered else 0)
         self._Q = Q
         props = self.props
         P = len(props)
@@ -420,17 +548,56 @@ class ResidentSearch:
                         discovered, disc_lo, disc_hi, i, bad, lo, hi
                     )
 
+            # -- tiered store: split claims into enqueue vs suspect ------------
+            # A fresh claim whose fingerprint hits the Bloom summary of the
+            # spilled set might be a revisit of an evicted state: it is
+            # buffered for exact host resolution instead of enqueued (a
+            # summary MISS proves novelty, so the common path never leaves
+            # the device). The claim itself stays in the table either way —
+            # that is what dedups further on-device probes of the same key.
+            if tiered:
+                suspect = is_new & maybe_contains(
+                    c.summary, slo, shi, slog2, khash
+                )
+                enq = is_new & ~suspect
+            else:
+                enq = is_new
+
             # -- append new states to the queue tail (cumsum compaction) -------
             src_row = jnp.arange(K * A, dtype=jnp.int32) // A
             q_states, q_lo, q_hi, q_ebits, q_depth, tail = _append(
                 c.q_states, c.q_lo, c.q_hi, c.q_ebits, c.q_depth, c.tail,
-                flat, slo, shi, ebits[src_row], depth[src_row] + 1, is_new,
+                flat, slo, shi, ebits[src_row], depth[src_row] + 1, enq,
             )
             new_count = tail - c.tail
-            # tail beyond S means more uniques than table slots — the table
-            # is overflowing anyway; the K*A slack above keeps the DUS and
-            # the next pop's dynamic_slice in bounds right up to that point.
-            q_full = tail > Q - K * A
+            hot_claims = c.hot_claims + is_new.sum().astype(jnp.int32)
+            if tiered:
+                (
+                    s_states, s_lo, s_hi, s_ebits, s_depth, s_tail,
+                ) = _append(
+                    c.s_states, c.s_lo, c.s_hi, c.s_ebits, c.s_depth,
+                    c.s_tail,
+                    flat, slo, shi, ebits[src_row], depth[src_row] + 1,
+                    suspect,
+                )
+                # Host-service exits (non-fatal): spill trigger crossed,
+                # suspect buffer near capacity, or queue tail past the
+                # compaction threshold.
+                service = (
+                    (hot_claims >= TRIGGER)
+                    | (s_tail > SQ - K * A)
+                    | (tail > QL)
+                )
+                q_full = jnp.bool_(False)  # the host decides queue fatality
+            else:
+                s_states, s_lo, s_hi = c.s_states, c.s_lo, c.s_hi
+                s_ebits, s_depth, s_tail = c.s_ebits, c.s_depth, c.s_tail
+                service = jnp.bool_(False)
+                # tail beyond S means more uniques than table slots — the
+                # table is overflowing anyway; the K*A slack above keeps the
+                # DUS and the next pop's dynamic_slice in bounds right up to
+                # that point.
+                q_full = tail > Q - K * A
 
             gen_lo, gen_hi = count_add(c.gen_lo, c.gen_hi, gen)
             return _Carry(
@@ -454,8 +621,17 @@ class ResidentSearch:
                 disc_hi=disc_hi,
                 overflow=c.overflow
                 | (ovf.astype(jnp.uint32) * jnp.uint32(ABORT_TABLE))
-                | (q_full.astype(jnp.uint32) * jnp.uint32(ABORT_QUEUE)),
+                | (q_full.astype(jnp.uint32) * jnp.uint32(ABORT_QUEUE))
+                | (service.astype(jnp.uint32) * jnp.uint32(EXIT_SERVICE)),
                 steps=c.steps + 1,
+                hot_claims=hot_claims,
+                s_states=s_states,
+                s_lo=s_lo,
+                s_hi=s_hi,
+                s_ebits=s_ebits,
+                s_depth=s_depth,
+                s_tail=s_tail,
+                summary=c.summary,
             )
 
         def should_continue(
@@ -530,12 +706,22 @@ class ResidentSearch:
                 disc_hi=jnp.zeros(max(P, 1), dtype=jnp.uint32),
                 overflow=ovf.astype(jnp.uint32) * jnp.uint32(ABORT_TABLE),
                 steps=jnp.int32(0),
+                hot_claims=is_new.sum().astype(jnp.int32),
+                s_states=jnp.zeros((SQ, L), dtype=jnp.uint32),
+                s_lo=jnp.zeros(SQ, dtype=jnp.uint32),
+                s_hi=jnp.zeros(SQ, dtype=jnp.uint32),
+                s_ebits=jnp.zeros(SQ, dtype=jnp.uint32),
+                s_depth=jnp.zeros(SQ, dtype=jnp.uint32),
+                s_tail=jnp.int32(0),
+                summary=jnp.zeros(W, dtype=jnp.uint32),
             )
 
         def summary_of(carry: _Carry, stop):
             # Pack every host-facing scalar into ONE small vector so the host
             # reads the whole result in a single device transfer (each fetch
-            # over the device tunnel costs a full round trip).
+            # over the device tunnel costs a full round trip). Layout:
+            # [0..9] as before, [10] hot_claims, [11] s_tail, then
+            # disc_lo/disc_hi.
             return jnp.concatenate(
                 [
                     jnp.stack(
@@ -550,6 +736,8 @@ class ResidentSearch:
                             carry.overflow.astype(jnp.uint32),
                             carry.steps.astype(jnp.uint32),
                             stop.astype(jnp.uint32),
+                            carry.hot_claims.astype(jnp.uint32),
+                            carry.s_tail.astype(jnp.uint32),
                         ]
                     ),
                     carry.disc_lo,
@@ -667,6 +855,11 @@ class ResidentSearch:
           so `checkpoint()` + `load_checkpoint(..., table_log2=bigger)` can
           continue the run instead of discarding it.
         """
+        # Tiered runs are always chunked: the host must regain control for
+        # spill eviction and suspect resolution (the ISSUE's "exit to host
+        # on high-water instead of aborting").
+        if self._store is not None and budget is None and timeout is None:
+            budget = 1 << 20
         chunked, budget = _resolve_chunking(
             budget, timeout, progress, self._carry
         )
@@ -773,8 +966,18 @@ class ResidentSearch:
                     self._dyn_dev,
                 )
                 summary = np.asarray(summary)  # one small transfer per chunk
-                if summary[7]:  # overflow (abort code)
-                    self._last_abort = int(summary[7])
+                code = int(summary[7])
+                if code & EXIT_SERVICE and not (
+                    code & (ABORT_TABLE | ABORT_QUEUE)
+                ):
+                    # Non-fatal host-service exit (tiered store): drain the
+                    # suspect buffer, evict past-high-water buckets, compact
+                    # the queue, clear the flag, resume the same carry.
+                    self._carry = carry
+                    self._service()
+                    continue
+                if code:  # fatal overflow (abort code)
+                    self._last_abort = code & (ABORT_TABLE | ABORT_QUEUE)
                     reason = _abort_reason(self._last_abort)
                     if self.donate_chunks:
                         # The pre-chunk carry was donated into the dispatch;
@@ -814,6 +1017,13 @@ class ResidentSearch:
                     gl, gh, uc, md = (int(x) for x in summary[:4])
                     progress(gl | (gh << 32), uc, md)
                 if summary[9]:  # stop: search finished (or hit max_steps)
+                    if self._store is not None and int(summary[11]) > 0:
+                        # The queue drained with suspects still buffered:
+                        # resolve them — confirmed-new rows reopen the
+                        # frontier; the next chunk re-evaluates the stop
+                        # with an empty buffer, so this cannot loop.
+                        self._service()
+                        continue
                     break
                 if timeout is not None and time.monotonic() - start > timeout:
                     timed_out = True
@@ -844,8 +1054,8 @@ class ResidentSearch:
             )
 
         P = len(self.props)
-        disc_lo = summary[10 : 10 + max(P, 1)]
-        disc_hi = summary[10 + max(P, 1) :]
+        disc_lo = summary[12 : 12 + max(P, 1)]
+        disc_hi = summary[12 + max(P, 1) :]
         discoveries = {
             p.name: int(pack_fp(disc_lo[i], disc_hi[i]))
             for i, p in enumerate(self.props)
@@ -859,6 +1069,113 @@ class ResidentSearch:
             complete=head >= tail and not timed_out,
             duration=time.monotonic() - start,
             steps=steps,
+            detail=self.store_stats(),
+        )
+
+    def _service(self) -> None:
+        """Host half of the tiered store, run between chunked dispatches on
+        an EXIT_SERVICE (or a drained queue with buffered suspects):
+
+        1. compact the frontier queue (live rows shift to the front — with
+           spilling, total uniques exceed the table, so the append-only
+           tail would otherwise grow without bound);
+        2. drain the suspect buffer: exact membership against the host
+           spill store; confirmed duplicates are dropped, Bloom false
+           positives are injected at the queue tail and counted unique;
+        3. evict past-high-water occupancy: cold non-full buckets move to
+           the spill tier and the Bloom summary absorbs their keys.
+
+        The carry is rebuilt with the service bit cleared; the caller
+        resumes the same while_loop."""
+        c = self._carry
+        L = self.model.lanes
+        SQ = self._SQ
+        head, tail = int(c.head), int(c.tail)
+        s_tail = int(c.s_tail)
+        hot = int(c.hot_claims)
+        unique = int(c.unique_count)
+        q_states, q_lo, q_hi = c.q_states, c.q_lo, c.q_hi
+        q_ebits, q_depth = c.q_ebits, c.q_depth
+
+        if head > 0:
+            q_states, q_lo, q_hi, q_ebits, q_depth = _compact_queue(
+                q_states, q_lo, q_hi, q_ebits, q_depth, jnp.int32(head)
+            )
+            tail -= head
+            head = 0
+            self._q_compacted = True
+        if tail > (1 << self.queue_log2):
+            # The LIVE frontier exceeds the queue even compacted — a real
+            # capacity wall, recoverable exactly like the device-store
+            # queue abort (the carry is sound; checkpoint + regrow).
+            self._carry = c._replace(
+                q_states=q_states, q_lo=q_lo, q_hi=q_hi,
+                q_ebits=q_ebits, q_depth=q_depth,
+                head=jnp.int32(head), tail=jnp.int32(tail),
+                overflow=jnp.uint32(0),
+            )
+            self._last_abort = ABORT_QUEUE
+            raise RuntimeError(
+                f"frontier queue full — {_abort_reason(ABORT_QUEUE)}; the "
+                "live frontier exceeds the compacted queue — checkpoint() "
+                "then load_checkpoint with a larger queue_log2 to continue"
+            )
+
+        if s_tail > 0:
+            sus_lo = np.asarray(c.s_lo[:s_tail])
+            sus_hi = np.asarray(c.s_hi[:s_tail])
+            dup = self._store.resolve_suspects(sus_lo, sus_hi)
+            keep = ~dup
+            n_conf = int(keep.sum())
+            if n_conf:
+                blk_states = np.zeros((SQ, L), dtype=np.uint32)
+                blk_lo = np.zeros(SQ, dtype=np.uint32)
+                blk_hi = np.zeros(SQ, dtype=np.uint32)
+                blk_eb = np.zeros(SQ, dtype=np.uint32)
+                blk_dp = np.zeros(SQ, dtype=np.uint32)
+                blk_states[:n_conf] = np.asarray(c.s_states[:s_tail])[keep]
+                blk_lo[:n_conf] = sus_lo[keep]
+                blk_hi[:n_conf] = sus_hi[keep]
+                blk_eb[:n_conf] = np.asarray(c.s_ebits[:s_tail])[keep]
+                blk_dp[:n_conf] = np.asarray(c.s_depth[:s_tail])[keep]
+                q_states, q_lo, q_hi, q_ebits, q_depth = _inject_rows(
+                    q_states, q_lo, q_hi, q_ebits, q_depth,
+                    jnp.int32(tail),
+                    jnp.asarray(blk_states), jnp.asarray(blk_lo),
+                    jnp.asarray(blk_hi), jnp.asarray(blk_eb),
+                    jnp.asarray(blk_dp),
+                )
+                tail += n_conf
+                unique += n_conf
+
+        t_lo, t_hi, p_lo, p_hi = c.t_lo, c.t_hi, c.p_lo, c.p_hi
+        if hot >= self._spill_trigger:
+            t_lo, t_hi, p_lo, p_hi, n_ev = self._store.evict(
+                t_lo, t_hi, p_lo, p_hi, hot
+            )
+            if n_ev == 0:
+                raise RuntimeError(
+                    "tiered store could not free any bucket (every bucket "
+                    "is full and pinned); raise table_log2 or lower "
+                    "high_water"
+                )
+            hot -= n_ev
+
+        self._carry = c._replace(
+            t_lo=t_lo, t_hi=t_hi, p_lo=p_lo, p_hi=p_hi,
+            q_states=q_states, q_lo=q_lo, q_hi=q_hi,
+            q_ebits=q_ebits, q_depth=q_depth,
+            head=jnp.int32(head), tail=jnp.int32(tail),
+            unique_count=jnp.int32(unique),
+            hot_claims=jnp.int32(hot),
+            s_tail=jnp.int32(0),
+            # A FRESH upload, never the store's cached device array: with
+            # donate_chunks the next dispatch donates (deletes) whatever
+            # sits in the carry, and a later no-eviction service would
+            # otherwise re-install the same deleted buffer. The words are
+            # tiny; one upload per (rare) service event is free.
+            summary=jnp.asarray(self._store.summary_np),
+            overflow=jnp.uint32(0),
         )
 
     def set_dyn_tables(self, tables: dict) -> None:
@@ -873,6 +1190,9 @@ class ResidentSearch:
         self._parent_map = None
         self._last_tables = None
         self._last_abort = 0  # a fresh run owes nothing to an old overflow
+        self._q_compacted = False
+        if self._store is not None:
+            self._fresh_store()  # spill tier + Bloom summary start empty
 
     def dump_states(
         self, decode: bool = True, evaluated_only: bool = False,
@@ -895,6 +1215,13 @@ class ResidentSearch:
             raise RuntimeError(
                 "no retained carry to dump: run with budget=... (chunked "
                 "dispatch) before dump_states()"
+            )
+        if self._q_compacted:
+            raise RuntimeError(
+                "dump_states is unavailable once the tiered store has "
+                "compacted the frontier queue (rows [0, tail) no longer "
+                "cover every unique state; spilled states live host-side) — "
+                "use store='device' for exact state-set dumps"
             )
         end = int(self._carry.head if evaluated_only else self._carry.tail)
         if raw:
@@ -936,6 +1263,10 @@ class ResidentSearch:
             )
         c = self._carry
         arrays = {f: np.asarray(getattr(c, f)) for f in c._fields}
+        if self._store is not None:
+            # Spill tier rides along; the Bloom summary is rebuilt from the
+            # fingerprints on load (see store/tiered.py).
+            arrays.update(self._store.to_checkpoint())
         arrays["meta"] = np.frombuffer(
             json.dumps(
                 {
@@ -947,6 +1278,10 @@ class ResidentSearch:
                     "batch_size": self.batch_size,
                     "table_layout": self.table_layout,
                     "insert_variant": self.insert_variant,
+                    "store": (
+                        self._store.meta() if self._store is not None else None
+                    ),
+                    "q_compacted": self._q_compacted,
                     # Why the run aborted (0 = clean suspension): lets
                     # load_checkpoint refuse a resume that would hit the
                     # same wall again.
@@ -1008,6 +1343,7 @@ class ResidentSearch:
                 f"(queue_log2={meta_q}); pass a larger queue_log2 to "
                 "load_checkpoint to regrow the queue"
             )
+        store_meta = meta.get("store")
         rs = cls(
             model,
             batch_size=batch_size or meta["batch_size"],
@@ -1019,8 +1355,65 @@ class ResidentSearch:
             # where silently falling back to the full-batch sort would
             # reintroduce the cost the variant was chosen to avoid.
             insert_variant=meta.get("insert_variant", "sort"),
+            store="tiered" if store_meta else "device",
+            **(
+                {
+                    "high_water": store_meta["high_water"],
+                    "low_water": store_meta["low_water"],
+                    "summary_log2": store_meta["summary_log2"],
+                }
+                if store_meta
+                else {}
+            ),
         )
-        fields = {f: data[f] for f in _Carry._fields}
+        if store_meta:
+            from ..store.tiered import TieredStore
+
+            rs._store.close()  # replaced by the checkpointed tier
+            rs._store = TieredStore.from_checkpoint(
+                1 << log2, store_meta,
+                data["spill_fps"], data["spill_parents"],
+            )
+            rs._q_compacted = bool(meta.get("q_compacted", False))
+        # Pre-tiered checkpoints lack the suspect-buffer/summary fields;
+        # default them to this engine's (empty) shapes.
+        defaults = {
+            "hot_claims": np.int32((np.asarray(data["t_lo"]) != 0).sum()),
+            "s_states": np.zeros((rs._SQ, model.lanes), np.uint32),
+            "s_lo": np.zeros(rs._SQ, np.uint32),
+            "s_hi": np.zeros(rs._SQ, np.uint32),
+            "s_ebits": np.zeros(rs._SQ, np.uint32),
+            "s_depth": np.zeros(rs._SQ, np.uint32),
+            "s_tail": np.int32(0),
+            "summary": np.zeros(1, np.uint32),
+        }
+        fields = {
+            f: data[f] if f in data else defaults[f] for f in _Carry._fields
+        }
+        # The suspect buffer is sized by batch_size x max_actions: a resume
+        # with a different batch size renormalizes it like the queue below
+        # (live rows [0, s_tail) are preserved; shrinking past them is
+        # refused).
+        if store_meta:
+            s_tail_live = int(fields["s_tail"])
+            if s_tail_live > rs._SQ - rs.batch_size * model.max_actions:
+                raise ValueError(
+                    "batch_size too small for the checkpointed suspect "
+                    f"buffer ({s_tail_live} live suspects); resume with the "
+                    "original batch_size"
+                )
+            for f in ("s_states", "s_lo", "s_hi", "s_ebits", "s_depth"):
+                old = fields[f]
+                if old.shape[0] != rs._SQ:
+                    grown = np.zeros(
+                        (rs._SQ,) + old.shape[1:], dtype=old.dtype
+                    )
+                    keep = min(old.shape[0], rs._SQ)
+                    grown[:keep] = old[:keep]
+                    fields[f] = grown
+            # The summary is a pure function of the spilled set — always
+            # use the freshly rebuilt words (also covers regrown tables).
+            fields["summary"] = rs._store.summary_np
         # Pre-abort-code checkpoints stored overflow as a bool; the carry
         # now holds a uint32 abort bitmask. Clear it on resume: a chunked
         # checkpoint sits at a sound boundary (code 0) already, but a
@@ -1037,6 +1430,11 @@ class ResidentSearch:
                     model, fields, meta["table_log2"], log2, rs.batch_size,
                     queue_rows=rs._Q,
                 )
+            )
+            # Bucket residency changed wholesale; recount occupied slots
+            # (the spilled set is untouched by a regrow).
+            fields["hot_claims"] = np.int32(
+                (np.asarray(fields["t_lo"]) != 0).sum()
             )
         # Normalize queue arrays to this search's capacity (covers
         # checkpoints from the pre-slack format, changed batch sizes, and
@@ -1086,6 +1484,11 @@ class ResidentSearch:
             keys = pack_fp(t_lo[nz], t_hi[nz])
             parents = pack_fp(p_lo[nz], p_hi[nz])
             self._parent_map = dict(zip(keys.tolist(), parents.tolist()))
+            if self._store is not None:
+                # Spill entries win on keys present in both tiers: they
+                # carry the ORIGINAL (BFS-discovery) parent, which keeps
+                # reconstructed chains acyclic.
+                self._parent_map.update(self._store.parent_map())
         return self._parent_map
 
     def reconstruct_path(self, fp: int):

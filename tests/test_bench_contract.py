@@ -54,3 +54,30 @@ def test_no_baseline_still_reports_device_value():
     metric, value, vs_baseline = bench.headline_summary(dev, {})
     assert value == 5.0
     assert vs_baseline is None
+
+
+def test_device_detail_pins_tier_occupancy_keys():
+    # The tiered store's per-tier counters are part of the artifact
+    # contract: a tiered run's degradation must be observable in every
+    # BENCH_r*.json (hot-tier fill, spilled-state count, spill events).
+    for key in ("hot_fill", "spilled_states", "spill_events"):
+        assert key in bench.DEVICE_DETAIL_FIELDS
+    row = bench.device_detail(
+        {
+            "states_per_sec": 1000.0,
+            "sec": 2.0,
+            "hot_fill": 0.51,
+            "spilled_states": 636,
+            "spill_events": 3,
+            "compile_sec": 9.0,  # not a detail field: must not leak
+        }
+    )
+    assert row["hot_fill"] == 0.51
+    assert row["spilled_states"] == 636
+    assert row["spill_events"] == 3
+    assert "compile_sec" not in row
+
+
+def test_device_detail_omits_tier_keys_for_device_store_runs():
+    row = bench.device_detail({"states_per_sec": 1000.0, "sec": 2.0})
+    assert "hot_fill" not in row and "spilled_states" not in row

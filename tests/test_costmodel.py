@@ -101,3 +101,62 @@ def test_bytes_per_state_and_hbm_frac():
 def test_cpu_spec_exists_for_rehearsal_reporting():
     sc = cm.step_cost(**ANCHOR, variant="split", device=cm.CPU1)
     assert sc.total_ms > 0
+
+
+# -- tiered-store spill term ---------------------------------------------------
+
+
+def test_r4_anchor_reproduces_within_1pct():
+    # Regression pin for the spill-term addition: the calibrated model must
+    # keep reproducing the round-4 silicon anchor within 1% — any change to
+    # the shared terms that drifts the anchor shows up here, not on tunnel
+    # day.
+    sc = cm.step_cost(**ANCHOR, variant="split", append="dus")
+    assert abs(sc.total_ms - ANCHOR_MS) / ANCHOR_MS < 0.01, sc.total_ms
+
+
+def test_spill_none_is_byte_and_ms_identical():
+    base = cm.step_cost(**ANCHOR, variant="split")
+    off = cm.step_cost(**ANCHOR, variant="split", spill=None)
+    assert base == off
+
+
+def test_spill_term_adds_probe_and_eviction_ops():
+    sc = cm.step_cost(
+        **ANCHOR, variant="split",
+        spill={"summary_hashes": 4, "evict_per_step": 500.0},
+    )
+    names = [o.name for o in sc.ops]
+    assert "spill_probe" in names and "spill_evict" in names
+    base = cm.step_cost(**ANCHOR, variant="split")
+    assert sc.total_ms > base.total_ms
+    assert sc.total_bytes > base.total_bytes
+    # Probe cost scales with the hash count; eviction with the evict rate.
+    k8 = cm.step_cost(
+        **ANCHOR, variant="split", spill={"summary_hashes": 8}
+    )
+    k4 = cm.step_cost(
+        **ANCHOR, variant="split", spill={"summary_hashes": 4}
+    )
+    probe = lambda s: next(o for o in s.ops if o.name == "spill_probe")
+    assert probe(k8).bytes == 2 * probe(k4).bytes
+    heavier = cm.step_cost(
+        **ANCHOR, variant="split", spill={"evict_per_step": 1000.0}
+    )
+    lighter = cm.step_cost(
+        **ANCHOR, variant="split", spill={"evict_per_step": 100.0}
+    )
+    assert heavier.total_ms > lighter.total_ms
+
+
+def test_spill_term_composes_with_ranking():
+    r = cm.predict_ranking(
+        **ANCHOR, new_frac=0.35, spill={"summary_hashes": 4}
+    )
+    plain = cm.predict_ranking(**ANCHOR, new_frac=0.35)
+    assert {x["variant"] for x in r} == set(cm.INSERT_VARIANTS)
+    for with_spill, without in zip(
+        sorted(r, key=lambda x: x["variant"]),
+        sorted(plain, key=lambda x: x["variant"]),
+    ):
+        assert with_spill["total_ms"] > without["total_ms"]

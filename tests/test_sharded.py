@@ -1,7 +1,14 @@
 """Multi-chip sharded-search tests on the virtual 8-device CPU mesh
 (conftest sets xla_force_host_platform_device_count=8): count parity against
 the reference goldens and the single-chip engines, discovery parity, path
-reconstruction across table shards, and early-exit policies."""
+reconstruction across table shards, and early-exit policies.
+
+Marker budget: the tier-1 run is wall-clock-bounded, so the long-running
+golden configs (2pc-5/2pc-7 at scale, refine_check end-to-end, the
+multi-mesh and checkpoint round-trips — each 10-80s on the virtual mesh)
+carry @pytest.mark.slow; fast representatives of every behavior (2pc-3
+golden, path reconstruction, chunked-vs-single parity, suspend/resume,
+overflow detection, early exits) stay in tier-1."""
 
 import numpy as np
 import pytest
@@ -30,6 +37,7 @@ def test_2pc3_golden_on_8_chips():
     assert r.complete
 
 
+@pytest.mark.slow
 def test_2pc5_golden_on_8_chips():
     # ref golden: 8,832 unique states (examples/2pc.rs:158-159).
     r = ShardedSearch(
@@ -38,6 +46,7 @@ def test_2pc5_golden_on_8_chips():
     assert r.unique_state_count == 8832
 
 
+@pytest.mark.slow
 def test_mesh_size_independence():
     # The same search on 2, 4, and 8 chips produces identical totals — the
     # shard layout must not be observable in results.
@@ -134,6 +143,7 @@ def test_sharded_chunked_matches_single_dispatch():
     assert chunked.discoveries == full.discoveries
 
 
+@pytest.mark.slow
 def test_sharded_suspend_resume_and_progress():
     full = ShardedSearch(
         TensorTwoPhaseSys(4), mesh=make_mesh(4), batch_size=128, table_log2=13
@@ -152,6 +162,7 @@ def test_sharded_suspend_resume_and_progress():
     assert seen and seen[-1] == full.state_count
 
 
+@pytest.mark.slow
 def test_sharded_kill_and_resume_reproduces_exact_counts(tmp_path):
     full = ShardedSearch(
         TensorTwoPhaseSys(4), mesh=make_mesh(4), batch_size=128, table_log2=13
@@ -177,6 +188,7 @@ def test_sharded_kill_and_resume_reproduces_exact_counts(tmp_path):
     assert path.last_state() is not None
 
 
+@pytest.mark.slow
 def test_sharded_overflow_checkpoints_then_regrows(tmp_path):
     full = ShardedSearch(
         TensorTwoPhaseSys(5), mesh=make_mesh(4), batch_size=128, table_log2=14
@@ -214,6 +226,7 @@ def test_sharded_chip_count_mismatch_rejected(tmp_path):
         )
 
 
+@pytest.mark.slow
 def test_refine_check_over_sharded_engine():
     """Incremental closure refinement driven by the MULTI-CHIP engine: gaps
     surface from every shard's queue and the final run is poison-free."""
@@ -242,6 +255,7 @@ def test_refine_check_over_sharded_engine():
     assert r.state_count == host.state_count()
 
 
+@pytest.mark.slow
 def test_sharded_append_variants_identical_results():
     # The mesh-platform default picks scatter on CPU meshes; pin the DUS
     # variant explicitly so its slack/guard path (queue rows = S + N*C,
@@ -266,6 +280,7 @@ def test_sharded_append_variants_identical_results():
     assert a.complete and b.complete
 
 
+@pytest.mark.slow
 def test_sharded_lowered_paxos2_golden():
     """VERDICT r4 next #9: the multichip engine on a LOWERED actor model with
     a consistency tester — proves history/ebits lanes route correctly across

@@ -1,0 +1,258 @@
+"""Tiered state store (stateright_tpu/store/): device-resident hot set +
+host spill tier behind the engines' insert/probe path.
+
+The contract under test is graceful degradation at exact golden parity: a
+search whose unique-state count exceeds the configured device table must
+COMPLETE (spilling cold buckets to the host tier, filtering re-probes
+through the device Bloom summary) with the same generated/unique counts and
+discoveries as an amply-sized run — on the host-orchestrated engine, the
+resident engine, and the 8-device virtual-mesh sharded engine — plus a
+checkpoint→resume round-trip taken while states are actually spilled.
+
+Eviction safety rides on one invariant pinned here directly: a bucket that
+ever overflowed a key to its neighbor is full at that moment and is never
+evicted, so the insert kernel's probe-chain membership argument survives
+partial eviction (store/tiered.py module docstring).
+"""
+
+import numpy as np
+import pytest
+
+from stateright_tpu.store import (
+    HostSpillStore,
+    TieredConfig,
+    TieredStore,
+    host_insert,
+    maybe_contains,
+    summary_words,
+)
+from stateright_tpu.tensor import FrontierSearch
+from stateright_tpu.tensor.models import TensorTwoPhaseSys
+
+# 2pc goldens (generated, unique) — reference examples/2pc.rs:153-159 and
+# the repo-wide baseline oracle.
+GOLD_2PC3 = (1_146, 288)
+GOLD_2PC4 = (8_258, 1_568)
+
+
+# -- store units ---------------------------------------------------------------
+
+
+def test_summary_no_false_negatives_and_low_fp_rate():
+    rng = np.random.default_rng(7)
+    lo = rng.integers(1, 2**32, 4000, dtype=np.uint32)
+    hi = rng.integers(0, 2**32, 4000, dtype=np.uint32)
+    bits = np.zeros(summary_words(16), np.uint32)
+    host_insert(bits, lo, hi, 16)
+    assert maybe_contains(bits, lo, hi, 16).all()  # Bloom: proof of absence
+    other_lo = rng.integers(1, 2**32, 4000, dtype=np.uint32)
+    other_hi = rng.integers(0, 2**32, 4000, dtype=np.uint32)
+    assert maybe_contains(bits, other_lo, other_hi, 16).mean() < 0.05
+
+
+def test_host_spill_store_dedup_keeps_first_parent():
+    s = HostSpillStore(background=False)
+    s.append(np.array([5, 7], np.uint64), np.array([1, 2], np.uint64))
+    s.append(np.array([7, 9], np.uint64), np.array([99, 3], np.uint64))
+    assert s.contains(np.array([5, 7, 9, 11], np.uint64)).tolist() == [
+        True, True, True, False,
+    ]
+    # First writer wins: a re-spilled key keeps its ORIGINAL parent (the
+    # BFS-discovery one), which is what keeps reconstructed paths acyclic.
+    assert s.parent_map()[7] == 2
+    assert len(s) == 3
+
+
+def test_eviction_never_touches_full_buckets():
+    # 512-slot table = 4 buckets of 128. Bucket 0 full (it may anchor probe
+    # chains), bucket 1 partial, bucket 2 empty, bucket 3 partial.
+    ts = TieredStore(
+        512, TieredConfig(high_water=0.5, summary_log2=10), background=False
+    )
+    t_lo = np.zeros(512, np.uint32)
+    t_hi = np.zeros(512, np.uint32)
+    p_lo = np.zeros(512, np.uint32)
+    p_hi = np.zeros(512, np.uint32)
+    t_lo[0:128] = np.arange(1, 129)
+    t_lo[128:178] = np.arange(1, 51)
+    t_hi[128:178] = 8
+    t_lo[384:394] = np.arange(1, 11)
+    t_hi[384:394] = 9
+    freed = ts.evict_host(t_lo, t_hi, p_lo, p_hi, hot_claims=188)
+    assert freed == 60
+    assert (t_lo[0:128] != 0).all()  # full bucket pinned
+    assert (t_lo[128:384] == 0).all()  # non-full buckets emptied
+    # Membership moved to the spill tier, visible to the summary + store.
+    dup = ts.resolve_suspects(
+        np.arange(1, 51, dtype=np.uint32), np.full(50, 8, np.uint32)
+    )
+    assert dup.all()
+
+
+def test_tiered_config_validation():
+    with pytest.raises(ValueError):
+        TieredConfig(high_water=1.5).validate()
+    with pytest.raises(ValueError):
+        TieredConfig(high_water=0.5, low_water=0.6).validate()
+    with pytest.raises(ValueError):
+        FrontierSearch(
+            TensorTwoPhaseSys(3), 64, 12, store="bogus"  # noqa
+        )
+
+
+# -- engines: spill mid-search, finish at golden parity ------------------------
+
+
+def test_frontier_tiered_spills_and_hits_2pc3_golden():
+    # 2^9 = 512 table slots < 288 uniques * safety margin at a 0.5 water
+    # mark — the run MUST spill to finish.
+    fs = FrontierSearch(
+        TensorTwoPhaseSys(3), 16, 9,
+        store="tiered", high_water=0.5, summary_log2=12,
+    )
+    r = fs.run()
+    assert (r.state_count, r.unique_state_count) == GOLD_2PC3
+    assert set(r.discoveries) == {"abort agreement", "commit agreement"}
+    assert r.complete
+    assert r.detail["store"] == "tiered"
+    assert r.detail["spill_events"] >= 1 and r.detail["spilled_states"] > 0
+    # Path reconstruction must cross tiers (spilled parents included).
+    assert fs.reconstruct_path(
+        r.discoveries["commit agreement"]
+    ).last_state() is not None
+
+
+def test_frontier_tiered_checkpoint_resume_while_spilled(tmp_path):
+    fs = FrontierSearch(
+        TensorTwoPhaseSys(4), 32, 11,
+        store="tiered", high_water=0.6, summary_log2=14,
+    )
+    r = None
+    for _ in range(100):  # advance until states are actually spilled
+        r = fs.run(max_steps=10)
+        if fs.store_stats()["spill_events"] >= 1 or r.complete:
+            break
+    assert not r.complete and fs.store_stats()["spill_events"] >= 1
+    ckpt = str(tmp_path / "spilled.npz")
+    fs.checkpoint(ckpt)
+    del fs
+
+    resumed = FrontierSearch.load_checkpoint(
+        TensorTwoPhaseSys(4), ckpt, batch_size=32
+    )
+    rr = resumed.run()
+    assert (rr.state_count, rr.unique_state_count) == GOLD_2PC4
+    assert resumed.reconstruct_path(
+        rr.discoveries["commit agreement"]
+    ).last_state() is not None
+
+
+def test_resident_tiered_spills_and_hits_2pc4_golden():
+    from stateright_tpu.tensor.resident import ResidentSearch
+
+    rs = ResidentSearch(
+        TensorTwoPhaseSys(4), 32, 11,
+        store="tiered", high_water=0.6, summary_log2=14,
+    )
+    r = rs.run()
+    assert (r.state_count, r.unique_state_count) == GOLD_2PC4
+    assert r.complete
+    assert r.detail["spill_events"] >= 1 and r.detail["spilled_states"] > 0
+    assert set(r.discoveries) == {"abort agreement", "commit agreement"}
+    assert rs.reconstruct_path(
+        r.discoveries["commit agreement"]
+    ).last_state() is not None
+
+
+def test_resident_tiered_checkpoint_resume_and_regrow(tmp_path):
+    from stateright_tpu.tensor.resident import ResidentSearch
+
+    rs = ResidentSearch(
+        TensorTwoPhaseSys(4), 32, 11,
+        store="tiered", high_water=0.6, summary_log2=14,
+    )
+    r = None
+    for i in range(100):
+        r = rs.run(max_steps=10 * (i + 1), budget=5)
+        if rs._store.spill_events >= 1 or r.complete:
+            break
+    assert not r.complete and rs._store.spill_events >= 1
+    ckpt = str(tmp_path / "res_spilled.npz")
+    rs.checkpoint(ckpt)
+    del rs
+
+    resumed = ResidentSearch.load_checkpoint(TensorTwoPhaseSys(4), ckpt)
+    rr = resumed.run()
+    assert (rr.state_count, rr.unique_state_count) == GOLD_2PC4
+    assert resumed.reconstruct_path(
+        rr.discoveries["commit agreement"]
+    ).last_state() is not None
+
+    # Regrown resume: the spilled tier survives a table regrow.
+    grown = ResidentSearch.load_checkpoint(
+        TensorTwoPhaseSys(4), ckpt, table_log2=14
+    )
+    rg = grown.run()
+    assert (rg.state_count, rg.unique_state_count) == GOLD_2PC4
+
+
+def test_sharded_tiered_spills_and_hits_golden_on_8_chips():
+    from stateright_tpu.parallel import ShardedSearch, make_mesh
+
+    ss = ShardedSearch(
+        TensorTwoPhaseSys(4), mesh=make_mesh(8), batch_size=4,
+        table_log2=9, dest_capacity=32,
+        store="tiered", high_water=0.3, summary_log2=12,
+    )
+    r = ss.run()
+    assert (r.state_count, r.unique_state_count) == GOLD_2PC4
+    assert r.complete
+    assert r.detail["spill_events"] >= 1 and r.detail["spilled_states"] > 0
+    assert len(r.detail["per_shard_spilled"]) == 8
+    assert ss.reconstruct_path(
+        r.discoveries["commit agreement"]
+    ).last_state() is not None
+
+
+# -- surface: spawn_tpu + Explorer ---------------------------------------------
+
+
+def test_spawn_tpu_tiered_and_status_view_report_tiers():
+    from stateright_tpu.explorer.server import status_view
+
+    checker = (
+        TensorTwoPhaseSys(4)
+        .checker()
+        .spawn_tpu(
+            batch_size=32, table_log2=11,
+            store="tiered", high_water=0.6, summary_log2=14,
+        )
+        .join()
+    )
+    assert (checker.state_count(), checker.unique_state_count()) == GOLD_2PC4
+    stats = checker.store_stats()
+    assert stats["store"] == "tiered"
+    for key in ("hot_fill", "spilled_states", "spill_events"):
+        assert key in stats
+    view = status_view(checker)
+    assert view["store"] == stats  # /.status surfaces the same counters
+
+    # Single-tier checkers report None, not a missing key.
+    from stateright_tpu import Model, Property
+
+    class Tiny(Model):
+        def init_states(self):
+            return [0]
+
+        def actions(self, s, acts):
+            if s < 3:
+                acts.append("t")
+
+        def next_state(self, s, a):
+            return s + 1
+
+        def properties(self):
+            return [Property.always("ok", lambda m, s: True)]
+
+    bfs = Tiny().checker().spawn_bfs().join()
+    assert status_view(bfs)["store"] is None
