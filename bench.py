@@ -520,6 +520,80 @@ def _attach_store_stats(out: dict, search) -> None:
         log(f"store-stats annotation failed: {e}")
 
 
+def device_search_service(n_jobs: int = 8):
+    """BENCH_SERVICE=1 row: throughput of a mixed job batch through the
+    multi-job check service (one shared device table, continuous batching)
+    vs the SAME jobs run serially on fresh standalone engines — the
+    serving-layer A/B. Composition: 3x 2pc-3, 3x 2pc-4, 2x inclock-6.
+    Returns (result dict, parity error or None); parity = every service
+    job's counts equal its serial twin's."""
+    _pin_platform()
+    from stateright_tpu.service import CheckService
+    from stateright_tpu.tensor.frontier import FrontierSearch
+    from stateright_tpu.tensor.models import (
+        TensorIncrementLock,
+        TensorTwoPhaseSys,
+    )
+
+    m3, m4, mi = (
+        TensorTwoPhaseSys(3), TensorTwoPhaseSys(4), TensorIncrementLock(6)
+    )
+    jobs = ([m3] * 3 + [m4] * 3 + [mi] * 2)[:n_jobs]
+
+    # Serial reference: a fresh standalone engine per job — the deployment
+    # story the service replaces (each engine compiles its own step and
+    # owns the whole table for its run).
+    t0 = time.monotonic()
+    serial = []
+    serial_steps = 0
+    for m in jobs:
+        fs = FrontierSearch(m, batch_size=1024, table_log2=16)
+        r = fs.run()
+        serial.append(r)
+        serial_steps += r.steps
+    serial_sec = time.monotonic() - t0
+
+    t0 = time.monotonic()
+    svc = CheckService(batch_size=1024, table_log2=18, background=False)
+    handles = [svc.submit(m) for m in jobs]
+    svc.drain()
+    service_sec = time.monotonic() - t0
+    results = [h.result() for h in handles]
+    service_steps = svc.stats()["device_steps"]
+    svc.close()
+
+    err = None
+    for i, (r, s) in enumerate(zip(results, serial)):
+        got = (r.state_count, r.unique_state_count, r.max_depth)
+        want = (s.state_count, s.unique_state_count, s.max_depth)
+        # Full items comparison: the discovery FINGERPRINTS must survive
+        # the salting round-trip bit-identically, not just the names.
+        if got != want or sorted(r.discoveries.items()) != sorted(
+            s.discoveries.items()
+        ):
+            err = (
+                f"service parity failure on job {i}: {got} / "
+                f"{sorted(r.discoveries.items())} != serial {want} / "
+                f"{sorted(s.discoveries.items())}"
+            )
+            break
+    states = sum(r.state_count for r in results)
+    out = {
+        "states": states,
+        "unique": sum(r.unique_state_count for r in results),
+        "sec": round(service_sec, 4),
+        "states_per_sec": states / max(service_sec, 1e-9),
+        "compile_sec": 0.0,  # compiles are inside both wall clocks (A/B fair)
+        "n_jobs": len(jobs),
+        "jobs_per_sec": round(len(jobs) / max(service_sec, 1e-9), 4),
+        "serial_sec": round(serial_sec, 4),
+        "vs_serial": round(serial_sec / max(service_sec, 1e-9), 3),
+        "service_steps": service_steps,
+        "serial_steps": serial_steps,
+    }
+    return out, err
+
+
 def device_search_sharded(model_name: str, n: int, n_chips: int = 8):
     """Run the multi-chip sharded engine over a mesh of `n_chips` (virtual
     CPU devices when real multi-chip hardware is absent — the bench marks
@@ -570,6 +644,10 @@ DEVICE_DETAIL_FIELDS = (
     "virtual_mesh", "n_chips", "per_chip_unique",
     "closure_sec", "bytes_per_state", "cpu_bytes_per_state", "hbm_frac",
     "hot_fill", "spilled_states", "spill_events",
+    # Check-service row (BENCH_SERVICE=1): mixed-job-batch throughput and
+    # the serial A/B ratio (>1 = continuous batching beats serial runs).
+    "n_jobs", "jobs_per_sec", "vs_serial", "serial_sec",
+    "service_steps", "serial_steps",
 )
 
 
@@ -759,6 +837,11 @@ def main(argv: list | None = None) -> int:
                 ("2pc", 10, 3000.0, "--worker", None),
             )
         )
+        # BENCH_SERVICE=1: add the check-service mixed-job row (8 jobs
+        # through one shared table vs the same jobs serially; the ratio
+        # lands in detail.device["service-mixed-8"].vs_serial).
+        if os.environ.get("BENCH_SERVICE") == "1" and not smoke:
+            workloads += (("service-mixed", 8, 2400.0, "--worker-service", None),)
         for model, n, wl_timeout, mode, env_extra in workloads:
             key = f"{model}-{n}" + (
                 "-sharded8" if mode == "--worker-sharded" else ""
@@ -821,12 +904,16 @@ def main(argv: list | None = None) -> int:
     return 1 if errors else 0
 
 
-def worker_main(model_name: str, n: int, sharded: bool = False) -> int:
-    """`bench.py --worker[-sharded] MODEL N`: run one device workload, print
-    one JSON line {"result": ..., "error": ...} on stdout."""
+def worker_main(model_name: str, n: int, mode: str = "--worker") -> int:
+    """`bench.py --worker[-sharded|-service] MODEL N`: run one device
+    workload, print one JSON line {"result": ..., "error": ...} on stdout."""
     try:
-        fn = device_search_sharded if sharded else device_search
-        r, perr = fn(model_name, n)
+        if mode == "--worker-service":
+            r, perr = device_search_service(n)
+        elif mode == "--worker-sharded":
+            r, perr = device_search_sharded(model_name, n)
+        else:
+            r, perr = device_search(model_name, n)
         print(json.dumps({"result": r, "error": perr}), flush=True)
         return 0
     except Exception:  # noqa: BLE001
@@ -837,14 +924,10 @@ def worker_main(model_name: str, n: int, sharded: bool = False) -> int:
 
 
 if __name__ == "__main__":
-    if len(sys.argv) == 4 and sys.argv[1] in ("--worker", "--worker-sharded"):
-        sys.exit(
-            worker_main(
-                sys.argv[2],
-                int(sys.argv[3]),
-                sharded=sys.argv[1] == "--worker-sharded",
-            )
-        )
+    if len(sys.argv) == 4 and sys.argv[1] in (
+        "--worker", "--worker-sharded", "--worker-service"
+    ):
+        sys.exit(worker_main(sys.argv[2], int(sys.argv[3]), mode=sys.argv[1]))
     try:
         sys.exit(main())
     except Exception:  # noqa: BLE001 — the one-JSON-line contract is absolute

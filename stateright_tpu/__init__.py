@@ -30,6 +30,16 @@ from .checker.base import Checker
 
 __version__ = "0.1.0"
 
+
+def __getattr__(name):
+    # Lazy: the check service pulls in the tensor/jax stack, which
+    # host-only users (pure Model checking) should not pay for at import.
+    if name in ("CheckService", "JobHandle", "ServiceChecker"):
+        from . import service
+
+        return getattr(service, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 __all__ = [
     "Model",
     "Property",

@@ -118,3 +118,30 @@ class CheckerBuilder:
                 "the TPU frontier checker has not landed yet in this build"
             ) from e
         return TpuChecker(self, **kwargs)
+
+    def spawn_service(self, service, priority: int = 0):
+        """Submit this check as a JOB on a shared `CheckService` (the
+        continuous-batching multi-job scheduler, stateright_tpu/service/)
+        and return the same `Checker` handle surface `spawn_tpu` gives —
+        except the device state tables are shared with every other job the
+        service is running. Builder config (finish_when, targets, timeout)
+        maps onto the job options; visitors/symmetry_fn are unsupported,
+        as on spawn_tpu."""
+        if self.visitor_ is not None:
+            raise NotImplementedError(
+                "visitors are not supported on service jobs; use spawn_tpu"
+            )
+        if self.symmetry_fn_ is not None:
+            raise NotImplementedError(
+                "symmetry_fn is host-level; device symmetry is the "
+                "TensorModel.representative kernel (see spawn_tpu)"
+            )
+        handle = service.submit(
+            self.model,
+            finish_when=self.finish_when_,
+            target_state_count=self.target_state_count_,
+            target_max_depth=self.target_max_depth_,
+            timeout=self.timeout_,
+            priority=priority,
+        )
+        return handle.as_checker()

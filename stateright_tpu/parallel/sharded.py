@@ -87,9 +87,25 @@ from ..tensor.resident import (
     ABORT_QUEUE,
     ABORT_TABLE,
     EXIT_SERVICE,
+    _compact_queue,
     _finish_masks,
+    _inject_rows,
     _resolve_chunking,
 )
+
+# Per-shard service kernels: the single-device queue compaction / suspect
+# injection, vmapped over the shard axis so one dispatch services every
+# shard without gathering the [N, Q, L] queues to host (see _service).
+_compact_queue_sharded = None
+_inject_rows_sharded = None
+
+
+def _service_kernels():
+    global _compact_queue_sharded, _inject_rows_sharded
+    if _compact_queue_sharded is None:
+        _compact_queue_sharded = jax.jit(jax.vmap(_compact_queue))
+        _inject_rows_sharded = jax.jit(jax.vmap(_inject_rows))
+    return _compact_queue_sharded, _inject_rows_sharded
 
 # Sharded-only abort bit (on top of the resident engine's codes): the
 # all-to-all send buffer's per-destination capacity overflowed — wants a
@@ -1077,78 +1093,147 @@ class ShardedSearch:
         )
 
     def _service(self) -> None:
-        """Host half of the tiered store for the sharded engine: gather the
-        per-shard carry (the same full round-trip a checkpoint pays —
-        service events are water-mark-rare), then per shard: compact the
-        queue, drain the suspect buffer against that shard's RANK-LOCAL
-        spill store, evict past-high-water buckets, and push the carry
-        back sharded. Single-process meshes only (enforced in __init__)."""
+        """Host half of the tiered store for the sharded engine, with
+        WINDOWED per-shard transfers (like the single-device path) instead
+        of the full-carry gather it used to pay per event:
+
+        - queue compaction runs ON DEVICE (the single-device compaction
+          kernel vmapped over the shard axis) — the [N, Q, L] queues never
+          cross to host;
+        - only each shard's LIVE suspect rows ([s_tail] slices) transfer
+          for exact resolution, and confirmed-new rows are injected back
+          with the vmapped device-side injection kernel;
+        - eviction uses `TieredStore.evict` on per-shard table slices —
+          per-bucket counts + evictable-bucket gathers (the device-side
+          pre-filter), not whole tables.
+
+        ROUND8_NOTES.md records the measured delta. Single-process meshes
+        only (enforced in __init__)."""
         c = self._carry
-        f = {k: np.array(v) for k, v in zip(c._fields, _host(c))}
         N = self.n_chips
         S = 1 << self.table_log2
-        for i in range(N):
-            head, tail = int(f["head"][i]), int(f["tail"][i])
-            if head > 0:
-                live = tail - head
-                for k in ("q_states", "q_lo", "q_hi", "q_ebits", "q_depth"):
-                    f[k][i][:live] = f[k][i][head:tail].copy()
-                tail = live
-                f["head"][i] = 0
-            if tail > S:
-                f["tail"][i] = tail
-                self._carry = self._put_carry(f)
-                raise RuntimeError(
-                    f"sharded tiered store: shard {i}'s live frontier "
-                    f"({tail} rows) exceeds the compacted queue — raise "
-                    "table_log2 (the per-shard queue is table-sized)"
-                )
-            s_tail = int(f["s_tail"][i])
-            if s_tail > 0:
-                sus_lo = f["s_lo"][i][:s_tail]
-                sus_hi = f["s_hi"][i][:s_tail]
+        SQ = self._SQ
+        L = self.model.lanes
+        compact_v, inject_v = _service_kernels()
+        # Tiny per-shard scalar vectors — the only unconditional transfers.
+        head = np.asarray(c.head).astype(np.int32)
+        tail = np.asarray(c.tail).astype(np.int32).copy()
+        s_tail = np.asarray(c.s_tail)
+        hot = np.asarray(c.hot_claims).astype(np.int32).copy()
+        unique = np.asarray(c.unique_count).astype(np.int32).copy()
+
+        q = (c.q_states, c.q_lo, c.q_hi, c.q_ebits, c.q_depth)
+        if (head > 0).any():
+            q = compact_v(*q, jnp.asarray(head))
+            tail = tail - head
+            head = np.zeros_like(head)
+            self._q_compacted = True
+        if (tail > S).any():
+            i = int(np.argmax(tail > S))
+            self._carry = self._replace_carry(
+                c, q, head, tail, s_tail, hot, unique, None, None
+            )
+            raise RuntimeError(
+                f"sharded tiered store: shard {i}'s live frontier "
+                f"({int(tail[i])} rows) exceeds the compacted queue — raise "
+                "table_log2 (the per-shard queue is table-sized)"
+            )
+
+        # Suspect resolution: transfer only the live rows of shards that
+        # actually buffered suspects.
+        n_confs = np.zeros(N, dtype=np.int32)
+        if s_tail.any():
+            blk_states = np.zeros((N, SQ, L), dtype=np.uint32)
+            blk = {
+                k: np.zeros((N, SQ), dtype=np.uint32)
+                for k in ("lo", "hi", "eb", "dp")
+            }
+            for i in range(N):
+                st_i = int(s_tail[i])
+                if st_i == 0:
+                    continue
+                sus_lo = np.asarray(c.s_lo[i, :st_i])
+                sus_hi = np.asarray(c.s_hi[i, :st_i])
                 dup = self._stores[i].resolve_suspects(sus_lo, sus_hi)
                 keep = ~dup
                 n_conf = int(keep.sum())
                 if n_conf:
-                    sl = slice(tail, tail + n_conf)
-                    f["q_states"][i][sl] = f["s_states"][i][:s_tail][keep]
-                    f["q_lo"][i][sl] = sus_lo[keep]
-                    f["q_hi"][i][sl] = sus_hi[keep]
-                    f["q_ebits"][i][sl] = f["s_ebits"][i][:s_tail][keep]
-                    f["q_depth"][i][sl] = f["s_depth"][i][:s_tail][keep]
-                    tail += n_conf
-                    f["unique_count"][i] += n_conf
-                f["s_tail"][i] = 0
-            f["tail"][i] = tail
-            hot = int(f["hot_claims"][i])
-            if hot >= self._spill_trigger:
-                freed = self._stores[i].evict_host(
-                    f["t_lo"][i], f["t_hi"][i],
-                    f["p_lo"][i], f["p_hi"][i], hot,
+                    blk_states[i, :n_conf] = np.asarray(
+                        c.s_states[i, :st_i]
+                    )[keep]
+                    blk["lo"][i, :n_conf] = sus_lo[keep]
+                    blk["hi"][i, :n_conf] = sus_hi[keep]
+                    blk["eb"][i, :n_conf] = np.asarray(
+                        c.s_ebits[i, :st_i]
+                    )[keep]
+                    blk["dp"][i, :n_conf] = np.asarray(
+                        c.s_depth[i, :st_i]
+                    )[keep]
+                    n_confs[i] = n_conf
+            if n_confs.any():
+                q = inject_v(
+                    *q, jnp.asarray(tail),
+                    jnp.asarray(blk_states), jnp.asarray(blk["lo"]),
+                    jnp.asarray(blk["hi"]), jnp.asarray(blk["eb"]),
+                    jnp.asarray(blk["dp"]),
                 )
-                if freed == 0:
-                    raise RuntimeError(
-                        f"sharded tiered store: shard {i} could not free "
-                        "any bucket (every bucket full and pinned); raise "
-                        "table_log2 or lower high_water"
-                    )
-                f["hot_claims"][i] = hot - freed
-            f["summary"][i] = self._stores[i].summary_np
-            f["overflow"][i] = 0
-        self._q_compacted = True
-        self._carry = self._put_carry(f)
+                tail = tail + n_confs
+                unique = unique + n_confs
 
-    def _put_carry(self, fields: dict) -> "_Carry":
+        # Eviction: windowed device-slice transfers per over-water shard.
+        tables = None
+        if (hot >= self._spill_trigger).any():
+            parts = {k: [] for k in ("t_lo", "t_hi", "p_lo", "p_hi")}
+            for i in range(N):
+                tl, th = c.t_lo[i], c.t_hi[i]
+                pl, ph = c.p_lo[i], c.p_hi[i]
+                if hot[i] >= self._spill_trigger:
+                    tl, th, pl, ph, n_ev = self._stores[i].evict(
+                        tl, th, pl, ph, int(hot[i])
+                    )
+                    if n_ev == 0:
+                        raise RuntimeError(
+                            f"sharded tiered store: shard {i} could not "
+                            "free any bucket (every bucket full and "
+                            "pinned); raise table_log2 or lower high_water"
+                        )
+                    hot[i] -= n_ev
+                parts["t_lo"].append(tl)
+                parts["t_hi"].append(th)
+                parts["p_lo"].append(pl)
+                parts["p_hi"].append(ph)
+            tables = {k: jnp.stack(v) for k, v in parts.items()}
+
+        summary = np.stack([s.summary_np for s in self._stores])
+        self._carry = self._replace_carry(
+            c, q, head, tail, np.zeros(N, np.int32), hot, unique, tables,
+            summary,
+        )
+
+    def _replace_carry(
+        self, c, q, head, tail, s_tail, hot, unique, tables, summary
+    ) -> "_Carry":
+        """Push serviced fields back with shard placement; untouched leaves
+        keep their existing buffers."""
         from jax.sharding import NamedSharding
 
         sh = NamedSharding(self.mesh, P(self.axis))
-        return _Carry(
-            **{
-                k: jax.device_put(jnp.asarray(v), sh)
-                for k, v in fields.items()
-            }
+        put = lambda x: jax.device_put(jnp.asarray(x), sh)
+        upd = dict(
+            q_states=put(q[0]), q_lo=put(q[1]), q_hi=put(q[2]),
+            q_ebits=put(q[3]), q_depth=put(q[4]),
+            head=put(head.astype(np.int32)),
+            tail=put(tail.astype(np.int32)),
+            s_tail=put(s_tail.astype(np.int32)),
+            hot_claims=put(hot.astype(np.int32)),
+            unique_count=put(unique.astype(np.int32)),
+            overflow=put(np.zeros(self.n_chips, np.uint32)),
         )
+        if tables is not None:
+            upd.update({k: put(v) for k, v in tables.items()})
+        if summary is not None:
+            upd["summary"] = put(summary)
+        return c._replace(**upd)
 
     def reset(self) -> None:
         """Drop any suspended carry so the next `run()` starts fresh."""

@@ -1,0 +1,54 @@
+"""Check service: a continuous-batching multi-job scheduler over shared
+device state tables.
+
+The standalone engines (`spawn_tpu`, FrontierSearch/ResidentSearch) own the
+whole device for one check. This package is the serving layer above them —
+the model-checking twin of continuous-batching inference servers (Orca) and
+of swarm verification: one persistent `CheckService` multiplexes many
+concurrent check jobs onto one device, packing their frontier lanes into
+shared fused steps and admitting/retiring/preempting jobs mid-flight.
+
+Why it is sound to share ONE device hash table (and one tiered spill
+store) across jobs: every key is job-salted (tensor/fingerprint.salt_fp),
+a per-job bijection of the fingerprint space — within-job dedup is
+bit-identical to a standalone run, cross-job collisions are as improbable
+as any two unrelated 64-bit fingerprints, and unsalting (the same
+involution) hands back discovery fingerprints bit-identical to a
+single-job run.
+
+Pieces:
+
+- `queue`     — admission queue + per-job frontier/counters/salt.
+- `scheduler` — the continuous-batching engine: shared table, one fused
+                step per model group, waterfilled round-robin lane grants,
+                FrontierSearch-parity bookkeeping.
+- `api`       — `CheckService.submit(model, ...) -> JobHandle`
+                (poll/result/cancel), preemption + timeouts, and the
+                `Checker`-shaped adapter behind
+                `model.checker().spawn_service(service)`.
+- `server`    — HTTP front end (`serve_service`): POST /jobs, GET
+                /jobs/<id>, cancel, `/.status` with per-job metrics.
+- `metrics`   — per-job queue wait / device steps / lanes held /
+                preemptions / spill share.
+"""
+
+from .api import CheckService, JobHandle, ServiceChecker
+from .metrics import JobMetrics
+from .queue import Job, JobStatus
+from .scheduler import ServiceEngine, ServiceError
+from .server import ModelRegistry, default_registry, serve_service, status_view
+
+__all__ = [
+    "CheckService",
+    "JobHandle",
+    "ServiceChecker",
+    "JobMetrics",
+    "Job",
+    "JobStatus",
+    "ServiceEngine",
+    "ServiceError",
+    "ModelRegistry",
+    "default_registry",
+    "serve_service",
+    "status_view",
+]

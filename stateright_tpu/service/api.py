@@ -1,0 +1,473 @@
+"""Async client API of the check service.
+
+`CheckService` is the persistent front door: `submit(model, ...)` returns a
+`JobHandle` immediately; a scheduler thread packs every admitted job's
+frontier lanes into shared fused device steps (continuous batching — see
+scheduler.py) until each job finishes, is cancelled, or times out. All jobs
+share ONE device hash table via job-salted fingerprints, so a service
+outlives any single check the way an inference server outlives any single
+request.
+
+Scheduling policy:
+
+- admission: jobs wait in a priority queue; at most `max_resident` jobs
+  hold lanes at once (None = unlimited — continuous batching itself is the
+  fairness mechanism then).
+- fairness: per-step lane grants are waterfilled round-robin across a
+  group's runnable jobs, and the grant rotation advances every step.
+- preemption: with `preempt_steps=N`, a job that has consumed N device
+  steps since admission while others wait is parked (its frontier spilled
+  through the checkpoint machinery when `spill_dir` is set) and re-queued
+  behind its priority class; its visited set stays in the shared table, so
+  resumption is exact.
+- cancellation (`JobHandle.cancel()`) drops the job's frontier on the spot;
+  its lanes are free for other jobs at the very next step — no batch drain.
+
+Synchronous use: `CheckService(background=False)` runs no thread; tests and
+scripts drive it deterministically with `pump()` / `drain()`.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from ..core.discovery import HasDiscoveries
+from ..checker.base import Checker
+from .queue import AdmissionQueue, Job, JobStatus
+from .scheduler import ServiceEngine, ServiceError
+
+
+class JobHandle:
+    """Client-side handle to a submitted job (the service analogue of the
+    `Checker` handle a spawn returns)."""
+
+    def __init__(self, service: "CheckService", job: Job):
+        self._service = service
+        self._job = job
+
+    @property
+    def id(self) -> int:
+        return self._job.id
+
+    def status(self) -> str:
+        return self._job.status
+
+    def poll(self) -> dict:
+        return self._service.poll(self._job.id)
+
+    def result(self, wait: bool = True, timeout: Optional[float] = None):
+        """The job's SearchResult. Raises on cancelled/errored jobs; with
+        wait=False returns None while the job is still in flight."""
+        return self._service.result(self._job.id, wait=wait, timeout=timeout)
+
+    def cancel(self) -> bool:
+        return self._service.cancel(self._job.id)
+
+    def discoveries(self) -> dict:
+        """{property name: Path} — reconstructed through the shared table's
+        salted parent chain (scheduler.reconstruct_path)."""
+        return self._service.discovery_paths(self._job.id)
+
+    def metrics(self) -> dict:
+        return self._job.metrics.to_dict(self._job.unique_count)
+
+    def as_checker(self) -> "ServiceChecker":
+        return ServiceChecker(self)
+
+
+class CheckService:
+    def __init__(
+        self,
+        batch_size: int = 1024,
+        table_log2: int = 20,
+        insert_variant: str = "sort",
+        store: str = "device",
+        high_water: float = 0.85,
+        low_water: Optional[float] = None,
+        summary_log2: int = 20,
+        max_resident: Optional[int] = None,
+        preempt_steps: Optional[int] = None,
+        spill_dir: Optional[str] = None,
+        background: bool = True,
+    ):
+        self._engine = ServiceEngine(
+            batch_size=batch_size,
+            table_log2=table_log2,
+            insert_variant=insert_variant,
+            store=store,
+            high_water=high_water,
+            low_water=low_water,
+            summary_log2=summary_log2,
+        )
+        self.max_resident = max_resident
+        self.preempt_steps = preempt_steps
+        self.spill_dir = spill_dir
+        self._adm = AdmissionQueue()
+        self._jobs: dict[int, Job] = {}
+        self._next_id = 1
+        self._lock = threading.RLock()
+        self._work = threading.Condition(self._lock)
+        self._idle = threading.Condition(self._lock)
+        self._closed = False
+        self._failed: Optional[str] = None
+        self._thread = None
+        if background:
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    # -- client surface --------------------------------------------------------
+
+    def submit(
+        self,
+        model,
+        finish_when: HasDiscoveries = HasDiscoveries.ALL,
+        target_state_count: Optional[int] = None,
+        target_max_depth: Optional[int] = None,
+        timeout: Optional[float] = None,
+        priority: int = 0,
+    ) -> JobHandle:
+        """Enqueue a check job; returns immediately. The model must be a
+        TensorModel; submit the SAME model instance for jobs that should
+        share a compiled step (and batch lanes) with each other."""
+        from ..tensor.model import TensorModel
+
+        if not isinstance(model, TensorModel):
+            raise TypeError(
+                "CheckService.submit requires a stateright_tpu.tensor."
+                f"TensorModel; got {type(model).__name__}"
+            )
+        with self._work:
+            if self._closed:
+                raise RuntimeError("service is closed")
+            if self._failed:
+                raise ServiceError(self._failed)
+            job = Job(
+                self._next_id,
+                model,
+                finish_when=finish_when,
+                target_state_count=target_state_count,
+                target_max_depth=target_max_depth,
+                timeout=timeout,
+                priority=priority,
+            )
+            self._next_id += 1
+            self._jobs[job.id] = job
+            self._adm.push(job)
+            self._work.notify_all()
+            return JobHandle(self, job)
+
+    def poll(self, job_id: int) -> dict:
+        job = self._get(job_id)
+        with self._lock:
+            return {
+                "id": job.id,
+                "status": job.status,
+                "state_count": job.state_count,
+                "unique_state_count": job.unique_count,
+                "max_depth": job.max_depth,
+                "steps": job.metrics.device_steps,
+                "pending_lanes": job.pending_lanes,
+                "discoveries": sorted(job.discoveries),
+                "error": job.error,
+                "metrics": job.metrics.to_dict(job.unique_count),
+            }
+
+    def result(
+        self, job_id: int, wait: bool = True, timeout: Optional[float] = None
+    ):
+        job = self._get(job_id)
+        if wait:
+            if not job.event.wait(timeout):
+                raise TimeoutError(f"job {job_id} still running")
+        elif not job.event.is_set():
+            return None
+        if job.status == JobStatus.CANCELLED:
+            raise RuntimeError(f"job {job_id} was cancelled")
+        if job.status == JobStatus.ERROR:
+            raise ServiceError(job.error or f"job {job_id} failed")
+        return job.result
+
+    def cancel(self, job_id: int) -> bool:
+        """Cancel a job mid-flight. Its frontier lanes are reclaimed at the
+        next scheduling round; already-inserted table entries stay (salted,
+        so they shadow nothing). Returns False once the job had finished."""
+        job = self._get(job_id)
+        with self._work:
+            if job.status in JobStatus.FINISHED:
+                return False
+            self._adm.remove(job)
+            self._engine.retire(job)
+            job.status = JobStatus.CANCELLED
+            job.metrics.finished_at = time.monotonic()
+            job.event.set()
+            self._work.notify_all()
+            self._idle.notify_all()
+            return True
+
+    def discovery_paths(self, job_id: int) -> dict:
+        job = self._get(job_id)
+        with self._lock:
+            return {
+                name: self._engine.reconstruct_path(job, fp)
+                for name, fp in job.discoveries.items()
+            }
+
+    def job_ids(self) -> list:
+        with self._lock:
+            return sorted(self._jobs)
+
+    def stats(self) -> dict:
+        """Service-level counters for dashboards and the HTTP `/.status`."""
+        with self._lock:
+            by_status: dict[str, int] = {}
+            for j in self._jobs.values():
+                by_status[j.status] = by_status.get(j.status, 0) + 1
+            return {
+                "jobs": by_status,
+                "queued": len(self._adm),
+                "device_steps": self._engine.total_steps,
+                "groups": len(self._engine.groups),
+                "table_fill": round(
+                    self._engine.hot_claims / self._engine.table.size, 4
+                ),
+                "store": self._engine.store_stats(),
+            }
+
+    def store_stats(self) -> Optional[dict]:
+        with self._lock:
+            return self._engine.store_stats()
+
+    # -- scheduling ------------------------------------------------------------
+
+    def _get(self, job_id: int) -> Job:
+        with self._lock:
+            try:
+                return self._jobs[job_id]
+            except KeyError:
+                raise KeyError(f"no such job {job_id}") from None
+
+    def _resident(self) -> list:
+        return [
+            j for j in self._jobs.values() if j.status == JobStatus.RUNNING
+        ]
+
+    def _has_work(self) -> bool:
+        return bool(
+            (len(self._adm) and self._admittable())
+            or any(g.runnable() for g in self._engine.groups.values())
+        )
+
+    def _admittable(self) -> bool:
+        return (
+            self.max_resident is None
+            or len(self._resident()) < self.max_resident
+        )
+
+    def _finalize(self, job: Job, status: str = JobStatus.DONE) -> None:
+        job.status = status
+        job.metrics.finished_at = time.monotonic()
+        self._engine.retire(job)
+        job.result = self._engine.build_result(job)
+        job.event.set()
+        self._idle.notify_all()
+
+    def _expire_timeouts(self) -> None:
+        now = time.monotonic()
+        for job in list(self._jobs.values()):
+            if job.status in JobStatus.FINISHED or job.deadline is None:
+                continue
+            if now > job.deadline:
+                self._adm.remove(job)
+                job.timed_out = True
+                self._finalize(job)
+
+    def _admit_waiting(self) -> None:
+        while len(self._adm) and self._admittable():
+            job = self._adm.pop_next()
+            if job.status == JobStatus.PREEMPTED:
+                job.load_frontier()
+                job.status = JobStatus.RUNNING
+                job.steps_since_admit = 0
+                self._engine.group_of(job).jobs.append(job)
+                continue
+            try:
+                done = self._engine.admit(job)
+            except ServiceError:
+                raise
+            except Exception as e:  # noqa: BLE001 — a bad model fails its job
+                job.status = JobStatus.ERROR
+                job.error = f"admission failed: {e}"
+                job.metrics.finished_at = time.monotonic()
+                job.event.set()
+                self._idle.notify_all()
+                continue
+            job.metrics.admitted_at = time.monotonic()
+            job.status = JobStatus.RUNNING
+            job.steps_since_admit = 0
+            if done is not None:
+                self._finalize(job)
+
+    def _preempt_if_due(self) -> None:
+        """Park the longest-running over-budget job (at most one per round)
+        when waiting jobs cannot be admitted — round-robin lane grants at
+        admission-queue scale."""
+        if self.preempt_steps is None or not len(self._adm):
+            return
+        if self._admittable():
+            return  # free capacity: nothing to preempt for
+        head = self._adm.peek()
+        due = [
+            j for j in self._resident()
+            if j.steps_since_admit >= self.preempt_steps
+            # Never preempt for a strictly lower-priority waiter — that
+            # would just swap the pair back and forth round after round.
+            and head.priority >= j.priority
+        ]
+        if not due:
+            return
+        job = max(due, key=lambda j: j.steps_since_admit)
+        g = self._engine.groups.get(id(job.model))
+        if g is not None and job in g.jobs:
+            g.jobs.remove(job)
+        job.status = JobStatus.PREEMPTED
+        job.metrics.preemptions += 1
+        if self.spill_dir is not None and job.pending_lanes:
+            job.spill_frontier(
+                os.path.join(self.spill_dir, f"job{job.id}.frontier.npz")
+            )
+        self._adm.push(job)
+        self._admit_waiting()
+
+    def _round(self) -> bool:
+        """One scheduling round: timeouts, admission, preemption, one fused
+        step of the next runnable group. Returns True if a step ran."""
+        self._expire_timeouts()
+        self._admit_waiting()
+        self._preempt_if_due()
+        group = self._engine.next_group()
+        if group is None:
+            return False
+        finished = self._engine.step_group(group)
+        for job in finished:
+            self._finalize(job)
+        return True
+
+    def _loop(self) -> None:
+        while True:
+            with self._work:
+                while not self._closed and not self._has_work():
+                    # The wait doubles as the timeout poll for deadlines.
+                    self._work.wait(timeout=0.05)
+                    self._expire_timeouts()
+                if self._closed:
+                    return
+                try:
+                    self._round()
+                except ServiceError as e:
+                    self._failed = str(e)
+                    self._idle.notify_all()
+                    return
+
+    # -- foreground driving (background=False) ---------------------------------
+
+    def pump(self, rounds: int = 1) -> int:
+        """Run up to `rounds` scheduling rounds in the calling thread;
+        returns how many actually dispatched a step."""
+        ran = 0
+        with self._lock:
+            for _ in range(rounds):
+                try:
+                    if self._round():
+                        ran += 1
+                    elif not self._has_work():
+                        break
+                except ServiceError as e:
+                    self._failed = str(e)
+                    raise
+        return ran
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Block until every submitted job has finished."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+
+        def all_done():
+            return all(
+                j.status in JobStatus.FINISHED for j in self._jobs.values()
+            )
+
+        if self._thread is None:
+            with self._lock:
+                while not all_done():
+                    if self._failed:
+                        raise ServiceError(self._failed)
+                    if not self.pump(64):
+                        self._expire_timeouts()
+                        if not all_done() and not self._has_work():
+                            time.sleep(0.01)
+                    if deadline is not None and time.monotonic() > deadline:
+                        raise TimeoutError("drain timed out")
+            return
+        with self._idle:
+            while not all_done():
+                if self._failed:
+                    raise ServiceError(self._failed)
+                left = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if left is not None and left <= 0:
+                    raise TimeoutError("drain timed out")
+                self._idle.wait(timeout=0.05 if left is None else min(left, 0.05))
+
+    def close(self) -> None:
+        """Stop the scheduler thread; queued/running jobs are cancelled."""
+        with self._work:
+            self._closed = True
+            for job in list(self._jobs.values()):
+                if job.status not in JobStatus.FINISHED:
+                    self._adm.remove(job)
+                    self._engine.retire(job)
+                    job.status = JobStatus.CANCELLED
+                    job.event.set()
+            self._work.notify_all()
+            self._idle.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+class ServiceChecker(Checker):
+    """`Checker`-shaped adapter over a JobHandle — the same handle surface
+    `spawn_tpu` gives (counts, discoveries, join, assertions), served by a
+    shared CheckService instead of a dedicated engine. Spawn one via
+    `model.checker().spawn_service(service)`."""
+
+    def __init__(self, handle: JobHandle):
+        super().__init__(handle._job.model)
+        self._handle = handle
+
+    def state_count(self) -> int:
+        return self._handle._job.state_count
+
+    def unique_state_count(self) -> int:
+        return self._handle._job.unique_count
+
+    def max_depth(self) -> int:
+        return self._handle._job.max_depth
+
+    def discoveries(self) -> dict:
+        if not self._handle._job.event.is_set():
+            return {}
+        return self._handle.discoveries()
+
+    def join(self) -> "ServiceChecker":
+        self._handle.result(wait=True)
+        return self
+
+    def is_done(self) -> bool:
+        return self._handle._job.event.is_set()
+
+    def store_stats(self) -> Optional[dict]:
+        return self._handle._service.store_stats()

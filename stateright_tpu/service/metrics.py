@@ -1,0 +1,57 @@
+"""Per-job service metrics.
+
+Every job admitted to the check service carries one `JobMetrics`: queueing
+delay, device steps it rode in, cumulative lanes it held across those steps
+(the service's "GPU-seconds" analogue — lanes x steps is the job's share of
+the device), preemption count, and the tiered-store suspect counters that
+attribute spill-tier traffic to the job that caused it. Surfaced through
+`JobHandle.metrics()`, `SearchResult.detail["service"]`, and the service
+HTTP front end's `/.status`.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class JobMetrics:
+    submitted_at: float
+    admitted_at: Optional[float] = None  # first admission to lane scheduling
+    finished_at: Optional[float] = None
+    device_steps: int = 0  # fused steps this job held >= 1 lane in
+    lanes_held: int = 0  # cumulative lanes across those steps
+    preemptions: int = 0
+    suspects_checked: int = 0  # tiered store: this job's Bloom-positive claims
+    suspects_dup: int = 0  # ... of which were confirmed spilled duplicates
+
+    @classmethod
+    def now(cls) -> "JobMetrics":
+        return cls(submitted_at=time.monotonic())
+
+    def queue_wait(self) -> Optional[float]:
+        """Seconds between submission and first lane grant (None while
+        still queued)."""
+        if self.admitted_at is None:
+            return None
+        return self.admitted_at - self.submitted_at
+
+    def to_dict(self, unique_count: int = 0) -> dict:
+        qw = self.queue_wait()
+        d = {
+            "queue_wait": None if qw is None else round(qw, 4),
+            "device_steps": self.device_steps,
+            "lanes_held": self.lanes_held,
+            "preemptions": self.preemptions,
+        }
+        if self.suspects_checked:
+            d["suspects_checked"] = self.suspects_checked
+            d["suspects_dup"] = self.suspects_dup
+            # Fraction of the job's unique states that needed the spill
+            # tier's exact membership check — the job's "spill share".
+            d["spill_share"] = round(
+                self.suspects_checked / max(unique_count, 1), 4
+            )
+        return d

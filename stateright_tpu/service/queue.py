@@ -1,0 +1,242 @@
+"""Admission queue + per-job state for the multi-job check service.
+
+A `Job` owns everything host-side about one check: its per-job fingerprint
+salt (see tensor/fingerprint.salt_fp — what lets all co-resident jobs share
+one device hash table), its frontier (numpy chunks with PER-LANE depth, so a
+scheduler batch may mix depths without breaking BFS order), its counters,
+discoveries, and completion event. The `AdmissionQueue` orders waiting jobs
+by (priority desc, submission order) — preempted jobs re-enter it behind
+their priority class, which is what makes lane grants round-robin fair.
+
+Preemption uses the engines' checkpoint machinery: `spill_frontier` dumps
+the pending chunks with the same array schema FrontierSearch.checkpoint
+uses for its queue (q_states / q_lo / q_hi / q_ebits / q_lens / q_depths),
+so a parked job's host memory drops to its counters while its visited set
+stays resident (shared device table — eviction of that is the tiered
+store's business, not the scheduler's).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from ..core.discovery import HasDiscoveries
+from ..tensor.fingerprint import job_salt
+from .metrics import JobMetrics
+
+
+class JobStatus:
+    QUEUED = "queued"
+    RUNNING = "running"
+    PREEMPTED = "preempted"
+    DONE = "done"
+    CANCELLED = "cancelled"
+    ERROR = "error"
+
+    FINISHED = (DONE, CANCELLED, ERROR)
+
+
+class _Chunk:
+    """One frontier segment: states + unsalted fingerprints + per-lane
+    eventually-bit rows and depths (uint32, matching the engines)."""
+
+    __slots__ = ("states", "lo", "hi", "ebits", "depth")
+
+    def __init__(self, states, lo, hi, ebits, depth):
+        self.states = states  # uint32[n, L]
+        self.lo = lo  # uint32[n]
+        self.hi = hi  # uint32[n]
+        self.ebits = ebits  # bool[n, P]
+        self.depth = depth  # uint32[n]
+
+    def __len__(self) -> int:
+        return len(self.lo)
+
+
+class Job:
+    def __init__(
+        self,
+        job_id: int,
+        model,
+        finish_when: HasDiscoveries = HasDiscoveries.ALL,
+        target_state_count: Optional[int] = None,
+        target_max_depth: Optional[int] = None,
+        timeout: Optional[float] = None,
+        priority: int = 0,
+    ):
+        self.id = job_id
+        self.model = model
+        self.salt_lo, self.salt_hi = job_salt(job_id)
+        self.finish_when = finish_when
+        self.target_state_count = target_state_count
+        self.target_max_depth = target_max_depth
+        self.timeout = timeout
+        self.priority = priority
+
+        self.status = JobStatus.QUEUED
+        self.metrics = JobMetrics.now()
+        self.deadline = (
+            None if timeout is None else self.metrics.submitted_at + timeout
+        )
+        self.state_count = 0
+        self.unique_count = 0
+        self.max_depth = 0
+        self.steps_since_admit = 0
+        self.early_exit = False
+        self.timed_out = False
+        self.discoveries: dict[str, int] = {}  # name -> packed UNSALTED fp
+        self.result = None  # SearchResult once finished
+        self.error: Optional[str] = None
+        self.event = threading.Event()
+
+        self._chunks: deque[_Chunk] = deque()
+        self._pending = 0
+        self._spill_path: Optional[str] = None
+
+    # -- frontier --------------------------------------------------------------
+
+    @property
+    def pending_lanes(self) -> int:
+        return self._pending
+
+    def push(self, states, lo, hi, ebits, depth) -> None:
+        if len(lo) == 0:
+            return
+        self._chunks.append(_Chunk(states, lo, hi, ebits, depth))
+        self._pending += len(lo)
+
+    def take(self, k: int):
+        """Pop up to k lanes from the frontier front (FIFO across chunks —
+        the flattened order is exactly the order a standalone engine's
+        coalesced same-depth queue would pop). Returns (states, lo, hi,
+        ebits, depth) numpy arrays with n <= k rows."""
+        parts = []
+        taken = 0
+        while taken < k and self._chunks:
+            c = self._chunks[0]
+            need = k - taken
+            if len(c) <= need:
+                parts.append(c)
+                self._chunks.popleft()
+                taken += len(c)
+            else:
+                parts.append(
+                    _Chunk(
+                        c.states[:need], c.lo[:need], c.hi[:need],
+                        c.ebits[:need], c.depth[:need],
+                    )
+                )
+                self._chunks[0] = _Chunk(
+                    c.states[need:], c.lo[need:], c.hi[need:],
+                    c.ebits[need:], c.depth[need:],
+                )
+                taken += need
+        self._pending -= taken
+        if len(parts) == 1:
+            c = parts[0]
+            return c.states, c.lo, c.hi, c.ebits, c.depth
+        return (
+            np.concatenate([c.states for c in parts]),
+            np.concatenate([c.lo for c in parts]),
+            np.concatenate([c.hi for c in parts]),
+            np.concatenate([c.ebits for c in parts]),
+            np.concatenate([c.depth for c in parts]),
+        )
+
+    def drop_frontier(self) -> None:
+        self._chunks.clear()
+        self._pending = 0
+
+    # -- preemption spill (checkpoint machinery) --------------------------------
+
+    def spill_frontier(self, path: str) -> None:
+        """Park the pending frontier on disk (same array schema as the
+        engines' checkpoint queue section) and free the host memory."""
+        chunks = list(self._chunks)
+        P = chunks[0].ebits.shape[1] if chunks else 0
+        L = chunks[0].states.shape[1] if chunks else self.model.lanes
+        np.savez_compressed(
+            path,
+            q_states=(
+                np.concatenate([c.states for c in chunks])
+                if chunks else np.zeros((0, L), np.uint32)
+            ),
+            q_lo=(
+                np.concatenate([c.lo for c in chunks])
+                if chunks else np.zeros(0, np.uint32)
+            ),
+            q_hi=(
+                np.concatenate([c.hi for c in chunks])
+                if chunks else np.zeros(0, np.uint32)
+            ),
+            q_ebits=(
+                np.concatenate([c.ebits for c in chunks])
+                if chunks else np.zeros((0, P), bool)
+            ),
+            q_depths=(
+                np.concatenate([c.depth for c in chunks])
+                if chunks else np.zeros(0, np.uint32)
+            ),
+            q_lens=np.asarray([len(c) for c in chunks], np.int64),
+        )
+        self.drop_frontier()
+        self._spill_path = path
+
+    def load_frontier(self) -> None:
+        """Reload a spilled frontier for resumption."""
+        if self._spill_path is None:
+            return
+        data = np.load(self._spill_path)
+        off = 0
+        for ln in data["q_lens"]:
+            ln = int(ln)
+            self.push(
+                data["q_states"][off : off + ln],
+                data["q_lo"][off : off + ln],
+                data["q_hi"][off : off + ln],
+                data["q_ebits"][off : off + ln],
+                data["q_depths"][off : off + ln],
+            )
+            off += ln
+        self._spill_path = None
+
+
+class AdmissionQueue:
+    """Waiting jobs ordered by (priority desc, arrival). Preempted jobs
+    re-enter through `push` and land BEHIND queued peers of the same
+    priority — the round-robin half of the fairness story (the other half
+    is the scheduler's per-step lane grants)."""
+
+    def __init__(self):
+        self._q: list[Job] = []
+        self._seq = 0
+        self._order: dict[int, int] = {}
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def push(self, job: Job) -> None:
+        self._order[job.id] = self._seq
+        self._seq += 1
+        self._q.append(job)
+        self._q.sort(key=lambda j: (-j.priority, self._order[j.id]))
+
+    def pop_next(self) -> Optional[Job]:
+        return self._q.pop(0) if self._q else None
+
+    def peek(self) -> Optional[Job]:
+        return self._q[0] if self._q else None
+
+    def remove(self, job: Job) -> bool:
+        try:
+            self._q.remove(job)
+            return True
+        except ValueError:
+            return False
+
+    def jobs(self) -> list:
+        return list(self._q)

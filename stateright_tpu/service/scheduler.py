@@ -1,0 +1,610 @@
+"""Continuous-batching engine of the multi-job check service.
+
+The inference-serving idea (Orca-style continuous batching), translated to
+model checking: ONE device-resident visited set (hash table + optional
+tiered spill store) is shared by every co-resident job, and each fused
+device step packs frontier lanes from MANY jobs — admitted, preempted, and
+retired between steps without draining anything.
+
+Sharing is sound because every key the table sees is job-salted
+(tensor/fingerprint.salt_fp): a bijection per job keeps within-job dedup
+bit-identical to a standalone run while making cross-job collisions exactly
+as (im)probable as any two unrelated 64-bit fingerprints.
+
+Job-to-batch packing ("groups"): lanes in one fused step must share one
+`TensorModel.expand` kernel, so jobs are grouped by model instance — jobs
+of the same model share batches lane-by-lane (the continuous-batching win:
+four small same-model jobs fill one batch four deep instead of running four
+quarter-full searches), while distinct models time-share the device
+round-robin, all against the one shared table.
+
+Per-batch bookkeeping mirrors FrontierSearch.run (tensor/frontier.py)
+order-for-order per job — property discovery scan, eventually-bit
+clear/terminal check, early exit BEFORE count accumulation, suspect
+resolution, successor append, spill eviction. Parity argument: a job's
+queue order is INVARIANT to lane-grant segmentation (successors append in
+queue order whatever the batch boundaries), so for a job that runs to
+exhaustion the counts, discovery fingerprints (first sat state in pop
+order), and reconstructed paths are bit-identical to a standalone run —
+even mid-multiplex. The one segmentation-sensitive quantity is the
+discarded final-batch contribution of an EARLY-EXITING job (all
+properties found): its discovery set is still exact, but its state_count
+can differ from a standalone run by the lanes that shared its last batch.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.model import Expectation
+from ..tensor.fingerprint import pack_fp, salt_fp, unpack_fp
+from ..tensor.frontier import (
+    FrontierSearch,
+    SearchResult,
+    compact_flags,
+    compact_new,
+    expand_insert,
+    replay_fp_chain,
+    seed_init,
+)
+from ..tensor.hashtable import HashTable
+from .queue import Job, JobStatus
+
+
+def _build_service_step(model, K, props, insert, store):
+    """The fused multi-job step: property masks, expand, salted visited-set
+    insert, successor compaction, Bloom suspect marking — FrontierSearch's
+    step plus per-lane job salts and per-row generated counts."""
+    tiered = store is not None
+    if tiered:
+        from ..store.summary import maybe_contains
+
+        slog2 = store.config.summary_log2
+        khash = store.config.summary_hashes
+    A = model.max_actions
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+    def step(t_lo, t_hi, p_lo, p_hi, states, lo, hi, salt_lo, salt_hi,
+             active, summary):
+        prop_masks = (
+            jnp.stack([p.condition(model, states) for p in props])
+            if props
+            else jnp.zeros((0, K), dtype=bool)
+        )
+        (
+            t_lo, t_hi, p_lo, p_hi,
+            flat, slo, shi, is_new,
+            gen_rows, has_succ, ovf,
+        ) = expand_insert(
+            model, t_lo, t_hi, p_lo, p_hi, states, lo, hi, active,
+            insert=insert, salt_lo=salt_lo, salt_hi=salt_hi,
+        )
+        out_states, out_lo, out_hi, out_src, new_count = compact_new(
+            flat, slo, shi, is_new
+        )
+        if tiered:
+            # Suspects are detected on the SALTED keys — the spill tier
+            # stores table keys, and the salt is what keeps one job's
+            # spilled states from shadowing another's.
+            sl_rep = jnp.repeat(salt_lo, A)
+            sh_rep = jnp.repeat(salt_hi, A)
+            k_lo, k_hi = salt_fp(slo, shi, sl_rep, sh_rep)
+            suspect = is_new & maybe_contains(summary, k_lo, k_hi, slog2, khash)
+        else:
+            suspect = jnp.zeros_like(is_new)
+        out_sus = compact_flags(suspect, is_new)
+        return (
+            t_lo, t_hi, p_lo, p_hi,
+            out_states, out_lo, out_hi, out_src, out_sus,
+            new_count, gen_rows, has_succ, ovf, prop_masks,
+        )
+
+    return step
+
+
+class _Group:
+    """Jobs sharing one model (and therefore one compiled step)."""
+
+    def __init__(self, model, K, insert, store):
+        self.model = model
+        self.props = model.properties()
+        self.prop_is = {
+            "always": [
+                i for i, p in enumerate(self.props)
+                if p.expectation == Expectation.ALWAYS
+            ],
+            "sometimes": [
+                i for i, p in enumerate(self.props)
+                if p.expectation == Expectation.SOMETIMES
+            ],
+            "eventually": [
+                i for i, p in enumerate(self.props)
+                if p.expectation == Expectation.EVENTUALLY
+            ],
+        }
+        self.step = _build_service_step(model, K, self.props, insert, store)
+        self.jobs: list[Job] = []
+        self.rr = 0  # lane-grant rotation pointer
+
+    def runnable(self) -> list:
+        return [
+            j for j in self.jobs
+            if j.status == JobStatus.RUNNING and j.pending_lanes
+        ]
+
+
+class ServiceError(RuntimeError):
+    """The shared device state is unusable (table overflow without a spill
+    tier); every in-flight job was failed with this message."""
+
+
+class ServiceEngine:
+    """Shared device state + step execution. Not thread-safe by itself —
+    the owning CheckService serializes access."""
+
+    # Same visited-set designs the standalone engines race.
+    INSERT_VARIANTS = FrontierSearch.INSERT_VARIANTS
+
+    def __init__(
+        self,
+        batch_size: int = 1024,
+        table_log2: int = 20,
+        insert_variant: str = "sort",
+        store: str = "device",
+        high_water: float = 0.85,
+        low_water: Optional[float] = None,
+        summary_log2: int = 20,
+    ):
+        self.batch_size = batch_size
+        self.table = HashTable(table_log2)
+        if insert_variant not in self.INSERT_VARIANTS:
+            raise ValueError(
+                f"insert_variant must be one of "
+                f"{sorted(self.INSERT_VARIANTS)}, got {insert_variant!r}"
+            )
+        self._insert = self.INSERT_VARIANTS[insert_variant]
+        self.insert_variant = insert_variant
+        if store not in ("device", "tiered"):
+            raise ValueError(f"store must be 'device' or 'tiered', got {store!r}")
+        self.store = store
+        self._store = None
+        self._spill_trigger = 0
+        if store == "tiered":
+            from ..store.tiered import TieredConfig, TieredStore
+
+            self._store = TieredStore(
+                self.table.size,
+                TieredConfig(
+                    high_water=high_water,
+                    low_water=low_water,
+                    summary_log2=summary_log2,
+                ),
+            )
+            # One-batch headroom, exactly like FrontierSearch: eviction only
+            # runs between steps, and a step can claim K*A slots. The K*A
+            # bound is per GROUP model; use the max as groups appear.
+            self._spill_trigger = self._store.high_slots
+        self._no_summary = jnp.zeros(1, dtype=jnp.uint32)
+        self.hot_claims = 0
+        self.groups: dict[int, _Group] = {}
+        self._group_rr: list[int] = []
+        self.total_steps = 0
+        self._table_stamp = 0  # bumped per step; parent-map cache key
+        self._parent_map = None
+        self._parent_map_stamp = -1
+
+    # -- admission / retirement ------------------------------------------------
+
+    def group_of(self, job: Job) -> _Group:
+        key = id(job.model)
+        g = self.groups.get(key)
+        if g is None:
+            g = _Group(job.model, self.batch_size, self._insert, self._store)
+            self.groups[key] = g
+            self._group_rr.append(key)
+            if self._store is not None:
+                ka = self.batch_size * job.model.max_actions
+                self._spill_trigger = min(
+                    self._spill_trigger, self.table.size - ka
+                )
+                if self._spill_trigger <= self._store.low_slots:
+                    raise ValueError(
+                        "table too small for tiered spilling at this batch: "
+                        f"table {self.table.size} minus one batch of claims "
+                        f"({ka}) leaves no room above the low-water mark "
+                        f"({self._store.low_slots} slots); raise table_log2 "
+                        "or lower batch_size/low_water"
+                    )
+        return g
+
+    def admit(self, job: Job) -> Optional[Job]:
+        """Seed a job's init states into the shared table (salted) and hand
+        its frontier to the scheduler. Returns the job if it finished
+        immediately (vacuous finish policy / empty space), else None."""
+        g = self.group_of(job)
+        model = job.model
+        props = g.props
+        P = len(props)
+        init, init_lo, init_hi, n_raw = seed_init(model)
+        n0 = len(init)
+        job.state_count = n_raw  # host checkers count pre-dedup (bfs.rs:54)
+
+        if job.finish_when.matches(props, set()) or not props:
+            # Vacuously-true finish policy: stop before exploring anything
+            # (the resident engine's immediate early-out).
+            job.unique_count = n0
+            job.max_depth = 1 if n0 else 0
+            job.early_exit = True
+            return job
+
+        K = self.batch_size
+        slo, shi = salt_fp(init_lo, init_hi, job.salt_lo, job.salt_hi)
+        for b0 in range(0, max(n0, 1), K):
+            sl = slice(b0, min(b0 + K, n0))
+            n = sl.stop - sl.start
+            lo_pad = np.zeros(K, dtype=np.uint32)
+            hi_pad = np.zeros(K, dtype=np.uint32)
+            lo_pad[:n] = slo[sl]
+            hi_pad[:n] = shi[sl]
+            res = self.table.insert(
+                jnp.asarray(lo_pad),
+                jnp.asarray(hi_pad),
+                jnp.zeros(K, dtype=jnp.uint32),
+                jnp.zeros(K, dtype=jnp.uint32),
+                jnp.asarray(np.arange(K) < n),
+            )
+            if bool(res.overflow):
+                self._fail_all("shared hash table full; raise table_log2")
+                raise ServiceError("shared hash table full; raise table_log2")
+            n_new = int(np.asarray(res.is_new).sum())
+            job.unique_count += n_new
+            self.hot_claims += n_new
+        self._table_stamp += 1
+
+        ebits0 = np.zeros((n0, P), dtype=bool)
+        for i in g.prop_is["eventually"]:
+            ebits0[:, i] = True
+        job.push(
+            init, init_lo, init_hi, ebits0,
+            np.ones(n0, dtype=np.uint32),
+        )
+        g.jobs.append(job)
+        if job.pending_lanes == 0:
+            return job  # empty reachable space: complete immediately
+        return None
+
+    def retire(self, job: Job) -> None:
+        g = self.groups.get(id(job.model))
+        if g is not None and job in g.jobs:
+            g.jobs.remove(job)
+        job.drop_frontier()
+        # Empty groups are kept: their compiled step is the expensive part,
+        # and a later job on the same model instance reuses it.
+
+    def runnable_groups(self) -> list:
+        return [
+            self.groups[k] for k in self._group_rr if self.groups[k].runnable()
+        ]
+
+    def next_group(self) -> Optional[_Group]:
+        """Round-robin over groups with runnable work."""
+        n = len(self._group_rr)
+        for _ in range(n):
+            key = self._group_rr.pop(0)
+            self._group_rr.append(key)
+            g = self.groups[key]
+            if g.runnable():
+                return g
+        return None
+
+    # -- lane grants -----------------------------------------------------------
+
+    def _grants(self, jobs: list, K: int) -> list:
+        """Waterfill K lanes across jobs in rotation order: each pass gives
+        every still-hungry job an equal share (>= 1 lane), so small jobs
+        finish their frontier and big jobs absorb the slack."""
+        pend = [j.pending_lanes for j in jobs]
+        grants = [0] * len(jobs)
+        left = K
+        while left > 0:
+            live = [i for i in range(len(jobs)) if pend[i] > grants[i]]
+            if not live:
+                break
+            share = max(left // len(live), 1)
+            for i in live:
+                t = min(share, pend[i] - grants[i], left)
+                grants[i] += t
+                left -= t
+                if left == 0:
+                    break
+        return grants
+
+    # -- one fused step --------------------------------------------------------
+
+    def step_group(self, group: _Group) -> list:
+        """Assemble one batch from the group's runnable jobs, dispatch the
+        fused step, and do the per-job bookkeeping. Returns jobs finished by
+        this step (result built; caller signals their events)."""
+        model = group.model
+        props = group.props
+        prop_is = group.prop_is
+        K = self.batch_size
+        A = model.max_actions
+        P = len(props)
+
+        jobs = group.runnable()
+        if not jobs:
+            return []
+        # Rotate the grant order so no job is permanently first in line.
+        group.rr %= len(jobs)
+        rotation = jobs[group.rr:] + jobs[: group.rr]
+        group.rr += 1
+        grants = self._grants(rotation, K)
+
+        st = np.zeros((K, model.lanes), dtype=np.uint32)
+        lo = np.zeros(K, dtype=np.uint32)
+        hi = np.zeros(K, dtype=np.uint32)
+        salt_lo = np.zeros(K, dtype=np.uint32)
+        salt_hi = np.zeros(K, dtype=np.uint32)
+        depth = np.zeros(K, dtype=np.uint32)
+        ebits = np.zeros((K, P), dtype=bool)
+        eval_mask = np.zeros(K, dtype=bool)
+        segments = []  # (job, start, end)
+        m = 0
+        for job, grant in zip(rotation, grants):
+            if grant == 0:
+                continue
+            s_states, s_lo, s_hi, s_eb, s_dp = job.take(grant)
+            n = len(s_lo)
+            seg = slice(m, m + n)
+            st[seg] = s_states
+            lo[seg] = s_lo
+            hi[seg] = s_hi
+            ebits[seg] = s_eb
+            depth[seg] = s_dp
+            salt_lo[seg] = job.salt_lo
+            salt_hi[seg] = job.salt_hi
+            # target_max_depth: lanes at the cutoff are popped but neither
+            # evaluated nor expanded (ref: bfs.rs:219-224) — and still raise
+            # max_depth, exactly like FrontierSearch's skipped chunks.
+            tmd = job.target_max_depth
+            eval_mask[seg] = True if tmd is None else (s_dp < tmd)
+            job.max_depth = max(job.max_depth, int(s_dp.max()) if n else 0)
+            job.metrics.device_steps += 1
+            job.metrics.lanes_held += n
+            job.steps_since_admit += 1
+            segments.append((job, m, m + n))
+            m += n
+
+        (
+            t_lo, t_hi, p_lo, p_hi,
+            out_states, out_lo, out_hi, out_src, out_sus,
+            new_count, gen_rows, has_succ, overflow, prop_masks,
+        ) = group.step(
+            self.table.t_lo, self.table.t_hi,
+            self.table.p_lo, self.table.p_hi,
+            jnp.asarray(st), jnp.asarray(lo), jnp.asarray(hi),
+            jnp.asarray(salt_lo), jnp.asarray(salt_hi),
+            jnp.asarray(eval_mask),
+            self._store.device_summary()
+            if self._store is not None
+            else self._no_summary,
+        )
+        self.table.t_lo, self.table.t_hi = t_lo, t_hi
+        self.table.p_lo, self.table.p_hi = p_lo, p_hi
+        self.total_steps += 1
+        self._table_stamp += 1
+        if bool(overflow):
+            msg = "shared hash table full; raise table_log2 (or store='tiered')"
+            self._fail_all(msg)
+            raise ServiceError(msg)
+
+        masks = np.asarray(prop_masks)
+        gen_rows = np.asarray(gen_rows)
+        has_succ = np.asarray(has_succ)
+        nc = int(new_count)
+        finished: list[Job] = []
+        early: set[int] = set()
+
+        # -- per-job discovery scan + early exit (FrontierSearch order) --------
+        for job, s, e in segments:
+            ev = eval_mask[s:e]
+            for i in prop_is["always"]:
+                if props[i].name in job.discoveries:
+                    continue
+                viol = ev & ~masks[i][s:e]
+                if viol.any():
+                    j = int(np.argmax(viol))
+                    job.discoveries[props[i].name] = int(
+                        pack_fp(lo[s + j], hi[s + j])
+                    )
+            for i in prop_is["sometimes"]:
+                if props[i].name in job.discoveries:
+                    continue
+                sat = ev & masks[i][s:e]
+                if sat.any():
+                    j = int(np.argmax(sat))
+                    job.discoveries[props[i].name] = int(
+                        pack_fp(lo[s + j], hi[s + j])
+                    )
+            if prop_is["eventually"]:
+                for i in prop_is["eventually"]:
+                    ebits[s:e, i] &= ~masks[i][s:e]
+                term = ev & ~has_succ[s:e]
+                for i in prop_is["eventually"]:
+                    if props[i].name in job.discoveries:
+                        continue
+                    bad = term & ebits[s:e, i]
+                    if bad.any():
+                        j = int(np.argmax(bad))
+                        job.discoveries[props[i].name] = int(
+                            pack_fp(lo[s + j], hi[s + j])
+                        )
+            if (props and len(job.discoveries) == len(props)) or (
+                job.finish_when.matches(props, set(job.discoveries))
+            ):
+                # Early exit discards this batch's count/successor
+                # contributions for THIS job only (frontier.py does the
+                # same for the whole search).
+                job.early_exit = True
+                early.add(job.id)
+                job.drop_frontier()
+                finished.append(job)
+                continue
+            job.state_count += int(gen_rows[s:e].sum())
+
+        # -- successors: attribute to jobs, resolve suspects, append -----------
+        self.hot_claims += nc  # device slot claims (incl. suspects)
+        lane_job = np.full(K, -1, dtype=np.int64)
+        for idx, (job, s, e) in enumerate(segments):
+            lane_job[s:e] = idx
+        if nc:
+            o_states = np.asarray(out_states[:nc])
+            o_lo = np.asarray(out_lo[:nc])
+            o_hi = np.asarray(out_hi[:nc])
+            parents = np.asarray(out_src[:nc]) // A
+            keep = np.ones(nc, dtype=bool)
+            if self._store is not None:
+                sus = np.asarray(out_sus[:nc])
+                if sus.any():
+                    k_lo, k_hi = salt_fp(
+                        o_lo[sus], o_hi[sus],
+                        salt_lo[parents[sus]], salt_hi[parents[sus]],
+                    )
+                    dup = self._store.resolve_suspects(k_lo, k_hi)
+                    keep[np.nonzero(sus)[0][dup]] = False
+                    sus_jobs = lane_job[parents[sus]]
+                    for idx, (job, _s, _e) in enumerate(segments):
+                        mine = sus_jobs == idx
+                        job.metrics.suspects_checked += int(mine.sum())
+                        job.metrics.suspects_dup += int(dup[mine].sum())
+            owner = lane_job[parents]
+            for idx, (job, _s, _e) in enumerate(segments):
+                if job.id in early:
+                    continue
+                rows = np.nonzero((owner == idx) & keep)[0]
+                n_j = len(rows)
+                if n_j == 0:
+                    continue
+                job.unique_count += n_j
+                pr = parents[rows]
+                job.push(
+                    o_states[rows], o_lo[rows], o_hi[rows],
+                    ebits[pr] if P else np.zeros((n_j, 0), dtype=bool),
+                    depth[pr] + 1,
+                )
+
+        # -- spill eviction (tiered) -------------------------------------------
+        if self._store is not None and self.hot_claims >= self._spill_trigger:
+            tl, th, pl, ph, n_ev = self._store.evict(
+                self.table.t_lo, self.table.t_hi,
+                self.table.p_lo, self.table.p_hi,
+                self.hot_claims,
+            )
+            if n_ev == 0:
+                msg = (
+                    "tiered store could not free any bucket (every bucket "
+                    "full and pinned); raise table_log2 or lower high_water"
+                )
+                self._fail_all(msg)
+                raise ServiceError(msg)
+            self.table.t_lo, self.table.t_hi = tl, th
+            self.table.p_lo, self.table.p_hi = pl, ph
+            self.hot_claims -= n_ev
+
+        # -- per-job finish checks ---------------------------------------------
+        for job, _s, _e in segments:
+            if job.id in early:
+                continue
+            if (
+                job.target_state_count is not None
+                and job.state_count >= job.target_state_count
+            ):
+                job.early_exit = True
+                job.drop_frontier()
+                finished.append(job)
+            elif job.pending_lanes == 0:
+                finished.append(job)
+        return finished
+
+    # -- results / failure -----------------------------------------------------
+
+    def build_result(self, job: Job) -> SearchResult:
+        detail = dict(self.store_stats() or {})
+        detail["service"] = job.metrics.to_dict(job.unique_count)
+        if job.timed_out:
+            detail["timed_out"] = True
+        ref = job.metrics.admitted_at or job.metrics.submitted_at
+        return SearchResult(
+            state_count=job.state_count,
+            unique_state_count=job.unique_count,
+            max_depth=job.max_depth,
+            discoveries=dict(job.discoveries),
+            complete=(
+                job.pending_lanes == 0
+                and not job.early_exit
+                and not job.timed_out
+                and job.status != JobStatus.CANCELLED
+            ),
+            duration=(job.metrics.finished_at or time.monotonic()) - ref,
+            steps=job.metrics.device_steps,
+            detail=detail,
+        )
+
+    def _fail_all(self, msg: str) -> None:
+        for g in self.groups.values():
+            for job in list(g.jobs):
+                job.status = JobStatus.ERROR
+                job.error = msg
+                job.metrics.finished_at = time.monotonic()
+                job.drop_frontier()
+                job.event.set()
+            g.jobs.clear()
+
+    def store_stats(self) -> Optional[dict]:
+        if self._store is None:
+            return None
+        return self._store.stats(self.hot_claims)
+
+    # -- path reconstruction ---------------------------------------------------
+
+    def parent_map(self) -> dict:
+        """Salted {key: parent} of the shared table (+ spill tier), cached
+        per table version."""
+        if self._parent_map_stamp != self._table_stamp:
+            pm = self.table.dump()
+            if self._store is not None:
+                pm.update(self._store.parent_map())
+            self._parent_map = pm
+            self._parent_map_stamp = self._table_stamp
+        return self._parent_map
+
+    def reconstruct_path(self, job: Job, fp: int):
+        """Walk the SALTED parent chain for a job's (unsalted) discovery
+        fingerprint, unsalt it, and re-execute the model along it — the
+        engines' TLC-style reconstruction, made job-aware. A parent written
+        by another job can never appear in the chain: every parent pointer
+        stored for a job's state is that job's own salted key."""
+        pm = self.parent_map()
+        lo32, hi32 = unpack_fp(fp)
+        klo, khi = salt_fp(
+            np.uint32(lo32), np.uint32(hi32), job.salt_lo, job.salt_hi
+        )
+        cur = int(pack_fp(klo, khi))
+        chain = []
+        while cur:
+            lo32, hi32 = unpack_fp(cur)
+            ulo, uhi = salt_fp(
+                np.uint32(lo32), np.uint32(hi32), job.salt_lo, job.salt_hi
+            )
+            chain.append(int(pack_fp(ulo, uhi)))
+            cur = pm.get(cur, 0)
+        chain.reverse()
+        return replay_fp_chain(job.model, chain)

@@ -1,0 +1,236 @@
+"""HTTP front end of the check service (the Explorer server plumbing,
+repointed at the multi-job scheduler).
+
+Endpoints:
+
+- ``GET /.status`` — service counters + one summary row per job (queue
+  wait, lanes held, preemptions, per-tier store occupancy — the service
+  twin of the Explorer's `/.status`).
+- ``POST /jobs`` — submit a job: ``{"model": "<registry name>", "args":
+  {...}, "opts": {"target_max_depth": ..., "timeout": ..., "priority":
+  ...}}`` → ``{"job": id}``. Models are named through a REGISTRY of
+  builder callables (HTTP clients cannot ship Python model objects); the
+  default registry carries the bundled tensor workloads.
+- ``GET /jobs/<id>`` — poll one job (status, counts, discovery names,
+  metrics).
+- ``POST /jobs/<id>/cancel`` / ``DELETE /jobs/<id>`` — cancel.
+- ``GET /jobs/<id>/discoveries`` — the reconstructed discovery paths of a
+  finished job (action-label lists, the `assert_discovery` currency).
+
+The view builders are pure functions over the service, the same
+test-without-sockets strategy as explorer/server.py.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Callable, Optional
+
+from ..explorer.server import ExplorerServer
+from .api import CheckService
+
+
+def default_registry() -> dict:
+    """Name -> model-builder callables for the bundled tensor workloads.
+    Builders are cached per argument tuple so repeat submissions of the
+    same config share one model instance — and therefore one compiled
+    step and one batch (the continuous-batching win)."""
+    from ..tensor.models import (
+        TensorIncrementLock,
+        TensorLinearEquation,
+        TensorTwoPhaseSys,
+    )
+    from ..tensor.paxos import TensorPaxos
+
+    reg: dict[str, Callable] = {
+        "2pc": lambda n=3, **kw: TensorTwoPhaseSys(int(n), **kw),
+        "paxos": lambda n=2, **kw: TensorPaxos(client_count=int(n), **kw),
+        "inclock": lambda n=3, **kw: TensorIncrementLock(int(n), **kw),
+        "lineq": lambda a=2, b=10, **kw: TensorLinearEquation(
+            int(a), int(b), **kw
+        ),
+    }
+    return reg
+
+
+class ModelRegistry:
+    """Instance-caching wrapper over builder callables (see
+    default_registry): same (name, args) -> same model object."""
+
+    def __init__(self, builders: Optional[dict] = None):
+        self._builders = (
+            dict(builders) if builders is not None else default_registry()
+        )
+        self._cache: dict = {}
+
+    def names(self) -> list:
+        return sorted(self._builders)
+
+    def get(self, name: str, args: Optional[dict] = None):
+        if name not in self._builders:
+            raise KeyError(
+                f"unknown model {name!r} (registered: {self.names()})"
+            )
+        args = dict(args or {})
+        key = (name, tuple(sorted(args.items())))
+        if key not in self._cache:
+            self._cache[key] = self._builders[name](**args)
+        return self._cache[key]
+
+
+# -- pure view builders --------------------------------------------------------
+
+
+def job_view(service: CheckService, job_id: int) -> dict:
+    return service.poll(job_id)
+
+
+def status_view(service: CheckService) -> dict:
+    """JSON for `GET /.status`: service counters + per-job rows."""
+    return {
+        **service.stats(),
+        "job_rows": [service.poll(jid) for jid in service.job_ids()],
+    }
+
+
+def submit_view(
+    service: CheckService, registry: ModelRegistry, payload: dict
+) -> dict:
+    from ..core.discovery import HasDiscoveries
+
+    opts = dict(payload.get("opts") or {})
+    fw = opts.pop("finish_when", None)
+    if fw is not None:
+        opts["finish_when"] = {
+            "all": HasDiscoveries.ALL,
+            "any": HasDiscoveries.ANY,
+            "all_failures": HasDiscoveries.ALL_FAILURES,
+            "any_failures": HasDiscoveries.ANY_FAILURES,
+        }[fw]
+    model = registry.get(payload["model"], payload.get("args"))
+    handle = service.submit(model, **opts)
+    return {"job": handle.id}
+
+
+def discoveries_view(service: CheckService, job_id: int) -> dict:
+    job = service._get(job_id)
+    paths = service.discovery_paths(job_id)
+    return {
+        name: {
+            "fingerprint": str(job.discoveries[name]),
+            "actions": [repr(a) for a in path.actions()],
+            "last_state": repr(path.last_state()),
+        }
+        for name, path in paths.items()
+    }
+
+
+# -- HTTP plumbing -------------------------------------------------------------
+
+
+def serve_service(
+    service: CheckService,
+    address: str = "localhost:3400",
+    registry: Optional[ModelRegistry] = None,
+    block: bool = False,
+) -> ExplorerServer:
+    """Start the HTTP front end; returns the same server handle shape as
+    the Explorer's `serve` (shutdown() stops it)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    reg = registry if registry is not None else ModelRegistry()
+    host, _, port = address.partition(":")
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # quiet by default
+            pass
+
+        def _json(self, obj, code=200):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _job_id(self, suffix: str = "") -> Optional[int]:
+            raw = self.path[len("/jobs/"):]
+            if suffix:
+                if not raw.endswith(suffix):
+                    return None
+                raw = raw[: -len(suffix)]
+            try:
+                return int(raw.strip("/"))
+            except ValueError:
+                return None
+
+        def do_GET(self):
+            try:
+                if self.path == "/.status":
+                    self._json(status_view(service))
+                    return
+                if self.path.startswith("/jobs/"):
+                    if self.path.endswith("/discoveries"):
+                        jid = self._job_id("/discoveries")
+                        if jid is not None:
+                            self._json(discoveries_view(service, jid))
+                            return
+                    jid = self._job_id()
+                    if jid is not None:
+                        self._json(job_view(service, jid))
+                        return
+                self._json({"error": "not found"}, 404)
+            except KeyError as e:
+                self._json({"error": str(e)}, 404)
+
+        def do_POST(self):
+            try:
+                if self.path == "/jobs":
+                    n = int(self.headers.get("Content-Length") or 0)
+                    try:
+                        payload = json.loads(self.rfile.read(n) or b"{}")
+                    except ValueError:
+                        self._json({"error": "bad JSON body"}, 400)
+                        return
+                    if "model" not in payload:
+                        self._json({"error": "missing 'model'"}, 400)
+                        return
+                    self._json(submit_view(service, reg, payload))
+                    return
+                if self.path.startswith("/jobs/") and self.path.endswith(
+                    "/cancel"
+                ):
+                    jid = self._job_id("/cancel")
+                    if jid is not None:
+                        self._json({"cancelled": service.cancel(jid)})
+                        return
+                self._json({"error": "not found"}, 404)
+            except KeyError as e:
+                self._json({"error": str(e)}, 404)
+            except Exception as e:  # noqa: BLE001 — bad submits must not kill
+                self._json({"error": f"{type(e).__name__}: {e}"}, 400)
+
+        def do_DELETE(self):
+            jid = self._job_id()
+            if jid is None:
+                self._json({"error": "not found"}, 404)
+                return
+            try:
+                self._json({"cancelled": service.cancel(jid)})
+            except KeyError as e:
+                self._json({"error": str(e)}, 404)
+
+    httpd = ThreadingHTTPServer(
+        (host or "localhost", int(port or 3400)), Handler
+    )
+    if block:
+        server = ExplorerServer(httpd, service, None)
+        try:
+            httpd.serve_forever()
+        finally:
+            httpd.server_close()
+        return server
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    return ExplorerServer(httpd, service, thread)

@@ -63,3 +63,55 @@ def pack_fp(lo, hi):
 def unpack_fp(fp: int) -> tuple[int, int]:
     """Host int -> (lo, hi) pair."""
     return int(fp) & 0xFFFFFFFF, (int(fp) >> 32) & 0xFFFFFFFF
+
+
+# -- job-salted fingerprints (check service) -----------------------------------
+#
+# The multi-job check service (stateright_tpu/service/) packs many concurrent
+# check jobs into ONE device hash table. Co-resident jobs must never collide
+# on identical states, so each job folds a per-job salt into its table keys:
+# `salt_fp` is a BIJECTION of the (lo, hi) pair per salt — injectivity within
+# a job is preserved exactly (unique-count parity with a standalone run), and
+# two jobs checking the same model map the same state to different keys with
+# the same 2^-64 accidental-collision odds as any two unrelated states.
+#
+# The map is an involution (salt_fp(salt_fp(x)) == x), so unsalting a table
+# key back to the standalone fingerprint is the same call — discovery
+# fingerprints leave the service bit-identical to a single-job run.
+
+
+def _mix32_int(h: int) -> int:
+    """fmix32 over plain Python ints (no numpy overflow warnings)."""
+    h &= 0xFFFFFFFF
+    h = ((h ^ (h >> 16)) * int(_M1)) & 0xFFFFFFFF
+    h = ((h ^ (h >> 13)) * int(_M2)) & 0xFFFFFFFF
+    return h ^ (h >> 16)
+
+
+def job_salt(job_id: int) -> tuple[np.uint32, np.uint32]:
+    """Two well-mixed uint32 salt words for a job id (host-side).
+
+    Distinct job ids give distinct salts (fmix32 is a bijection of u32, and
+    the two words mix independent streams), and job ids are never reused
+    within one service, so co-resident jobs always carry distinct salts."""
+    j = int(job_id) & 0xFFFFFFFF
+    lo = _mix32_int((j * int(_GOLDEN)) ^ 0x243F6A88)
+    hi = _mix32_int((j * int(_M2)) ^ 0x85A308D3)
+    return np.uint32(lo), np.uint32(hi)
+
+
+def salt_fp(lo, hi, salt_lo, salt_hi):
+    """Fold a job salt into (lo, hi) fingerprint pairs — array-generic
+    (numpy or jax.numpy), traceable, and an involution per salt.
+
+    XOR is the bijection; the one wrinkle is the engine-wide sentinel
+    contract (lo == 0 marks empty slots / "no parent"): `lo ^ salt_lo` hits
+    zero exactly when lo == salt_lo, so that single point is remapped to
+    `salt_lo` — which is otherwise unreachable (it would need lo == 0, and
+    real fingerprints are never zero). The remap keeps the map injective
+    over nonzero lo, keeps outputs nonzero, and makes the function its own
+    inverse, so the same call salts and unsalts."""
+    slo = lo ^ salt_lo
+    xp = np if isinstance(slo, (np.ndarray, np.generic)) else jnp
+    slo = xp.where(slo == 0, salt_lo, slo)
+    return slo, hi ^ salt_hi

@@ -85,19 +85,29 @@ def count_ge(clo, chi, tlo, thi):
 
 def expand_insert(
     model, t_lo, t_hi, p_lo, p_hi, states, lo, hi, active,
-    insert=_insert_impl,
+    insert=_insert_impl, salt_lo=None, salt_hi=None,
 ):
     """The traced core of one frontier step, shared by the host-orchestrated
     and device-resident engines: expand, boundary-mask, fingerprint, visited-
     set insert with parent tracking (the insert also dedups within the batch).
 
     Returns (t_lo, t_hi, p_lo, p_hi, flat_states, succ_lo, succ_hi, is_new,
-    gen_count, has_succ, overflow); row i of the flattened successor arrays
-    came from input row i // max_actions. `insert` swaps the visited-set
-    implementation (same 9-arg signature/6-tuple result as
-    hashtable._insert_impl) — the engines use it for the interleaved-kv
-    table layout, where t_lo is the uint32[2S] kv array and t_hi is a
-    zero-length placeholder.
+    gen_rows, has_succ, overflow); row i of the flattened successor arrays
+    came from input row i // max_actions; `gen_rows` is the per-input-row
+    post-boundary pre-dedup successor count (ref: bfs.rs:288-291 — callers
+    sum it for the generated-state counter; the check service segments it by
+    the lane's job). `insert` swaps the visited-set implementation (same
+    9-arg signature/6-tuple result as hashtable._insert_impl) — the engines
+    use it for the interleaved-kv table layout, where t_lo is the uint32[2S]
+    kv array and t_hi is a zero-length placeholder.
+
+    `salt_lo`/`salt_hi` (uint32[K] per-lane, optional) fold a per-job salt
+    into every key the visited set sees — successor keys AND the parent
+    pointers stored beside them — so concurrent jobs can share one table
+    with zero cross-job collisions (see fingerprint.salt_fp). The RETURNED
+    succ_lo/succ_hi stay unsalted: they are the state identities the host
+    uses for discovery recording and queue bookkeeping, bit-identical to a
+    standalone (unsalted) run.
     """
     K = states.shape[0]
     A = model.max_actions
@@ -106,7 +116,7 @@ def expand_insert(
     flat = succs.reshape(K * A, model.lanes)
     validf = valid.reshape(-1) & model.within_boundary(flat)
     # Generated-state count is pre-dedup, post-boundary (ref: bfs.rs:288-291).
-    gen_count = validf.sum().astype(jnp.uint32)
+    gen_rows = validf.reshape(K, A).sum(axis=1).astype(jnp.uint32)
     # Terminality counts deduped successors too, but not boundary-excluded
     # ones (ref: bfs.rs:287-333).
     has_succ = validf.reshape(K, A).any(axis=1)
@@ -114,13 +124,22 @@ def expand_insert(
     slo, shi = state_fingerprint(model, flat)
     par_lo = jnp.repeat(lo, A)
     par_hi = jnp.repeat(hi, A)
+    if salt_lo is not None:
+        from .fingerprint import salt_fp
+
+        sl_rep = jnp.repeat(salt_lo, A)
+        sh_rep = jnp.repeat(salt_hi, A)
+        key_lo, key_hi = salt_fp(slo, shi, sl_rep, sh_rep)
+        par_lo, par_hi = salt_fp(par_lo, par_hi, sl_rep, sh_rep)
+    else:
+        key_lo, key_hi = slo, shi
     t_lo, t_hi, p_lo, p_hi, is_new, ovf = insert(
-        t_lo, t_hi, p_lo, p_hi, slo, shi, par_lo, par_hi, validf
+        t_lo, t_hi, p_lo, p_hi, key_lo, key_hi, par_lo, par_hi, validf
     )
     return (
         t_lo, t_hi, p_lo, p_hi,
         flat, slo, shi, is_new,
-        gen_count, has_succ, ovf,
+        gen_rows, has_succ, ovf,
     )
 
 
@@ -422,11 +441,12 @@ class FrontierSearch:
             (
                 t_lo, t_hi, p_lo, p_hi,
                 flat, slo, shi, is_new,
-                gen_count, has_succ, ovf,
+                gen_rows, has_succ, ovf,
             ) = expand_insert(
                 model, t_lo, t_hi, p_lo, p_hi, states, lo, hi, active,
                 insert=insert,
             )
+            gen_count = gen_rows.sum()
             out_states, out_lo, out_hi, out_src, new_count = compact_new(
                 flat, slo, shi, is_new
             )

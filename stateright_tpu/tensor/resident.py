@@ -533,11 +533,12 @@ class ResidentSearch:
             (
                 t_lo, t_hi, p_lo, p_hi,
                 flat, slo, shi, is_new,
-                gen, has_succ, ovf,
+                gen_rows, has_succ, ovf,
             ) = expand_insert(
                 model, c.t_lo, c.t_hi, c.p_lo, c.p_hi, states, lo, hi,
                 active, insert=insert,
             )
+            gen = gen_rows.sum()
 
             # -- eventually counterexamples at terminal states -----------------
             if eventually_i:

@@ -81,3 +81,28 @@ def test_device_detail_pins_tier_occupancy_keys():
 def test_device_detail_omits_tier_keys_for_device_store_runs():
     row = bench.device_detail({"states_per_sec": 1000.0, "sec": 2.0})
     assert "hot_fill" not in row and "spilled_states" not in row
+
+
+def test_device_detail_pins_service_row_keys():
+    # The BENCH_SERVICE=1 check-service row is part of the artifact
+    # contract: mixed-job-batch throughput and the serial A/B ratio must
+    # survive into detail.device so the "service beats serial" claim is
+    # auditable in every BENCH_r*.json.
+    for key in ("n_jobs", "jobs_per_sec", "vs_serial", "serial_sec"):
+        assert key in bench.DEVICE_DETAIL_FIELDS
+    row = bench.device_detail(
+        {
+            "states_per_sec": 3400.0,
+            "sec": 12.7,
+            "n_jobs": 8,
+            "jobs_per_sec": 0.63,
+            "vs_serial": 1.74,
+            "serial_sec": 22.2,
+            "service_steps": 54,
+            "serial_steps": 125,
+        }
+    )
+    assert row["n_jobs"] == 8
+    assert row["vs_serial"] == 1.74
+    assert row["jobs_per_sec"] == 0.63
+    assert row["service_steps"] == 54

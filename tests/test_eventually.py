@@ -57,6 +57,18 @@ def test_fixme_can_miss_counterexample_when_revisiting_a_state():
     # Preserved reference semantics: revisits (cycles / DAG joins) are not
     # treated as terminal, so these counterexamples are missed
     # (ref: src/checker.rs:663-680 and the FIXME at src/checker/bfs.rs:293-315).
+    #
+    # NOTE for readers: the `discovery("odd") is None` assertions below are
+    # DELIBERATE reference-FIXME parity, not a latent bug in this codebase.
+    # The reference checker's eventually-bits are cleared per-path and a
+    # revisit of an already-inserted state neither re-propagates pending
+    # bits nor counts as terminal, so a liveness counterexample that only
+    # manifests through a cycle or a DAG join is silently missed — and the
+    # reference pins that miss in its own tests. Every checker here (host
+    # BFS/DFS, device engines, the check service) reproduces the same false
+    # negative on purpose; "fixing" it would break count/discovery parity
+    # with the reference. If the upstream FIXME is ever resolved, these
+    # assertions should flip to real discoveries in the same commit.
     c = DGraph.with_property(eventually_odd()).with_path([0, 2, 4, 2]).check()
     assert c.discovery("odd") is None  # FIXME parity: should be [0, 2, 4, 2]
 

@@ -100,6 +100,60 @@ def test_tiered_config_validation():
         )
 
 
+def test_tiered_rejects_kv_layout_at_construction():
+    # The interleaved-kv table layout has no eviction path (the sweep and
+    # the bucket-zeroing kernels read the split arrays); the combination
+    # must die at construction with a clear unsupported-layout error, not
+    # degrade silently mid-run.
+    from stateright_tpu.tensor.resident import ResidentSearch
+
+    with pytest.raises(ValueError, match="split table layout"):
+        ResidentSearch(
+            TensorTwoPhaseSys(3), batch_size=64, table_log2=12,
+            table_layout="kv", store="tiered",
+        )
+    with pytest.raises(ValueError, match="split table layout"):
+        TensorTwoPhaseSys(3).checker().spawn_tpu(
+            batch_size=64, table_log2=12,
+            table_layout="kv", store="tiered",
+        )
+
+
+def test_device_evict_prefilter_moves_only_evictable_buckets():
+    # Device-side eviction pre-filter (ROUND7 open item): with most buckets
+    # full (pinned) or empty, only the per-bucket counts and the few
+    # evictable bucket rows may cross PCIe — the byte counters prove the
+    # reduction vs an unfiltered full-window transfer.
+    import jax.numpy as jnp
+
+    size, b = 2048, 128  # 16 buckets
+    ts = TieredStore(
+        size, TieredConfig(high_water=0.5, low_water=0.1, summary_log2=12),
+        background=False,
+    )
+    t_lo = np.zeros(size, np.uint32)
+    for i in range(10):  # 10 full buckets: pinned, must not move
+        t_lo[i * b : (i + 1) * b] = np.arange(1, b + 1)
+    for i in range(10, 13):  # 3 partial buckets: the evictable set
+        t_lo[i * b : i * b + 40] = np.arange(1, 41)
+    zeros = np.zeros(size, np.uint32)
+    hot = int((t_lo != 0).sum())
+    tl, th, pl, ph, freed = ts.evict(
+        jnp.asarray(t_lo), jnp.asarray(zeros),
+        jnp.asarray(zeros), jnp.asarray(zeros), hot,
+    )
+    assert freed == 3 * 40
+    st = ts.stats(hot - freed)
+    assert st["evict_bytes_pcie"] < st["evict_bytes_unfiltered"] / 2, st
+    tln = np.asarray(tl)
+    assert (tln[: 10 * b] == t_lo[: 10 * b]).all()  # pinned rows untouched
+    assert (tln[10 * b : 13 * b] == 0).all()  # evicted buckets zeroed
+    # Spilled membership is intact (summary + exact store see the keys).
+    assert ts.resolve_suspects(
+        np.arange(1, 41, dtype=np.uint32), np.zeros(40, np.uint32)
+    ).all()
+
+
 # -- engines: spill mid-search, finish at golden parity ------------------------
 
 
