@@ -505,7 +505,55 @@ def device_search(model_name: str, n: int, repeats: int = 3):
     best, out = _time_search(search, run_kwargs, repeats, closure_s)
     _attach_roofline(out, best, model, batch, table_log2, search)
     _attach_store_stats(out, search)
+    _attach_telemetry(out, best)
     return out, _parity_err(model_name, n, best, golden)
+
+
+def _attach_telemetry(out: dict, best) -> None:
+    """Step-telemetry digest (obs/ring.py) in the bench row — lane
+    utilization, fill trajectory, step-time percentiles ride in
+    detail.device so every BENCH_r*.json can answer "where did the step
+    budget go" without a rerun."""
+    try:
+        if best.detail and "telemetry" in best.detail:
+            out["telemetry"] = best.detail["telemetry"]
+    except Exception as e:  # noqa: BLE001 — reporting must never kill a run
+        log(f"telemetry annotation failed: {e}")
+
+
+def device_search_obs(model_name: str, n: int):
+    """BENCH_OBS=1 row: the r4 anchor workload run twice on the resident
+    engine — telemetry OFF then telemetry ON — proving the ring buffer's
+    overhead on the pinned row (acceptance: <= 2% step time; the ring adds
+    no per-step host sync, so the delta is one ~32-byte in-loop scatter).
+    Returns (result dict for the telemetry-ON run plus `sec_off` and
+    `telemetry_overhead_pct`, parity error or None)."""
+    _pin_platform()
+    from stateright_tpu.tensor.resident import ResidentSearch
+
+    model, batch, table_log2, run_kwargs, engine_kwargs, golden, closure_s = (
+        _build_workload(model_name, n)
+    )
+    runs = {}
+    for telemetry in (False, True):
+        search = ResidentSearch(
+            model, batch_size=batch, table_log2=table_log2,
+            telemetry=telemetry, **engine_kwargs,
+        )
+        best, out = _time_search(search, run_kwargs, repeats=2,
+                                 closure_s=closure_s)
+        runs[telemetry] = (best, out)
+    best_on, out = runs[True]
+    _attach_telemetry(out, best_on)
+    sec_off = runs[False][1]["sec"]
+    out["sec_off"] = sec_off
+    out["telemetry_overhead_pct"] = round(
+        100.0 * (out["sec"] - sec_off) / max(sec_off, 1e-9), 2
+    )
+    perr = _parity_err(model_name, n, best_on, golden) or _parity_err(
+        model_name, n, runs[False][0], golden
+    )
+    return out, perr
 
 
 def _attach_store_stats(out: dict, search) -> None:
@@ -630,6 +678,7 @@ def device_search_sharded(model_name: str, n: int, n_chips: int = 8):
         per_chip_unique=best.detail["per_chip_unique"],
     )
     _attach_store_stats(out, search)
+    _attach_telemetry(out, best)
     return out, _parity_err(model_name, n, best, golden)
 
 
@@ -648,6 +697,10 @@ DEVICE_DETAIL_FIELDS = (
     # the serial A/B ratio (>1 = continuous batching beats serial runs).
     "n_jobs", "jobs_per_sec", "vs_serial", "serial_sec",
     "service_steps", "serial_steps",
+    # Telemetry spine (stateright_tpu/obs/): the step-telemetry digest of
+    # the run, and — on the BENCH_OBS=1 A/B row — the telemetry-off wall
+    # time plus the measured on-vs-off overhead (acceptance: <= 2%).
+    "telemetry", "sec_off", "telemetry_overhead_pct",
 )
 
 
@@ -842,9 +895,16 @@ def main(argv: list | None = None) -> int:
         # lands in detail.device["service-mixed-8"].vs_serial).
         if os.environ.get("BENCH_SERVICE") == "1" and not smoke:
             workloads += (("service-mixed", 8, 2400.0, "--worker-service", None),)
+        # BENCH_OBS=1: add the telemetry on/off A/B on the r4 anchor row
+        # (paxos-3 — the costmodel's pinned 12.9 ms/step workload); the
+        # measured overhead lands in
+        # detail.device["paxos-3-obs"].telemetry_overhead_pct.
+        if os.environ.get("BENCH_OBS") == "1" and not smoke:
+            workloads += (("paxos", 3, 2400.0, "--worker-obs", None),)
         for model, n, wl_timeout, mode, env_extra in workloads:
             key = f"{model}-{n}" + (
-                "-sharded8" if mode == "--worker-sharded" else ""
+                {"--worker-sharded": "-sharded8", "--worker-obs": "-obs"}
+                .get(mode, "")
             )
             r, perr = device_search_subprocess(
                 model,
@@ -912,6 +972,8 @@ def worker_main(model_name: str, n: int, mode: str = "--worker") -> int:
             r, perr = device_search_service(n)
         elif mode == "--worker-sharded":
             r, perr = device_search_sharded(model_name, n)
+        elif mode == "--worker-obs":
+            r, perr = device_search_obs(model_name, n)
         else:
             r, perr = device_search(model_name, n)
         print(json.dumps({"result": r, "error": perr}), flush=True)
@@ -925,7 +987,7 @@ def worker_main(model_name: str, n: int, mode: str = "--worker") -> int:
 
 if __name__ == "__main__":
     if len(sys.argv) == 4 and sys.argv[1] in (
-        "--worker", "--worker-sharded", "--worker-service"
+        "--worker", "--worker-sharded", "--worker-service", "--worker-obs"
     ):
         sys.exit(worker_main(sys.argv[2], int(sys.argv[3]), mode=sys.argv[1]))
     try:

@@ -72,6 +72,12 @@ class Checker:
         spill_events here); None for single-tier checkers."""
         return None
 
+    def table_fill(self) -> Optional[float]:
+        """Visited-table fill fraction (0..1) when the checker can report
+        it cheaply; None otherwise. Feeds the WriteReporter `fill=` field
+        and `/metrics`."""
+        return None
+
     # -- conveniences ----------------------------------------------------------
 
     def discovery(self, name: str) -> Optional[Path]:
@@ -88,14 +94,30 @@ class Checker:
         """Periodically emit status until done, then a final line plus the
         discovery summary (ref: src/checker.rs:412-452)."""
         start = time.monotonic()
+        prev: Optional[tuple] = None  # (states, t) of the previous tick
         while not self.is_done():
+            now = time.monotonic()
+            states = self.state_count()
+            # rate: states/sec over the last reporting window (telemetry
+            # satellite) — the live-progress twin of the bench's
+            # states_per_sec, without waiting for the Done line. The first
+            # tick has no window yet (the search started before this loop),
+            # so it reports no rate rather than a microsecond-window blowup.
+            rate = (
+                (states - prev[0]) / max(now - prev[1], 1e-9)
+                if prev is not None
+                else None
+            )
+            prev = (states, now)
             reporter.report_checking(
                 ReportData(
-                    total_states=self.state_count(),
+                    total_states=states,
                     unique_states=self.unique_state_count(),
                     max_depth=self.max_depth(),
-                    duration=time.monotonic() - start,
+                    duration=now - start,
                     done=False,
+                    rate=rate,
+                    fill=self.table_fill(),
                 )
             )
             time.sleep(reporter.delay())

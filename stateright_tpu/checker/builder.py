@@ -32,6 +32,7 @@ class CheckerBuilder:
         self.visitor_ = None
         self.finish_when_: HasDiscoveries = HasDiscoveries.ALL
         self.timeout_: Optional[float] = None
+        self.trace_out_: Optional[str] = None
 
     # -- config (fluent; ref: src/checker.rs:219-287) --------------------------
 
@@ -66,6 +67,14 @@ class CheckerBuilder:
 
     def timeout(self, seconds: float) -> "CheckerBuilder":
         self.timeout_ = seconds
+        return self
+
+    def trace_out(self, path: str) -> "CheckerBuilder":
+        """Record the spawned checker's host phases (dispatch, tiered-store
+        servicing, checkpointing) as Chrome trace-event JSON at `path` —
+        viewable in Perfetto (stateright_tpu/obs/trace.py). Honored by
+        `spawn_tpu`; the host checkers ignore it."""
+        self.trace_out_ = path
         return self
 
     @property
@@ -117,6 +126,8 @@ class CheckerBuilder:
             raise NotImplementedError(
                 "the TPU frontier checker has not landed yet in this build"
             ) from e
+        if self.trace_out_ is not None:
+            kwargs.setdefault("trace_out", self.trace_out_)
         return TpuChecker(self, **kwargs)
 
     def spawn_service(self, service, priority: int = 0):

@@ -20,17 +20,23 @@ class TpuChecker(Checker):
         batch_size: int = 1024,
         table_log2: int = 20,
         resident: bool = None,
+        trace_out: Optional[str] = None,
         **engine_kwargs,
     ):
         # engine_kwargs pass through to the underlying engine —
         # ResidentSearch options like table_layout ("split"/"kv"),
         # insert_variant ("sort"/"phased"/"capped"/"capped-phased"),
-        # append ("scatter"/"dus"), queue_log2, donate_chunks, and the
+        # append ("scatter"/"dus"), queue_log2, donate_chunks, the
         # tiered-store knobs (store="tiered", high_water, low_water,
-        # summary_log2 — stateright_tpu/store/) — so builder-API users can
-        # reach the same design knobs the tuner races. With resident=False
-        # the host-orchestrated engine accepts insert_variant and the
-        # tiered-store knobs (it races the same visited-set designs).
+        # summary_log2 — stateright_tpu/store/), and the telemetry knobs
+        # (telemetry=..., telemetry_log2=... — stateright_tpu/obs/) — so
+        # builder-API users can reach the same design knobs the tuner
+        # races. With resident=False the host-orchestrated engine accepts
+        # insert_variant, the tiered-store knobs, and telemetry (it races
+        # the same visited-set designs). `trace_out=<path>` records host
+        # phases as Chrome trace-event JSON, saved when the search thread
+        # finishes (load it in Perfetto; see obs/trace.py).
+        from ..obs import Tracer
         from ..tensor.frontier import FrontierSearch
         from ..tensor.model import TensorModel
         from ..tensor.resident import ResidentSearch
@@ -89,13 +95,16 @@ class TpuChecker(Checker):
         if not resident:
             unsupported = set(engine_kwargs) - {
                 "insert_variant", "store", "high_water", "low_water",
-                "summary_log2",
+                "summary_log2", "telemetry", "telemetry_log2",
             }
             if unsupported:
                 raise ValueError(
                     f"engine options {sorted(unsupported)} require the "
                     "resident engine (drop resident=False)"
                 )
+        self._trace_out = trace_out
+        if trace_out is not None:
+            engine_kwargs["tracer"] = Tracer(annotate=True)
         self._search = (
             ResidentSearch(model, batch_size, table_log2, **engine_kwargs)
             if resident
@@ -141,7 +150,8 @@ class TpuChecker(Checker):
             # budget — overriding it here would defeat the wall clock.
             kwargs.setdefault("budget", 1 << 20)
         try:
-            self._result = self._search.run(**kwargs)
+            with self._search._tracer.span("search.run", cat="checker"):
+                self._result = self._search.run(**kwargs)
             if self._recorder is not None:
                 from ..core.visitor import StateRecorder
 
@@ -158,6 +168,12 @@ class TpuChecker(Checker):
                     self._visit_paths()
         except BaseException as e:  # noqa: BLE001 — surfaced by join()
             self._panic = e
+        finally:
+            if self._trace_out is not None:
+                try:
+                    self._search._tracer.save(self._trace_out)
+                except OSError:
+                    pass  # tracing must never fail a finished search
 
     def _visit_paths(self) -> None:
         """Call the visitor with a full Path for every evaluated state.
@@ -270,6 +286,24 @@ class TpuChecker(Checker):
         engine runs store="tiered") — surfaced in the Explorer `/.status`."""
         stats = getattr(self._search, "store_stats", None)
         return stats() if stats is not None else None
+
+    def telemetry_summary(self) -> Optional[dict]:
+        """The engine's step-telemetry digest (obs/ring.py; None with
+        telemetry off) — surfaced in the Explorer `/.status`/`/metrics`."""
+        t = getattr(self._search, "telemetry_summary", None)
+        return t() if t is not None else None
+
+    def table_fill(self) -> Optional[float]:
+        """Visited-table fill for the WriteReporter `fill=` field: the
+        tiered store's exact hot_fill when present, else live uniques over
+        table slots (exact for the device store — claims == uniques)."""
+        stats = self.store_stats()
+        if stats and "hot_fill" in stats:
+            return stats["hot_fill"]
+        log2 = getattr(self._search, "table_log2", None)
+        if log2 is None:
+            log2 = self._search.table.log2_size
+        return min(self.unique_state_count() / (1 << log2), 1.0)
 
     def discoveries(self) -> dict[str, Path]:
         if self._result is None:

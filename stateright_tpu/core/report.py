@@ -14,13 +14,19 @@ from typing import Optional, TextIO
 
 @dataclass
 class ReportData:
-    """Snapshot of checker progress (ref: src/report.rs:10-21)."""
+    """Snapshot of checker progress (ref: src/report.rs:10-21).
+
+    `rate` (states/sec over the last reporting window) and `fill`
+    (visited-table fill fraction) come from the telemetry spine when the
+    checker exposes them; None keeps the reference's plain line."""
 
     total_states: int
     unique_states: int
     max_depth: int
     duration: float  # seconds
     done: bool
+    rate: Optional[float] = None
+    fill: Optional[float] = None
 
 
 class Reporter:
@@ -45,18 +51,25 @@ class WriteReporter(Reporter):
         self.stream = stream if stream is not None else sys.stdout
 
     def report_checking(self, data: ReportData) -> None:
-        # Line formats match the reference exactly (ref: src/report.rs:65-82);
-        # bench harnesses grep the `sec=` field of the Done line.
+        # The Done line is BYTE-format-compatible with the reference
+        # (ref: src/report.rs:65-82) — bench harnesses grep its `sec=`
+        # field; the Checking lines append telemetry-fed `rate=`/`fill=`
+        # fields when the checker provides them.
         if data.done:
             self.stream.write(
                 f"Done. states={data.total_states}, unique={data.unique_states}, "
                 f"depth={data.max_depth}, sec={data.duration:.6g}\n"
             )
         else:
-            self.stream.write(
+            line = (
                 f"Checking. states={data.total_states}, "
-                f"unique={data.unique_states}, depth={data.max_depth}\n"
+                f"unique={data.unique_states}, depth={data.max_depth}"
             )
+            if data.rate is not None:
+                line += f", rate={data.rate:.0f}"
+            if data.fill is not None:
+                line += f", fill={100.0 * data.fill:.1f}%"
+            self.stream.write(line + "\n")
         self.stream.flush()
 
     def report_discoveries(self, model, discoveries: dict) -> None:

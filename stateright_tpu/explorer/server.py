@@ -7,7 +7,11 @@ background as they are visited. Endpoints mirror the reference:
 - ``GET /``, ``/app.js``, ``/app.css`` — static UI assets
   (ref: src/checker/explorer.rs:134-138)
 - ``GET /.status`` — counts + per-property verdicts as JSON
-  (ref: src/checker/explorer.rs:139-143, 171-190)
+  (ref: src/checker/explorer.rs:139-143, 171-190); checkers that expose a
+  state store / step telemetry surface those here too
+- ``GET /metrics`` — checker counters plus every obs-registry source in
+  Prometheus text exposition format (no reference equivalent; the
+  scrape-ready twin of `/.status`)
 - ``GET /.states/{fp}/{fp}/...`` — re-executes the model along the
   fingerprint path and returns the NEXT steps as StateViews (action,
   formatted outcome, state dump, per-property status, sequence-diagram SVG)
@@ -34,6 +38,7 @@ from ..core.fingerprint import fingerprint
 from ..core.model import Expectation
 from ..core.path import Path
 from ..core.visitor import CheckerVisitor
+from ..obs import REGISTRY, render_prometheus
 
 
 class RecentPathSnapshot(CheckerVisitor):
@@ -168,6 +173,7 @@ def status_view(checker, recent: Optional[RecentPathSnapshot] = None) -> dict:
             }
         )
     store = getattr(checker, "store_stats", None)
+    telemetry = getattr(checker, "telemetry_summary", None)
     return {
         "model": type(model).__name__,
         "state_count": checker.state_count(),
@@ -182,7 +188,40 @@ def status_view(checker, recent: Optional[RecentPathSnapshot] = None) -> dict:
         # spill_events) when the checker runs the tiered store; None for
         # single-tier checkers — degradation past HBM is observable live.
         "store": store() if store is not None else None,
+        # Step-telemetry digest (obs/ring.py) for checkers that carry one
+        # (the TPU engines); None for the host checkers.
+        "telemetry": telemetry() if telemetry is not None else None,
     }
+
+
+def checker_metrics(checker) -> dict:
+    """Flat counter snapshot of a checker for `/metrics` (the Prometheus
+    twin of `status_view`, minus the per-property rows)."""
+    out = {
+        "state_count": checker.state_count(),
+        "unique_state_count": checker.unique_state_count(),
+        "max_depth": checker.max_depth(),
+        "done": checker.is_done(),
+    }
+    store = getattr(checker, "store_stats", None)
+    stats = store() if store is not None else None
+    if stats:
+        # Non-numeric leaves (the store kind string) are dropped by the
+        # Prometheus renderer's flatten step.
+        out["store"] = stats
+    fill_fn = getattr(checker, "table_fill", None)
+    fill = fill_fn() if fill_fn is not None else None
+    if fill is not None:
+        out["table_fill"] = fill
+    return out
+
+
+def prometheus_view(checker) -> str:
+    """Prometheus text for `GET /metrics`: the served checker plus every
+    source in the obs registry (live engines, services, ...)."""
+    groups = dict(REGISTRY.collect())
+    groups["checker"] = checker_metrics(checker)
+    return render_prometheus(groups)
 
 
 # -- HTTP plumbing -------------------------------------------------------------
@@ -242,6 +281,17 @@ def serve(builder, address: str = "localhost:3000", block: bool = False):
                 return
             if self.path == "/.status":
                 self._json(status_view(checker, snapshot))
+                return
+            if self.path == "/metrics":
+                body = prometheus_view(checker).encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
                 return
             if self.path == "/.states" or self.path.startswith("/.states/"):
                 raw = self.path[len("/.states") :].strip("/")

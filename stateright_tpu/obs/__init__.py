@@ -1,0 +1,51 @@
+"""Telemetry spine: device step counters, span tracing, counter registry.
+
+Three planes, wired through every layer of the checker (engines, tiered
+store, check service, HTTP servers):
+
+1. **Device step telemetry** (`ring.py`) — each engine step appends one
+   fixed-width metrics row (`STEP_COLS`) into a device-resident ring buffer
+   drained to host in bulk at chunk boundaries; `StepRing.summary()` is the
+   digest surfaced in `SearchResult.detail["telemetry"]` and bench rows.
+2. **Span tracing** (`trace.py`) — host phases (dispatch, eviction, suspect
+   resolution, checkpoint, service grants) recorded as Chrome trace-event
+   JSON via the `trace_out=` knob; viewable in Perfetto, optionally aligned
+   with XLA traces through `jax.profiler.TraceAnnotation`.
+3. **Counter registry + export** (`registry.py`, `schema.py`) — components
+   register metric providers into `REGISTRY`; both HTTP servers render it as
+   Prometheus text at `GET /metrics`; `schema.py` pins the one documented
+   `SearchResult.detail` vocabulary.
+"""
+
+from .ring import N_COLS, STEP_COLS, StepRing, build_detail
+from .registry import (
+    REGISTRY,
+    CounterRegistry,
+    flatten_metrics,
+    render_prometheus,
+)
+from .schema import (
+    DETAIL_KEYS,
+    SERVICE_DETAIL_KEYS,
+    TELEMETRY_KEYS,
+    validate_detail,
+)
+from .trace import NULL_TRACER, Tracer, as_tracer
+
+__all__ = [
+    "STEP_COLS",
+    "N_COLS",
+    "StepRing",
+    "build_detail",
+    "REGISTRY",
+    "CounterRegistry",
+    "flatten_metrics",
+    "render_prometheus",
+    "DETAIL_KEYS",
+    "SERVICE_DETAIL_KEYS",
+    "TELEMETRY_KEYS",
+    "validate_detail",
+    "NULL_TRACER",
+    "Tracer",
+    "as_tracer",
+]

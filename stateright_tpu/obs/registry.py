@@ -1,0 +1,153 @@
+"""Central counter registry + Prometheus text exposition.
+
+Components that own counters — the tiered store, the engines, the check
+service scheduler — register a zero-arg PROVIDER (usually a bound `metrics()`
+method) under a source name. `collect()` calls every live provider and
+returns `{source: flat-metrics-dict}`; `render_prometheus` turns that into
+the Prometheus text exposition format served at `GET /metrics` by both the
+Explorer server and the service HTTP front end.
+
+Providers are held through weak references (`weakref.WeakMethod` for bound
+methods), so registering a per-search engine cannot leak it: dead sources are
+pruned on every `collect()`. A provider that raises is reported as a
+`<source>_scrape_error 1` gauge instead of failing the whole scrape.
+
+Metric values may be numbers, bools (0/1), None (skipped), nested dicts
+(flattened with `_`), or lists of numbers (exported with an `{index="i"}`
+label — e.g. per-shard counters).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import weakref
+from typing import Callable, Optional
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _sanitize(name: str) -> str:
+    name = _NAME_RE.sub("_", str(name))
+    if not name or not (name[0].isalpha() or name[0] == "_"):
+        name = "_" + name
+    return name
+
+
+def flatten_metrics(d: dict, prefix: str = "") -> dict:
+    """Flatten nested dicts to `a_b_c -> number`; bools become 0/1, None and
+    non-numeric leaves are dropped, numeric lists survive as lists (rendered
+    with an index label)."""
+    out: dict = {}
+    for k, v in (d or {}).items():
+        key = f"{prefix}{_sanitize(k)}"
+        if isinstance(v, dict):
+            out.update(flatten_metrics(v, prefix=key + "_"))
+        elif isinstance(v, bool):
+            out[key] = int(v)
+        elif isinstance(v, (int, float)):
+            out[key] = v
+        elif isinstance(v, (list, tuple)) and all(
+            isinstance(x, (int, float)) and not isinstance(x, bool) for x in v
+        ):
+            out[key] = list(v)
+    return out
+
+
+def render_prometheus(groups: dict, prefix: str = "stateright") -> str:
+    """Prometheus text exposition for `{source: metrics-dict}` (values as
+    accepted by `flatten_metrics`). Every metric is exported as a gauge named
+    `<prefix>_<source>_<key>`."""
+    lines: list[str] = []
+    for source in sorted(groups):
+        flat = flatten_metrics(groups[source])
+        src = _sanitize(source)
+        for key in sorted(flat):
+            name = f"{prefix}_{src}_{key}"
+            value = flat[key]
+            lines.append(f"# TYPE {name} gauge")
+            if isinstance(value, list):
+                for i, x in enumerate(value):
+                    lines.append(f'{name}{{index="{i}"}} {_num(x)}')
+            else:
+                lines.append(f"{name} {_num(value)}")
+    return "\n".join(lines) + "\n"
+
+
+def _num(x) -> str:
+    if isinstance(x, float):
+        return repr(x)
+    return str(x)
+
+
+class CounterRegistry:
+    """Weakly-held named metric sources (see module docstring)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sources: dict[str, Callable] = {}
+
+    def register(self, name: str, provider: Callable[[], dict]) -> str:
+        """Register `provider` under `name` (auto-suffixed on collision with
+        a live source); returns the name actually used. Bound methods are
+        held via `WeakMethod` — the registry never keeps an engine alive."""
+        ref: Callable
+        if hasattr(provider, "__self__"):
+            wm = weakref.WeakMethod(provider)
+            ref = lambda: (lambda m: m() if m is not None else None)(wm())
+            ref._weak = wm  # liveness probe for pruning
+        else:
+            ref = lambda: provider()
+            ref._weak = None
+        with self._lock:
+            base, n = _sanitize(name), 1
+            used = base
+            while used in self._sources and self._alive(self._sources[used]):
+                n += 1
+                used = f"{base}{n}"
+            self._sources[used] = ref
+            return used
+
+    @staticmethod
+    def _alive(ref) -> bool:
+        weak = getattr(ref, "_weak", None)
+        return weak is None or weak() is not None
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._sources.pop(name, None)
+
+    def sources(self) -> list:
+        with self._lock:
+            return sorted(
+                k for k, v in self._sources.items() if self._alive(v)
+            )
+
+    def collect(self) -> dict:
+        """{source: metrics dict} from every live provider; dead weakrefs are
+        pruned, raising providers degrade to a `scrape_error` gauge."""
+        with self._lock:
+            items = list(self._sources.items())
+        out: dict = {}
+        dead: list[str] = []
+        for name, ref in items:
+            if not self._alive(ref):
+                dead.append(name)
+                continue
+            try:
+                m = ref()
+            except Exception:  # noqa: BLE001 — one bad source can't kill /metrics
+                m = {"scrape_error": 1}
+            if m is None:
+                dead.append(name)
+                continue
+            out[name] = m
+        if dead:
+            with self._lock:
+                for name in dead:
+                    self._sources.pop(name, None)
+        return out
+
+
+#: The process-global registry both HTTP `/metrics` endpoints render from.
+REGISTRY = CounterRegistry()

@@ -1,0 +1,86 @@
+"""The ONE documented counter schema for `SearchResult.detail`.
+
+Before this module, per-engine counters were ad-hoc: `store_stats()` invented
+tier keys, the sharded engine added balance lists, the check service nested
+its own dict — each consumer (bench.py's DEVICE_DETAIL_FIELDS, the
+bench-contract tests, the Explorer `/.status`) had to know every producer's
+private spelling. This schema pins the shared vocabulary: every key an engine
+may put in `SearchResult.detail` is named here with its owner and meaning,
+`tests/test_bench_contract.py` pins the schema against bench's field list,
+and `validate_detail` gives tests a one-call check that an engine has not
+drifted off it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+#: Top-level `SearchResult.detail` keys (owner → meaning).
+DETAIL_KEYS = {
+    # tiered state store (store/tiered.py `stats()`)
+    "store": "state-store kind; 'tiered' when the two-tier store is active",
+    "hot_fill": "device hot-tier fill fraction (claimed slots / table slots)",
+    "spilled_states": "states resident in the host spill tier",
+    "spill_events": "high-water eviction sweeps completed",
+    "suspects_checked": "Bloom-positive claims resolved exactly on host",
+    "suspects_dup": "suspects confirmed as spilled duplicates",
+    "evict_bytes_pcie": "bytes actually moved over PCIe by eviction",
+    "evict_bytes_unfiltered": "bytes full-window eviction would have moved",
+    # sharded engine (parallel/sharded.py)
+    "per_chip_unique": "per-shard unique-state counts (balance evidence)",
+    "per_shard_spilled": "per-shard spill-tier occupancy (tiered only)",
+    # check service (service/scheduler.py `build_result`)
+    "service": "per-job service metrics sub-dict (SERVICE_DETAIL_KEYS)",
+    "timed_out": "True when the job hit its service deadline",
+    # telemetry spine (obs/ring.py `StepRing.summary`)
+    "telemetry": "step-telemetry digest sub-dict (TELEMETRY_KEYS)",
+}
+
+#: Keys of `detail["service"]` (service/metrics.py JobMetrics.to_dict).
+SERVICE_DETAIL_KEYS = {
+    "queue_wait": "seconds between submission and first lane grant",
+    "device_steps": "fused device steps the job held >= 1 lane in",
+    "lanes_held": "cumulative lanes across those steps (device share)",
+    "preemptions": "times the job was parked for waiting jobs",
+    "suspects_checked": "the job's Bloom-positive claims",
+    "suspects_dup": "...of which were confirmed spilled duplicates",
+    "spill_share": "suspects_checked / unique states (spill pressure)",
+}
+
+#: Keys of `detail["telemetry"]` (obs/ring.py StepRing.summary).
+TELEMETRY_KEYS = {
+    "steps": "total engine steps observed",
+    "captured_steps": "steps with a retained telemetry row",
+    "dropped_steps": "steps without a retained row (ring overwrite on "
+                     "device, or evicted from the host retention window)",
+    "generated_total": "sum of per-step generated counts over every "
+                       "DRAINED row (exact unless the device ring wrapped)",
+    "claimed_total": "sum of per-step fresh table claims over every "
+                     "drained row",
+    "active_lanes": "batch occupancy digest {mean,p50,p95,max}",
+    "generated_per_step": "per-step generated digest {mean,p50,p95,max}",
+    "claimed_per_step": "per-step claim digest {mean,p50,p95,max}",
+    "queue_len_max": "peak frontier-queue occupancy",
+    "fill": "table-fill trajectory {last,p95,max}",
+    "lane_util": "mean active lanes / batch size",
+    "step_us": "per-step wall-time digest {mean,p50,p95,max} where timed",
+    "suspects_max": "peak suspect-buffer occupancy (tiered only)",
+    "shard_imbalance": "max/mean of per-shard claimed totals (sharded only)",
+}
+
+
+def validate_detail(detail: Optional[dict]) -> list:
+    """Key paths in a `SearchResult.detail` dict that the schema does not
+    name (empty list = conforming). Tests assert `== []`."""
+    if detail is None:
+        return []
+    bad = [k for k in detail if k not in DETAIL_KEYS]
+    for sub, allowed in (
+        ("service", SERVICE_DETAIL_KEYS),
+        ("telemetry", TELEMETRY_KEYS),
+    ):
+        if isinstance(detail.get(sub), dict):
+            bad.extend(
+                f"{sub}.{k}" for k in detail[sub] if k not in allowed
+            )
+    return bad

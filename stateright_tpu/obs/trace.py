@@ -1,0 +1,183 @@
+"""Host-side span tracing as Chrome trace-event JSON.
+
+The engines' device kernels are opaque to wall-clock tracing (one dispatch =
+one black box), but everything AROUND them is host phases worth seeing on a
+timeline: compile+dispatch chunks, tiered-store eviction / suspect
+resolution, queue compaction, checkpointing, and the check service's
+admission/grant/preempt/finalize lifecycle. `Tracer` records those phases as
+complete ("ph": "X") events in the Chrome trace-event format, so the file a
+run leaves behind (`trace_out=` on `CheckerBuilder`/`spawn_tpu`/
+`CheckService`) loads directly in Perfetto (https://ui.perfetto.dev) or
+chrome://tracing.
+
+With `annotate=True` each span also enters a `jax.profiler.TraceAnnotation`,
+so when a jax profiler session is active the host phases line up with the
+XLA device trace in the same Perfetto view.
+
+`NULL_TRACER` is the default collaborator everywhere: `span()` returns a
+shared no-op context manager, so call sites trace unconditionally with ~zero
+cost when tracing is off.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "_name", "_cat", "_args", "_t0", "_ann")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str, args: dict):
+        self._tracer = tracer
+        self._name = name
+        self._cat = cat
+        self._args = args
+        self._ann = None
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        if self._tracer.annotate:
+            try:
+                from jax.profiler import TraceAnnotation
+
+                self._ann = TraceAnnotation(self._name)
+                self._ann.__enter__()
+            except Exception:  # noqa: BLE001 — annotation is best-effort
+                self._ann = None
+        return self
+
+    def __exit__(self, *exc):
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(*exc)
+            except Exception:  # noqa: BLE001
+                pass
+        self._tracer._record(
+            self._name, self._cat, self._t0, time.monotonic(), self._args
+        )
+        return False
+
+
+class Tracer:
+    """Collects trace events; thread-safe (the check service spans from its
+    scheduler thread while clients span from theirs)."""
+
+    def __init__(self, annotate: bool = False, max_events: int = 200_000):
+        self.annotate = annotate
+        self.max_events = max_events
+        self.events: list[dict] = []
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._epoch = time.monotonic()
+        self._pid = os.getpid()
+
+    @property
+    def enabled(self) -> bool:
+        return True
+
+    def span(self, name: str, cat: str = "host", **args) -> _Span:
+        """Context manager timing one phase; nests naturally per thread."""
+        return _Span(self, name, cat, args)
+
+    def instant(self, name: str, cat: str = "host", **args) -> None:
+        with self._lock:
+            if len(self.events) >= self.max_events:
+                self.dropped += 1
+                return
+            self.events.append(
+                {
+                    "name": name,
+                    "cat": cat,
+                    "ph": "i",
+                    "s": "t",
+                    "ts": (time.monotonic() - self._epoch) * 1e6,
+                    "pid": self._pid,
+                    "tid": threading.get_ident(),
+                    **({"args": args} if args else {}),
+                }
+            )
+
+    def _record(self, name, cat, t0, t1, args) -> None:
+        with self._lock:
+            if len(self.events) >= self.max_events:
+                self.dropped += 1
+                return
+            self.events.append(
+                {
+                    "name": name,
+                    "cat": cat,
+                    "ph": "X",
+                    "ts": (t0 - self._epoch) * 1e6,
+                    "dur": (t1 - t0) * 1e6,
+                    "pid": self._pid,
+                    "tid": threading.get_ident(),
+                    **({"args": args} if args else {}),
+                }
+            )
+
+    def to_json(self) -> dict:
+        """The Chrome trace-event envelope (object form, the variant every
+        consumer accepts)."""
+        with self._lock:
+            events = list(self.events)
+        meta = {
+            "name": "process_name",
+            "ph": "M",
+            "pid": self._pid,
+            "args": {"name": "stateright_tpu"},
+        }
+        return {
+            "traceEvents": [meta] + events,
+            "displayTimeUnit": "ms",
+            "otherData": {"dropped_events": self.dropped},
+        }
+
+    def save(self, path: str) -> str:
+        """Write the trace JSON; returns the path (load it in Perfetto)."""
+        with open(path, "w") as f:
+            json.dump(self.to_json(), f)
+        return path
+
+
+class _NullTracer:
+    """Span/instant/save no-ops; the default `tracer` everywhere."""
+
+    annotate = False
+    enabled = False
+    events: list = []
+
+    def span(self, name: str, cat: str = "host", **args) -> _NullSpan:
+        return _NULL_SPAN
+
+    def instant(self, name: str, cat: str = "host", **args) -> None:
+        pass
+
+    def to_json(self) -> dict:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+
+    def save(self, path: str) -> Optional[str]:
+        return None
+
+
+NULL_TRACER = _NullTracer()
+
+
+def as_tracer(tracer: Optional[Tracer]) -> "Tracer | _NullTracer":
+    return tracer if tracer is not None else NULL_TRACER

@@ -65,6 +65,7 @@ else:  # pragma: no cover - exercised on jax 0.4.x images
 
     _SHARD_MAP_KW = {"check_rep": False}
 
+from ..obs import N_COLS, REGISTRY, StepRing, as_tracer
 from ..tensor.fingerprint import pack_fp
 from ..core.discovery import HasDiscoveries
 from ..core.model import Expectation
@@ -191,6 +192,8 @@ class _Carry(NamedTuple):
     s_depth: jnp.ndarray  # uint32[SQ]
     s_tail: jnp.ndarray  # int32
     summary: jnp.ndarray  # uint32[W] per-shard Bloom words (read-only in-loop)
+    # -- step telemetry (obs/ring.py; zero-row placeholder when disabled) ------
+    tm_rows: jnp.ndarray  # uint32[TMR, N_COLS] per-shard in-carry metrics ring
 
 
 class ShardedSearch:
@@ -209,6 +212,9 @@ class ShardedSearch:
         high_water: float = 0.85,
         low_water: Optional[float] = None,
         summary_log2: int = 20,
+        telemetry: bool = True,
+        telemetry_log2: int = 12,
+        tracer=None,
     ):
         """`donate_chunks=True` donates the per-shard carry to each chunked
         dispatch so XLA updates the sharded tables/queues in place instead
@@ -221,7 +227,15 @@ class ShardedSearch:
         the same water-mark semantics as the single-device engines — every
         shard spills the states it owns, so the fingerprint→owner map and
         the all-to-all routing are untouched (single-process meshes only:
-        servicing needs every shard addressable)."""
+        servicing needs every shard addressable).
+
+        `telemetry=True` (default) gives each SHARD a device-resident ring
+        of 2^telemetry_log2 obs.STEP_COLS rows in the while_loop carry,
+        drained in bulk at chunk boundaries (steps are globally synced, so
+        per-step rows align across shards — the drain sums extensive
+        columns and tracks per-shard claims for the imbalance digest in
+        `SearchResult.detail["telemetry"]`). `tracer` records host phases
+        as Chrome trace events."""
         self.model = model
         self.donate_chunks = donate_chunks
         self.mesh = mesh if mesh is not None else make_mesh()
@@ -275,6 +289,12 @@ class ShardedSearch:
         else:
             self._spill_trigger = 0
             self._SQ = 0
+        # Per-shard telemetry ring capacity (0 compiles the kernels without
+        # the in-carry ring — the bench A/B knob).
+        self._TMR = (1 << telemetry_log2) if telemetry else 0
+        self._ring = StepRing(self._TMR) if telemetry else None
+        self._tracer = as_tracer(tracer)
+        self._metrics_name = REGISTRY.register("sharded", self.metrics)
         self.props = model.properties()
         self._kernel, self._seed_k, self._chunk_k = self._build()
         self._last_tables = None
@@ -345,6 +365,7 @@ class ShardedSearch:
         else:
             W = 1
         SQ = self._SQ
+        TMR = self._TMR
         # N*C rows of slack beyond the per-shard table size: the append
         # block is N*C rows, and the DUS variant's contract requires the
         # start never to clamp (append_new_dus docstring) — without the
@@ -477,6 +498,7 @@ class ShardedSearch:
                 s_depth=jnp.zeros(SQ, dtype=jnp.uint32),
                 s_tail=jnp.int32(0),
                 summary=jnp.zeros(W, dtype=jnp.uint32),
+                tm_rows=jnp.zeros((TMR, N_COLS), dtype=jnp.uint32),
             )
 
         def make_body(
@@ -671,6 +693,29 @@ class ShardedSearch:
                     required_mask, any_mask, target_lo, target_hi, max_steps,
                 )
 
+                # -- per-shard step telemetry row (obs/ring.py STEP_COLS) ------
+                # Steps are globally synced, so row i holds shard-local
+                # values for the SAME global step on every shard; the host
+                # drain aligns and aggregates them.
+                if TMR:
+                    tm_row = jnp.stack(
+                        [
+                            c.steps.astype(jnp.uint32),
+                            active.sum().astype(jnp.uint32),
+                            gen.astype(jnp.uint32),
+                            is_new.sum().astype(jnp.uint32),
+                            (tail - head).astype(jnp.uint32),
+                            hot_claims.astype(jnp.uint32),
+                            s_tail.astype(jnp.uint32),
+                            max_depth.astype(jnp.uint32),
+                        ]
+                    )
+                    tm_rows = c.tm_rows.at[
+                        jnp.remainder(c.steps, TMR)
+                    ].set(tm_row)
+                else:
+                    tm_rows = c.tm_rows
+
                 return _Carry(
                     t_lo=t_lo2,
                     t_hi=t_hi2,
@@ -701,6 +746,7 @@ class ShardedSearch:
                     s_depth=s_depth,
                     s_tail=s_tail,
                     summary=c.summary,
+                    tm_rows=tm_rows,
                 )
 
             return body
@@ -751,6 +797,7 @@ class ShardedSearch:
                 shard(carry.head >= carry.tail),
                 shard(carry.overflow),
                 shard(carry.steps),
+                shard(carry.tm_rows),
             )
 
         def per_chip_seed(
@@ -869,6 +916,9 @@ class ShardedSearch:
         K = self.batch_size
         start = time.monotonic()
         self._parent_map = None
+        if self._ring is not None and self._carry is None and self._ring.steps:
+            # Fresh search (no suspended carry): telemetry starts over too.
+            self._ring = self._ring.fresh()
 
         # seed_init is deterministic per model; cache its padded host form so
         # resumed runs skip the host expansion/fingerprint work entirely.
@@ -912,35 +962,47 @@ class ShardedSearch:
         )
 
         if not chunked:
-            (
-                t_lo, t_hi, p_lo, p_hi,
-                gen_lo, gen_hi, unique_counts, max_depths,
-                discovered, disc_lo, disc_hi, drained, overflow, steps,
-            ) = jax.block_until_ready(
-                self._kernel(
-                    jnp.asarray(st),
-                    jnp.asarray(lo),
-                    jnp.asarray(hi),
-                    jnp.asarray(active),
-                    *t32,
-                    *seed32,
-                    jnp.uint32(required_mask),
-                    jnp.uint32(any_mask),
-                    jnp.int32(max_steps),
-                    jnp.uint32(target_max_depth or 0),
+            with self._tracer.span("sharded.search", cat="engine"):
+                (
+                    t_lo, t_hi, p_lo, p_hi,
+                    gen_lo, gen_hi, unique_counts, max_depths,
+                    discovered, disc_lo, disc_hi, drained, overflow, steps,
+                    tm_rows,
+                ) = jax.block_until_ready(
+                    self._kernel(
+                        jnp.asarray(st),
+                        jnp.asarray(lo),
+                        jnp.asarray(hi),
+                        jnp.asarray(active),
+                        *t32,
+                        *seed32,
+                        jnp.uint32(required_mask),
+                        jnp.uint32(any_mask),
+                        jnp.int32(max_steps),
+                        jnp.uint32(target_max_depth or 0),
+                    )
                 )
-            )
             # ONE gather for the whole output tuple (one DCN round-trip on
             # multi-host meshes instead of one per array).
             (
                 t_lo, t_hi, p_lo, p_hi,
                 gen_lo, gen_hi, unique_counts, max_depths,
                 discovered, disc_lo, disc_hi, drained, overflow, steps,
+                tm_rows,
             ) = _host((
                 t_lo, t_hi, p_lo, p_hi,
                 gen_lo, gen_hi, unique_counts, max_depths,
                 discovered, disc_lo, disc_hi, drained, overflow, steps,
+                tm_rows,
             ))
+            if self._ring is not None:
+                # Whole-search dispatch: one bulk drain of every shard's
+                # ring (includes compile time in the window average).
+                self._ring.drain_sharded(
+                    tm_rows,
+                    int(steps.max()),
+                    window_us=(time.monotonic() - start) * 1e6,
+                )
             if bool(overflow.any()):
                 # A previous run's snapshot must not silently serve paths
                 # for states this failed run discovered.
@@ -973,11 +1035,21 @@ class ShardedSearch:
             tmd = jnp.uint32(target_max_depth or 0)
             timed_out = False
             while True:
-                carry, summary = self._chunk_k(
-                    self._carry, req, anym, *t32, tmd,
-                    jnp.int32(budget), jnp.int32(max_steps),
-                )
-                s = _host(summary)  # [N, 12 + 2*max(P,1)] — one transfer
+                t_chunk0 = time.monotonic()
+                with self._tracer.span("sharded.chunk", cat="engine"):
+                    carry, summary = self._chunk_k(
+                        self._carry, req, anym, *t32, tmd,
+                        jnp.int32(budget), jnp.int32(max_steps),
+                    )
+                    s = _host(summary)  # [N, 12 + 2*max(P,1)] — one transfer
+                if self._ring is not None:
+                    # The chunk already synced (summary gather); the ring
+                    # drain is one more bulk copy, never a per-step sync.
+                    self._ring.drain_sharded(
+                        _host(carry.tm_rows),
+                        int(s[:, 8].max()),
+                        window_us=(time.monotonic() - t_chunk0) * 1e6,
+                    )
                 codes = s[:, 7].astype(np.uint32)
                 if (codes & EXIT_SERVICE).any() and not (
                     codes & (ABORT_TABLE | ABORT_QUEUE | ABORT_ROUTE)
@@ -1089,8 +1161,43 @@ class ShardedSearch:
                 # fp-sharding balance evidence (task: per-chip spread).
                 "per_chip_unique": [int(x) for x in unique_counts],
                 **(self.store_stats() or {}),
+                **(
+                    {"telemetry": self.telemetry_summary()}
+                    if self._ring is not None
+                    else {}
+                ),
             },
         )
+
+    def telemetry_summary(self) -> Optional[dict]:
+        """Cross-shard step-telemetry digest (obs/ring.py; None with
+        telemetry off) — includes the per-shard claim imbalance."""
+        if self._ring is None:
+            return None
+        # table_claims drains as the MAX across shards, so the fill digest
+        # is the hottest shard's fill against the PER-SHARD table size (the
+        # store_stats()["hot_fill"] convention); active lanes SUM across
+        # shards, so utilization is against the mesh-wide batch.
+        return self._ring.summary(
+            1 << self.table_log2, self.n_chips * self.batch_size
+        )
+
+    def metrics(self) -> dict:
+        """Flat counter snapshot for the obs registry / Prometheus export
+        (host-side values only — a scrape never syncs the mesh)."""
+        out: dict = {"n_chips": self.n_chips}
+        if self._ring is not None:
+            out.update(
+                steps=self._ring.steps,
+                generated_states=self._ring.generated_total,
+                claimed_states=self._ring.claimed_total,
+            )
+        stats = self.store_stats()
+        if stats:
+            # Non-numeric leaves (the store's kind string) are dropped by
+            # the Prometheus renderer's flatten step.
+            out["store"] = stats
+        return out
 
     def _service(self) -> None:
         """Host half of the tiered store for the sharded engine, with
@@ -1143,6 +1250,10 @@ class ShardedSearch:
         # actually buffered suspects.
         n_confs = np.zeros(N, dtype=np.int32)
         if s_tail.any():
+            self._tracer.instant(
+                "tiered.suspect_resolve", cat="store",
+                suspects=int(s_tail.sum()),
+            )
             blk_states = np.zeros((N, SQ, L), dtype=np.uint32)
             blk = {
                 k: np.zeros((N, SQ), dtype=np.uint32)
@@ -1183,6 +1294,7 @@ class ShardedSearch:
         # Eviction: windowed device-slice transfers per over-water shard.
         tables = None
         if (hot >= self._spill_trigger).any():
+            self._tracer.instant("tiered.evict", cat="store")
             parts = {k: [] for k in ("t_lo", "t_hi", "p_lo", "p_hi")}
             for i in range(N):
                 tl, th = c.t_lo[i], c.t_hi[i]
@@ -1241,6 +1353,8 @@ class ShardedSearch:
         self._parent_map = None
         self._last_tables = None
         self._q_compacted = False
+        if self._ring is not None:
+            self._ring = self._ring.fresh()  # telemetry starts over too
         if self._stores is not None:
             self._fresh_stores()  # spill tiers + summaries start empty
 
@@ -1421,11 +1535,19 @@ class ShardedSearch:
             "s_depth": np.zeros((N_, ss._SQ), np.uint32),
             "s_tail": np.zeros(N_, np.int32),
             "summary": np.zeros((N_, 1), np.uint32),
+            "tm_rows": np.zeros((N_, ss._TMR, N_COLS), np.uint32),
         }
         fields = {
             f: data[f] if f in data else defaults[f] for f in _Carry._fields
         }
         fields["overflow"] = np.asarray(fields["overflow"], np.uint32)
+        # Telemetry ring: observability, not search state — a different ring
+        # size (or pre-obs checkpoint) restores empty, with pre-restore
+        # steps counted as uncaptured.
+        if np.asarray(fields["tm_rows"]).shape != (N_, ss._TMR, N_COLS):
+            fields["tm_rows"] = np.zeros((N_, ss._TMR, N_COLS), np.uint32)
+        if ss._ring is not None:
+            ss._ring.skip_to(int(np.asarray(fields["steps"]).max()))
         if store_meta:
             from ..store.tiered import TieredStore
 

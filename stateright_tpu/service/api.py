@@ -36,6 +36,7 @@ from typing import Optional
 
 from ..core.discovery import HasDiscoveries
 from ..checker.base import Checker
+from ..obs import REGISTRY, Tracer, as_tracer
 from .queue import AdmissionQueue, Job, JobStatus
 from .scheduler import ServiceEngine, ServiceError
 
@@ -92,7 +93,19 @@ class CheckService:
         preempt_steps: Optional[int] = None,
         spill_dir: Optional[str] = None,
         background: bool = True,
+        telemetry: bool = True,
+        telemetry_log2: int = 12,
+        trace_out: Optional[str] = None,
     ):
+        """`telemetry=True` records one step-metrics row per fused device
+        step (obs/ring.py; digest in `stats()["telemetry"]`, `/.status`,
+        and `/metrics`). `trace_out=<path>` records the service lifecycle
+        (admission, fused steps, eviction, preemption, finalize) as Chrome
+        trace-event JSON saved on `close()` — load it in Perfetto."""
+        self._trace_out = trace_out
+        self._tracer = as_tracer(
+            Tracer(annotate=True) if trace_out else None
+        )
         self._engine = ServiceEngine(
             batch_size=batch_size,
             table_log2=table_log2,
@@ -101,7 +114,14 @@ class CheckService:
             high_water=high_water,
             low_water=low_water,
             summary_log2=summary_log2,
+            telemetry=telemetry,
+            telemetry_log2=telemetry_log2,
+            tracer=self._tracer if trace_out else None,
         )
+        # Central counter registry (obs/registry.py): both HTTP front ends'
+        # `/metrics` render every registered source; weakly held, so a
+        # dropped service unregisters itself.
+        self._metrics_name = REGISTRY.register("service", self.metrics)
         self.max_resident = max_resident
         self.preempt_steps = preempt_steps
         self.spill_dir = spill_dir
@@ -234,11 +254,33 @@ class CheckService:
                     self._engine.hot_claims / self._engine.table.size, 4
                 ),
                 "store": self._engine.store_stats(),
+                # Step-telemetry digest (obs/ring.py) — merged into the
+                # HTTP `/.status` through this dict.
+                "telemetry": self._engine.telemetry_summary(),
             }
 
     def store_stats(self) -> Optional[dict]:
         with self._lock:
             return self._engine.store_stats()
+
+    def telemetry_summary(self) -> Optional[dict]:
+        with self._lock:  # a scrape must not race the scheduler's appends
+            return self._engine.telemetry_summary()
+
+    def table_fill(self) -> float:
+        """The shared table's fill fraction alone — the reporter's per-tick
+        read, without rebuilding the whole stats()/telemetry digest."""
+        with self._lock:
+            return round(
+                self._engine.hot_claims / self._engine.table.size, 4
+            )
+
+    def metrics(self) -> dict:
+        """Flat counters for the obs registry / `GET /metrics` (service
+        stats plus the engine's step digest; per-job rows stay in
+        `/.status` — unbounded label cardinality does not belong in
+        Prometheus gauges)."""
+        return self.stats()
 
     # -- scheduling ------------------------------------------------------------
 
@@ -267,6 +309,9 @@ class CheckService:
         )
 
     def _finalize(self, job: Job, status: str = JobStatus.DONE) -> None:
+        self._tracer.instant(
+            "service.finalize", cat="service", job=job.id, status=status
+        )
         job.status = status
         job.metrics.finished_at = time.monotonic()
         self._engine.retire(job)
@@ -294,7 +339,10 @@ class CheckService:
                 self._engine.group_of(job).jobs.append(job)
                 continue
             try:
-                done = self._engine.admit(job)
+                with self._tracer.span(
+                    "service.admit", cat="service", job=job.id
+                ):
+                    done = self._engine.admit(job)
             except ServiceError:
                 raise
             except Exception as e:  # noqa: BLE001 — a bad model fails its job
@@ -329,6 +377,9 @@ class CheckService:
         if not due:
             return
         job = max(due, key=lambda j: j.steps_since_admit)
+        self._tracer.instant(
+            "service.preempt", cat="service", job=job.id
+        )
         g = self._engine.groups.get(id(job.model))
         if g is not None and job in g.jobs:
             g.jobs.remove(job)
@@ -436,6 +487,12 @@ class CheckService:
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
+        REGISTRY.unregister(self._metrics_name)
+        if self._trace_out:
+            try:
+                self._tracer.save(self._trace_out)
+            except OSError:
+                pass  # tracing must never fail a clean shutdown
 
 
 class ServiceChecker(Checker):
@@ -471,3 +528,9 @@ class ServiceChecker(Checker):
 
     def store_stats(self) -> Optional[dict]:
         return self._handle._service.store_stats()
+
+    def table_fill(self) -> Optional[float]:
+        return self._handle._service.table_fill()
+
+    def telemetry_summary(self) -> Optional[dict]:
+        return self._handle._service.telemetry_summary()

@@ -7,6 +7,12 @@ the device), preemption count, and the tiered-store suspect counters that
 attribute spill-tier traffic to the job that caused it. Surfaced through
 `JobHandle.metrics()`, `SearchResult.detail["service"]`, and the service
 HTTP front end's `/.status`.
+
+The keys `to_dict` emits are part of the one documented detail schema
+(`stateright_tpu/obs/schema.py:SERVICE_DETAIL_KEYS`, pinned by
+tests/test_bench_contract.py) — rename there first if you rename here.
+Engine-wide step counters live in the telemetry spine (obs/ring.py), not
+here: JobMetrics is strictly the PER-JOB slice.
 """
 
 from __future__ import annotations

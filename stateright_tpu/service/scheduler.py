@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.model import Expectation
+from ..obs import StepRing, as_tracer
 from ..tensor.fingerprint import pack_fp, salt_fp, unpack_fp
 from ..tensor.frontier import (
     FrontierSearch,
@@ -161,9 +162,19 @@ class ServiceEngine:
         high_water: float = 0.85,
         low_water: Optional[float] = None,
         summary_log2: int = 20,
+        telemetry: bool = True,
+        telemetry_log2: int = 12,
+        tracer=None,
     ):
         self.batch_size = batch_size
         self.table = HashTable(table_log2)
+        # Step telemetry (obs/ring.py): the scheduler is host-orchestrated,
+        # so every per-step scalar the row needs is already fetched — the
+        # ring adds no device work. One ring for the engine lifetime (a
+        # service is a long-lived server; totals are monotonic, retention
+        # keeps the last 2^telemetry_log2 step rows).
+        self._ring = StepRing(1 << telemetry_log2) if telemetry else None
+        self._tracer = as_tracer(tracer)
         if insert_variant not in self.INSERT_VARIANTS:
             raise ValueError(
                 f"insert_variant must be one of "
@@ -383,28 +394,36 @@ class ServiceEngine:
             segments.append((job, m, m + n))
             m += n
 
-        (
-            t_lo, t_hi, p_lo, p_hi,
-            out_states, out_lo, out_hi, out_src, out_sus,
-            new_count, gen_rows, has_succ, overflow, prop_masks,
-        ) = group.step(
-            self.table.t_lo, self.table.t_hi,
-            self.table.p_lo, self.table.p_hi,
-            jnp.asarray(st), jnp.asarray(lo), jnp.asarray(hi),
-            jnp.asarray(salt_lo), jnp.asarray(salt_hi),
-            jnp.asarray(eval_mask),
-            self._store.device_summary()
-            if self._store is not None
-            else self._no_summary,
-        )
-        self.table.t_lo, self.table.t_hi = t_lo, t_hi
-        self.table.p_lo, self.table.p_hi = p_lo, p_hi
-        self.total_steps += 1
-        self._table_stamp += 1
-        if bool(overflow):
-            msg = "shared hash table full; raise table_log2 (or store='tiered')"
-            self._fail_all(msg)
-            raise ServiceError(msg)
+        t_step0 = time.monotonic()
+        with self._tracer.span(
+            "service.step", cat="service", jobs=len(jobs), lanes=m
+        ):
+            (
+                t_lo, t_hi, p_lo, p_hi,
+                out_states, out_lo, out_hi, out_src, out_sus,
+                new_count, gen_rows, has_succ, overflow, prop_masks,
+            ) = group.step(
+                self.table.t_lo, self.table.t_hi,
+                self.table.p_lo, self.table.p_hi,
+                jnp.asarray(st), jnp.asarray(lo), jnp.asarray(hi),
+                jnp.asarray(salt_lo), jnp.asarray(salt_hi),
+                jnp.asarray(eval_mask),
+                self._store.device_summary()
+                if self._store is not None
+                else self._no_summary,
+            )
+            self.table.t_lo, self.table.t_hi = t_lo, t_hi
+            self.table.p_lo, self.table.p_hi = p_lo, p_hi
+            self.total_steps += 1
+            self._table_stamp += 1
+            if bool(overflow):  # first host sync of the step
+                msg = (
+                    "shared hash table full; raise table_log2 "
+                    "(or store='tiered')"
+                )
+                self._fail_all(msg)
+                raise ServiceError(msg)
+        step_us = (time.monotonic() - t_step0) * 1e6
 
         masks = np.asarray(prop_masks)
         gen_rows = np.asarray(gen_rows)
@@ -462,6 +481,7 @@ class ServiceEngine:
 
         # -- successors: attribute to jobs, resolve suspects, append -----------
         self.hot_claims += nc  # device slot claims (incl. suspects)
+        sus_n = 0
         lane_job = np.full(K, -1, dtype=np.int64)
         for idx, (job, s, e) in enumerate(segments):
             lane_job[s:e] = idx
@@ -473,7 +493,11 @@ class ServiceEngine:
             keep = np.ones(nc, dtype=bool)
             if self._store is not None:
                 sus = np.asarray(out_sus[:nc])
+                sus_n = int(sus.sum())
                 if sus.any():
+                    self._tracer.instant(
+                        "tiered.suspect_resolve", cat="store", suspects=sus_n
+                    )
                     k_lo, k_hi = salt_fp(
                         o_lo[sus], o_hi[sus],
                         salt_lo[parents[sus]], salt_hi[parents[sus]],
@@ -503,11 +527,12 @@ class ServiceEngine:
 
         # -- spill eviction (tiered) -------------------------------------------
         if self._store is not None and self.hot_claims >= self._spill_trigger:
-            tl, th, pl, ph, n_ev = self._store.evict(
-                self.table.t_lo, self.table.t_hi,
-                self.table.p_lo, self.table.p_hi,
-                self.hot_claims,
-            )
+            with self._tracer.span("tiered.evict", cat="store"):
+                tl, th, pl, ph, n_ev = self._store.evict(
+                    self.table.t_lo, self.table.t_hi,
+                    self.table.p_lo, self.table.p_hi,
+                    self.hot_claims,
+                )
             if n_ev == 0:
                 msg = (
                     "tiered store could not free any bucket (every bucket "
@@ -518,6 +543,23 @@ class ServiceEngine:
             self.table.t_lo, self.table.t_hi = tl, th
             self.table.p_lo, self.table.p_hi = pl, ph
             self.hot_claims -= n_ev
+
+        # -- step telemetry row (every scalar above is already host-side) ------
+        if self._ring is not None:
+            self._ring.append(
+                active=m,
+                generated=int(gen_rows.sum()),
+                claimed=nc,
+                queue_len=sum(
+                    j.pending_lanes
+                    for g in self.groups.values()
+                    for j in g.jobs
+                ),
+                table_claims=self.hot_claims,
+                suspects=sus_n,
+                depth=int(depth[:m].max()) if m else 0,
+                step_us=step_us,
+            )
 
         # -- per-job finish checks ---------------------------------------------
         for job, _s, _e in segments:
@@ -539,6 +581,11 @@ class ServiceEngine:
     def build_result(self, job: Job) -> SearchResult:
         detail = dict(self.store_stats() or {})
         detail["service"] = job.metrics.to_dict(job.unique_count)
+        t = self.telemetry_summary()
+        if t is not None:
+            # Engine-wide step digest (the shared batches this job rode in),
+            # not a per-job slice — per-job shares live under "service".
+            detail["telemetry"] = t
         if job.timed_out:
             detail["timed_out"] = True
         ref = job.metrics.admitted_at or job.metrics.submitted_at
@@ -572,6 +619,15 @@ class ServiceEngine:
         if self._store is None:
             return None
         return self._store.stats(self.hot_claims)
+
+    def telemetry_summary(self) -> Optional[dict]:
+        """Engine-wide step-telemetry digest (obs/ring.py; None with
+        telemetry off) — surfaced in `/.status`, `/metrics`, and every
+        job result's detail (the owning CheckService is the registry
+        provider; it folds this into its stats())."""
+        if self._ring is None:
+            return None
+        return self._ring.summary(self.table.size, self.batch_size)
 
     # -- path reconstruction ---------------------------------------------------
 

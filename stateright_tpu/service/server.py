@@ -4,8 +4,11 @@ repointed at the multi-job scheduler).
 Endpoints:
 
 - ``GET /.status`` — service counters + one summary row per job (queue
-  wait, lanes held, preemptions, per-tier store occupancy — the service
-  twin of the Explorer's `/.status`).
+  wait, lanes held, preemptions, per-tier store occupancy, step-telemetry
+  digest — the service twin of the Explorer's `/.status`).
+- ``GET /metrics`` — every registered counter source (the obs registry:
+  this service, any live engines, ...) in Prometheus text exposition
+  format, scrape-ready.
 - ``POST /jobs`` — submit a job: ``{"model": "<registry name>", "args":
   {...}, "opts": {"target_max_depth": ..., "timeout": ..., "priority":
   ...}}`` → ``{"job": id}``. Models are named through a REGISTRY of
@@ -28,6 +31,7 @@ import threading
 from typing import Callable, Optional
 
 from ..explorer.server import ExplorerServer
+from ..obs import REGISTRY, render_prometheus
 from .api import CheckService
 
 
@@ -92,6 +96,17 @@ def status_view(service: CheckService) -> dict:
         **service.stats(),
         "job_rows": [service.poll(jid) for jid in service.job_ids()],
     }
+
+
+def metrics_view(service: CheckService) -> str:
+    """Prometheus text for `GET /metrics`: every source in the obs
+    registry. The served (live, strongly-referenced) service is already in
+    the collection under its registered name; the fallback only fires if it
+    was somehow unregistered (e.g. scrape racing close())."""
+    groups = REGISTRY.collect()
+    if service._metrics_name not in groups:
+        groups[service._metrics_name] = service.metrics()
+    return render_prometheus(groups)
 
 
 def submit_view(
@@ -165,10 +180,23 @@ def serve_service(
             except ValueError:
                 return None
 
+        def _text(self, body: str, code=200):
+            data = body.encode()
+            self.send_response(code)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
         def do_GET(self):
             try:
                 if self.path == "/.status":
                     self._json(status_view(service))
+                    return
+                if self.path == "/metrics":
+                    self._text(metrics_view(service))
                     return
                 if self.path.startswith("/jobs/"):
                     if self.path.endswith("/discoveries"):

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from ..core.discovery import HasDiscoveries
 from ..core.model import Expectation
 from ..core.path import Path
+from ..obs import REGISTRY, StepRing, as_tracer, build_detail
 from .fingerprint import device_fingerprint, pack_fp
 from .hashtable import (
     HashTable,
@@ -357,6 +358,9 @@ class FrontierSearch:
         high_water: float = 0.85,
         low_water: Optional[float] = None,
         summary_log2: int = 20,
+        telemetry: bool = True,
+        telemetry_log2: int = 12,
+        tracer=None,
     ):
         """`store="tiered"` enables the two-tier state store
         (stateright_tpu/store/): when device-table occupancy crosses
@@ -364,7 +368,14 @@ class FrontierSearch:
         tier and a device Bloom summary (2^summary_log2 bits) filters
         re-probes — searches whose unique-state count exceeds the table
         degrade gracefully instead of aborting. With the default
-        `store="device"` behavior is byte-identical to before."""
+        `store="device"` behavior is byte-identical to before.
+
+        `telemetry=True` (default) records one obs.STEP_COLS metrics row
+        per device step, host-side — this engine already fetches every
+        per-step scalar the row needs, so telemetry adds no device work or
+        sync; the digest lands in `SearchResult.detail["telemetry"]`.
+        `tracer` (obs.Tracer) records host phases (step dispatch, suspect
+        resolution, eviction) as Chrome trace events."""
         self.model = model
         self.batch_size = batch_size
         self.table = HashTable(table_log2)
@@ -406,6 +417,13 @@ class FrontierSearch:
                     "lower batch_size/low_water"
                 )
         self._hot_claims = 0  # occupied device-table slots (claims - evictions)
+        self._telemetry = telemetry
+        self._tm_capacity = 1 << telemetry_log2  # host row-retention window
+        self._ring: Optional[StepRing] = None  # created per seed (fresh search)
+        self._tracer = as_tracer(tracer)
+        # Weakly registered: /metrics scrapes can see any live engine, and
+        # the registry never keeps a finished search alive (obs/registry.py).
+        self._metrics_name = REGISTRY.register("frontier", self.metrics)
         # Placeholder summary operand for store="device" (the step signature
         # is uniform so both modes share one code path).
         self._no_summary = jnp.zeros(1, dtype=jnp.uint32)
@@ -494,6 +512,7 @@ class FrontierSearch:
         )
         self._disc = {}
         self._hot_claims = 0
+        self._ring = StepRing(self._tm_capacity) if self._telemetry else None
 
         # Insert init states (chunked to batch size).
         for b0 in range(0, n0, K):
@@ -587,29 +606,34 @@ class FrontierSearch:
                 hi[:m] = chunk.hi[b0:b1]
                 active = np.arange(K) < m
 
-                (
-                    t_lo, t_hi, p_lo, p_hi,
-                    out_states, out_lo, out_hi, out_src, out_sus,
-                    new_count, gen_count, has_succ, overflow, prop_masks,
-                ) = self._step(
-                    self.table.t_lo,
-                    self.table.t_hi,
-                    self.table.p_lo,
-                    self.table.p_hi,
-                    jnp.asarray(st),
-                    jnp.asarray(lo),
-                    jnp.asarray(hi),
-                    jnp.asarray(active),
-                    self._store.device_summary()
-                    if self._store is not None
-                    else self._no_summary,
-                )
-                self.table.t_lo, self.table.t_hi = t_lo, t_hi
-                self.table.p_lo, self.table.p_hi = p_lo, p_hi
-                steps += 1
-                run_steps += 1
-                if bool(overflow):
-                    raise RuntimeError("hash table full; raise table_log2")
+                t_step0 = time.monotonic()
+                with self._tracer.span("frontier.step", cat="engine"):
+                    (
+                        t_lo, t_hi, p_lo, p_hi,
+                        out_states, out_lo, out_hi, out_src, out_sus,
+                        new_count, gen_count, has_succ, overflow, prop_masks,
+                    ) = self._step(
+                        self.table.t_lo,
+                        self.table.t_hi,
+                        self.table.p_lo,
+                        self.table.p_hi,
+                        jnp.asarray(st),
+                        jnp.asarray(lo),
+                        jnp.asarray(hi),
+                        jnp.asarray(active),
+                        self._store.device_summary()
+                        if self._store is not None
+                        else self._no_summary,
+                    )
+                    self.table.t_lo, self.table.t_hi = t_lo, t_hi
+                    self.table.p_lo, self.table.p_hi = p_lo, p_hi
+                    steps += 1
+                    run_steps += 1
+                    if bool(overflow):  # first host sync of the step
+                        raise RuntimeError(
+                            "hash table full; raise table_log2"
+                        )
+                step_us = (time.monotonic() - t_step0) * 1e6
 
                 prop_masks = np.asarray(prop_masks)
                 ebits = chunk.ebits[b0:b1]
@@ -649,20 +673,26 @@ class FrontierSearch:
 
                 # Early exit when every property is discovered
                 # (ref: bfs.rs:278-280) or finish_when matches.
-                if props and len(discoveries) == len(props):
-                    complete = False
-                    counts["early_exit"] = True
-                    queue.clear()
-                    break
-                if finish_when.matches(props, set(discoveries)):
+                if (props and len(discoveries) == len(props)) or (
+                    finish_when.matches(props, set(discoveries))
+                ):
+                    if self._ring is not None:
+                        # The exiting step ran but its contribution is
+                        # discarded (never counted) — record it as an
+                        # uncaptured step so telemetry steps == result
+                        # steps while dropped_steps marks the gap.
+                        self._ring.note_uncaptured()
                     complete = False
                     counts["early_exit"] = True
                     queue.clear()
                     break
 
-                state_count += int(gen_count)
+                gen_i = int(gen_count)
+                state_count += gen_i
                 nc = int(new_count)
-                self._hot_claims += nc  # device slot claims (incl. suspects)
+                claims = nc  # device slot claims this step (incl. suspects)
+                sus_n = 0
+                self._hot_claims += nc
                 if nc:
                     out_states = np.asarray(out_states[:nc])
                     out_lo = np.asarray(out_lo[:nc])
@@ -670,14 +700,19 @@ class FrontierSearch:
                     parent_rows = np.asarray(out_src[:nc]) // A
                     if self._store is not None:
                         sus = np.asarray(out_sus[:nc])
+                        sus_n = int(sus.sum())
                         if sus.any():
                             # Exact membership check against the spill tier:
                             # confirmed duplicates of spilled states are
                             # dropped (not unique, not re-enqueued); Bloom
                             # false positives stay.
-                            dup = self._store.resolve_suspects(
-                                out_lo[sus], out_hi[sus]
-                            )
+                            with self._tracer.span(
+                                "tiered.suspect_resolve", cat="store",
+                                suspects=sus_n,
+                            ):
+                                dup = self._store.resolve_suspects(
+                                    out_lo[sus], out_hi[sus]
+                                )
                             if dup.any():
                                 keep = np.ones(nc, dtype=bool)
                                 keep[np.nonzero(sus)[0][dup]] = False
@@ -703,11 +738,12 @@ class FrontierSearch:
                     self._store is not None
                     and self._hot_claims >= self._spill_trigger
                 ):
-                    tl, th, pl, ph, n_ev = self._store.evict(
-                        self.table.t_lo, self.table.t_hi,
-                        self.table.p_lo, self.table.p_hi,
-                        self._hot_claims,
-                    )
+                    with self._tracer.span("tiered.evict", cat="store"):
+                        tl, th, pl, ph, n_ev = self._store.evict(
+                            self.table.t_lo, self.table.t_hi,
+                            self.table.p_lo, self.table.p_hi,
+                            self._hot_claims,
+                        )
                     if n_ev == 0:
                         raise RuntimeError(
                             "tiered store could not free any bucket (every "
@@ -717,6 +753,21 @@ class FrontierSearch:
                     self.table.t_lo, self.table.t_hi = tl, th
                     self.table.p_lo, self.table.p_hi = pl, ph
                     self._hot_claims -= n_ev
+                if self._ring is not None:
+                    # Every scalar here was already fetched for the counters
+                    # above — telemetry adds no device work or extra sync.
+                    self._ring.append(
+                        active=m,
+                        generated=gen_i,
+                        claimed=claims,
+                        queue_len=(
+                            sum(len(c.lo) for c in queue) + (n - b1)
+                        ),
+                        table_claims=self._hot_claims,
+                        suspects=sus_n,
+                        depth=chunk.depth,
+                        step_us=step_us,
+                    )
                 if (
                     target_state_count is not None
                     and state_count >= target_state_count
@@ -762,7 +813,7 @@ class FrontierSearch:
             and not counts.get("early_exit", False),
             duration=time.monotonic() - start,
             steps=steps,
-            detail=self.store_stats(),
+            detail=self._detail(),
         )
 
     def store_stats(self) -> Optional[dict]:
@@ -771,6 +822,44 @@ class FrontierSearch:
         if self._store is None:
             return None
         return self._store.stats(self._hot_claims)
+
+    def telemetry_summary(self) -> Optional[dict]:
+        """Step-telemetry digest (obs/ring.py; None with telemetry off) —
+        surfaced in SearchResult.detail["telemetry"] and `/metrics`."""
+        if self._ring is None:
+            return None
+        return self._ring.summary(self.table.size, self.batch_size)
+
+    def metrics(self) -> dict:
+        """Flat counter snapshot for the obs registry / Prometheus export
+        (host-side values only — scraping never touches the device). The
+        ring's totals update per step, so a mid-search scrape sees LIVE
+        steps/generated values (self._counts is only written back when
+        run() returns); non-numeric leaves (the store's kind string) are
+        dropped by the Prometheus renderer itself."""
+        if self._ring is not None:
+            out = {
+                "steps": self._ring.steps,
+                "generated_states": self._ring.generated_total,
+                "claimed_states": self._ring.claimed_total,
+            }
+        else:
+            out = {
+                "steps": self._counts["steps"] if self._counts else 0,
+                "generated_states": (
+                    self._counts["state_count"] if self._counts else 0
+                ),
+            }
+        out["table_fill"] = round(self._hot_claims / self.table.size, 4)
+        stats = self.store_stats()
+        if stats:
+            out["store"] = stats
+        return out
+
+    def _detail(self) -> Optional[dict]:
+        """SearchResult.detail under the one documented schema
+        (obs/schema.py, shared assembly in obs.build_detail)."""
+        return build_detail(self.store_stats(), self.telemetry_summary())
 
     # -- checkpoint / resume ---------------------------------------------------
     # SURVEY.md §5: the reference has no partial-search checkpointing; with
@@ -785,6 +874,7 @@ class FrontierSearch:
 
         if self._q is None:
             raise RuntimeError("nothing to checkpoint: run() has not started")
+        self._tracer.instant("checkpoint", cat="engine", path=path)
         chunks = list(self._q)
         # Tiered runs serialize the spill tier alongside the device table
         # (the Bloom summary is rebuilt from the fingerprints on load).
@@ -899,6 +989,11 @@ class FrontierSearch:
         fs._counts = meta["counts"]
         fs._disc = dict(meta["discoveries"])
         fs._hot_claims = int(meta.get("hot_claims", 0))
+        if fs._telemetry:
+            # Pre-restore steps happened in another process: count them as
+            # uncaptured so the resumed digest stays honest.
+            fs._ring = StepRing(fs._tm_capacity)
+            fs._ring.skip_to(int(meta["counts"].get("steps", 0)))
         fs._q = deque()
         off = 0
         for ln, depth in zip(data["q_lens"], data["q_depths"]):

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 
 from ..core.discovery import HasDiscoveries
 from ..core.model import Expectation
+from ..obs import N_COLS, REGISTRY, StepRing, as_tracer, build_detail
 from .fingerprint import pack_fp
 from .frontier import (
     SearchResult,
@@ -142,6 +143,8 @@ class _Carry(NamedTuple):
     s_depth: jnp.ndarray  # uint32[SQ]
     s_tail: jnp.ndarray  # int32
     summary: jnp.ndarray  # uint32[W] Bloom words (read-only in-loop)
+    # -- step telemetry (obs/ring.py; zero-row placeholder when disabled) ------
+    tm_rows: jnp.ndarray  # uint32[TMR, N_COLS] in-carry metrics ring
 
 
 def _resolve_chunking(budget, timeout, progress, carry):
@@ -290,6 +293,9 @@ class ResidentSearch:
         high_water: float = 0.85,
         low_water: Optional[float] = None,
         summary_log2: int = 20,
+        telemetry: bool = True,
+        telemetry_log2: int = 12,
+        tracer=None,
     ):
         """`donate_chunks=True` donates the carry to each chunked dispatch:
         XLA updates the tables/queue IN PLACE instead of copying the whole
@@ -305,7 +311,17 @@ class ResidentSearch:
         when states are wide — 2pc-10 at table 2^27 needs 9.1 GB of queue
         for at most 61.5 M uniques (< 2^26): right-sizing it is what fits
         the workload on a 16 GB v5e. Exceeding the cap is detected as the
-        same overflow signal as a full table (never a silent drop)."""
+        same overflow signal as a full table (never a silent drop).
+
+        `telemetry=True` (default) appends one obs.STEP_COLS metrics row
+        per loop step into a device-resident ring of 2^telemetry_log2 rows
+        carried through the while_loop — a ~32-byte scatter next to the
+        megabytes the step already moves, with NO host involvement; the
+        ring is drained in bulk at boundaries where the host has already
+        synced (chunk returns, run end) and digested into
+        `SearchResult.detail["telemetry"]`. `tracer` (obs.Tracer) records
+        the host phases (chunk dispatch, tiered-store servicing,
+        checkpoint) as Chrome trace events."""
         self.model = model
         self.batch_size = batch_size
         self.table_log2 = table_log2
@@ -389,6 +405,12 @@ class ResidentSearch:
             self._spill_trigger = 0
             self._SQ = 0
         self._q_compacted = False
+        # Telemetry ring capacity (0 disables the in-carry ring entirely —
+        # the kernels compile without it, the A/B knob for bench OBS rows).
+        self._TMR = (1 << telemetry_log2) if telemetry else 0
+        self._ring = StepRing(self._TMR) if telemetry else None
+        self._tracer = as_tracer(tracer)
+        self._metrics_name = REGISTRY.register("resident", self.metrics)
         self.props = model.properties()
         self._kernel, self._seed_k, self._chunk_k = self._build()
         self._last_tables = None
@@ -475,6 +497,7 @@ class ResidentSearch:
         else:
             W = 1
         SQ = self._SQ
+        TMR = self._TMR
         TRIGGER = jnp.int32(self._spill_trigger) if tiered else None
         # Queue capacity: every unique state is enqueued exactly once (<= S
         # before the table overflows, and <= 2^queue_log2 when the caller
@@ -601,6 +624,28 @@ class ResidentSearch:
                 q_full = tail > Q - K * A
 
             gen_lo, gen_hi = count_add(c.gen_lo, c.gen_hi, gen)
+
+            # -- step telemetry row (obs/ring.py STEP_COLS order) --------------
+            # One tiny scatter into the in-carry ring; the host drains it in
+            # bulk at chunk boundaries — zero per-step host involvement.
+            if TMR:
+                tm_row = jnp.stack(
+                    [
+                        c.steps.astype(jnp.uint32),
+                        active.sum().astype(jnp.uint32),
+                        gen.astype(jnp.uint32),
+                        is_new.sum().astype(jnp.uint32),
+                        (tail - head).astype(jnp.uint32),
+                        hot_claims.astype(jnp.uint32),
+                        s_tail.astype(jnp.uint32),
+                        max_depth.astype(jnp.uint32),
+                    ]
+                )
+                tm_rows = c.tm_rows.at[
+                    jnp.remainder(c.steps, TMR)
+                ].set(tm_row)
+            else:
+                tm_rows = c.tm_rows
             return _Carry(
                 t_lo=t_lo,
                 t_hi=t_hi,
@@ -633,6 +678,7 @@ class ResidentSearch:
                 s_depth=s_depth,
                 s_tail=s_tail,
                 summary=c.summary,
+                tm_rows=tm_rows,
             )
 
         def should_continue(
@@ -715,6 +761,7 @@ class ResidentSearch:
                 s_depth=jnp.zeros(SQ, dtype=jnp.uint32),
                 s_tail=jnp.int32(0),
                 summary=jnp.zeros(W, dtype=jnp.uint32),
+                tm_rows=jnp.zeros((TMR, N_COLS), dtype=jnp.uint32),
             )
 
         def summary_of(carry: _Carry, stop):
@@ -781,7 +828,10 @@ class ResidentSearch:
                 summary = summary_of(carry, jnp.bool_(True))
             finally:
                 model._dyn = None
-            return carry.t_lo, carry.t_hi, carry.p_lo, carry.p_hi, summary
+            return (
+                carry.t_lo, carry.t_hi, carry.p_lo, carry.p_hi, summary,
+                carry.tm_rows,
+            )
 
         @jax.jit
         def seed_k(init_states, init_lo, init_hi, n0, seed_lo, seed_hi):
@@ -868,6 +918,9 @@ class ResidentSearch:
         K = self.batch_size
         start = time.monotonic()
         self._parent_map = None  # invalidate any prior reconstruction cache
+        if self._ring is not None and self._carry is None and self._ring.steps:
+            # Fresh search (no suspended carry): telemetry starts over too.
+            self._ring = self._ring.fresh()
 
         # seed_init is deterministic per model; cache it (and its padded
         # device-side form) so repeat runs skip the host<->device round trips.
@@ -917,21 +970,32 @@ class ResidentSearch:
 
         timed_out = False
         if not chunked:
-            t_lo, t_hi, p_lo, p_hi, summary = self._kernel(
-                *dev,
-                required_mask,
-                any_mask,
-                t_lo32,
-                t_hi32,
-                max_steps,
-                jnp.int32(n0),
-                jnp.uint32(n_raw & 0xFFFFFFFF),
-                jnp.uint32(n_raw >> 32),
-                tmd,
-                self._dyn_dev,
-            )
-            # ONE device->host transfer for the entire result.
-            summary = np.asarray(summary)
+            with self._tracer.span("resident.search", cat="engine"):
+                t_lo, t_hi, p_lo, p_hi, summary, tm_rows = self._kernel(
+                    *dev,
+                    required_mask,
+                    any_mask,
+                    t_lo32,
+                    t_hi32,
+                    max_steps,
+                    jnp.int32(n0),
+                    jnp.uint32(n_raw & 0xFFFFFFFF),
+                    jnp.uint32(n_raw >> 32),
+                    tmd,
+                    self._dyn_dev,
+                )
+                # ONE device->host transfer for the entire result.
+                summary = np.asarray(summary)
+            if self._ring is not None:
+                # Whole-search dispatch: one bulk drain at the end (the ring
+                # holds the LAST 2^telemetry_log2 steps; earlier rows count
+                # as dropped). The window average includes compile time on a
+                # cold first run.
+                self._ring.drain(
+                    np.asarray(tm_rows),
+                    int(summary[8]),
+                    window_us=(time.monotonic() - start) * 1e6,
+                )
             # On overflow the failed run's tables are unsound AND a previous
             # run's snapshot must not silently serve paths for states this
             # run discovered — invalidate (matches the sharded engine).
@@ -955,18 +1019,28 @@ class ResidentSearch:
                 # jax's "Array has been deleted".
                 self._last_tables = None
             while True:
-                carry, summary = self._chunk_k(
-                    self._carry,
-                    req,
-                    anym,
-                    t_lo32,
-                    t_hi32,
-                    tmd,
-                    jnp.int32(budget),
-                    jnp.int32(max_steps),
-                    self._dyn_dev,
-                )
-                summary = np.asarray(summary)  # one small transfer per chunk
+                t_chunk0 = time.monotonic()
+                with self._tracer.span("resident.chunk", cat="engine"):
+                    carry, summary = self._chunk_k(
+                        self._carry,
+                        req,
+                        anym,
+                        t_lo32,
+                        t_hi32,
+                        tmd,
+                        jnp.int32(budget),
+                        jnp.int32(max_steps),
+                        self._dyn_dev,
+                    )
+                    summary = np.asarray(summary)  # one small transfer/chunk
+                if self._ring is not None:
+                    # The chunk already synced (summary fetch); pulling the
+                    # ring here adds a bulk copy, never a per-step sync.
+                    self._ring.drain(
+                        np.asarray(carry.tm_rows),
+                        int(summary[8]),
+                        window_us=(time.monotonic() - t_chunk0) * 1e6,
+                    )
                 code = int(summary[7])
                 if code & EXIT_SERVICE and not (
                     code & (ABORT_TABLE | ABORT_QUEUE)
@@ -1070,8 +1144,38 @@ class ResidentSearch:
             complete=head >= tail and not timed_out,
             duration=time.monotonic() - start,
             steps=steps,
-            detail=self.store_stats(),
+            detail=self._detail(),
         )
+
+    def telemetry_summary(self) -> Optional[dict]:
+        """Step-telemetry digest (obs/ring.py; None with telemetry off) —
+        surfaced in SearchResult.detail["telemetry"] and `/metrics`."""
+        if self._ring is None:
+            return None
+        return self._ring.summary(1 << self.table_log2, self.batch_size)
+
+    def metrics(self) -> dict:
+        """Flat counter snapshot for the obs registry / Prometheus export.
+        Host-side values only (drained telemetry + store counters) — a
+        scrape never syncs the device mid-search."""
+        out: dict = {}
+        if self._ring is not None:
+            out.update(
+                steps=self._ring.steps,
+                generated_states=self._ring.generated_total,
+                claimed_states=self._ring.claimed_total,
+            )
+        stats = self.store_stats()
+        if stats:
+            # Non-numeric leaves (the store's kind string) are dropped by
+            # the Prometheus renderer's flatten step.
+            out["store"] = stats
+        return out
+
+    def _detail(self) -> Optional[dict]:
+        """SearchResult.detail under the one documented schema
+        (obs/schema.py, shared assembly in obs.build_detail)."""
+        return build_detail(self.store_stats(), self.telemetry_summary())
 
     def _service(self) -> None:
         """Host half of the tiered store, run between chunked dispatches on
@@ -1099,9 +1203,10 @@ class ResidentSearch:
         q_ebits, q_depth = c.q_ebits, c.q_depth
 
         if head > 0:
-            q_states, q_lo, q_hi, q_ebits, q_depth = _compact_queue(
-                q_states, q_lo, q_hi, q_ebits, q_depth, jnp.int32(head)
-            )
+            with self._tracer.span("tiered.queue_compact", cat="store"):
+                q_states, q_lo, q_hi, q_ebits, q_depth = _compact_queue(
+                    q_states, q_lo, q_hi, q_ebits, q_depth, jnp.int32(head)
+                )
             tail -= head
             head = 0
             self._q_compacted = True
@@ -1123,6 +1228,9 @@ class ResidentSearch:
             )
 
         if s_tail > 0:
+            self._tracer.instant(
+                "tiered.suspect_resolve", cat="store", suspects=s_tail
+            )
             sus_lo = np.asarray(c.s_lo[:s_tail])
             sus_hi = np.asarray(c.s_hi[:s_tail])
             dup = self._store.resolve_suspects(sus_lo, sus_hi)
@@ -1151,9 +1259,10 @@ class ResidentSearch:
 
         t_lo, t_hi, p_lo, p_hi = c.t_lo, c.t_hi, c.p_lo, c.p_hi
         if hot >= self._spill_trigger:
-            t_lo, t_hi, p_lo, p_hi, n_ev = self._store.evict(
-                t_lo, t_hi, p_lo, p_hi, hot
-            )
+            with self._tracer.span("tiered.evict", cat="store"):
+                t_lo, t_hi, p_lo, p_hi, n_ev = self._store.evict(
+                    t_lo, t_hi, p_lo, p_hi, hot
+                )
             if n_ev == 0:
                 raise RuntimeError(
                     "tiered store could not free any bucket (every bucket "
@@ -1192,6 +1301,8 @@ class ResidentSearch:
         self._last_tables = None
         self._last_abort = 0  # a fresh run owes nothing to an old overflow
         self._q_compacted = False
+        if self._ring is not None:
+            self._ring = self._ring.fresh()  # telemetry starts over too
         if self._store is not None:
             self._fresh_store()  # spill tier + Bloom summary start empty
 
@@ -1263,7 +1374,8 @@ class ResidentSearch:
                 "table_layout='split' (default) for checkpoint/resume runs"
             )
         c = self._carry
-        arrays = {f: np.asarray(getattr(c, f)) for f in c._fields}
+        with self._tracer.span("checkpoint", cat="engine", path=path):
+            arrays = {f: np.asarray(getattr(c, f)) for f in c._fields}
         if self._store is not None:
             # Spill tier rides along; the Bloom summary is rebuilt from the
             # fingerprints on load (see store/tiered.py).
@@ -1387,10 +1499,18 @@ class ResidentSearch:
             "s_depth": np.zeros(rs._SQ, np.uint32),
             "s_tail": np.int32(0),
             "summary": np.zeros(1, np.uint32),
+            "tm_rows": np.zeros((rs._TMR, N_COLS), np.uint32),
         }
         fields = {
             f: data[f] if f in data else defaults[f] for f in _Carry._fields
         }
+        # The telemetry ring is observability, not search state: a restore
+        # with a different ring size (or a pre-obs checkpoint) just starts
+        # the ring empty, with the pre-restore steps counted as uncaptured.
+        if np.asarray(fields["tm_rows"]).shape != (rs._TMR, N_COLS):
+            fields["tm_rows"] = np.zeros((rs._TMR, N_COLS), np.uint32)
+        if rs._ring is not None:
+            rs._ring.skip_to(int(np.asarray(fields["steps"])))
         # The suspect buffer is sized by batch_size x max_actions: a resume
         # with a different batch size renormalizes it like the queue below
         # (live rows [0, s_tail) are preserved; shrinking past them is

@@ -83,6 +83,66 @@ def test_device_detail_omits_tier_keys_for_device_store_runs():
     assert "hot_fill" not in row and "spilled_states" not in row
 
 
+def test_device_detail_pins_telemetry_fields():
+    # The telemetry spine's bench surface (ISSUE 4): the step digest rides
+    # in detail.device, and the BENCH_OBS=1 A/B row must carry the
+    # measured telemetry-on overhead so the <= 2% acceptance is auditable
+    # in the artifact itself.
+    for key in ("telemetry", "sec_off", "telemetry_overhead_pct"):
+        assert key in bench.DEVICE_DETAIL_FIELDS
+    row = bench.device_detail(
+        {
+            "states_per_sec": 1000.0,
+            "sec": 2.0,
+            "telemetry": {"steps": 11, "lane_util": 0.37},
+            "sec_off": 1.96,
+            "telemetry_overhead_pct": 2.0,
+        }
+    )
+    assert row["telemetry"]["steps"] == 11
+    assert row["telemetry_overhead_pct"] == 2.0
+
+
+def test_detail_counter_keys_conform_to_obs_schema():
+    # One documented schema for every SearchResult.detail counter
+    # (stateright_tpu/obs/schema.py): the tier keys bench copies verbatim,
+    # the per-job service keys, and the telemetry digest keys must all be
+    # spelled there — a producer renaming a counter breaks THIS pin, not a
+    # dashboard three rounds later.
+    from stateright_tpu.obs.schema import (
+        DETAIL_KEYS,
+        SERVICE_DETAIL_KEYS,
+        TELEMETRY_KEYS,
+        validate_detail,
+    )
+
+    for key in ("hot_fill", "spilled_states", "spill_events",
+                "per_chip_unique", "per_shard_spilled", "telemetry"):
+        assert key in DETAIL_KEYS
+    # Every detail-shaped bench field is schema-known (service/bench-row
+    # scalars like n_jobs/vs_serial are bench-JSON-only, not detail keys).
+    for key in ("hot_fill", "spilled_states", "spill_events", "telemetry"):
+        assert key in bench.DEVICE_DETAIL_FIELDS and key in DETAIL_KEYS
+    # JobMetrics.to_dict's vocabulary (service/metrics.py) is the schema's.
+    from stateright_tpu.service.metrics import JobMetrics
+
+    jm = JobMetrics(submitted_at=0.0)
+    jm.suspects_checked = 3  # exercise the optional spill keys too
+    assert set(jm.to_dict(10)) <= set(SERVICE_DETAIL_KEYS)
+    # A conforming synthetic detail validates clean; a drifted one is named.
+    detail = {
+        "store": "tiered",
+        "hot_fill": 0.5,
+        "spilled_states": 1,
+        "spill_events": 1,
+        "service": {"device_steps": 2},
+        "telemetry": {k: 0 for k in TELEMETRY_KEYS},
+    }
+    assert validate_detail(detail) == []
+    detail["telemetry"]["renamed_counter"] = 1
+    assert validate_detail(detail) == ["telemetry.renamed_counter"]
+
+
 def test_device_detail_pins_service_row_keys():
     # The BENCH_SERVICE=1 check-service row is part of the artifact
     # contract: mixed-job-batch throughput and the serial A/B ratio must
