@@ -556,6 +556,87 @@ def device_search_obs(model_name: str, n: int):
     return out, perr
 
 
+def device_search_faults(model_name: str, n: int):
+    """BENCH_FAULTS=1 row: the anchor workload run twice — plain resident
+    engine vs `run_supervised` with injection DISABLED — proving the
+    supervisor's overhead (run slicing + periodic atomic checkpoints +
+    watchdog plumbing) is within noise when nothing faults. Returns (result
+    dict for the SUPERVISED run plus `sec_unsupervised`,
+    `supervisor_overhead_pct`, and the `faults` recovery digest, parity
+    error or None)."""
+    _pin_platform()
+    import os
+    import shutil
+    import tempfile
+
+    from stateright_tpu.faults import FaultPlan, SupervisorConfig, run_supervised
+    from stateright_tpu.tensor.resident import ResidentSearch
+
+    model, batch, table_log2, run_kwargs, engine_kwargs, golden, closure_s = (
+        _build_workload(model_name, n)
+    )
+    # Cold-vs-cold A/B: `run_supervised` necessarily builds a fresh engine
+    # (per-instance jit closures recompile), so the plain side is timed the
+    # same way — fresh instance, end-to-end including compile — or the
+    # "overhead" would mostly be the compile asymmetry.
+    plain_best = None
+    plain_sec = None
+    for _ in range(2):
+        search = ResidentSearch(
+            model, batch_size=batch, table_log2=table_log2, **engine_kwargs
+        )
+        t0 = time.monotonic()
+        r = search.run(**run_kwargs)
+        sec = time.monotonic() - t0 - closure_s
+        if plain_sec is None or sec < plain_sec:
+            plain_best, plain_sec = r, sec
+
+    cfg = SupervisorConfig(checkpoint_every_steps=512)
+    sup = None
+    best_sec = None
+    for rep in range(2):  # same best-of-N protocol as the plain run
+        # Fresh checkpoint dir per rep: reusing one path would make rep 2
+        # restore rep 1's FINAL generation and time a vacuous resume.
+        ckpt_dir = tempfile.mkdtemp(prefix="bench_faults_")
+        try:
+            t0 = time.monotonic()
+            sup = run_supervised(
+                model,
+                engine="resident",
+                # Injection disabled: an EMPTY plan, not None — None falls
+                # back to SR_TPU_FAULTS, and a leftover chaos env var must
+                # not contaminate the overhead measurement.
+                plan=FaultPlan(),
+                config=cfg,
+                checkpoint_path=os.path.join(ckpt_dir, "bench.ckpt.npz"),
+                engine_kwargs=dict(
+                    batch_size=batch, table_log2=table_log2, **engine_kwargs
+                ),
+                run_kwargs=run_kwargs,
+            )
+            sec = time.monotonic() - t0 - closure_s
+        finally:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+        if best_sec is None or sec < best_sec:
+            best_sec = sec
+
+    out = {
+        "states": sup.state_count,
+        "unique": sup.unique_state_count,
+        "sec": round(best_sec, 4),
+        "states_per_sec": sup.state_count / max(best_sec, 1e-9),
+        "sec_unsupervised": round(plain_sec, 4),
+        "supervisor_overhead_pct": round(
+            100.0 * (best_sec - plain_sec) / max(plain_sec, 1e-9), 2
+        ),
+        "faults": sup.detail.get("faults", {}),
+    }
+    perr = _parity_err(model_name, n, sup, golden) or _parity_err(
+        model_name, n, plain_best, golden
+    )
+    return out, perr
+
+
 def _attach_store_stats(out: dict, search) -> None:
     """Per-tier occupancy counters in every artifact of a tiered run (the
     DEVICE_DETAIL_FIELDS tail); no-op on the plain device store."""
@@ -701,6 +782,10 @@ DEVICE_DETAIL_FIELDS = (
     # the run, and — on the BENCH_OBS=1 A/B row — the telemetry-off wall
     # time plus the measured on-vs-off overhead (acceptance: <= 2%).
     "telemetry", "sec_off", "telemetry_overhead_pct",
+    # Chaos plane / supervisor (BENCH_FAULTS=1 A/B row): the recovery
+    # digest plus the unsupervised wall time and the measured supervisor
+    # overhead with injection disabled (expected within noise).
+    "faults", "sec_unsupervised", "supervisor_overhead_pct",
 )
 
 
@@ -901,10 +986,19 @@ def main(argv: list | None = None) -> int:
         # detail.device["paxos-3-obs"].telemetry_overhead_pct.
         if os.environ.get("BENCH_OBS") == "1" and not smoke:
             workloads += (("paxos", 3, 2400.0, "--worker-obs", None),)
+        # BENCH_FAULTS=1: add the supervisor-overhead A/B on the 2pc-4
+        # anchor (plain resident vs run_supervised with injection off; the
+        # measured overhead lands in
+        # detail.device["2pc-4-faults"].supervisor_overhead_pct).
+        if os.environ.get("BENCH_FAULTS") == "1" and not smoke:
+            workloads += (("2pc", 4, 2400.0, "--worker-faults", None),)
         for model, n, wl_timeout, mode, env_extra in workloads:
             key = f"{model}-{n}" + (
-                {"--worker-sharded": "-sharded8", "--worker-obs": "-obs"}
-                .get(mode, "")
+                {
+                    "--worker-sharded": "-sharded8",
+                    "--worker-obs": "-obs",
+                    "--worker-faults": "-faults",
+                }.get(mode, "")
             )
             r, perr = device_search_subprocess(
                 model,
@@ -974,6 +1068,8 @@ def worker_main(model_name: str, n: int, mode: str = "--worker") -> int:
             r, perr = device_search_sharded(model_name, n)
         elif mode == "--worker-obs":
             r, perr = device_search_obs(model_name, n)
+        elif mode == "--worker-faults":
+            r, perr = device_search_faults(model_name, n)
         else:
             r, perr = device_search(model_name, n)
         print(json.dumps({"result": r, "error": perr}), flush=True)
@@ -987,7 +1083,8 @@ def worker_main(model_name: str, n: int, mode: str = "--worker") -> int:
 
 if __name__ == "__main__":
     if len(sys.argv) == 4 and sys.argv[1] in (
-        "--worker", "--worker-sharded", "--worker-service", "--worker-obs"
+        "--worker", "--worker-sharded", "--worker-service", "--worker-obs",
+        "--worker-faults",
     ):
         sys.exit(worker_main(sys.argv[2], int(sys.argv[3]), mode=sys.argv[1]))
     try:

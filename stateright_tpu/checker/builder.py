@@ -130,6 +130,45 @@ class CheckerBuilder:
             kwargs.setdefault("trace_out", self.trace_out_)
         return TpuChecker(self, **kwargs)
 
+    def run_supervised(
+        self,
+        engine: str = "resident",
+        plan=None,
+        config=None,
+        checkpoint_path: str = None,
+        **engine_kwargs,
+    ):
+        """Run this check under the self-healing supervisor
+        (stateright_tpu/faults/): periodic atomic checkpoints, bounded
+        retry with backoff, the degrade ladder, and the watchdog — with
+        fault injection active when a `FaultPlan` is passed (or the
+        `SR_TPU_FAULTS=` env is set). Blocking; returns the engine's
+        `SearchResult` with recovery counters in `detail["faults"]`.
+        Builder config (finish_when, targets) maps onto the run; the model
+        must be a TensorModel, as on spawn_tpu."""
+        from ..faults import run_supervised as _run_supervised
+        from ..tensor.model import TensorModel
+
+        if not isinstance(self.model, TensorModel):
+            raise TypeError(
+                "run_supervised requires a stateright_tpu.tensor."
+                f"TensorModel; got {type(self.model).__name__}"
+            )
+        run_kwargs = {"finish_when": self.finish_when_}
+        if self.target_state_count_ is not None:
+            run_kwargs["target_state_count"] = self.target_state_count_
+        if self.target_max_depth_ is not None:
+            run_kwargs["target_max_depth"] = self.target_max_depth_
+        return _run_supervised(
+            self.model,
+            engine=engine,
+            plan=plan,
+            config=config,
+            checkpoint_path=checkpoint_path,
+            engine_kwargs=engine_kwargs,
+            run_kwargs=run_kwargs,
+        )
+
     def spawn_service(self, service, priority: int = 0):
         """Submit this check as a JOB on a shared `CheckService` (the
         continuous-batching multi-job scheduler, stateright_tpu/service/)

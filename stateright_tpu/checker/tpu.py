@@ -150,6 +150,14 @@ class TpuChecker(Checker):
             # budget — overriding it here would defeat the wall clock.
             kwargs.setdefault("budget", 1 << 20)
         try:
+            # Chaos-plane boundary: the spawn's search thread itself (the
+            # engines add their own per-dispatch points; this one exercises
+            # the join()/panic surface — faults/plan.py).
+            from ..faults.plan import maybe_fault
+
+            maybe_fault(
+                "checker.run", engine=type(self._search).__name__
+            )
             with self._search._tracer.span("search.run", cat="checker"):
                 self._result = self._search.run(**kwargs)
             if self._recorder is not None:

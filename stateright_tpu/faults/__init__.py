@@ -1,0 +1,84 @@
+"""Chaos plane + self-healing supervisor (the robustness subsystem).
+
+Two halves:
+
+1. **Chaos plane** (`plan.py`) — a seeded, deterministic `FaultPlan`
+   (config object + ``SR_TPU_FAULTS=`` env) with named injection points
+   threaded through every failure boundary the checker already has: engine
+   step dispatch, tiered-store spill/resolution, sharded per-shard
+   transfers, checkpoint writes, service job steps, and the HTTP front end.
+2. **Supervisor** (`supervisor.py`) — `run_supervised(...)` wraps the
+   engines with periodic atomic checkpointing (`ckptio.py`: tmp+fsync+
+   rename, CRC32 footer, generation fallback), bounded retry with
+   deterministic backoff, a degrade ladder, a watchdog that converts hangs
+   into retriable faults, and graceful SIGTERM drain. Service hardening
+   (per-group failure isolation + poison-job quarantine) lives in
+   stateright_tpu/service/.
+
+Recovery events register into the obs counter registry and appear in
+`SearchResult.detail["faults"]` (schema: obs/schema.py FAULTS_DETAIL_KEYS).
+"""
+
+from .ckptio import (
+    CheckpointCorrupt,
+    atomic_savez,
+    latest_generation,
+    load_latest,
+    normalize_ckpt_path,
+    read_verified,
+)
+from .plan import (
+    KINDS,
+    DeviceOOM,
+    FaultError,
+    FaultPlan,
+    FaultRule,
+    HttpFault,
+    PoisonFault,
+    PreemptionFault,
+    ShardFault,
+    SpillIOError,
+    WatchdogTimeout,
+    XlaError,
+    active,
+    active_plan,
+    install_plan,
+    maybe_fault,
+)
+from .supervisor import (
+    RUNGS,
+    Supervisor,
+    SupervisorConfig,
+    SupervisorGaveUp,
+    run_supervised,
+)
+
+__all__ = [
+    "FaultPlan",
+    "FaultRule",
+    "FaultError",
+    "DeviceOOM",
+    "XlaError",
+    "PreemptionFault",
+    "SpillIOError",
+    "ShardFault",
+    "PoisonFault",
+    "HttpFault",
+    "WatchdogTimeout",
+    "KINDS",
+    "maybe_fault",
+    "install_plan",
+    "active_plan",
+    "active",
+    "atomic_savez",
+    "read_verified",
+    "load_latest",
+    "latest_generation",
+    "normalize_ckpt_path",
+    "CheckpointCorrupt",
+    "Supervisor",
+    "SupervisorConfig",
+    "SupervisorGaveUp",
+    "RUNGS",
+    "run_supervised",
+]

@@ -1,0 +1,186 @@
+"""Crash-atomic checkpoint I/O with CRC32 verification + generation fallback.
+
+Before this module, every checkpoint writer (`FrontierSearch.checkpoint`,
+`ResidentSearch.checkpoint`, `ShardedSearch.checkpoint`, the service's
+`Job.spill_frontier`) called `np.savez_compressed(path)` directly: a crash
+or full disk mid-write left a truncated archive AT THE FINAL PATH, and the
+next `load_checkpoint` raised `BadZipFile` — a partial write poisoned
+resume, the exact opposite of what a checkpoint is for.
+
+The fix is the classic tmp+fsync+rename discipline plus an end-to-end
+integrity check and one generation of history:
+
+- `atomic_savez` serializes the npz payload in memory, appends a footer
+  (magic + payload length + CRC32), writes to ``path + ".tmp"``, fsyncs,
+  rotates any existing ``path`` to ``path + ".prev"``, and `os.replace`s
+  the tmp into place (atomic on POSIX). A crash at ANY point leaves either
+  the old generation at `path`, or the old at `.prev` and the new at
+  `path` — never a torn file at a name a loader trusts.
+- `read_verified` checks the footer CRC before handing bytes to `np.load`;
+  a mismatch (torn write, bit flip) raises `CheckpointCorrupt`. Footerless
+  files (pre-fault-plane checkpoints) load unverified for compatibility.
+- `load_latest` tries ``path`` then ``path + ".prev"``: a corrupt current
+  generation falls back to the previous good one instead of raising, and
+  reports which file actually served the restore.
+
+The ``ckpt.write`` injection point (kind ``torn``) corrupts the file right
+after a successful write — that is how tests/chaos runs prove the fallback
+actually engages.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import struct
+import zipfile
+import zlib
+from typing import Optional
+
+import numpy as np
+
+from .plan import active_plan
+
+#: Footer layout: 8-byte magic, u64 payload length, u32 CRC32 of payload.
+MAGIC = b"SRTPCKP1"
+_FOOTER = struct.Struct("<8sQI")
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint file failed CRC / container verification."""
+
+
+#: Paths this process wrote and fsynced intact (invalidated when the chaos
+#: plane corrupts one): rotation can trust them without re-reading and
+#: re-CRC-ing the whole previous generation on every checkpoint write.
+_WRITTEN_INTACT: set = set()
+
+
+def normalize_ckpt_path(path: str) -> str:
+    """`np.savez` historically appended `.npz` when the suffix was absent;
+    keep every writer/loader on the same normalized name."""
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def atomic_savez(path: str, arrays: dict, keep_prev: bool = True) -> str:
+    """Write `arrays` as a compressed npz at `path`, crash-atomically, with
+    a CRC32 footer. Rotates an existing `path` to ``path + ".prev"`` first
+    (the fallback generation). Returns the path written."""
+    path = normalize_ckpt_path(path)
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    payload = buf.getvalue()
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+        f.write(_FOOTER.pack(MAGIC, len(payload), crc))
+        f.flush()
+        os.fsync(f.fileno())
+    if keep_prev and os.path.exists(path):
+        # Only a VERIFIED current generation may become the fallback:
+        # rotating a torn file into .prev would evict the last good
+        # generation. A file this process itself wrote intact is trusted
+        # without re-reading it (re-CRC-ing the whole previous generation
+        # on every write would double checkpoint I/O).
+        if path in _WRITTEN_INTACT:
+            os.replace(path, path + ".prev")
+        else:
+            try:
+                read_verified(path)
+            except CheckpointCorrupt:
+                os.unlink(path)
+            else:
+                os.replace(path, path + ".prev")
+    os.replace(tmp, path)
+    _WRITTEN_INTACT.add(path)
+    # Make the renames themselves durable (best-effort: not every
+    # filesystem supports directory fsync).
+    try:
+        dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass
+    plan = active_plan()
+    if plan is not None and plan.consume_corruption("ckpt.write"):
+        _corrupt_file(path, plan.seed)
+    return path
+
+
+def _corrupt_file(path: str, seed: int) -> None:
+    """Deterministically simulate a torn write on `path`: truncate to half
+    on even seeds, flip a payload byte on odd seeds. Both must be caught by
+    `read_verified` and absorbed by `load_latest`'s fallback."""
+    _WRITTEN_INTACT.discard(path)  # no longer trustworthy for rotation
+    size = os.path.getsize(path)
+    if seed % 2 == 0:
+        with open(path, "r+b") as f:
+            f.truncate(max(size // 2, 1))
+    else:
+        pos = max((size - _FOOTER.size) // 2, 0)
+        with open(path, "r+b") as f:
+            f.seek(pos)
+            b = f.read(1)
+            f.seek(pos)
+            f.write(bytes([(b[0] ^ 0xFF) if b else 0xFF]))
+
+
+def read_verified(path: str):
+    """Load one checkpoint file, verifying the CRC footer when present.
+    Returns an `NpzFile`-alike; raises `CheckpointCorrupt` on any torn /
+    flipped / truncated content, `FileNotFoundError` when absent."""
+    with open(path, "rb") as f:
+        data = f.read()
+    payload = data
+    if len(data) >= _FOOTER.size:
+        magic, length, crc = _FOOTER.unpack(data[-_FOOTER.size:])
+        if magic == MAGIC:
+            payload = data[: -_FOOTER.size]
+            if length != len(payload) or (
+                zlib.crc32(payload) & 0xFFFFFFFF
+            ) != crc:
+                raise CheckpointCorrupt(
+                    f"checkpoint {path} failed CRC verification "
+                    "(torn or corrupted write)"
+                )
+    try:
+        return np.load(io.BytesIO(payload), allow_pickle=False)
+    except (zipfile.BadZipFile, ValueError, OSError) as e:
+        # Footerless legacy file that is ALSO torn — same verdict.
+        raise CheckpointCorrupt(f"checkpoint {path} is unreadable: {e}") from e
+
+
+def load_latest(path: str):
+    """Load the newest intact generation of `path`: the file itself, else
+    ``path + ".prev"``. Returns ``(npz, served_path)``; raises
+    `CheckpointCorrupt` naming every candidate only when none verifies."""
+    path = normalize_ckpt_path(path)
+    tried: list[str] = []
+    for p in (path, path + ".prev"):
+        if not os.path.exists(p):
+            tried.append(f"{p} (missing)")
+            continue
+        try:
+            return read_verified(p), p
+        except CheckpointCorrupt as e:
+            tried.append(str(e))
+    raise CheckpointCorrupt(
+        "no intact checkpoint generation: " + "; ".join(tried)
+    )
+
+
+def latest_generation(path: str) -> Optional[str]:
+    """The path `load_latest` would serve, or None — a cheap existence
+    probe for supervisors deciding between restore and fresh restart."""
+    path = normalize_ckpt_path(path)
+    for p in (path, path + ".prev"):
+        if os.path.exists(p):
+            try:
+                read_verified(p)
+                return p
+            except CheckpointCorrupt:
+                continue
+    return None

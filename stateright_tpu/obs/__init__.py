@@ -26,6 +26,7 @@ from .registry import (
 )
 from .schema import (
     DETAIL_KEYS,
+    FAULTS_DETAIL_KEYS,
     SERVICE_DETAIL_KEYS,
     TELEMETRY_KEYS,
     validate_detail,
@@ -42,6 +43,7 @@ __all__ = [
     "flatten_metrics",
     "render_prometheus",
     "DETAIL_KEYS",
+    "FAULTS_DETAIL_KEYS",
     "SERVICE_DETAIL_KEYS",
     "TELEMETRY_KEYS",
     "validate_detail",

@@ -34,6 +34,9 @@ DETAIL_KEYS = {
     "timed_out": "True when the job hit its service deadline",
     # telemetry spine (obs/ring.py `StepRing.summary`)
     "telemetry": "step-telemetry digest sub-dict (TELEMETRY_KEYS)",
+    # chaos plane + supervisor (stateright_tpu/faults/)
+    "faults": "fault-injection/recovery counters sub-dict "
+              "(FAULTS_DETAIL_KEYS)",
 }
 
 #: Keys of `detail["service"]` (service/metrics.py JobMetrics.to_dict).
@@ -69,6 +72,26 @@ TELEMETRY_KEYS = {
 }
 
 
+#: Keys of `detail["faults"]` (faults/supervisor.py `fault_stats` and the
+#: check service's engine-level fault counters). `injected` is the one
+#: intentionally-dynamic sub-dict: its keys are "<point>:<kind>" pairs from
+#: the active FaultPlan.
+FAULTS_DETAIL_KEYS = {
+    "injected_total": "faults injected by the active FaultPlan, total",
+    "injected": "per-injection-point counts sub-dict ('point:kind' keys)",
+    "retries": "recovery retries (supervisor slices / service step retries)",
+    "backoff_ms": "cumulative retry backoff, milliseconds",
+    "degrade_steps": "degrade-ladder escalations taken",
+    "degrade_rung": "final ladder rung index (faults.RUNGS order)",
+    "checkpoint_generations": "atomic checkpoint generations written",
+    "restores": "engine rebuilds served from a checkpoint generation",
+    "watchdog_fired": "hangs the watchdog cancelled or abandoned",
+    "drained": "graceful SIGTERM drains taken",
+    "step_faults": "service fused-step faults absorbed (group-scoped)",
+    "quarantined_jobs": "poison jobs parked by the service retry policy",
+}
+
+
 def validate_detail(detail: Optional[dict]) -> list:
     """Key paths in a `SearchResult.detail` dict that the schema does not
     name (empty list = conforming). Tests assert `== []`."""
@@ -78,6 +101,7 @@ def validate_detail(detail: Optional[dict]) -> list:
     for sub, allowed in (
         ("service", SERVICE_DETAIL_KEYS),
         ("telemetry", TELEMETRY_KEYS),
+        ("faults", FAULTS_DETAIL_KEYS),
     ):
         if isinstance(detail.get(sub), dict):
             bad.extend(

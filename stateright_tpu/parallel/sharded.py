@@ -65,6 +65,8 @@ else:  # pragma: no cover - exercised on jax 0.4.x images
 
     _SHARD_MAP_KW = {"check_rep": False}
 
+from ..faults.ckptio import atomic_savez, load_latest
+from ..faults.plan import maybe_fault
 from ..obs import N_COLS, REGISTRY, StepRing, as_tracer
 from ..tensor.fingerprint import pack_fp
 from ..core.discovery import HasDiscoveries
@@ -962,6 +964,9 @@ class ShardedSearch:
         )
 
         if not chunked:
+            # Chaos-plane boundary (faults/plan.py): faults land before the
+            # dispatch, never mid-update.
+            maybe_fault("engine.step", engine="sharded")
             with self._tracer.span("sharded.search", cat="engine"):
                 (
                     t_lo, t_hi, p_lo, p_hi,
@@ -1035,6 +1040,9 @@ class ShardedSearch:
             tmd = jnp.uint32(target_max_depth or 0)
             timed_out = False
             while True:
+                # Chaos-plane boundary: pre-dispatch, so a faulted chunk
+                # never half-updates the retained carry.
+                maybe_fault("engine.step", engine="sharded")
                 t_chunk0 = time.monotonic()
                 with self._tracer.span("sharded.chunk", cat="engine"):
                     carry, summary = self._chunk_k(
@@ -1092,6 +1100,9 @@ class ShardedSearch:
                         "run with a larger dest_capacity)"
                     )
                 self._carry = carry
+                # Chaos-plane boundary: simulated preemption at a chunk
+                # boundary (the carry is sound here).
+                maybe_fault("engine.chunk", engine="sharded")
                 if progress is not None:
                     progress(
                         int(s[0, 0]) | (int(s[0, 1]) << 32),
@@ -1263,6 +1274,10 @@ class ShardedSearch:
                 st_i = int(s_tail[i])
                 if st_i == 0:
                     continue
+                # Chaos-plane boundary: one shard's transfer failing must
+                # not corrupt the others (the supervisor restores the whole
+                # carry from the last checkpoint on fault).
+                maybe_fault("shard.transfer", shard=i, phase="resolve")
                 sus_lo = np.asarray(c.s_lo[i, :st_i])
                 sus_hi = np.asarray(c.s_hi[i, :st_i])
                 dup = self._stores[i].resolve_suspects(sus_lo, sus_hi)
@@ -1300,6 +1315,7 @@ class ShardedSearch:
                 tl, th = c.t_lo[i], c.t_hi[i]
                 pl, ph = c.p_lo[i], c.p_hi[i]
                 if hot[i] >= self._spill_trigger:
+                    maybe_fault("shard.transfer", shard=i, phase="evict")
                     tl, th, pl, ph, n_ev = self._stores[i].evict(
                         tl, th, pl, ph, int(hot[i])
                     )
@@ -1462,7 +1478,9 @@ class ShardedSearch:
             ).encode(),
             dtype=np.uint8,
         )
-        np.savez_compressed(_ckpt_path(path), **arrays)
+        # Crash-atomic write (tmp+fsync+rename, CRC32 footer, previous
+        # generation kept at `path + ".prev"` — faults/ckptio.py).
+        atomic_savez(_ckpt_path(path), arrays)
 
     @classmethod
     def load_checkpoint(
@@ -1483,7 +1501,9 @@ class ShardedSearch:
 
         from ..tensor.resident import _ckpt_path, _regrow, _validate_ckpt_meta
 
-        data = np.load(_ckpt_path(path))
+        # CRC-verified; a corrupt current generation falls back to
+        # `path + ".prev"` instead of raising (faults/ckptio.load_latest).
+        data, _src = load_latest(_ckpt_path(path))
         meta = json.loads(bytes(data["meta"].tobytes()).decode())
         _validate_ckpt_meta(model, meta)
         store_meta = meta.get("store")

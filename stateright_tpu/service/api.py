@@ -36,9 +36,10 @@ from typing import Optional
 
 from ..core.discovery import HasDiscoveries
 from ..checker.base import Checker
+from ..faults.ckptio import CheckpointCorrupt
 from ..obs import REGISTRY, Tracer, as_tracer
 from .queue import AdmissionQueue, Job, JobStatus
-from .scheduler import ServiceEngine, ServiceError
+from .scheduler import ServiceEngine, ServiceError, StepFault
 
 
 class JobHandle:
@@ -96,12 +97,20 @@ class CheckService:
         telemetry: bool = True,
         telemetry_log2: int = 12,
         trace_out: Optional[str] = None,
+        retry_limit: int = 2,
     ):
         """`telemetry=True` records one step-metrics row per fused device
         step (obs/ring.py; digest in `stats()["telemetry"]`, `/.status`,
         and `/metrics`). `trace_out=<path>` records the service lifecycle
         (admission, fused steps, eviction, preemption, finalize) as Chrome
-        trace-event JSON saved on `close()` — load it in Perfetto."""
+        trace-event JSON saved on `close()` — load it in Perfetto.
+
+        `retry_limit` is the per-group step-fault budget: a group whose
+        fused step keeps failing is retried that many times (the faulted
+        lanes were pushed back, so retries are exact), then each job is
+        probed SOLO and only the job(s) whose step fails in isolation are
+        quarantined — one poison job cannot kill its group, let alone the
+        service (see scheduler.StepFault)."""
         self._trace_out = trace_out
         self._tracer = as_tracer(
             Tracer(annotate=True) if trace_out else None
@@ -125,6 +134,7 @@ class CheckService:
         self.max_resident = max_resident
         self.preempt_steps = preempt_steps
         self.spill_dir = spill_dir
+        self.retry_limit = retry_limit
         self._adm = AdmissionQueue()
         self._jobs: dict[int, Job] = {}
         self._next_id = 1
@@ -192,6 +202,7 @@ class CheckService:
                 "pending_lanes": job.pending_lanes,
                 "discoveries": sorted(job.discoveries),
                 "error": job.error,
+                "quarantined": job.quarantined,
                 "metrics": job.metrics.to_dict(job.unique_count),
             }
 
@@ -257,6 +268,10 @@ class CheckService:
                 # Step-telemetry digest (obs/ring.py) — merged into the
                 # HTTP `/.status` through this dict.
                 "telemetry": self._engine.telemetry_summary(),
+                # Robustness counters (step faults absorbed, exact
+                # retries, quarantined poison jobs) — the service half of
+                # the chaos plane's accounting.
+                "faults": dict(self._engine.fault_counters),
             }
 
     def store_stats(self) -> Optional[dict]:
@@ -333,7 +348,18 @@ class CheckService:
         while len(self._adm) and self._admittable():
             job = self._adm.pop_next()
             if job.status == JobStatus.PREEMPTED:
-                job.load_frontier()
+                try:
+                    job.load_frontier()
+                except CheckpointCorrupt as e:
+                    # A torn preemption spill loses ONLY this job's
+                    # frontier — fail it alone instead of letting the
+                    # exception escalate to the service-wide bail-out.
+                    job.status = JobStatus.ERROR
+                    job.error = f"preemption spill unreadable: {e}"
+                    job.metrics.finished_at = time.monotonic()
+                    job.event.set()
+                    self._idle.notify_all()
+                    continue
                 job.status = JobStatus.RUNNING
                 job.steps_since_admit = 0
                 self._engine.group_of(job).jobs.append(job)
@@ -392,16 +418,71 @@ class CheckService:
         self._adm.push(job)
         self._admit_waiting()
 
+    def _handle_step_fault(self, fault: StepFault) -> None:
+        """Per-group retry, then solo-probe quarantine. The faulted lanes
+        were already pushed back (scheduler.step_group's unwind), so:
+
+        1. within the retry budget, just let the next round re-step the
+           group — the retry is exact;
+        2. past the budget, probe each of the group's runnable jobs SOLO:
+           a job whose step fails in isolation is the poison — quarantine
+           it; healthy jobs keep their (exactly preserved) progress and
+           resume shared batching. Unrelated groups never notice."""
+        group = fault.group
+        group.fault_count += 1
+        if group.fault_count <= self.retry_limit:
+            self._engine.fault_counters["retries"] += 1
+            self._tracer.instant(
+                "service.step_retry", cat="service",
+                attempt=group.fault_count,
+            )
+            return
+        group.fault_count = 0
+        for job in list(group.runnable()):
+            try:
+                finished = self._engine.step_group(group, only=[job])
+            except StepFault as probe:
+                self._quarantine(job, probe)
+            except ServiceError:
+                raise
+            else:
+                for j in finished:
+                    self._finalize(j)
+
+    def _quarantine(self, job: Job, fault: StepFault) -> None:
+        """Park a poison job as an ERROR with the quarantined marker; its
+        table entries stay (salted — they shadow nothing) and its lanes
+        free up at the next round."""
+        self._tracer.instant(
+            "service.quarantine", cat="service", job=job.id
+        )
+        job.quarantined = True
+        job.status = JobStatus.ERROR
+        job.error = (
+            f"quarantined after repeated step faults: {fault.cause!r}"
+        )
+        job.metrics.finished_at = time.monotonic()
+        self._engine.retire(job)
+        self._engine.fault_counters["quarantined_jobs"] += 1
+        job.event.set()
+        self._idle.notify_all()
+
     def _round(self) -> bool:
         """One scheduling round: timeouts, admission, preemption, one fused
-        step of the next runnable group. Returns True if a step ran."""
+        step of the next runnable group. Returns True if a step ran. A
+        `StepFault` is absorbed here (retry/quarantine policy) — one bad
+        group or job never takes the scheduler down."""
         self._expire_timeouts()
         self._admit_waiting()
         self._preempt_if_due()
         group = self._engine.next_group()
         if group is None:
             return False
-        finished = self._engine.step_group(group)
+        try:
+            finished = self._engine.step_group(group)
+        except StepFault as e:
+            self._handle_step_fault(e)
+            return True
         for job in finished:
             self._finalize(job)
         return True
@@ -419,6 +500,14 @@ class CheckService:
                     self._round()
                 except ServiceError as e:
                     self._failed = str(e)
+                    self._idle.notify_all()
+                    return
+                except Exception as e:  # noqa: BLE001 — never die silently
+                    # A scheduler bug outside the StepFault envelope used
+                    # to kill this thread silently, hanging every client
+                    # in result(); fail loudly instead.
+                    self._failed = f"scheduler error: {type(e).__name__}: {e}"
+                    self._engine._fail_all(self._failed)
                     self._idle.notify_all()
                     return
 

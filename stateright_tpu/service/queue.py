@@ -25,6 +25,7 @@ from typing import Optional
 import numpy as np
 
 from ..core.discovery import HasDiscoveries
+from ..faults.ckptio import atomic_savez, load_latest
 from ..tensor.fingerprint import job_salt
 from .metrics import JobMetrics
 
@@ -88,6 +89,7 @@ class Job:
         self.steps_since_admit = 0
         self.early_exit = False
         self.timed_out = False
+        self.quarantined = False  # poison job parked by the retry policy
         self.discoveries: dict[str, int] = {}  # name -> packed UNSALTED fp
         self.result = None  # SearchResult once finished
         self.error: Optional[str] = None
@@ -107,6 +109,15 @@ class Job:
         if len(lo) == 0:
             return
         self._chunks.append(_Chunk(states, lo, hi, ebits, depth))
+        self._pending += len(lo)
+
+    def push_front(self, states, lo, hi, ebits, depth) -> None:
+        """Return lanes taken by a FAULTED step to the frontier FRONT, so
+        the retry pops them in the original order (what keeps per-job
+        results bit-identical through service step faults)."""
+        if len(lo) == 0:
+            return
+        self._chunks.appendleft(_Chunk(states, lo, hi, ebits, depth))
         self._pending += len(lo)
 
     def take(self, k: int):
@@ -155,12 +166,13 @@ class Job:
 
     def spill_frontier(self, path: str) -> None:
         """Park the pending frontier on disk (same array schema as the
-        engines' checkpoint queue section) and free the host memory."""
+        engines' checkpoint queue section) and free the host memory. The
+        write is crash-atomic with a CRC32 footer (faults/ckptio.py) — a
+        torn spill must not poison the job's resumption."""
         chunks = list(self._chunks)
         P = chunks[0].ebits.shape[1] if chunks else 0
         L = chunks[0].states.shape[1] if chunks else self.model.lanes
-        np.savez_compressed(
-            path,
+        arrays = dict(
             q_states=(
                 np.concatenate([c.states for c in chunks])
                 if chunks else np.zeros((0, L), np.uint32)
@@ -183,14 +195,14 @@ class Job:
             ),
             q_lens=np.asarray([len(c) for c in chunks], np.int64),
         )
+        self._spill_path = atomic_savez(path, arrays, keep_prev=False)
         self.drop_frontier()
-        self._spill_path = path
 
     def load_frontier(self) -> None:
-        """Reload a spilled frontier for resumption."""
+        """Reload a spilled frontier for resumption (CRC-verified)."""
         if self._spill_path is None:
             return
-        data = np.load(self._spill_path)
+        data, _src = load_latest(self._spill_path)
         off = 0
         for ln in data["q_lens"]:
             ln = int(ln)

@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.model import Expectation
+from ..faults.plan import maybe_fault
 from ..obs import StepRing, as_tracer
 from ..tensor.fingerprint import pack_fp, salt_fp, unpack_fp
 from ..tensor.frontier import (
@@ -115,6 +116,7 @@ class _Group:
 
     def __init__(self, model, K, insert, store):
         self.model = model
+        self.fault_count = 0  # consecutive step faults (service retry policy)
         self.props = model.properties()
         self.prop_is = {
             "always": [
@@ -143,7 +145,26 @@ class _Group:
 
 class ServiceError(RuntimeError):
     """The shared device state is unusable (table overflow without a spill
-    tier); every in-flight job was failed with this message."""
+    tier); every in-flight job was failed with this message. This is the
+    ONLY failure class with service-wide blast radius — a step exception in
+    one group raises `StepFault` instead and fails/quarantines only that
+    group's jobs."""
+
+
+class StepFault(RuntimeError):
+    """One group's fused step failed BEFORE any shared state changed: the
+    lanes it had taken were pushed back to the front of each job's
+    frontier, so the step is exactly retriable. Carries the group and the
+    original cause; the owning CheckService applies the per-job retry /
+    poison-quarantine policy."""
+
+    def __init__(self, group: "_Group", cause: BaseException):
+        super().__init__(
+            f"service step fault in group {type(group.model).__name__}: "
+            f"{type(cause).__name__}: {cause}"
+        )
+        self.group = group
+        self.cause = cause
 
 
 class ServiceEngine:
@@ -206,6 +227,13 @@ class ServiceEngine:
         self.hot_claims = 0
         self.groups: dict[int, _Group] = {}
         self._group_rr: list[int] = []
+        # Robustness accounting (surfaced in stats()["faults"] and each
+        # job result's detail["faults"] — obs/schema.py FAULTS_DETAIL_KEYS).
+        self.fault_counters = {
+            "step_faults": 0,
+            "retries": 0,
+            "quarantined_jobs": 0,
+        }
         self.total_steps = 0
         self._table_stamp = 0  # bumped per step; parent-map cache key
         self._parent_map = None
@@ -339,10 +367,20 @@ class ServiceEngine:
 
     # -- one fused step --------------------------------------------------------
 
-    def step_group(self, group: _Group) -> list:
+    def step_group(self, group: _Group, only: Optional[list] = None) -> list:
         """Assemble one batch from the group's runnable jobs, dispatch the
         fused step, and do the per-job bookkeeping. Returns jobs finished by
-        this step (result built; caller signals their events)."""
+        this step (result built; caller signals their events).
+
+        `only` restricts the batch to specific jobs — the isolation probe
+        the CheckService uses to find the poison job after a group's step
+        has faulted past its retry budget.
+
+        A step exception (injected `service.step` fault, or a real dispatch
+        error) is converted to `StepFault` AFTER pushing every taken lane
+        back to the FRONT of its job's frontier and reversing the per-job
+        bookkeeping — so retrying the step re-packs the identical lanes in
+        the identical order and per-job results stay bit-identical."""
         model = group.model
         props = group.props
         prop_is = group.prop_is
@@ -351,6 +389,8 @@ class ServiceEngine:
         P = len(props)
 
         jobs = group.runnable()
+        if only is not None:
+            jobs = [j for j in jobs if j in only]
         if not jobs:
             return []
         # Rotate the grant order so no job is permanently first in line.
@@ -395,34 +435,68 @@ class ServiceEngine:
             m += n
 
         t_step0 = time.monotonic()
-        with self._tracer.span(
-            "service.step", cat="service", jobs=len(jobs), lanes=m
-        ):
-            (
-                t_lo, t_hi, p_lo, p_hi,
-                out_states, out_lo, out_hi, out_src, out_sus,
-                new_count, gen_rows, has_succ, overflow, prop_masks,
-            ) = group.step(
-                self.table.t_lo, self.table.t_hi,
-                self.table.p_lo, self.table.p_hi,
-                jnp.asarray(st), jnp.asarray(lo), jnp.asarray(hi),
-                jnp.asarray(salt_lo), jnp.asarray(salt_hi),
-                jnp.asarray(eval_mask),
-                self._store.device_summary()
-                if self._store is not None
-                else self._no_summary,
+        try:
+            # Chaos-plane boundary (faults/plan.py): fires BEFORE the
+            # dispatch — rules can target a specific job via `job=<id>`
+            # matching against this batch's job list (the poison-job
+            # scenario).
+            maybe_fault(
+                "service.step",
+                job=[j.id for j, _s, _e in segments],
+                group=type(model).__name__,
             )
-            self.table.t_lo, self.table.t_hi = t_lo, t_hi
-            self.table.p_lo, self.table.p_hi = p_lo, p_hi
-            self.total_steps += 1
-            self._table_stamp += 1
-            if bool(overflow):  # first host sync of the step
-                msg = (
-                    "shared hash table full; raise table_log2 "
-                    "(or store='tiered')"
+            with self._tracer.span(
+                "service.step", cat="service", jobs=len(jobs), lanes=m
+            ):
+                (
+                    t_lo, t_hi, p_lo, p_hi,
+                    out_states, out_lo, out_hi, out_src, out_sus,
+                    new_count, gen_rows, has_succ, overflow, prop_masks,
+                ) = group.step(
+                    self.table.t_lo, self.table.t_hi,
+                    self.table.p_lo, self.table.p_hi,
+                    jnp.asarray(st), jnp.asarray(lo), jnp.asarray(hi),
+                    jnp.asarray(salt_lo), jnp.asarray(salt_hi),
+                    jnp.asarray(eval_mask),
+                    self._store.device_summary()
+                    if self._store is not None
+                    else self._no_summary,
                 )
-                self._fail_all(msg)
-                raise ServiceError(msg)
+                self.table.t_lo, self.table.t_hi = t_lo, t_hi
+                self.table.p_lo, self.table.p_hi = p_lo, p_hi
+                self.total_steps += 1
+                self._table_stamp += 1
+                if bool(overflow):  # first host sync of the step
+                    msg = (
+                        "shared hash table full; raise table_log2 "
+                        "(or store='tiered')"
+                    )
+                    self._fail_all(msg)
+                    raise ServiceError(msg)
+            # A successful step resets the group's CONSECUTIVE-fault
+            # streak — without this the retry budget erodes over a
+            # long-lived service until one transient fault skips straight
+            # to solo-probe quarantine.
+            group.fault_count = 0
+        except ServiceError:
+            raise  # shared-state failure: service-wide by design
+        except Exception as e:  # noqa: BLE001 — group-scoped by design
+            # Exactly-retriable unwind: the taken lanes go back to the
+            # FRONT of each job's frontier (pop order preserved) and the
+            # per-job bookkeeping above is reversed.
+            for job, s, e2 in segments:
+                job.push_front(
+                    st[s:e2], lo[s:e2], hi[s:e2], ebits[s:e2], depth[s:e2]
+                )
+                job.metrics.device_steps -= 1
+                job.metrics.lanes_held -= e2 - s
+                job.steps_since_admit -= 1
+            self.fault_counters["step_faults"] += 1
+            self._tracer.instant(
+                "service.step_fault", cat="service",
+                group=type(model).__name__, error=type(e).__name__,
+            )
+            raise StepFault(group, e) from e
         step_us = (time.monotonic() - t_step0) * 1e6
 
         masks = np.asarray(prop_masks)
@@ -581,6 +655,12 @@ class ServiceEngine:
     def build_result(self, job: Job) -> SearchResult:
         detail = dict(self.store_stats() or {})
         detail["service"] = job.metrics.to_dict(job.unique_count)
+        if any(self.fault_counters.values()):
+            # Engine-wide recovery counters (documented schema:
+            # obs/schema.py FAULTS_DETAIL_KEYS) — present only once a
+            # fault actually happened, so fault-free results stay
+            # byte-identical to before.
+            detail["faults"] = dict(self.fault_counters)
         t = self.telemetry_summary()
         if t is not None:
             # Engine-wide step digest (the shared batches this job rode in),
@@ -606,14 +686,24 @@ class ServiceEngine:
         )
 
     def _fail_all(self, msg: str) -> None:
+        """Service-wide failure: ONLY for unusable shared device state
+        (table overflow without a spill tier). Per-group step exceptions
+        take the `StepFault` → retry → quarantine path instead — see
+        `_fail_group` and CheckService._handle_step_fault."""
         for g in self.groups.values():
-            for job in list(g.jobs):
-                job.status = JobStatus.ERROR
-                job.error = msg
-                job.metrics.finished_at = time.monotonic()
-                job.drop_frontier()
-                job.event.set()
-            g.jobs.clear()
+            self._fail_group(g, msg)
+
+    def _fail_group(self, group: _Group, msg: str) -> None:
+        """Fail one group's jobs without touching any other group — the
+        blast-radius fix: a poison model (or a fault localized to one
+        group's step) must not kill unrelated jobs sharing the service."""
+        for job in list(group.jobs):
+            job.status = JobStatus.ERROR
+            job.error = msg
+            job.metrics.finished_at = time.monotonic()
+            job.drop_frontier()
+            job.event.set()
+        group.jobs.clear()
 
     def store_stats(self) -> Optional[dict]:
         if self._store is None:

@@ -31,6 +31,7 @@ import threading
 from typing import Callable, Optional
 
 from ..explorer.server import ExplorerServer
+from ..faults.plan import FaultError, maybe_fault
 from ..obs import REGISTRY, render_prometheus
 from .api import CheckService
 
@@ -190,7 +191,21 @@ def serve_service(
             self.end_headers()
             self.wfile.write(data)
 
+        def _injected_503(self, method: str) -> bool:
+            """Chaos-plane boundary for the HTTP plane: an injected
+            `service.http` fault degrades to a 503 (the retryable status
+            clients already understand) instead of crashing the handler —
+            the front end must stay up through its own faults."""
+            try:
+                maybe_fault("service.http", method=method, path=self.path)
+            except FaultError as e:
+                self._json({"error": f"injected fault: {e}"}, 503)
+                return True
+            return False
+
         def do_GET(self):
+            if self._injected_503("GET"):
+                return
             try:
                 if self.path == "/.status":
                     self._json(status_view(service))
@@ -213,6 +228,8 @@ def serve_service(
                 self._json({"error": str(e)}, 404)
 
         def do_POST(self):
+            if self._injected_503("POST"):
+                return
             try:
                 if self.path == "/jobs":
                     n = int(self.headers.get("Content-Length") or 0)
@@ -240,6 +257,8 @@ def serve_service(
                 self._json({"error": f"{type(e).__name__}: {e}"}, 400)
 
         def do_DELETE(self):
+            if self._injected_503("DELETE"):
+                return
             jid = self._job_id()
             if jid is None:
                 self._json({"error": "not found"}, 404)

@@ -30,6 +30,8 @@ from typing import Optional
 
 import numpy as np
 
+from ..faults.plan import maybe_fault
+
 
 def _compactor_loop(store_ref, wake: threading.Event) -> None:
     """Background compactor body. Holds only a WEAKREF to the store: a
@@ -91,6 +93,10 @@ class HostSpillStore:
         parents = np.asarray(parents, dtype=np.uint64)
         if fps.size == 0:
             return
+        # Chaos-plane boundary: the append is the spill tier's write path —
+        # an I/O fault here fires BEFORE the batch lands, so the store
+        # never holds half an eviction batch (faults/plan.py).
+        maybe_fault("store.append", n=int(fps.size))
         with self._lock:
             self._pending.append((fps.copy(), parents.copy()))
             self._pending_len += fps.size

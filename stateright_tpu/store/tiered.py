@@ -43,6 +43,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..faults.plan import maybe_fault
 from ..tensor.fingerprint import pack_fp
 from ..tensor.hashtable import BUCKET
 from .host import HostSpillStore
@@ -216,6 +217,9 @@ class TieredStore:
         target = hot_claims - self.low_slots
         if target <= 0:
             return 0
+        # Chaos-plane boundary: a spill-tier I/O fault fires before any
+        # bucket is emptied, so the tables stay sound (faults/plan.py).
+        maybe_fault("store.spill", tier="host", target=target)
         b = self.bucket
         freed = 0
         scanned = 0
@@ -249,6 +253,9 @@ class TieredStore:
         target = hot_claims - self.low_slots
         if target <= 0:
             return t_lo, t_hi, p_lo, p_hi, 0
+        # Chaos-plane boundary: fires before any PCIe transfer or device
+        # zeroing, so a faulted eviction leaves the tables untouched.
+        maybe_fault("store.spill", tier="device", target=target)
 
         count_window, gather_buckets, zero_buckets = _window_ops()
         b = self.bucket
@@ -301,6 +308,9 @@ class TieredStore:
         (the state is genuinely new — enqueue it)."""
         lo = np.asarray(lo)
         hi = np.asarray(hi)
+        # Chaos-plane boundary: exact-membership reads can fault too (the
+        # spill tier is the component designed to sit on slower storage).
+        maybe_fault("store.resolve", suspects=int(lo.size))
         dup = self.store.contains(pack_fp(lo, hi))
         self.suspects_checked += int(lo.size)
         self.suspects_dup += int(dup.sum())

@@ -33,6 +33,8 @@ import jax.numpy as jnp
 from ..core.discovery import HasDiscoveries
 from ..core.model import Expectation
 from ..core.path import Path
+from ..faults.ckptio import atomic_savez, load_latest
+from ..faults.plan import maybe_fault
 from ..obs import REGISTRY, StepRing, as_tracer, build_detail
 from .fingerprint import device_fingerprint, pack_fp
 from .hashtable import (
@@ -606,6 +608,10 @@ class FrontierSearch:
                 hi[:m] = chunk.hi[b0:b1]
                 active = np.arange(K) < m
 
+                # Chaos-plane boundary: simulated device OOM / XlaRuntime
+                # errors land BEFORE the dispatch, so a faulted step never
+                # half-updates the visited tables (faults/plan.py).
+                maybe_fault("engine.step", engine="frontier", step=steps)
                 t_step0 = time.monotonic()
                 with self._tracer.span("frontier.step", cat="engine"):
                     (
@@ -869,7 +875,10 @@ class FrontierSearch:
         """Dump the visited table, pending frontier queue, counters, and
         discoveries to `path` (.npz). Valid any time `run()` has returned —
         including after a suspension via max_steps/timeout — so an
-        interrupted search can be resumed elsewhere via `load_checkpoint`."""
+        interrupted search can be resumed elsewhere via `load_checkpoint`.
+        The write is crash-atomic (tmp+fsync+rename with a CRC32 footer,
+        previous generation kept at `path + ".prev"` — faults/ckptio.py):
+        a torn write can never poison resume."""
         import json
 
         if self._q is None:
@@ -879,8 +888,7 @@ class FrontierSearch:
         # Tiered runs serialize the spill tier alongside the device table
         # (the Bloom summary is rebuilt from the fingerprints on load).
         spill = self._store.to_checkpoint() if self._store is not None else {}
-        np.savez_compressed(
-            path,
+        arrays = dict(
             **spill,
             t_lo=np.asarray(self.table.t_lo),
             t_hi=np.asarray(self.table.t_hi),
@@ -929,16 +937,19 @@ class FrontierSearch:
                 dtype=np.uint8,
             ),
         )
+        atomic_savez(path, arrays)
 
     @classmethod
     def load_checkpoint(
         cls, model: TensorModel, path: str, batch_size: int = 1024
     ) -> "FrontierSearch":
         """Rebuild a suspended search from a `checkpoint` file; the next
-        `run()` continues exactly where the dump left off."""
+        `run()` continues exactly where the dump left off. The CRC footer
+        is verified; a corrupt current generation falls back to
+        `path + ".prev"` instead of raising (faults/ckptio.load_latest)."""
         import json
 
-        data = np.load(path)
+        data, _src = load_latest(path)
         meta = json.loads(bytes(data["meta"].tobytes()).decode())
         if (meta["lanes"], meta["max_actions"]) != (
             model.lanes,

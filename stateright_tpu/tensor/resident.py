@@ -36,6 +36,8 @@ import jax.numpy as jnp
 
 from ..core.discovery import HasDiscoveries
 from ..core.model import Expectation
+from ..faults.ckptio import atomic_savez, load_latest, normalize_ckpt_path
+from ..faults.plan import maybe_fault
 from ..obs import N_COLS, REGISTRY, StepRing, as_tracer, build_detail
 from .fingerprint import pack_fp
 from .frontier import (
@@ -169,11 +171,9 @@ def _resolve_chunking(budget, timeout, progress, carry):
 _ins_jit = jax.jit(_insert_impl)  # one compile cache shared by every regrow
 
 
-def _ckpt_path(path: str) -> str:
-    """`np.savez` appends `.npz` when the suffix is absent; normalize so
-    `checkpoint(p)` / `load_checkpoint(..., p)` round-trip on the same
-    string."""
-    return path if path.endswith(".npz") else path + ".npz"
+# `.npz`-suffix normalization so `checkpoint(p)` / `load_checkpoint(..., p)`
+# round-trip on the same string (now owned by the atomic checkpoint writer).
+_ckpt_path = normalize_ckpt_path
 
 
 def _validate_ckpt_meta(model, meta: dict) -> None:
@@ -970,6 +970,9 @@ class ResidentSearch:
 
         timed_out = False
         if not chunked:
+            # Chaos-plane boundary: a simulated OOM/XLA fault lands before
+            # the whole-search dispatch (faults/plan.py).
+            maybe_fault("engine.step", engine="resident")
             with self._tracer.span("resident.search", cat="engine"):
                 t_lo, t_hi, p_lo, p_hi, summary, tm_rows = self._kernel(
                     *dev,
@@ -1019,6 +1022,9 @@ class ResidentSearch:
                 # jax's "Array has been deleted".
                 self._last_tables = None
             while True:
+                # Chaos-plane boundary: faults land BEFORE the dispatch, so
+                # a faulted chunk never half-updates the retained carry.
+                maybe_fault("engine.step", engine="resident")
                 t_chunk0 = time.monotonic()
                 with self._tracer.span("resident.chunk", cat="engine"):
                     carry, summary = self._chunk_k(
@@ -1088,6 +1094,11 @@ class ResidentSearch:
                         "growth)"
                     )
                 self._carry = carry
+                # Chaos-plane boundary: simulated preemption mid-run —
+                # raised at a chunk boundary where the carry is sound, the
+                # same place a real TPU preemption would surface when the
+                # host regains control.
+                maybe_fault("engine.chunk", engine="resident")
                 if progress is not None:
                     gl, gh, uc, md = (int(x) for x in summary[:4])
                     progress(gl | (gh << 32), uc, md)
@@ -1403,7 +1414,9 @@ class ResidentSearch:
             ).encode(),
             dtype=np.uint8,
         )
-        np.savez_compressed(_ckpt_path(path), **arrays)
+        # Crash-atomic write (tmp+fsync+rename, CRC32 footer, previous
+        # generation kept at `path + ".prev"` — faults/ckptio.py).
+        atomic_savez(path, arrays)
 
     @classmethod
     def load_checkpoint(
@@ -1419,10 +1432,11 @@ class ResidentSearch:
         larger `table_log2` re-hashes the visited set into the bigger table
         (the recovery path for an overflow abort); the queue is padded to the
         matching capacity. The next `run()` continues where the dump left
-        off."""
+        off. The CRC footer is verified; a corrupt current generation falls
+        back to `path + ".prev"` instead of raising."""
         import json
 
-        data = np.load(_ckpt_path(path))
+        data, _src = load_latest(path)
         meta = json.loads(bytes(data["meta"].tobytes()).decode())
         _validate_ckpt_meta(model, meta)
         if meta.get("table_layout", "split") != "split":

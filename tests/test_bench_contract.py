@@ -143,6 +143,45 @@ def test_detail_counter_keys_conform_to_obs_schema():
     assert validate_detail(detail) == ["telemetry.renamed_counter"]
 
 
+def test_device_detail_pins_faults_row_keys():
+    # The BENCH_FAULTS=1 supervisor-overhead A/B row is part of the
+    # artifact contract: the recovery digest plus the unsupervised wall
+    # time and the measured overhead (expected within noise with injection
+    # disabled) must survive into detail.device so the "supervision is
+    # free" claim is auditable in every BENCH_r*.json.
+    for key in ("faults", "sec_unsupervised", "supervisor_overhead_pct"):
+        assert key in bench.DEVICE_DETAIL_FIELDS
+    row = bench.device_detail(
+        {
+            "states_per_sec": 1000.0,
+            "sec": 2.03,
+            "faults": {"injected_total": 0, "retries": 0},
+            "sec_unsupervised": 2.0,
+            "supervisor_overhead_pct": 1.5,
+        }
+    )
+    assert row["faults"]["injected_total"] == 0
+    assert row["supervisor_overhead_pct"] == 1.5
+    # And the faults vocabulary itself is the documented obs schema's
+    # (obs/schema.py FAULTS_DETAIL_KEYS) — renames break this pin.
+    from stateright_tpu.faults import FaultPlan, SupervisorConfig, Supervisor
+    from stateright_tpu.obs.schema import (
+        DETAIL_KEYS,
+        FAULTS_DETAIL_KEYS,
+        validate_detail,
+    )
+    from stateright_tpu.tensor.models import TensorTwoPhaseSys
+
+    assert "faults" in DETAIL_KEYS
+    sup = Supervisor(
+        TensorTwoPhaseSys(3), engine="frontier", plan=FaultPlan(),
+        config=SupervisorConfig(),
+    )
+    stats = sup.fault_stats()
+    assert set(stats) <= set(FAULTS_DETAIL_KEYS)
+    assert validate_detail({"faults": stats}) == []
+
+
 def test_device_detail_pins_service_row_keys():
     # The BENCH_SERVICE=1 check-service row is part of the artifact
     # contract: mixed-job-batch throughput and the serial A/B ratio must
