@@ -763,6 +763,88 @@ def device_search_sharded(model_name: str, n: int, n_chips: int = 8):
     return out, _parity_err(model_name, n, best, golden)
 
 
+# -- static-analysis budget row (BENCH_ANALYSIS=1) -----------------------------
+
+#: detail.analysis row shape (pinned by tests/test_bench_contract.py).
+ANALYSIS_ROW_FIELDS = ("srlint_findings", "knob_drift", "engines", "clean")
+#: per-engine audit fields inside detail.analysis.engines.<name>.
+ANALYSIS_ENGINE_FIELDS = (
+    "step_hbm_bytes", "step_flops", "transfer_bytes", "model_bytes",
+    "ratio", "ratio_ok", "violations", "skipped",
+)
+
+
+def worker_analysis() -> dict:
+    """`bench.py --worker-analysis`: the static-analysis budget row —
+    srlint over the repo, knob-registry drift, and the three engine
+    anchors' audited step totals (abstract jaxpr tracing on CPU; nothing
+    executes on a device). Runs in a fresh subprocess so the forced
+    8-device CPU mesh never leaks into the TPU workers."""
+    from stateright_tpu.analysis.anchors import audit_anchors
+    from stateright_tpu.analysis.srlint import lint_paths
+    from stateright_tpu.knobs import check_registry
+
+    findings = lint_paths()
+    drift = check_registry()
+    engines = {}
+    violations = 0
+    ratios_ok = True
+    for name, ar in audit_anchors().items():
+        if ar.skipped:
+            engines[name] = {"skipped": ar.skipped}
+            continue
+        s = ar.report.summary()
+        engines[name] = {
+            "step_hbm_bytes": s["step_hbm_bytes"],
+            "step_flops": s["step_flops"],
+            "transfer_bytes": s["transfer_bytes"],
+            "model_bytes": round(ar.model_bytes),
+            "ratio": round(ar.ratio, 2),
+            "ratio_ok": ar.ratio_ok,
+            "violations": s["violations"],
+        }
+        violations += len(s["violations"])
+        ratios_ok = ratios_ok and ar.ratio_ok
+    # Same verdict the CLI gate reaches over the project's own passes
+    # (srlint, drift, jaxpr violations, costmodel cross-check). ruff/mypy
+    # are deliberately excluded: the artifact row must not flip with what
+    # happens to be installed on the bench image.
+    return {
+        "srlint_findings": len(findings),
+        "knob_drift": len(drift),
+        "engines": engines,
+        "clean": not findings and not drift and violations == 0 and ratios_ok,
+    }
+
+
+def analysis_row(timeout: float = 600.0) -> dict:
+    """Run worker_analysis in a subprocess (fresh jax, CPU backend, 8 host
+    devices for the sharded anchor) and return its row; errors become an
+    {"error": ...} row, never a bench death."""
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker-analysis"],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+        line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+        payload = json.loads(line)
+    except Exception as e:  # noqa: BLE001 — reporting must never kill a run
+        log(f"analysis row failed: {e}")
+        return {"error": str(e)}
+    if payload.get("error"):
+        log(f"analysis row failed: {payload['error']}")
+        return {"error": payload["error"]}
+    return payload["result"]
+
+
 # -- main ----------------------------------------------------------------------
 
 # Per-workload fields copied into detail.device verbatim when present. The
@@ -1039,6 +1121,15 @@ def main(argv: list | None = None) -> int:
     if dev_errors:
         detail["device_errors"] = dev_errors
 
+    # BENCH_ANALYSIS=1: the static-analysis budget row — srlint finding
+    # count, knob drift, and each engine anchor's audited step
+    # FLOP/byte/transfer totals vs the costmodel (abstract CPU tracing in a
+    # fresh subprocess; no device). Keys pinned in test_bench_contract.py:
+    # the budget trend is part of the artifact, so a BENCH_r*.json can
+    # answer "did the compiled step program grow" without re-profiling.
+    if os.environ.get("BENCH_ANALYSIS") == "1" and not smoke:
+        detail["analysis"] = analysis_row()
+
     metric, value, vs_baseline = headline_summary(dev, base, smoke=smoke)
     if smoke:
         metric = f"[SMOKE MODE — not a benchmark] {metric}"
@@ -1087,6 +1178,18 @@ if __name__ == "__main__":
         "--worker-faults",
     ):
         sys.exit(worker_main(sys.argv[2], int(sys.argv[3]), mode=sys.argv[1]))
+    if len(sys.argv) == 2 and sys.argv[1] == "--worker-analysis":
+        try:
+            print(
+                json.dumps({"result": worker_analysis(), "error": None}),
+                flush=True,
+            )
+            sys.exit(0)
+        except Exception:  # noqa: BLE001 — one-JSON-line contract
+            traceback.print_exc()
+            err = traceback.format_exc(limit=3).strip().splitlines()[-1]
+            print(json.dumps({"result": None, "error": err}), flush=True)
+            sys.exit(1)
     try:
         sys.exit(main())
     except Exception:  # noqa: BLE001 — the one-JSON-line contract is absolute

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Analysis smoke: the full static-analysis CLI against the repo, end-to-end.
+
+CI-shaped proof of the analysis subsystem (stateright_tpu/analysis/) in one
+command: runs `python -m stateright_tpu.analysis` as a subprocess exactly
+the way CI does (fresh interpreter, 8-device CPU mesh for the sharded
+anchor), requires exit 0 + a clean summary line, then seeds one known-bad
+fixture per srlint rule through lint_source to prove the gate still has
+teeth — a lint pass that silently stopped firing would otherwise look
+identical to a clean repo. Exit code 0 iff every check passes.
+
+    python scripts/analysis_smoke.py [--skip-audit]
+
+--skip-audit skips the jaxpr half of the CLI run (for jax-free images);
+the srlint teeth checks always run.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: one minimal tripwire per srlint rule: (rule, fixture source).
+TRIPWIRES = [
+    ("SR001", """\
+        import jax
+
+        def step(c):
+            return c + c.sum().item()
+
+        jitted = jax.jit(step)
+        """),
+    ("SR002", """\
+        import numpy as np
+
+        def save(path, t):
+            np.savez(path, t=t)
+        """),
+    ("SR003", """\
+        def build(detail):
+            detail["invented_counter"] = 1
+        """),
+    ("SR004", """\
+        def transfer(buf):
+            raise RuntimeError("boom")
+        """),
+    ("SR005", """\
+        def build(store):
+            return store == "teired"
+        """),
+]
+
+
+def main(argv) -> int:
+    failures = []
+
+    def check(ok: bool, what: str):
+        print(("ok   " if ok else "FAIL ") + what)
+        if not ok:
+            failures.append(what)
+
+    # 1) The CLI, exactly as CI invokes it. JAX_PLATFORMS pinned so the
+    # audit traces on CPU wherever this runs; the module sets the 8-device
+    # flag itself.
+    cmd = [sys.executable, "-m", "stateright_tpu.analysis"]
+    if "--skip-audit" in argv:
+        cmd.append("--skip-audit")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        cmd, cwd=ROOT, env=env, capture_output=True, text=True, timeout=600
+    )
+    sys.stdout.write(textwrap.indent(proc.stdout, "     | "))
+    check(proc.returncode == 0, f"CLI exit 0 (got {proc.returncode})")
+    check("analysis: clean" in proc.stdout, "CLI reports 'analysis: clean'")
+    check("srlint: 0 finding(s)" in proc.stdout, "srlint repo run is clean")
+    if "--skip-audit" not in argv:
+        check(
+            proc.stdout.count("audit ") >= 3,
+            "all three engine anchors audited",
+        )
+
+    # 2) The gate has teeth: each rule still fires on its tripwire.
+    from stateright_tpu.analysis.srlint import lint_source
+
+    for rule, src in TRIPWIRES:
+        found = lint_source(
+            textwrap.dedent(src),
+            module="stateright_tpu.store.fixture",
+            root=ROOT,
+        )
+        check(
+            any(f.rule == rule for f in found),
+            f"{rule} fires on its known-bad fixture",
+        )
+
+    print(
+        "analysis smoke:",
+        "PASS" if not failures else f"{len(failures)} FAILURE(S)",
+    )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -118,9 +118,9 @@ def main(argv) -> int:
             .read()
             .decode()
         )
-        lines = [l for l in body.splitlines() if l.strip()]
+        lines = [ln for ln in body.splitlines() if ln.strip()]
         check(
-            bool(lines) and all(_PROM_LINE.match(l) for l in lines),
+            bool(lines) and all(_PROM_LINE.match(ln) for ln in lines),
             f"/metrics parses as Prometheus text ({len(lines)} lines)",
         )
         status = json.loads(

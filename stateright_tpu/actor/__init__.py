@@ -20,7 +20,7 @@ of Actor implementations directly.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Iterable, Optional, Tuple
+from typing import Any, Iterable, Tuple
 
 
 class Id(int):

@@ -5,7 +5,7 @@ actor systems checkable (ref: src/actor/model.rs).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
 from ..core.model import Expectation, Model, Property

@@ -8,7 +8,6 @@ against a `WORegister` spec.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any
 
 from ..semantics.register import ReadOk, WriteFail, WriteOk
 from . import Id, Out

@@ -1,0 +1,14 @@
+"""Project static analysis: srlint + the jaxpr auditor (ISSUE 6).
+
+- regions.py — step-region inference (which functions run traced);
+- srlint.py — the five project AST lint rules (SR001-SR005);
+- auditor.py — jaxpr walker: forbidden ops + FLOP/byte/transfer totals;
+- anchors.py — pinned engine anchor configs + costmodel cross-check;
+- __main__.py — ``python -m stateright_tpu.analysis`` CLI.
+
+srlint imports no jax, and neither does this package: the auditor modules
+import it lazily so the lint pass (and ``--skip-audit`` CLI runs) stay
+jax-free, matching the root package's host-only import discipline.
+"""
+
+from .srlint import Finding, lint_paths, lint_source  # noqa: F401

@@ -1,0 +1,136 @@
+"""``python -m stateright_tpu.analysis`` — the project's one static
+analysis entry point.
+
+Passes, in order (each independently skippable):
+
+1. **srlint** (srlint.py): the five project lint rules over every repo
+   .py file. Pure AST — jax is never imported.
+2. **knob registry drift** (knobs.check_registry): imports the modules
+   that re-state knob universes and reports disagreement with knobs.py.
+   The imports pull in the engine spines (and so jax), which is why
+   ``--skip-audit`` skips this pass too — on jax-free images srlint
+   SR005 still covers knob-literal drift at the AST level.
+3. **jaxpr audit** (anchors.py): abstract-trace each engine's step on the
+   pinned 2pc-3 anchors, flag forbidden ops, and cross-check audited
+   bytes against the costmodel. CPU-only and device-free, but it does
+   import jax (seconds, not minutes).
+4. **ruff / mypy** when the tools exist on PATH (config in
+   pyproject.toml). The container this repo grew in does not ship them;
+   they run wherever they are installed and are reported as "skipped
+   (not installed)" otherwise — srlint is the gate that always runs.
+
+Exit status 0 iff every pass that ran is clean. CI and
+scripts/analysis_smoke.py call exactly this module.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[2]
+
+
+def _run_srlint() -> int:
+    from .srlint import lint_paths
+
+    findings = lint_paths(root=ROOT)
+    for f in findings:
+        print(f)
+    print(f"srlint: {len(findings)} finding(s)")
+    return len(findings)
+
+
+def _run_knob_drift() -> int:
+    from ..knobs import check_registry
+
+    problems = check_registry()
+    for p in problems:
+        print(f"knobs: {p}")
+    print(f"knob registry: {len(problems)} drift(s)")
+    return len(problems)
+
+
+def _run_audit() -> int:
+    from .anchors import MODEL_RATIO_MAX, MODEL_RATIO_MIN, audit_anchors
+
+    bad = 0
+    for name, ar in audit_anchors().items():
+        if ar.skipped:
+            print(f"audit {name}: skipped — {ar.skipped}")
+            continue
+        s = ar.report.summary()
+        print(
+            f"audit {name}: step {s['step_hbm_bytes']:,} B "
+            f"/ {s['step_flops']:,} flop / {s['transfer_bytes']:,} B xfer; "
+            f"model {ar.model_bytes:,.0f} B (ratio {ar.ratio:.1f})"
+        )
+        for v in ar.report.violations:
+            print(f"audit {name}: {v}")
+            bad += 1
+        if not ar.ratio_ok:
+            print(
+                f"audit {name}: bytes ratio {ar.ratio:.1f} outside "
+                f"[{MODEL_RATIO_MIN:g}, {MODEL_RATIO_MAX:g}] — the jaxpr "
+                "and tensor/costmodel.py no longer describe the same program"
+            )
+            bad += 1
+    return bad
+
+
+def _run_tool(name: str, args: list) -> int:
+    """ruff/mypy when installed; 0 findings when absent (reported)."""
+    exe = shutil.which(name)
+    if exe is None:
+        print(f"{name}: skipped (not installed)")
+        return 0
+    proc = subprocess.run([exe, *args], cwd=ROOT)
+    print(f"{name}: exit {proc.returncode}")
+    # One problem per unclean tool, not the raw exit code: a signal-killed
+    # tool returns a NEGATIVE code, which must not subtract from the
+    # finding sum and cancel real findings into a clean exit.
+    return 1 if proc.returncode != 0 else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m stateright_tpu.analysis",
+        description="srlint + knob-drift + jaxpr audit (+ ruff/mypy)",
+    )
+    ap.add_argument(
+        "--skip-audit", action="store_true",
+        help="skip the jax-importing passes (jaxpr audit + cross-module "
+             "knob drift); the remaining run is AST-only and sub-second",
+    )
+    ap.add_argument(
+        "--skip-tools", action="store_true",
+        help="skip ruff/mypy even when installed",
+    )
+    args = ap.parse_args(argv)
+
+    # The sharded anchor needs 8 host devices on CPU; the flag only works
+    # before jax initializes, which is why the audit pass imports lazily.
+    if not args.skip_audit and "jax" not in sys.modules:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+
+    bad = _run_srlint()
+    if not args.skip_audit:
+        bad += _run_knob_drift()
+        bad += _run_audit()
+    if not args.skip_tools:
+        bad += _run_tool("ruff", ["check", "."])
+        bad += _run_tool("mypy", ["stateright_tpu"])
+    print("analysis:", "clean" if bad == 0 else f"{bad} problem(s)")
+    return 0 if bad == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,137 @@
+"""Anchor configs for the jaxpr auditor: the exact engine builds whose
+step programs are pinned as budgets.
+
+One anchor per engine spine, all on the 2pc-3 model (the tier-1 parity
+workload): small enough that abstract tracing takes seconds on CPU, big
+enough that every step phase (expand, fingerprint, insert, append,
+property masks) appears in the jaxpr. Shapes are pinned EXPLICITLY
+(batch, table_log2, append variant) — budgets are meaningless if the
+traced program floats with platform defaults.
+
+`audit_anchors()` is the auditor's entry point: trace each anchor's step
+kernel (`engine.audit_step()` — ShapeDtypeStructs only, no device
+execution), audit the jaxpr (auditor.py), and cross-check the audited
+per-step HBM bytes against the `tensor/costmodel.py` roofline prediction.
+The jaxpr accounting is compiler-naive (every eqn materializes), so the
+two will not match — but their RATIO is deterministic for a given
+program, and a ratio outside [MODEL_RATIO_MIN, MODEL_RATIO_MAX] means one
+side no longer describes the other: a giant new op the model does not
+know, or a model term the program no longer runs.
+
+The sharded anchor needs >= SHARDS devices
+(``--xla_force_host_platform_device_count=8`` on CPU — conftest.py and
+``python -m stateright_tpu.analysis`` both set it); it is skipped with a
+note when the mesh cannot exist.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+#: the 2pc-3 anchor knobs, shared by tests/bench/CLI.
+ANCHOR_MODEL = "2pc-3"
+BATCH = 256
+TABLE_LOG2 = 14
+SHARDED_TABLE_LOG2 = 12  # per shard
+SHARDS = 8
+APPEND = "dus"  # pinned: CPU default is "scatter", budgets must not float
+
+#: audited-vs-modeled per-step HBM byte ratio band (see module docstring).
+MODEL_RATIO_MIN = 0.2
+MODEL_RATIO_MAX = 50.0
+
+
+@dataclass
+class AnchorResult:
+    report: object  # auditor.AuditReport
+    model_bytes: float  # costmodel step_cost prediction
+    ratio: float  # audited step bytes / model bytes
+    ratio_ok: bool
+    skipped: Optional[str] = None  # reason when the anchor could not build
+
+
+def _model():
+    from ..tensor.models import TensorTwoPhaseSys
+
+    return TensorTwoPhaseSys(3)
+
+
+def _model_bytes(model, table_log2: int, variant: str) -> float:
+    from ..tensor import costmodel
+
+    sc = costmodel.step_cost(
+        model.lanes,
+        model.max_actions,
+        BATCH,
+        table_log2,
+        variant=costmodel.ENGINE_VARIANTS[("split", variant)],
+        append=APPEND,
+    )
+    return sc.total_bytes
+
+
+def _audit(engine, name: str, table_log2: int, variant: str, step_mode: str):
+    from .auditor import audit_fn
+
+    fn, args, host_slots = engine.audit_step()
+    report = audit_fn(
+        fn, args, name=name, host_slots=host_slots, step_mode=step_mode
+    )
+    mb = _model_bytes(engine.model, table_log2, variant)
+    ratio = report.step.hbm_bytes / max(mb, 1.0)
+    return AnchorResult(
+        report=report,
+        model_bytes=mb,
+        ratio=ratio,
+        ratio_ok=MODEL_RATIO_MIN <= ratio <= MODEL_RATIO_MAX,
+    )
+
+
+def audit_frontier() -> AnchorResult:
+    from ..tensor.frontier import FrontierSearch
+
+    eng = FrontierSearch(_model(), batch_size=BATCH, table_log2=TABLE_LOG2)
+    return _audit(
+        eng, f"frontier/{ANCHOR_MODEL}", TABLE_LOG2, "sort", "total"
+    )
+
+
+def audit_resident() -> AnchorResult:
+    from ..tensor.resident import ResidentSearch
+
+    eng = ResidentSearch(
+        _model(), batch_size=BATCH, table_log2=TABLE_LOG2, append=APPEND
+    )
+    return _audit(eng, f"resident/{ANCHOR_MODEL}", TABLE_LOG2, "sort", "loop")
+
+
+def audit_sharded() -> Optional[AnchorResult]:
+    import jax
+
+    if len(jax.devices()) < SHARDS:
+        return AnchorResult(
+            report=None, model_bytes=0.0, ratio=0.0, ratio_ok=True,
+            skipped=f"needs {SHARDS} devices, have {len(jax.devices())} "
+            "(set --xla_force_host_platform_device_count=8)",
+        )
+    from ..parallel.sharded import ShardedSearch
+
+    eng = ShardedSearch(
+        _model(),
+        batch_size=BATCH,
+        table_log2=SHARDED_TABLE_LOG2,
+        append=APPEND,
+    )
+    return _audit(
+        eng, f"sharded/{ANCHOR_MODEL}", SHARDED_TABLE_LOG2, "sort", "loop"
+    )
+
+
+def audit_anchors() -> dict:
+    """name -> AnchorResult for every engine anchor."""
+    return {
+        "frontier": audit_frontier(),
+        "resident": audit_resident(),
+        "sharded": audit_sharded(),
+    }

@@ -7,7 +7,6 @@ report stream that bench.sh greps (ref: src/report.rs:50-98, bench.sh:17-27).
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass
 from typing import Optional, TextIO
 

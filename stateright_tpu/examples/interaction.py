@@ -12,7 +12,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-from ..actor import Actor, Id, Network, Out, model_timeout
+from ..actor import Actor, Id, Out, model_timeout
 from ..actor.model import ActorModel
 from ..core.model import Expectation
 

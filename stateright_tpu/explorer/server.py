@@ -35,7 +35,6 @@ from pathlib import Path as FsPath
 from typing import Optional
 
 from ..core.fingerprint import fingerprint
-from ..core.model import Expectation
 from ..core.path import Path
 from ..core.visitor import CheckerVisitor
 from ..obs import REGISTRY, render_prometheus

@@ -18,6 +18,9 @@ Injection points (the name is the contract; grep for `maybe_fault(`):
 - ``store.spill``     — tiered-store high-water eviction entry
 - ``store.resolve``   — tiered-store suspect resolution
 - ``store.append``    — host spill-tier append (I/O boundary)
+- ``store.service``   — resident tiered-store host service entry (queue
+                        compaction + suspect injection + eviction; the
+                        suspended carry is sound, nothing mutated yet)
 - ``shard.transfer``  — sharded engine per-shard service transfer
                         (ctx ``shard=i``)
 - ``ckpt.write``      — checkpoint write; the ``torn`` kind CORRUPTS the

@@ -1,0 +1,112 @@
+"""The ONE registry of engine-knob string literals.
+
+Before this module every spine spelled its own copy of the knob universes:
+`FrontierSearch.INSERT_VARIANTS` named four insert designs, ResidentSearch
+re-listed them inside an error message, the check-service scheduler re-typed
+the store kinds, and `tensor/costmodel.py` kept a parallel variant alphabet —
+the exact triple-implementation drift ROADMAP item 3's step-core refactor
+will remove.  Until that refactor lands, this module is the drift *bound*:
+every validation site imports its universe from here, and the srlint pass
+(`stateright_tpu/analysis/`) flags any knob literal that is compared against
+a variable without being a member of the registry — a typo'd
+`store == "teired"` fails lint, not a benchmark three rounds later.
+
+Deliberately pure Python (no jax import): the cost model, the analysis CLI,
+and host-only tooling all read it without touching a backend.
+"""
+
+from __future__ import annotations
+
+#: Visited-set insert designs accepted by FrontierSearch/ResidentSearch
+#: (`insert_variant=`). The sharded engine runs the same implementations
+#: through the resident kernels.
+INSERT_VARIANTS = ("sort", "phased", "capped", "capped-phased")
+
+#: The subset of INSERT_VARIANTS built on the phased (claim-then-probe)
+#: insert — these require the split table layout (hashtable's phased impl
+#: has no kv lowering). Derived, not restated: srlint SR005 flags literal
+#: copies of this subset exactly like full-universe restatements.
+PHASED_VARIANTS = tuple(v for v in INSERT_VARIANTS if v.endswith("phased"))
+
+#: Hash-table layouts (`table_layout=`): split lo/hi arrays vs interleaved
+#: 64-slot kv buckets (hashtable._insert_impl_kv).
+TABLE_LAYOUTS = ("split", "kv")
+
+#: State-store kinds (`store=`): device-only hot set vs the two-tier
+#: device + host-spill store (stateright_tpu/store/).
+STORE_KINDS = ("device", "tiered")
+
+#: Queue-append variants (`append=`): whole-array row scatter vs
+#: compact-then-dynamic_update_slice (frontier.resolve_append).
+APPEND_KINDS = ("scatter", "dus")
+
+#: Engine spines (supervisor/adapter `engine=` selectors).
+ENGINES = ("frontier", "resident", "sharded")
+
+#: Cost-model variant alphabet (tensor/costmodel.py) — the (table_layout,
+#: insert_variant) product collapsed to the designs the roofline model
+#: distinguishes. Kept here so the mapping below is checkable by lint/tests.
+COST_VARIANTS = ("split", "kv", "phased", "capped", "capped-kv")
+
+
+def check_registry() -> list:
+    """Cross-module drift probe used by `python -m stateright_tpu.analysis`:
+    import every module that re-states a knob universe and report any
+    disagreement with this registry (empty list = no drift). Imports are
+    local so host-only callers (cost model, lint fixtures) never pay for
+    jax."""
+    problems: list[str] = []
+
+    try:
+        from .tensor import costmodel
+    except ModuleNotFoundError as e:
+        # The costmodel module is jax-free but lives under the jax-importing
+        # tensor package; on a jax-free image the cross-module probe simply
+        # cannot run (srlint SR005 still covers literal drift there).
+        if e.name and e.name.split(".")[0] in ("jax", "jaxlib"):
+            return problems
+        raise
+
+    # costmodel re-exports the registry tuple by reference; a set-equality
+    # check would be vacuous, so probe that the alias is still an alias —
+    # re-typing the tuple in costmodel.py is exactly the drift this guards.
+    if costmodel.INSERT_VARIANTS is not COST_VARIANTS:
+        problems.append(
+            "costmodel.INSERT_VARIANTS is a restated copy, not the "
+            "knobs.COST_VARIANTS alias: "
+            f"{sorted(costmodel.INSERT_VARIANTS)} vs {sorted(COST_VARIANTS)}"
+        )
+    for (layout, variant), cost in costmodel.ENGINE_VARIANTS.items():
+        if layout not in TABLE_LAYOUTS:
+            problems.append(
+                f"costmodel.ENGINE_VARIANTS layout {layout!r} not in "
+                "knobs.TABLE_LAYOUTS"
+            )
+        if variant not in INSERT_VARIANTS:
+            problems.append(
+                f"costmodel.ENGINE_VARIANTS insert variant {variant!r} not "
+                "in knobs.INSERT_VARIANTS"
+            )
+        if cost not in COST_VARIANTS:
+            problems.append(
+                f"costmodel.ENGINE_VARIANTS cost variant {cost!r} not in "
+                "knobs.COST_VARIANTS"
+            )
+
+    try:
+        from .tensor.frontier import FrontierSearch
+    except ModuleNotFoundError as e:
+        # jax-free images run the lint half only (`--skip-audit`); the
+        # engine cross-check needs the jax-importing spine and is the one
+        # probe that cannot run there.
+        if e.name and e.name.split(".")[0] in ("jax", "jaxlib"):
+            return problems
+        raise
+
+    if set(FrontierSearch.INSERT_VARIANTS) != set(INSERT_VARIANTS):
+        problems.append(
+            "FrontierSearch.INSERT_VARIANTS != knobs.INSERT_VARIANTS: "
+            f"{sorted(FrontierSearch.INSERT_VARIANTS)} vs "
+            f"{sorted(INSERT_VARIANTS)}"
+        )
+    return problems

@@ -22,7 +22,7 @@ from __future__ import annotations
 import re
 import threading
 import weakref
-from typing import Callable, Optional
+from typing import Callable
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 
@@ -94,10 +94,10 @@ class CounterRegistry:
         ref: Callable
         if hasattr(provider, "__self__"):
             wm = weakref.WeakMethod(provider)
-            ref = lambda: (lambda m: m() if m is not None else None)(wm())
+            ref = lambda: (lambda m: m() if m is not None else None)(wm())  # noqa: E731
             ref._weak = wm  # liveness probe for pruning
         else:
-            ref = lambda: provider()
+            ref = lambda: provider()  # noqa: E731
             ref._weak = None
         with self._lock:
             base, n = _sanitize(name), 1

@@ -92,17 +92,47 @@ FAULTS_DETAIL_KEYS = {
 }
 
 
+#: Source names components may register metric providers under
+#: (obs/registry.py REGISTRY.register). The srlint pass
+#: (stateright_tpu/analysis/) rejects a register() call whose literal source
+#: name is not declared here — /metrics scrape names are part of the
+#: dashboard contract, exactly like the detail keys above.
+REGISTRY_SOURCES = {
+    "frontier": "host-orchestrated engine (tensor/frontier.py)",
+    "resident": "device-resident engine (tensor/resident.py)",
+    "sharded": "multi-chip engine (parallel/sharded.py)",
+    "service": "check service scheduler (service/api.py)",
+    "supervisor": "self-healing supervisor (faults/supervisor.py)",
+}
+
+
+#: The nested sub-dict vocabularies under `SearchResult.detail` — the ONE
+#: declaration srlint's SR003 chain-walk and both validators below share;
+#: a new sub-schema added here is picked up by all three.
+DETAIL_SUBSCHEMAS = (
+    ("service", SERVICE_DETAIL_KEYS),
+    ("telemetry", TELEMETRY_KEYS),
+    ("faults", FAULTS_DETAIL_KEYS),
+)
+
+
+def all_detail_key_paths() -> set:
+    """Every declared `SearchResult.detail` key path ("store", "service.
+    queue_wait", ...) — the flat vocabulary the srlint undeclared-key rule
+    checks literal subscripts against."""
+    paths = set(DETAIL_KEYS)
+    for sub, allowed in DETAIL_SUBSCHEMAS:
+        paths.update(f"{sub}.{k}" for k in allowed)
+    return paths
+
+
 def validate_detail(detail: Optional[dict]) -> list:
     """Key paths in a `SearchResult.detail` dict that the schema does not
     name (empty list = conforming). Tests assert `== []`."""
     if detail is None:
         return []
     bad = [k for k in detail if k not in DETAIL_KEYS]
-    for sub, allowed in (
-        ("service", SERVICE_DETAIL_KEYS),
-        ("telemetry", TELEMETRY_KEYS),
-        ("faults", FAULTS_DETAIL_KEYS),
-    ):
+    for sub, allowed in DETAIL_SUBSCHEMAS:
         if isinstance(detail.get(sub), dict):
             bad.extend(
                 f"{sub}.{k}" for k in detail[sub] if k not in allowed

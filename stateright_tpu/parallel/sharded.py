@@ -67,6 +67,7 @@ else:  # pragma: no cover - exercised on jax 0.4.x images
 
 from ..faults.ckptio import atomic_savez, load_latest
 from ..faults.plan import maybe_fault
+from ..knobs import STORE_KINDS
 from ..obs import N_COLS, REGISTRY, StepRing, as_tracer
 from ..tensor.fingerprint import pack_fp
 from ..core.discovery import HasDiscoveries
@@ -134,8 +135,8 @@ def _host(x):
     epilogues pay one DCN round-trip, not one per array."""
     leaves = jax.tree.leaves(x)
     if any(
-        isinstance(l, jax.Array) and not l.is_fully_addressable
-        for l in leaves
+        isinstance(leaf, jax.Array) and not leaf.is_fully_addressable
+        for leaf in leaves
     ):
         from jax.experimental import multihost_utils
 
@@ -248,8 +249,8 @@ class ShardedSearch:
         )
         self.batch_size = batch_size
         self.table_log2 = table_log2
-        if store not in ("device", "tiered"):
-            raise ValueError(f"store must be 'device' or 'tiered', got {store!r}")
+        if store not in STORE_KINDS:  # knob universe: knobs.py
+            raise ValueError(f"store must be one of {STORE_KINDS}, got {store!r}")
         if store == "tiered" and jax.process_count() > 1:
             raise NotImplementedError(
                 "store='tiered' on the sharded engine requires a "
@@ -889,6 +890,28 @@ class ShardedSearch:
         )
         return jax.jit(sharded), jax.jit(seed_sm), chunk_jit
 
+    # -- static analysis -------------------------------------------------------
+
+    def audit_step(self):
+        """(chunk_fn, abstract_operands, host_slots) for the jaxpr auditor
+        (analysis/auditor.py). Carry shapes via eval_shape over the
+        engine's own shard_map'd seed kernel — abstract only; the mesh
+        must exist (conftest forces 8 host devices on CPU) but no device
+        executes anything."""
+        K, L = self.batch_size, self.model.lanes
+        sds = jax.ShapeDtypeStruct
+        u32 = lambda *s: sds(s, jnp.uint32)  # noqa: E731
+        carry = jax.eval_shape(
+            self._seed_k,
+            u32(K, L), u32(K), u32(K), sds((K,), jnp.bool_),
+            u32(), u32(), u32(), u32(), sds((), jnp.int32),
+        )
+        args = (
+            carry, u32(), u32(), u32(), u32(), u32(),
+            sds((), jnp.int32), sds((), jnp.int32),
+        )
+        return self._chunk_k, args, ()
+
     # -- host entry ------------------------------------------------------------
 
     def run(
@@ -1346,7 +1369,7 @@ class ShardedSearch:
         from jax.sharding import NamedSharding
 
         sh = NamedSharding(self.mesh, P(self.axis))
-        put = lambda x: jax.device_put(jnp.asarray(x), sh)
+        put = lambda x: jax.device_put(jnp.asarray(x), sh)  # noqa: E731
         upd = dict(
             q_states=put(q[0]), q_lo=put(q[1]), q_hi=put(q[2]),
             q_ebits=put(q[3]), q_depth=put(q[4]),
@@ -1386,11 +1409,13 @@ class ShardedSearch:
         Requires a chunked run, which retains the per-shard carry.
         `evaluated_only` restricts to popped rows ([0, head) per shard)."""
         if self._carry is None:
+            # srlint: fault-ok caller-contract guard, not an I/O/device surface
             raise RuntimeError(
                 "no retained carry to dump: run with budget=... (chunked "
                 "dispatch) before dump_states()"
             )
         if self._q_compacted:
+            # srlint: fault-ok caller-contract guard, not an I/O/device surface
             raise RuntimeError(
                 "dump_states is unavailable once the tiered store has "
                 "compacted a shard's frontier queue (rows [0, tail) no "
@@ -1443,6 +1468,7 @@ class ShardedSearch:
         import json
 
         if self._carry is None:
+            # srlint: fault-ok caller-contract guard, not an I/O/device surface
             raise RuntimeError(
                 "nothing to checkpoint: no suspended carry (run with "
                 "budget=... to enable chunked dispatch)"
@@ -1649,6 +1675,7 @@ class ShardedSearch:
         """Union the per-chip parent maps, then reconstruct as usual."""
         if self._parent_map is None:
             if self._last_tables is None:
+                # srlint: fault-ok caller-contract guard, not an I/O/device surface
                 raise RuntimeError(
                     "no table snapshot to reconstruct from: run() has not "
                     "completed since the last reset/donated overflow"

@@ -45,6 +45,7 @@ import jax.numpy as jnp
 
 from ..core.model import Expectation
 from ..faults.plan import maybe_fault
+from ..knobs import STORE_KINDS
 from ..obs import StepRing, as_tracer
 from ..tensor.fingerprint import pack_fp, salt_fp, unpack_fp
 from ..tensor.frontier import (
@@ -203,8 +204,8 @@ class ServiceEngine:
             )
         self._insert = self.INSERT_VARIANTS[insert_variant]
         self.insert_variant = insert_variant
-        if store not in ("device", "tiered"):
-            raise ValueError(f"store must be 'device' or 'tiered', got {store!r}")
+        if store not in STORE_KINDS:  # knob universe: knobs.py
+            raise ValueError(f"store must be one of {STORE_KINDS}, got {store!r}")
         self.store = store
         self._store = None
         self._spill_trigger = 0

@@ -107,7 +107,9 @@ class TensorModelAdapter:
         ]
 
     def within_boundary(self, state) -> bool:
+        # srlint: host-ok host-side explorer adapter (single-state path), never traced
         batch = jnp.asarray(np.asarray(state, dtype=np.uint32)[None])
+        # srlint: host-ok host-side explorer adapter (single-state path), never traced
         return bool(np.asarray(self.tensor_model.within_boundary(batch))[0])
 
     # -- display ---------------------------------------------------------------

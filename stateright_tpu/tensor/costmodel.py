@@ -35,6 +35,8 @@ import math
 from dataclasses import dataclass
 from typing import NamedTuple, Optional
 
+from ..knobs import COST_VARIANTS
+
 # Mirrors of tensor/hashtable.py layout constants (pinned by test).
 BUCKET = 128
 KV_BUCKET = 64
@@ -47,7 +49,10 @@ BUCKET_ROW_BYTES = BUCKET * 4  # one gathered bucket row (512 B)
 # overflow-loop sort is 4 but runs ~zero iterations at sane load factors.
 SORT_OPERANDS = 3
 
-INSERT_VARIANTS = ("split", "kv", "phased", "capped", "capped-kv")
+# The cost-variant alphabet lives in the one knob registry
+# (stateright_tpu/knobs.py COST_VARIANTS); re-exported under the name this
+# module has always used.
+INSERT_VARIANTS = COST_VARIANTS
 
 # (table_layout, insert_variant) engine options -> cost-model variant name.
 # The single source of truth for this mapping: bench.py's roofline

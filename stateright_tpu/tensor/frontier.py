@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from ..core.discovery import HasDiscoveries
 from ..core.model import Expectation
 from ..core.path import Path
+from ..knobs import APPEND_KINDS, STORE_KINDS
 from ..faults.ckptio import atomic_savez, load_latest
 from ..faults.plan import maybe_fault
 from ..obs import REGISTRY, StepRing, as_tracer, build_detail
@@ -162,7 +163,7 @@ def pop_batch(q_states, q_lo, q_hi, q_ebits, q_depth, head, tail, K):
     return states, lo, hi, ebits, depth, active, head + take
 
 
-def append_new(
+def append_new(  # srlint: step-region
     q_states, q_lo, q_hi, q_ebits, q_depth, tail,
     flat, slo, shi, ebits_rows, depth_rows, is_new,
 ):
@@ -190,12 +191,12 @@ def resolve_append(append, platform: str) -> str:
     engine will actually run on."""
     if append is None:
         return "scatter" if platform == "cpu" else "dus"
-    if append not in ("scatter", "dus"):
-        raise ValueError(f"append must be 'scatter' or 'dus', got {append!r}")
+    if append not in APPEND_KINDS:  # one knob universe: stateright_tpu/knobs.py
+        raise ValueError(f"append must be one of {APPEND_KINDS}, got {append!r}")
     return append
 
 
-def append_new_dus(
+def append_new_dus(  # srlint: step-region
     q_states, q_lo, q_hi, q_ebits, q_depth, tail,
     flat, slo, shi, ebits_rows, depth_rows, is_new,
 ):
@@ -280,6 +281,7 @@ def replay_fp_chain(model: TensorModel, chain: list) -> Path:
     init_fps = pack_fp(np.asarray(ilo), np.asarray(ihi))
     rows = np.nonzero(init_fps == np.uint64(chain[0]))[0]
     if len(rows) == 0:
+        # srlint: fault-ok host-side path reconstruction after the search; no recovery path exists
         raise RuntimeError(
             "failed to reconstruct init state from device fingerprint; "
             "the tensor model may be nondeterministic"
@@ -294,6 +296,7 @@ def replay_fp_chain(model: TensorModel, chain: list) -> Path:
         valid = np.asarray(valid)[0]
         hits = np.nonzero(valid & (sfps == np.uint64(next_fp)))[0]
         if len(hits) == 0:
+            # srlint: fault-ok host-side path reconstruction after the search; no recovery path exists
             raise RuntimeError(
                 "failed to reconstruct a step from device fingerprints; "
                 "the tensor model may be nondeterministic"
@@ -387,8 +390,8 @@ class FrontierSearch:
                 f"{sorted(self.INSERT_VARIANTS)}, got {insert_variant!r}"
             )
         self.insert_variant = insert_variant
-        if store not in ("device", "tiered"):
-            raise ValueError(f"store must be 'device' or 'tiered', got {store!r}")
+        if store not in STORE_KINDS:  # one knob universe: stateright_tpu/knobs.py
+            raise ValueError(f"store must be one of {STORE_KINDS}, got {store!r}")
         self.store = store
         self._store = None
         if store == "tiered":
@@ -489,6 +492,30 @@ class FrontierSearch:
 
         return step
 
+    # -- static analysis -------------------------------------------------------
+
+    def audit_step(self):
+        """(step_fn, abstract_operands, host_slots) for the jaxpr auditor
+        (analysis/auditor.py): operands mirror one run() dispatch as
+        ShapeDtypeStructs, so tracing touches no device data. host_slots
+        are the operand indices the host re-uploads every step (this
+        engine's per-step PCIe floor: the popped batch + active mask)."""
+        K, L, S = self.batch_size, self.model.lanes, self.table.size
+        sds = jax.ShapeDtypeStruct
+        summary = (
+            self._store.device_summary()
+            if self._store is not None
+            else self._no_summary
+        )
+        args = (
+            sds((S,), jnp.uint32), sds((S,), jnp.uint32),
+            sds((S,), jnp.uint32), sds((S,), jnp.uint32),
+            sds((K, L), jnp.uint32), sds((K,), jnp.uint32),
+            sds((K,), jnp.uint32), sds((K,), jnp.bool_),
+            sds(summary.shape, summary.dtype),
+        )
+        return self._step, args, (4, 5, 6, 7)
+
     # -- host orchestration ----------------------------------------------------
 
     def _seed(self) -> None:
@@ -516,6 +543,11 @@ class FrontierSearch:
         self._hot_claims = 0
         self._ring = StepRing(self._tm_capacity) if self._telemetry else None
 
+        # Chaos-plane boundary: the seed inserts below dispatch to the
+        # device and can overflow exactly like a run() step; before this
+        # boundary a seeding fault was the one engine failure surface the
+        # chaos plane could not reach (found by srlint SR004).
+        maybe_fault("engine.step", engine="frontier", phase="seed")
         # Insert init states (chunked to batch size).
         for b0 in range(0, n0, K):
             sl = slice(b0, min(b0 + K, n0))
@@ -882,6 +914,7 @@ class FrontierSearch:
         import json
 
         if self._q is None:
+            # srlint: fault-ok caller-contract guard, not an I/O/device surface
             raise RuntimeError("nothing to checkpoint: run() has not started")
         self._tracer.instant("checkpoint", cat="engine", path=path)
         chunks = list(self._q)

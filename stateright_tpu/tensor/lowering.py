@@ -112,7 +112,6 @@ from ..actor.model import ActorModel
 from ..actor.network import (
     Envelope,
     ORDERED,
-    UNORDERED_DUPLICATING,
     UNORDERED_NONDUPLICATING,
 )
 from ..core.discovery import HasDiscoveries
@@ -1598,7 +1597,6 @@ class LoweredActorModel(TensorModel):
 
         def lookup_deliver(eid, deliverable):
             """eid: [B, S] delivered envelope per slot; -> per-slot updates."""
-            S = eid.shape[1]
             safe = jnp.minimum(eid, u(self.E - 1)).astype(jnp.int32)
             dst = jnp.take(E_dst, safe)  # [B, S]; == n for undeliverable
             dst_ok = dst < n
@@ -1630,7 +1628,6 @@ class LoweredActorModel(TensorModel):
         ):
             """Write actor/timers/history/randoms lanes shared by
             deliver/timeout/select-random transitions."""
-            S = d_actor.shape[1]
             succ = base_succ
             sel = (
                 jnp.arange(n)[None, None, :] == d_actor[:, :, None]

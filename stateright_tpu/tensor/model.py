@@ -10,7 +10,7 @@ reference wastes a whole thread on one state at a time.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Callable, Optional
+from typing import Any, Callable
 
 import jax.numpy as jnp
 

@@ -129,8 +129,8 @@ class TensorPaxos(TensorModel):
         def leader(b):
             return (b - 1) % S
 
-        def peer(l, d):  # d-th peer of server l, in increasing id order
-            return d + (d >= l)
+        def peer(s, d):  # d-th peer of server s, in increasing id order
+            return d + (d >= s)
 
         for k in range(C):
             i = self.PUT0 + k

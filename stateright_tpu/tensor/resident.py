@@ -36,6 +36,7 @@ import jax.numpy as jnp
 
 from ..core.discovery import HasDiscoveries
 from ..core.model import Expectation
+from ..knobs import INSERT_VARIANTS, PHASED_VARIANTS, STORE_KINDS, TABLE_LAYOUTS
 from ..faults.ckptio import atomic_savez, load_latest, normalize_ckpt_path
 from ..faults.plan import maybe_fault
 from ..obs import N_COLS, REGISTRY, StepRing, as_tracer, build_detail
@@ -226,6 +227,7 @@ def _regrow(
         active = np.arange(K) < m
         tl, th, pl, ph, _, ovf = ins(tl, th, pl, ph, *batch, active)
         if bool(ovf):
+            # srlint: fault-ok deterministic capacity wall during host-side regrow, not injectable infra
             raise RuntimeError(
                 "table overflow while re-growing; raise table_log2 further"
             )
@@ -252,7 +254,7 @@ def _compact_queue(q_states, q_lo, q_hi, q_ebits, q_depth, head):
     tail of the gather fills with zeros, which nothing past the new tail
     reads."""
     idx = head + jnp.arange(q_lo.shape[0], dtype=jnp.int32)
-    one = lambda a: jnp.take(a, idx, mode="fill", fill_value=0)
+    one = lambda a: jnp.take(a, idx, mode="fill", fill_value=0)  # noqa: E731
     return (
         jnp.take(q_states, idx, axis=0, mode="fill", fill_value=0),
         one(q_lo), one(q_hi), one(q_ebits), one(q_depth),
@@ -269,7 +271,7 @@ def _inject_rows(
     caller's real count are scratch beyond the new tail). The caller
     guarantees tail + block_rows <= Q via the tiered queue slack."""
     upd2 = jax.lax.dynamic_update_slice(q_states, b_states, (tail, 0))
-    one = lambda q, b: jax.lax.dynamic_update_slice(q, b, (tail,))
+    one = lambda q, b: jax.lax.dynamic_update_slice(q, b, (tail,))  # noqa: E731
     return (
         upd2, one(q_lo, b_lo), one(q_hi, b_hi),
         one(q_ebits, b_eb), one(q_depth, b_dp),
@@ -343,8 +345,11 @@ class ResidentSearch:
         # uint32[2S] kv array and t_hi a zero-length placeholder.
         # Flag-gated pending the silicon race; checkpoint regrow is
         # split-only for now.
-        if table_layout not in ("split", "kv"):
-            raise ValueError("table_layout must be 'split' or 'kv'")
+        if table_layout not in TABLE_LAYOUTS:  # knob universe: knobs.py
+            raise ValueError(
+                f"table_layout must be one of {TABLE_LAYOUTS}, "
+                f"got {table_layout!r}"
+            )
         self.table_layout = table_layout
         # insert_variant selects the visited-set insert design:
         #   "sort"   — full-batch sort-claim (the at-scale default);
@@ -357,12 +362,12 @@ class ResidentSearch:
         #              expanded batch (hashtable.make_capped_insert);
         #              composes with table_layout="kv";
         #   "capped-phased" — the same cap around the phased insert.
-        if insert_variant not in ("sort", "phased", "capped", "capped-phased"):
+        if insert_variant not in INSERT_VARIANTS:  # knob universe: knobs.py
             raise ValueError(
-                "insert_variant must be 'sort', 'phased', 'capped', or "
-                "'capped-phased'"
+                f"insert_variant must be one of {INSERT_VARIANTS}, "
+                f"got {insert_variant!r}"
             )
-        if insert_variant in ("phased", "capped-phased") and table_layout == "kv":
+        if insert_variant in PHASED_VARIANTS and table_layout == "kv":
             raise ValueError(
                 f"insert_variant={insert_variant!r} supports the split "
                 "table layout only"
@@ -375,8 +380,8 @@ class ResidentSearch:
         # the host (EXIT_SERVICE) instead of aborting, so spaces bigger
         # than the table degrade gracefully; tiered runs are always
         # chunked (the host must get control between dispatches).
-        if store not in ("device", "tiered"):
-            raise ValueError(f"store must be 'device' or 'tiered', got {store!r}")
+        if store not in STORE_KINDS:  # knob universe: knobs.py
+            raise ValueError(f"store must be one of {STORE_KINDS}, got {store!r}")
         if store == "tiered" and table_layout != "split":
             raise ValueError("store='tiered' supports the split table layout only")
         self.store = store
@@ -882,6 +887,30 @@ class ResidentSearch:
         )
         return search, seed_k, chunk_k
 
+    # -- static analysis -------------------------------------------------------
+
+    def audit_step(self):
+        """(chunk_fn, abstract_operands, host_slots) for the jaxpr auditor
+        (analysis/auditor.py). The carry shapes come from eval_shape over
+        the engine's own seed kernel — abstract only, no device work. The
+        chunked dispatch re-uploads nothing per step (host_slots empty):
+        the auditor's while-body extraction reports the per-step cost."""
+        K, L = self.batch_size, self.model.lanes
+        sds = jax.ShapeDtypeStruct
+        u32 = lambda *s: sds(s, jnp.uint32)  # noqa: E731
+        carry = jax.eval_shape(
+            self._seed_k,
+            u32(K, L), u32(K), u32(K), sds((), jnp.int32), u32(), u32(),
+        )
+        dyn = jax.tree.map(
+            lambda x: sds(x.shape, x.dtype), self._dyn_dev
+        )
+        args = (
+            carry, u32(), u32(), u32(), u32(), u32(),
+            sds((), jnp.int32), sds((), jnp.int32), dyn,
+        )
+        return self._chunk_k, args, ()
+
     # -- host entry ------------------------------------------------------------
 
     def run(
@@ -1203,6 +1232,11 @@ class ResidentSearch:
 
         The carry is rebuilt with the service bit cleared; the caller
         resumes the same while_loop."""
+        # Chaos-plane boundary: the whole host half is retriable from the
+        # suspended carry (no host/device state mutated yet) — before this
+        # boundary the tiered service raises below were failure surfaces
+        # the chaos plane could not reach (found by srlint SR004).
+        maybe_fault("store.service", engine="resident")
         c = self._carry
         L = self.model.lanes
         SQ = self._SQ
@@ -1335,11 +1369,13 @@ class ResidentSearch:
         cut off by target_max_depth are popped-but-unevaluated and still
         appear — the one divergence from reference visitor semantics.)"""
         if self._carry is None:
+            # srlint: fault-ok caller-contract guard, not an I/O/device surface
             raise RuntimeError(
                 "no retained carry to dump: run with budget=... (chunked "
                 "dispatch) before dump_states()"
             )
         if self._q_compacted:
+            # srlint: fault-ok caller-contract guard, not an I/O/device surface
             raise RuntimeError(
                 "dump_states is unavailable once the tiered store has "
                 "compacted the frontier queue (rows [0, tail) no longer "
@@ -1372,6 +1408,7 @@ class ResidentSearch:
         import json
 
         if self._carry is None:
+            # srlint: fault-ok caller-contract guard, not an I/O/device surface
             raise RuntimeError(
                 "nothing to checkpoint: no suspended carry (run with "
                 "budget=... to enable chunked dispatch)"
@@ -1603,6 +1640,7 @@ class ResidentSearch:
         shared by path reconstruction and the TPU checker's visitors."""
         if self._parent_map is None:
             if self._last_tables is None:
+                # srlint: fault-ok caller-contract guard, not an I/O/device surface
                 raise RuntimeError(
                     "no table snapshot to reconstruct from: run() has not "
                     "completed since the last reset/donated resume"

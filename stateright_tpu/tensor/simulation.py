@@ -28,7 +28,7 @@ from __future__ import annotations
 
 import time
 from functools import partial
-from typing import NamedTuple, Optional
+from typing import NamedTuple
 
 import numpy as np
 
@@ -96,8 +96,6 @@ class DeviceSimulation:
         T = self.traces
         D = self.max_depth
         C = 1 << self.table_log2
-        L = model.lanes
-        A = model.max_actions
         props = self.props
         P = len(props)
         always_i = [i for i, p in enumerate(props) if p.expectation == Expectation.ALWAYS]

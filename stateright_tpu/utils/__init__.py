@@ -12,7 +12,7 @@ ref: src/util.rs:137-159).
 
 from __future__ import annotations
 
-from typing import Any, Iterable, Iterator, Optional, Tuple
+from typing import Iterable, Iterator, Optional, Tuple
 
 from ..core.fingerprint import stable_encode
 

@@ -12,7 +12,6 @@ from stateright_tpu.actor import (
     Id,
     LossyNetwork,
     Network,
-    Out,
     model_timeout,
 )
 from stateright_tpu.actor.test_util import Ping, PingPongCfg, Pong
@@ -33,7 +32,7 @@ def test_visits_expected_states():
             history=(0, 0),
         )
 
-    e = lambda s, d, m: Envelope(Id(s), Id(d), m)
+    e = lambda s, d, m: Envelope(Id(s), Id(d), m)  # noqa: E731
 
     recorder = StateRecorder()
     checker = (

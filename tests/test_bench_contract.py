@@ -182,6 +182,44 @@ def test_device_detail_pins_faults_row_keys():
     assert validate_detail({"faults": stats}) == []
 
 
+def test_analysis_row_pins_budget_keys():
+    # The BENCH_ANALYSIS=1 static-analysis budget row is part of the
+    # artifact contract: srlint finding count, knob-registry drift, and
+    # each engine anchor's audited step totals vs the costmodel must keep
+    # these spellings so a BENCH_r*.json can answer "did the compiled step
+    # program grow" across rounds without re-profiling. worker_analysis()
+    # (bench.py) produces exactly this shape; here we pin the vocabulary
+    # without importing jax.
+    assert bench.ANALYSIS_ROW_FIELDS == (
+        "srlint_findings", "knob_drift", "engines", "clean",
+    )
+    for key in ("step_hbm_bytes", "step_flops", "transfer_bytes",
+                "model_bytes", "ratio", "ratio_ok", "violations", "skipped"):
+        assert key in bench.ANALYSIS_ENGINE_FIELDS
+    # A worker_analysis-shaped row conforms to the pinned vocabulary: every
+    # top-level key is a row field, every per-engine key an engine field.
+    row = {
+        "srlint_findings": 0,
+        "knob_drift": 0,
+        "engines": {
+            "frontier": {
+                "step_hbm_bytes": 81_037_075,
+                "step_flops": 299_275_389,
+                "transfer_bytes": 8448,
+                "model_bytes": 5_964_248,
+                "ratio": 13.59,
+                "ratio_ok": True,
+                "violations": [],
+            },
+            "sharded": {"skipped": "needs 8 devices"},
+        },
+        "clean": True,
+    }
+    assert set(row) == set(bench.ANALYSIS_ROW_FIELDS)
+    for eng in row["engines"].values():
+        assert set(eng) <= set(bench.ANALYSIS_ENGINE_FIELDS)
+
+
 def test_device_detail_pins_service_row_keys():
     # The BENCH_SERVICE=1 check-service row is part of the artifact
     # contract: mixed-job-batch throughput and the serial A/B ratio must

@@ -3,7 +3,6 @@ partial-search checkpointing; with device-array frontiers it is nearly free):
 a suspended search dumped to disk and restored into a fresh engine must finish
 with exactly the counts of an uninterrupted run."""
 
-import numpy as np
 import pytest
 
 from stateright_tpu.tensor import FrontierSearch

@@ -66,14 +66,14 @@ def test_capped_cost_tracks_populated_lanes():
     # populated fraction must shed a visible share of insert time.
     lo = cm.step_cost(**ANCHOR, variant="capped", new_frac=0.25)
     hi = cm.step_cost(**ANCHOR, variant="capped", new_frac=1.0)
-    ins = lambda sc: sum(
+    ins = lambda sc: sum(  # noqa: E731
         o.ms for o in sc.ops if o.name.startswith("insert_")
     )
     assert ins(lo) < 0.5 * ins(hi)
 
 
 def test_kv_halves_probe_gather_bytes():
-    g = lambda v: sum(
+    g = lambda v: sum(  # noqa: E731
         o.bytes
         for o in cm.step_cost(**ANCHOR, variant=v).ops
         if o.name == "insert_gather"
@@ -138,7 +138,7 @@ def test_spill_term_adds_probe_and_eviction_ops():
     k4 = cm.step_cost(
         **ANCHOR, variant="split", spill={"summary_hashes": 4}
     )
-    probe = lambda s: next(o for o in s.ops if o.name == "spill_probe")
+    probe = lambda s: next(o for o in s.ops if o.name == "spill_probe")  # noqa: E731
     assert probe(k8).bytes == 2 * probe(k4).bytes
     heavier = cm.step_cost(
         **ANCHOR, variant="split", spill={"evict_per_step": 1000.0}

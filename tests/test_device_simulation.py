@@ -2,7 +2,6 @@
 conftest) against the host SimulationChecker's semantics — discovery verdicts,
 eventually handling at trace endings, reproducible seeds, path reconstruction."""
 
-import numpy as np
 
 from stateright_tpu.core.discovery import HasDiscoveries
 from stateright_tpu.tensor.models import TensorLinearEquation, TensorTwoPhaseSys

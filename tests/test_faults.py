@@ -239,6 +239,39 @@ def test_supervised_tiered_spill_and_resolve_io_faults(tmp_path):
     assert f["retries"] == 2
 
 
+def test_supervised_frontier_seed_phase_fault_recovers(tmp_path):
+    # The r11 srlint SR004 find: frontier seeding runs device inserts
+    # before the main loop and used to sit OFF the chaos plane. A fault
+    # injected exactly at the seed boundary (phase match) must be retried
+    # to golden parity like any step fault.
+    plan = FaultPlan(seed=11).rule(
+        "engine.step", "oom", match={"phase": "seed"},
+    )
+    r = run_supervised(
+        M3, engine="frontier", plan=plan, config=CFG,
+        checkpoint_path=str(tmp_path / "ck.npz"),
+        engine_kwargs=dict(batch_size=64, table_log2=12),
+    )
+    f = assert_golden(r, faults_expected=1)
+    assert f["injected"] == {"engine.step:oom": 1}
+    assert f["retries"] == 1
+
+
+def test_supervised_resident_tiered_service_fault_recovers(tmp_path):
+    # The other r11 SR004 find: the resident engine's tiered host service
+    # (queue compaction + suspect injection + eviction). The boundary sits
+    # before any carry mutation, so an injected I/O fault there must be
+    # cleanly retriable at golden parity.
+    plan = FaultPlan(seed=12).rule("store.service", "io", times=1)
+    r = run_supervised(
+        M3, engine="resident", plan=plan, config=CFG,
+        checkpoint_path=str(tmp_path / "ck.npz"),
+        engine_kwargs=dict(TIERED),
+    )
+    f = assert_golden(r, faults_expected=1)
+    assert f["injected"] == {"store.service:io": 1}
+
+
 def test_supervised_resident_preemption_and_watchdog_hang(tmp_path):
     # Mid-chunk preemption + an injected hang: the watchdog must convert
     # the hang into a retriable fault instead of waiting it out. The hang
@@ -431,7 +464,7 @@ def test_push_front_preserves_pop_order():
 
     job = Job(1, M3)
     P = 0
-    mk = lambda a, b: (
+    mk = lambda a, b: (  # noqa: E731
         np.arange(a, b, dtype=np.uint32).reshape(-1, 1),
         np.arange(a, b, dtype=np.uint32),
         np.arange(a, b, dtype=np.uint32),

@@ -62,7 +62,7 @@ def _run_ranks(extra_args=()):
     for p, out in zip(procs, outs):
         assert p.returncode == 0, f"rank failed:\n{out[-3000:]}"
         lines = [
-            l for l in out.splitlines() if l.startswith("MULTIHOST_RESULT ")
+            ln for ln in out.splitlines() if ln.startswith("MULTIHOST_RESULT ")
         ]
         assert len(lines) == 1, out[-3000:]
         results.append(json.loads(lines[0].split(" ", 1)[1]))

@@ -239,10 +239,10 @@ _PROM_LINE = re.compile(
 
 
 def _assert_prometheus_text(body: str) -> int:
-    lines = [l for l in body.splitlines() if l.strip()]
+    lines = [ln for ln in body.splitlines() if ln.strip()]
     for line in lines:
         assert _PROM_LINE.match(line), f"bad exposition line: {line!r}"
-    samples = [l for l in lines if not l.startswith("#")]
+    samples = [ln for ln in lines if not ln.startswith("#")]
     assert samples, "no samples in scrape"
     return len(samples)
 

@@ -16,7 +16,6 @@ from stateright_tpu.actor.ordered_reliable_link import (
     ActorWrapper,
     Deliver,
     Resend,
-    StateWrapper,
 )
 from stateright_tpu.core.fingerprint import fingerprint
 from stateright_tpu.core.model import Expectation

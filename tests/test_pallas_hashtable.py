@@ -53,10 +53,10 @@ def test_insert_parity_random_batches(pool_size):
         plo_np, phi_np = np.asarray(plo), np.asarray(phi)
         act_np = np.asarray(active)
         keys_x = {
-            (int(l), int(h)) for l, h, n in zip(lo_np, hi_np, kx) if n
+            (int(lo), int(h)) for lo, h, n in zip(lo_np, hi_np, kx) if n
         }
         keys_p = {
-            (int(l), int(h)) for l, h, n in zip(lo_np, hi_np, kp) if n
+            (int(lo), int(h)) for lo, h, n in zip(lo_np, hi_np, kp) if n
         }
         assert keys_x == keys_p
         for k in keys_x:

@@ -10,7 +10,6 @@ carry @pytest.mark.slow; fast representatives of every behavior (2pc-3
 golden, path reconstruction, chunked-vs-single parity, suspend/resume,
 overflow detection, early exits) stay in tier-1."""
 
-import numpy as np
 import pytest
 
 from stateright_tpu.core.discovery import HasDiscoveries

@@ -5,7 +5,7 @@ reference lacks)."""
 import socket
 import time
 
-from stateright_tpu.actor import Actor, Id, Out, model_timeout
+from stateright_tpu.actor import Actor, Id
 from stateright_tpu.actor.spawn import make_json_serde, spawn
 from stateright_tpu.actor.test_util import Ping, Pong
 

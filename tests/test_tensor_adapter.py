@@ -8,7 +8,7 @@ import json
 import urllib.request
 
 from stateright_tpu.core.visitor import StateRecorder
-from stateright_tpu.explorer.server import serve, states_view, status_view
+from stateright_tpu.explorer.server import serve, states_view
 from stateright_tpu.tensor import FrontierSearch, as_host_model
 from stateright_tpu.tensor.models import TensorTwoPhaseSys
 from stateright_tpu.tensor.resident import ResidentSearch

@@ -346,8 +346,7 @@ def test_hashtable_bucket_overflow_carries_to_next_bucket():
     # 12 keys all hashing to bucket 0 must spill 4 into bucket 1 and stay
     # findable (membership via linear bucket chain).
     ht = HashTable(4)
-    n_buckets = 2
-    # hi even -> bucket 0 (bucket = hi & (n_buckets-1)).
+    # hi even -> bucket 0 (bucket = hi & 1; the log2-4 table has 2 buckets).
     keys = [(2 * k << 32) | (k + 1) for k in range(12)]
     lo, hi = _pairs(keys)
     z = jnp.zeros(len(keys), dtype=jnp.uint32)
