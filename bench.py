@@ -556,6 +556,50 @@ def device_search_obs(model_name: str, n: int):
     return out, perr
 
 
+def device_search_pallas(model_name: str, n: int):
+    """BENCH_PALLAS=1 row: the anchor workload run twice on the resident
+    engine — insert_variant="capped" (the r6 winner) then "pallas" (the
+    SURVEY §7 end-state kernel, ROADMAP item 2) — the insert-design A/B.
+    On CPU images the kernel runs under Pallas interpret mode, so this
+    number prices plumbing and parity, not the silicon bet; the committed
+    pre-hardware ranking lives in tensor/costmodel.py (predict_ranking)
+    and ROUND12_NOTES.md. Returns (result dict for the PALLAS run plus
+    `sec_capped` and the `pallas_vs_capped` speed ratio, parity error or
+    None)."""
+    _pin_platform()
+    from stateright_tpu.tensor.resident import ResidentSearch
+
+    model, batch, table_log2, run_kwargs, engine_kwargs, golden, closure_s = (
+        _build_workload(model_name, n)
+    )
+    runs = {}
+    search_p = None
+    for variant in ("capped", "pallas"):
+        search = ResidentSearch(
+            model, batch_size=batch, table_log2=table_log2,
+            insert_variant=variant, **engine_kwargs,
+        )
+        best, out = _time_search(search, run_kwargs, repeats=2,
+                                 closure_s=closure_s)
+        runs[variant] = (best, out)
+        if variant == "pallas":
+            search_p = search
+        # The capped engine's table/queue buffers are dropped here, before
+        # the pallas engine is built — keeping both alive would double
+        # device memory pressure during the timed run at anchor sizes.
+        del search
+    best_p, out = runs["pallas"]
+    _attach_roofline(out, best_p, model, batch, table_log2, search_p)
+    sec_capped = runs["capped"][1]["sec"]
+    out["sec_capped"] = sec_capped
+    # >1 = pallas beats capped on this backend/workload.
+    out["pallas_vs_capped"] = round(sec_capped / max(out["sec"], 1e-9), 3)
+    perr = _parity_err(model_name, n, best_p, golden) or _parity_err(
+        model_name, n, runs["capped"][0], golden
+    )
+    return out, perr
+
+
 def device_search_faults(model_name: str, n: int):
     """BENCH_FAULTS=1 row: the anchor workload run twice — plain resident
     engine vs `run_supervised` with injection DISABLED — proving the
@@ -868,6 +912,9 @@ DEVICE_DETAIL_FIELDS = (
     # digest plus the unsupervised wall time and the measured supervisor
     # overhead with injection disabled (expected within noise).
     "faults", "sec_unsupervised", "supervisor_overhead_pct",
+    # Pallas insert A/B (BENCH_PALLAS=1 row): the capped-insert wall time
+    # next to the pallas run's, and the speed ratio (>1 = pallas wins).
+    "sec_capped", "pallas_vs_capped",
 )
 
 
@@ -1074,12 +1121,23 @@ def main(argv: list | None = None) -> int:
         # detail.device["2pc-4-faults"].supervisor_overhead_pct).
         if os.environ.get("BENCH_FAULTS") == "1" and not smoke:
             workloads += (("2pc", 4, 2400.0, "--worker-faults", None),)
+        # BENCH_PALLAS=1: add the pallas-vs-capped insert A/B on the 2pc-4
+        # and paxos-2 anchors (resident engine; the Pallas route-then-probe
+        # kernel vs the r6 capped insert — the measured ratio lands in
+        # detail.device["<wl>-pallas"].pallas_vs_capped next to the
+        # costmodel's committed ranking in ROUND12_NOTES.md).
+        if os.environ.get("BENCH_PALLAS") == "1" and not smoke:
+            workloads += (
+                ("2pc", 4, 2400.0, "--worker-pallas", None),
+                ("paxos", 2, 2400.0, "--worker-pallas", None),
+            )
         for model, n, wl_timeout, mode, env_extra in workloads:
             key = f"{model}-{n}" + (
                 {
                     "--worker-sharded": "-sharded8",
                     "--worker-obs": "-obs",
                     "--worker-faults": "-faults",
+                    "--worker-pallas": "-pallas",
                 }.get(mode, "")
             )
             r, perr = device_search_subprocess(
@@ -1161,6 +1219,8 @@ def worker_main(model_name: str, n: int, mode: str = "--worker") -> int:
             r, perr = device_search_obs(model_name, n)
         elif mode == "--worker-faults":
             r, perr = device_search_faults(model_name, n)
+        elif mode == "--worker-pallas":
+            r, perr = device_search_pallas(model_name, n)
         else:
             r, perr = device_search(model_name, n)
         print(json.dumps({"result": r, "error": perr}), flush=True)
@@ -1175,7 +1235,7 @@ def worker_main(model_name: str, n: int, mode: str = "--worker") -> int:
 if __name__ == "__main__":
     if len(sys.argv) == 4 and sys.argv[1] in (
         "--worker", "--worker-sharded", "--worker-service", "--worker-obs",
-        "--worker-faults",
+        "--worker-faults", "--worker-pallas",
     ):
         sys.exit(worker_main(sys.argv[2], int(sys.argv[3]), mode=sys.argv[1]))
     if len(sys.argv) == 2 and sys.argv[1] == "--worker-analysis":

@@ -15,14 +15,17 @@ Usage:
   python scripts/tpu_tune.py MODEL N BATCH TABLE_LOG2 [REPEATS] [LAYOUT] \
       [STORE] [HIGH_WATER] [SUMMARY_LOG2]
   python scripts/tpu_tune.py --sweep MODEL N TABLE_LOG2 \
-      [--batches 2048,4096,8192] [--variants split,kv,phased,capped] \
+      [--batches 2048,4096,8192] [--variants split,kv,phased,capped,pallas] \
       [--stores device,tiered] [--high-waters 0.85] [--summary-bits 20] \
       [--repeats R] [--timeout SEC] [--out tune_ranking.json]
 
 LAYOUT / --variants values: split (default) | kv | phased | capped |
-capped-kv | capped-phased — the visited-table designs to race (kv =
-interleaved buckets; phased = pre-sort-claim scatter-max insert; capped =
-batch-monotonic claim-tile insert, see hashtable.make_capped_insert).
+capped-kv | capped-phased | pallas — the visited-table designs to race
+(kv = interleaved buckets; phased = pre-sort-claim scatter-max insert;
+capped = batch-monotonic claim-tile insert, see
+hashtable.make_capped_insert; pallas = the partitioned-VMEM
+route-then-probe kernel, tensor/pallas_hashtable.py — the SURVEY §7
+end-state design; needs table_log2 >= 10 and runs interpret-mode off-TPU).
 
 STORE / --stores values: device (default) | tiered — the two-tier state
 store (stateright_tpu/store/: device hot set + host spill tier). With
@@ -58,6 +61,7 @@ LAYOUTS = {
     "capped": ("split", "capped"),
     "capped-kv": ("kv", "capped"),
     "capped-phased": ("split", "capped-phased"),
+    "pallas": ("split", "pallas"),
 }
 
 
@@ -190,7 +194,7 @@ def run_sweep(argv: list) -> int:
         return default
 
     batches = [int(b) for b in opt("--batches", "2048,4096,8192").split(",")]
-    variants = opt("--variants", "split,kv,phased,capped").split(",")
+    variants = opt("--variants", "split,kv,phased,capped,pallas").split(",")
     stores = opt("--stores", "device").split(",")
     high_waters = [float(x) for x in opt("--high-waters", "0.85").split(",")]
     summary_bits = [int(x) for x in opt("--summary-bits", "20").split(",")]

@@ -202,6 +202,43 @@ def _eqn_flops(eqn) -> int:
     return 0
 
 
+def _pallas_call_bytes(eqn) -> int:
+    """HBM traffic of one `pallas_call`: per-operand
+    max(array bytes, block bytes x grid steps). A PARTITIONED array
+    streams itself exactly once (block x grid == array), while a
+    REPLICATED block — constant index map, e.g. the fused Bloom summary
+    riding into VMEM with every partition — re-streams its block every
+    grid step, which the plain operand footprint would under-count by the
+    grid factor. Falls back to the plain operand/result footprint when
+    the (private) grid_mapping layout does not line up with the eqn's
+    operands."""
+    avals = [v.aval for v in eqn.invars] + [v.aval for v in eqn.outvars]
+    plain = sum(_aval_bytes(a) for a in avals)
+    gm = eqn.params.get("grid_mapping")
+    try:
+        steps = 1
+        for g in gm.grid:
+            steps *= int(g)
+        bms = list(gm.block_mappings)
+        if steps <= 1 or len(bms) != len(avals):
+            return plain
+        total = 0
+        for aval, bm in zip(avals, bms):
+            arr = _aval_bytes(aval)
+            dtype = getattr(aval, "dtype", None)
+            bshape = getattr(bm, "block_shape", None)
+            if not bshape or dtype is None:
+                total += arr
+                continue
+            belems = 1
+            for d in bshape:
+                belems *= int(d) if d is not None else 1
+            total += max(arr, belems * dtype.itemsize * steps)
+        return total
+    except Exception:  # pragma: no cover - private-API drift tolerance
+        return plain
+
+
 class _Walker:
     def __init__(
         self,
@@ -220,6 +257,25 @@ class _Walker:
         totals = AuditTotals()
         for eqn in _raw(jaxpr).eqns:
             name = eqn.primitive.name
+            if name == "pallas_call":
+                # Learned op signature of the Pallas insert (r12): bill the
+                # eqn as a LEAF via its grid-aware operand traffic (each
+                # partitioned array streams through VMEM once per call,
+                # replicated blocks like the fused Bloom summary once per
+                # grid step — costmodel's `insert_stream`/`spill_probe`
+                # terms; see _pallas_call_bytes). The kernel jaxpr is still
+                # scanned for forbidden ops (callbacks, f64 leaks), but its
+                # ref-level loads/stores are VMEM traffic — adding them to
+                # the totals would double-bill every block — and its
+                # internal probe/retry loops must not masquerade as the
+                # engine's search-loop body in `step_mode="loop"`.
+                n_wb = len(self.while_bodies)
+                for _key, sub in _sub_jaxprs(eqn.params):
+                    self.walk(sub)
+                del self.while_bodies[n_wb:]
+                totals.ops[name] += 1
+                totals.hbm_bytes += _pallas_call_bytes(eqn)
+                continue
             sub_totals = AuditTotals()
             is_while = name == "while"
             scale = 1

@@ -25,7 +25,9 @@ class TpuChecker(Checker):
     ):
         # engine_kwargs pass through to the underlying engine —
         # ResidentSearch options like table_layout ("split"/"kv"),
-        # insert_variant ("sort"/"phased"/"capped"/"capped-phased"),
+        # insert_variant (knobs.INSERT_VARIANTS: "sort"/"phased"/"capped"/
+        # "capped-phased"/"pallas" — the last is the partitioned-VMEM
+        # Pallas kernel, interpret mode off-TPU),
         # append ("scatter"/"dus"), queue_log2, donate_chunks, the
         # tiered-store knobs (store="tiered", high_water, low_water,
         # summary_log2 — stateright_tpu/store/), and the telemetry knobs

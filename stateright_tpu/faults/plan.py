@@ -23,6 +23,11 @@ Injection points (the name is the contract; grep for `maybe_fault(`):
                         suspended carry is sound, nothing mutated yet)
 - ``shard.transfer``  — sharded engine per-shard service transfer
                         (ctx ``shard=i``)
+- ``table.insert_retry`` — Pallas hash table spilled-lane re-offer
+                        (tensor/pallas_hashtable.py host handle; ctx
+                        ``pending=n, round=r``) — the re-offer happens
+                        before any further table mutation, so a fault here
+                        is exactly retriable by re-running the insert
 - ``ckpt.write``      — checkpoint write; the ``torn`` kind CORRUPTS the
                         just-written file instead of raising
 - ``service.step``    — check-service fused step (ctx ``jobs=[ids]``)

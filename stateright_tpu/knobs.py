@@ -17,10 +17,15 @@ and host-only tooling all read it without touching a backend.
 
 from __future__ import annotations
 
-#: Visited-set insert designs accepted by FrontierSearch/ResidentSearch
-#: (`insert_variant=`). The sharded engine runs the same implementations
-#: through the resident kernels.
-INSERT_VARIANTS = ("sort", "phased", "capped", "capped-phased")
+#: Visited-set insert designs accepted by FrontierSearch/ResidentSearch/
+#: ShardedSearch (`insert_variant=`). "pallas" is the partitioned-VMEM
+#: route-then-probe kernel (tensor/pallas_hashtable.py — SURVEY §7's
+#: prescribed "open-addressing table in HBM updated by a Pallas kernel");
+#: on non-TPU backends it runs under Pallas interpret mode with exact
+#: set/is_new parity to the XLA designs. The name → insert-fn dispatch
+#: lives in ONE module, tensor/inserts.py, which all three engines import
+#: (check_registry() pins the two against each other).
+INSERT_VARIANTS = ("sort", "phased", "capped", "capped-phased", "pallas")
 
 #: The subset of INSERT_VARIANTS built on the phased (claim-then-probe)
 #: insert — these require the split table layout (hashtable's phased impl
@@ -46,7 +51,7 @@ ENGINES = ("frontier", "resident", "sharded")
 #: Cost-model variant alphabet (tensor/costmodel.py) — the (table_layout,
 #: insert_variant) product collapsed to the designs the roofline model
 #: distinguishes. Kept here so the mapping below is checkable by lint/tests.
-COST_VARIANTS = ("split", "kv", "phased", "capped", "capped-kv")
+COST_VARIANTS = ("split", "kv", "phased", "capped", "capped-kv", "pallas")
 
 
 def check_registry() -> list:
@@ -94,6 +99,8 @@ def check_registry() -> list:
             )
 
     try:
+        from .service.scheduler import ServiceEngine
+        from .tensor import inserts
         from .tensor.frontier import FrontierSearch
     except ModuleNotFoundError as e:
         # jax-free images run the lint half only (`--skip-audit`); the
@@ -103,10 +110,30 @@ def check_registry() -> list:
             return problems
         raise
 
-    if set(FrontierSearch.INSERT_VARIANTS) != set(INSERT_VARIANTS):
+    # The dispatch table (tensor/inserts.py) must cover exactly this
+    # registry's variant names — a variant registered here without a
+    # dispatch entry (or vice versa) is the r10 drift class this module
+    # exists to bound.
+    if set(inserts.INSERT_TABLE) != set(INSERT_VARIANTS):
         problems.append(
-            "FrontierSearch.INSERT_VARIANTS != knobs.INSERT_VARIANTS: "
-            f"{sorted(FrontierSearch.INSERT_VARIANTS)} vs "
-            f"{sorted(INSERT_VARIANTS)}"
+            "inserts.INSERT_TABLE keys != knobs.INSERT_VARIANTS: "
+            f"{sorted(inserts.INSERT_TABLE)} vs {sorted(INSERT_VARIANTS)}"
+        )
+    if not set(inserts.KV_INSERT_TABLE) <= set(INSERT_VARIANTS):
+        problems.append(
+            "inserts.KV_INSERT_TABLE names a variant outside "
+            f"knobs.INSERT_VARIANTS: {sorted(inserts.KV_INSERT_TABLE)}"
+        )
+    # The engines must all dispatch through THE table, not a restated copy
+    # (same alias-identity probe as the costmodel tuple above).
+    if FrontierSearch.INSERT_VARIANTS is not inserts.INSERT_TABLE:
+        problems.append(
+            "FrontierSearch.INSERT_VARIANTS is a restated copy, not the "
+            "inserts.INSERT_TABLE alias"
+        )
+    if ServiceEngine.INSERT_VARIANTS is not inserts.INSERT_TABLE:
+        problems.append(
+            "ServiceEngine.INSERT_VARIANTS is a restated copy, not the "
+            "inserts.INSERT_TABLE alias"
         )
     return problems

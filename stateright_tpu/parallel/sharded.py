@@ -67,7 +67,7 @@ else:  # pragma: no cover - exercised on jax 0.4.x images
 
 from ..faults.ckptio import atomic_savez, load_latest
 from ..faults.plan import maybe_fault
-from ..knobs import STORE_KINDS
+from ..knobs import INSERT_VARIANTS, STORE_KINDS
 from ..obs import N_COLS, REGISTRY, StepRing, as_tracer
 from ..tensor.fingerprint import pack_fp
 from ..core.discovery import HasDiscoveries
@@ -85,7 +85,7 @@ from ..tensor.frontier import (
     seed_init,
     state_fingerprint,
 )
-from ..tensor.hashtable import _insert_impl
+from ..tensor.inserts import check_table_log2, resolve_insert
 from ..tensor.model import TensorModel
 from ..tensor.resident import (
     ABORT_QUEUE,
@@ -211,6 +211,7 @@ class ShardedSearch:
         dest_capacity: Optional[int] = None,
         donate_chunks: bool = False,
         append: Optional[str] = None,
+        insert_variant: str = "sort",
         store: str = "device",
         high_water: float = 0.85,
         low_water: Optional[float] = None,
@@ -249,6 +250,16 @@ class ShardedSearch:
         )
         self.batch_size = batch_size
         self.table_log2 = table_log2
+        # insert_variant: the same visited-set designs the single-device
+        # engines race (tensor/inserts.py is THE dispatch table; the
+        # per-shard table layout is always split here).
+        if insert_variant not in INSERT_VARIANTS:  # knob universe: knobs.py
+            raise ValueError(
+                f"insert_variant must be one of {INSERT_VARIANTS}, "
+                f"got {insert_variant!r}"
+            )
+        check_table_log2(insert_variant, table_log2)  # per-shard tiling guard
+        self.insert_variant = insert_variant
         if store not in STORE_KINDS:  # knob universe: knobs.py
             raise ValueError(f"store must be one of {STORE_KINDS}, got {store!r}")
         if store == "tiered" and jax.process_count() > 1:
@@ -365,8 +376,16 @@ class ShardedSearch:
             khash = self._stores[0].config.summary_hashes
             W = summary_words(slog2)
             TRIGGER = jnp.int32(self._spill_trigger)
+            s_cfg = (slog2, khash)
         else:
             W = 1
+            s_cfg = None
+        # THE dispatch table (tensor/inserts.py): seed inserts stay plain
+        # (fresh shard, empty summary); the in-loop insert carries the
+        # fused Bloom probe when the variant supports it (pallas).
+        _insert = resolve_insert(self.insert_variant)
+        _insert_step = resolve_insert(self.insert_variant, summary_cfg=s_cfg)
+        _fused = getattr(_insert_step, "fused_summary", False)
         SQ = self._SQ
         TMR = self._TMR
         # N*C rows of slack beyond the per-shard table size: the append
@@ -438,7 +457,7 @@ class ShardedSearch:
             p_lo = jnp.zeros(S, dtype=jnp.uint32)
             p_hi = jnp.zeros(S, dtype=jnp.uint32)
             zero_k = jnp.zeros(K, dtype=jnp.uint32)
-            t_lo, t_hi, p_lo, p_hi, is_new0, ovf0 = _insert_impl(
+            t_lo, t_hi, p_lo, p_hi, is_new0, ovf0 = _insert(
                 t_lo, t_hi, p_lo, p_hi, init_lo, init_hi, zero_k, zero_k, mine
             )
             n0 = mine.sum().astype(jnp.int32)
@@ -619,22 +638,31 @@ class ShardedSearch:
                 r_valid = r_packed[:, L + 6].astype(bool)
 
                 # -- insert into the local shard (handles duplicates) ----------
-                t_lo2, t_hi2, p_lo2, p_hi2, is_new, ins_ovf = _insert_impl(
-                    c.t_lo, c.t_hi, c.p_lo, c.p_hi,
-                    r_lo, r_hi, r_plo, r_phi, r_valid,
-                )
-                # -- tiered store: split claims into enqueue vs suspect --------
-                # (same protocol as the resident engine: a Bloom-positive
-                # fresh claim is buffered for exact host resolution against
-                # this shard's rank-local spill store; a miss proves
-                # novelty on-device.)
-                if tiered:
-                    suspect = is_new & maybe_contains(
-                        c.summary, r_lo, r_hi, slog2, khash
+                # Tiered: a Bloom-positive fresh claim is buffered for exact
+                # host resolution against this shard's rank-local spill
+                # store; a miss proves novelty on-device. The suspect probe
+                # fuses into the Pallas kernel's partition pass when that
+                # variant is selected (same protocol as the other engines).
+                if tiered and _fused:
+                    (
+                        t_lo2, t_hi2, p_lo2, p_hi2, is_new, suspect, ins_ovf,
+                    ) = _insert_step(
+                        c.t_lo, c.t_hi, c.p_lo, c.p_hi,
+                        r_lo, r_hi, r_plo, r_phi, r_valid,
+                        c.summary,
                     )
-                    enq = is_new & ~suspect
                 else:
-                    enq = is_new
+                    t_lo2, t_hi2, p_lo2, p_hi2, is_new, ins_ovf = _insert_step(
+                        c.t_lo, c.t_hi, c.p_lo, c.p_hi,
+                        r_lo, r_hi, r_plo, r_phi, r_valid,
+                    )
+                    suspect = (
+                        is_new
+                        & maybe_contains(c.summary, r_lo, r_hi, slog2, khash)
+                        if tiered
+                        else None
+                    )
+                enq = is_new & ~suspect if tiered else is_new
                 # -- append fresh states to the local queue (cumsum) -----------
                 _append = (
                     append_new if self.append == "scatter" else append_new_dus
@@ -1498,6 +1526,7 @@ class ShardedSearch:
                     "batch_size": self.batch_size,
                     "n_chips": self.n_chips,
                     "dest_capacity": self.dest_capacity,
+                    "insert_variant": self.insert_variant,
                     "store": store_meta,
                     "q_compacted": self._q_compacted,
                 }
@@ -1540,6 +1569,9 @@ class ShardedSearch:
             table_log2=table_log2 or meta["table_log2"],
             dest_capacity=meta["dest_capacity"],
             donate_chunks=donate_chunks,
+            # A pallas/capped run must resume on the same insert design
+            # (table slot layout and at-scale cost both depend on it).
+            insert_variant=meta.get("insert_variant", "sort"),
             store="tiered" if store_meta else "device",
             **(
                 {
@@ -1627,6 +1659,7 @@ class ShardedSearch:
                     log2,
                     ss.batch_size,
                     queue_rows=ss_Q,
+                    insert_variant=ss.insert_variant,
                 )
                 for i in range(ss.n_chips)
             ]

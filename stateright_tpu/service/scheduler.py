@@ -57,21 +57,26 @@ from ..tensor.frontier import (
     replay_fp_chain,
     seed_init,
 )
-from ..tensor.hashtable import HashTable
 from .queue import Job, JobStatus
 
 
 def _build_service_step(model, K, props, insert, store):
     """The fused multi-job step: property masks, expand, salted visited-set
     insert, successor compaction, Bloom suspect marking — FrontierSearch's
-    step plus per-lane job salts and per-row generated counts."""
-    tiered = store is not None
-    if tiered:
-        from ..store.summary import maybe_contains
+    step plus per-lane job salts and per-row generated counts.
 
-        slog2 = store.config.summary_log2
-        khash = store.config.summary_hashes
-    A = model.max_actions
+    Suspects are detected on the SALTED keys — the spill tier stores table
+    keys, and the salt is what keeps one job's spilled states from
+    shadowing another's. expand_insert probes the summary on exactly those
+    keys (fused into the Pallas kernel's own partition pass when that
+    insert is selected — salting happens before routing, so the kernel's
+    disjoint hash-bit layout sees only salted bits)."""
+    tiered = store is not None
+    s_cfg = (
+        (store.config.summary_log2, store.config.summary_hashes)
+        if tiered
+        else None
+    )
 
     @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
     def step(t_lo, t_hi, p_lo, p_hi, states, lo, hi, salt_lo, salt_hi,
@@ -83,25 +88,17 @@ def _build_service_step(model, K, props, insert, store):
         )
         (
             t_lo, t_hi, p_lo, p_hi,
-            flat, slo, shi, is_new,
+            flat, slo, shi, is_new, suspect,
             gen_rows, has_succ, ovf,
         ) = expand_insert(
             model, t_lo, t_hi, p_lo, p_hi, states, lo, hi, active,
             insert=insert, salt_lo=salt_lo, salt_hi=salt_hi,
+            summary=summary if tiered else None,
+            summary_cfg=s_cfg,
         )
         out_states, out_lo, out_hi, out_src, new_count = compact_new(
             flat, slo, shi, is_new
         )
-        if tiered:
-            # Suspects are detected on the SALTED keys — the spill tier
-            # stores table keys, and the salt is what keeps one job's
-            # spilled states from shadowing another's.
-            sl_rep = jnp.repeat(salt_lo, A)
-            sh_rep = jnp.repeat(salt_hi, A)
-            k_lo, k_hi = salt_fp(slo, shi, sl_rep, sh_rep)
-            suspect = is_new & maybe_contains(summary, k_lo, k_hi, slog2, khash)
-        else:
-            suspect = jnp.zeros_like(is_new)
         out_sus = compact_flags(suspect, is_new)
         return (
             t_lo, t_hi, p_lo, p_hi,
@@ -189,7 +186,18 @@ class ServiceEngine:
         tracer=None,
     ):
         self.batch_size = batch_size
-        self.table = HashTable(table_log2)
+        if insert_variant not in self.INSERT_VARIANTS:
+            raise ValueError(
+                f"insert_variant must be one of "
+                f"{sorted(self.INSERT_VARIANTS)}, got {insert_variant!r}"
+            )
+        self.insert_variant = insert_variant
+        # Variant-aware handle (PallasHashTable for "pallas", so job
+        # seeding probes the variant's own slot layout) + the shared
+        # tiling guard — both defined once in tensor/inserts.py.
+        from ..tensor.inserts import make_table
+
+        self.table = make_table(insert_variant, table_log2)
         # Step telemetry (obs/ring.py): the scheduler is host-orchestrated,
         # so every per-step scalar the row needs is already fetched — the
         # ring adds no device work. One ring for the engine lifetime (a
@@ -197,13 +205,6 @@ class ServiceEngine:
         # keeps the last 2^telemetry_log2 step rows).
         self._ring = StepRing(1 << telemetry_log2) if telemetry else None
         self._tracer = as_tracer(tracer)
-        if insert_variant not in self.INSERT_VARIANTS:
-            raise ValueError(
-                f"insert_variant must be one of "
-                f"{sorted(self.INSERT_VARIANTS)}, got {insert_variant!r}"
-            )
-        self._insert = self.INSERT_VARIANTS[insert_variant]
-        self.insert_variant = insert_variant
         if store not in STORE_KINDS:  # knob universe: knobs.py
             raise ValueError(f"store must be one of {STORE_KINDS}, got {store!r}")
         self.store = store
@@ -224,6 +225,22 @@ class ServiceEngine:
             # runs between steps, and a step can claim K*A slots. The K*A
             # bound is per GROUP model; use the max as groups appear.
             self._spill_trigger = self._store.high_slots
+        # THE dispatch table (tensor/inserts.py): the step insert carries
+        # the tiered store's fused Bloom probe when the variant supports it
+        # (pallas); job seeding goes through self.table.insert instead.
+        from ..tensor.inserts import resolve_insert
+
+        self._insert = resolve_insert(
+            insert_variant,
+            summary_cfg=(
+                (
+                    self._store.config.summary_log2,
+                    self._store.config.summary_hashes,
+                )
+                if self._store is not None
+                else None
+            ),
+        )
         self._no_summary = jnp.zeros(1, dtype=jnp.uint32)
         self.hot_claims = 0
         self.groups: dict[int, _Group] = {}

@@ -65,7 +65,21 @@ ENGINE_VARIANTS = {
     ("split", "capped"): "capped",
     ("kv", "capped"): "capped-kv",
     ("split", "capped-phased"): "capped",
+    ("split", "pallas"): "pallas",
 }
+
+# Mirrors of tensor/pallas_hashtable.py partitioning constants (pinned by
+# tests/test_costmodel.py — this module stays jax-free, so the formula is
+# restated, not imported).
+PALLAS_ROW_ALIGN = 1024
+PALLAS_DEFAULT_PARTITIONS = 64
+
+
+def pallas_partition_count(table_slots: int) -> int:
+    """pallas_hashtable.pallas_partitions without the jax import."""
+    return max(
+        1, min(PALLAS_DEFAULT_PARTITIONS, table_slots // PALLAS_ROW_ALIGN)
+    )
 
 
 @dataclass(frozen=True)
@@ -169,12 +183,14 @@ def step_cost(
     `phased_rounds` is the average serialized probe-round count of the
     phased scatter-max insert (r4 silicon measured ~3.9 on paxos-3).
 
-    `table_log2` is DELIBERATELY inert today: per-lane probe traffic is one
-    fixed 512-byte bucket row regardless of table size, and chain-overflow
-    rounds are ~zero at sane load factors, so table size only matters
-    through load factor — a term the r4 anchor cannot calibrate. It stays
-    in the signature because every caller naturally has it and a future
-    load-factor term will need it.
+    `table_log2` is DELIBERATELY inert for the XLA variants: per-lane probe
+    traffic is one fixed 512-byte bucket row regardless of table size, and
+    chain-overflow rounds are ~zero at sane load factors, so table size
+    only matters through load factor — a term the r4 anchor cannot
+    calibrate. The PALLAS variant is the exception: its kernel streams the
+    whole partitioned table through VMEM once per insert call, so its
+    `insert_stream` term scales directly with 2^table_log2 (the ranking
+    lever — see the variant branch below).
 
     `spill` (None = plain device store; the None path is byte- and
     ms-identical to the pre-tiered model, pinned by the 1% anchor
@@ -225,6 +241,36 @@ def step_cost(
             phased_rounds * per_round_scatter,
             phased_rounds * (_ms(per_round_scatter, device.gbps_scatter) + device.ms_dispatch),
         ))
+    elif variant == "pallas":
+        # Route-then-probe (tensor/pallas_hashtable.py): ONE stable sort of
+        # the batch by partition id (2 u32 operands: packed pid + iota)
+        # replaces the sort-claim phase entirely; the kernel then streams
+        # EVERY partition through VMEM once per insert call — a read+write
+        # of all four table arrays, the table-size term no XLA variant has
+        # (their per-lane probe traffic is one bucket row regardless of
+        # table size). In-partition probes run serially at VMEM speed
+        # (~free next to the HBM terms); the per-partition grid step is
+        # not, and neither are the routing scatter-pack and the verdict
+        # un-route. This is why the committed prediction ranks pallas by
+        # the table:batch ratio — it wins only when the routed batch
+        # amortizes the full-table round trip.
+        S = 1 << table_log2
+        n_parts = pallas_partition_count(S)
+        route_sort = 2 * 4 * B * log2_b
+        part_stream = 2 * 4 * S * 4  # 4 u32 arrays in + out of VMEM
+        pack_bytes = 10 * B * 4  # route scatter-pack + verdict un-route
+        ops.append(OpCost(
+            "insert_sort", route_sort, _ms(route_sort, device.gbps_sort)
+        ))
+        ops.append(OpCost(
+            "insert_stream", part_stream,
+            _ms(part_stream, device.gbps_stream),
+        ))
+        ops.append(OpCost(
+            "insert_claim", pack_bytes,
+            _ms(pack_bytes, device.gbps_scatter)
+            + n_parts * device.ms_dispatch,
+        ))
     else:  # capped / capped-kv: active-compaction + claim tiles
         pow2_b = 1 << max(int(B) - 1, 1).bit_length()
         T = min(pow2_b, max(tile, pow2_b // CAP_MAX_TILES))
@@ -248,11 +294,30 @@ def step_cost(
 
     # -- tiered store: summary probe + amortized eviction ----------------------
     if spill is not None:
-        hashes = int(spill.get("summary_hashes", 4))
-        probe_bytes = hashes * B * 4  # k word gathers per flat lane
-        ops.append(OpCost(
-            "spill_probe", probe_bytes, _ms(probe_bytes, device.gbps_gather)
-        ))
+        if variant == "pallas":
+            # The fused kernel probes the summary INSIDE its partition pass
+            # (no separate maybe_contains gather sweep): the word array
+            # rides into VMEM once per partition, so the probe cost is the
+            # grid-replicated summary stream, not k gathers per lane.
+            slog2 = int(spill.get("summary_log2", 20))
+            n_parts = pallas_partition_count(1 << table_log2)
+            # The kernel pads the word array to a tile-aligned block
+            # (>= ROW_ALIGN words) and streams the WHOLE padded block per
+            # grid step — small summaries still pay the padded size.
+            probe_bytes = n_parts * max(
+                PALLAS_ROW_ALIGN * 4, (1 << slog2) // 8
+            )
+            ops.append(OpCost(
+                "spill_probe", probe_bytes,
+                _ms(probe_bytes, device.gbps_stream),
+            ))
+        else:
+            hashes = int(spill.get("summary_hashes", 4))
+            probe_bytes = hashes * B * 4  # k word gathers per flat lane
+            ops.append(OpCost(
+                "spill_probe", probe_bytes,
+                _ms(probe_bytes, device.gbps_gather),
+            ))
         evict_per_step = float(spill.get("evict_per_step", 0.0))
         if evict_per_step > 0:
             pcie_bytes = evict_per_step * 2 * SPILL_ENTRY_BYTES

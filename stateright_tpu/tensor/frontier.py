@@ -38,13 +38,8 @@ from ..faults.ckptio import atomic_savez, load_latest
 from ..faults.plan import maybe_fault
 from ..obs import REGISTRY, StepRing, as_tracer, build_detail
 from .fingerprint import device_fingerprint, pack_fp
-from .hashtable import (
-    HashTable,
-    _insert_impl,
-    _insert_impl_capped,
-    _insert_impl_phased,
-    _insert_impl_phased_capped,
-)
+from .hashtable import _insert_impl
+from .inserts import INSERT_TABLE, make_table, resolve_insert
 from .model import TensorModel
 
 
@@ -90,20 +85,22 @@ def count_ge(clo, chi, tlo, thi):
 def expand_insert(
     model, t_lo, t_hi, p_lo, p_hi, states, lo, hi, active,
     insert=_insert_impl, salt_lo=None, salt_hi=None,
+    summary=None, summary_cfg=None,
 ):
     """The traced core of one frontier step, shared by the host-orchestrated
     and device-resident engines: expand, boundary-mask, fingerprint, visited-
     set insert with parent tracking (the insert also dedups within the batch).
 
     Returns (t_lo, t_hi, p_lo, p_hi, flat_states, succ_lo, succ_hi, is_new,
-    gen_rows, has_succ, overflow); row i of the flattened successor arrays
-    came from input row i // max_actions; `gen_rows` is the per-input-row
-    post-boundary pre-dedup successor count (ref: bfs.rs:288-291 — callers
-    sum it for the generated-state counter; the check service segments it by
-    the lane's job). `insert` swaps the visited-set implementation (same
-    9-arg signature/6-tuple result as hashtable._insert_impl) — the engines
-    use it for the interleaved-kv table layout, where t_lo is the uint32[2S]
-    kv array and t_hi is a zero-length placeholder.
+    suspect, gen_rows, has_succ, overflow); row i of the flattened successor
+    arrays came from input row i // max_actions; `gen_rows` is the
+    per-input-row post-boundary pre-dedup successor count (ref:
+    bfs.rs:288-291 — callers sum it for the generated-state counter; the
+    check service segments it by the lane's job). `insert` swaps the
+    visited-set implementation (same 9-arg signature/6-tuple result as
+    hashtable._insert_impl; resolve via tensor/inserts.py) — the engines use
+    it for the interleaved-kv table layout, where t_lo is the uint32[2S] kv
+    array and t_hi is a zero-length placeholder.
 
     `salt_lo`/`salt_hi` (uint32[K] per-lane, optional) fold a per-job salt
     into every key the visited set sees — successor keys AND the parent
@@ -112,6 +109,15 @@ def expand_insert(
     succ_lo/succ_hi stay unsalted: they are the state identities the host
     uses for discovery recording and queue bookkeeping, bit-identical to a
     standalone (unsalted) run.
+
+    `summary` (+ `summary_cfg=(summary_log2, hashes)`) is the tiered
+    store's Bloom summary of the spilled set: when given, the returned
+    `suspect` mask marks fresh claims whose TABLE key (salted when salts
+    are given — the spill tier stores table keys) hits the summary and so
+    needs exact host resolution. Inserts marked `fused_summary` (the
+    Pallas kernel) compute the probe inside their own partition pass; for
+    every other insert the probe is the usual maybe_contains gather sweep.
+    Without a summary, `suspect` is all-False.
     """
     K = states.shape[0]
     A = model.max_actions
@@ -137,12 +143,27 @@ def expand_insert(
         par_lo, par_hi = salt_fp(par_lo, par_hi, sl_rep, sh_rep)
     else:
         key_lo, key_hi = slo, shi
-    t_lo, t_hi, p_lo, p_hi, is_new, ovf = insert(
-        t_lo, t_hi, p_lo, p_hi, key_lo, key_hi, par_lo, par_hi, validf
-    )
+    if summary is not None and getattr(insert, "fused_summary", False):
+        t_lo, t_hi, p_lo, p_hi, is_new, suspect, ovf = insert(
+            t_lo, t_hi, p_lo, p_hi, key_lo, key_hi, par_lo, par_hi, validf,
+            summary,
+        )
+    else:
+        t_lo, t_hi, p_lo, p_hi, is_new, ovf = insert(
+            t_lo, t_hi, p_lo, p_hi, key_lo, key_hi, par_lo, par_hi, validf
+        )
+        if summary is not None:
+            from ..store.summary import maybe_contains
+
+            slog2, khash = summary_cfg
+            suspect = is_new & maybe_contains(
+                summary, key_lo, key_hi, slog2, khash
+            )
+        else:
+            suspect = jnp.zeros_like(is_new)
     return (
         t_lo, t_hi, p_lo, p_hi,
-        flat, slo, shi, is_new,
+        flat, slo, shi, is_new, suspect,
         gen_rows, has_succ, ovf,
     )
 
@@ -345,13 +366,10 @@ class _Chunk:
 class FrontierSearch:
     # Same variant names/semantics as ResidentSearch.insert_variant (the
     # host-orchestrated engine races the same visited-set designs; the
-    # table layout here is always split).
-    INSERT_VARIANTS = {
-        "sort": _insert_impl,
-        "phased": _insert_impl_phased,
-        "capped": _insert_impl_capped,
-        "capped-phased": _insert_impl_phased_capped,
-    }
+    # table layout here is always split). THE dispatch table — defined once
+    # in tensor/inserts.py, aliased (never restated) here; knobs.
+    # check_registry() pins the alias.
+    INSERT_VARIANTS = INSERT_TABLE
 
     def __init__(
         self,
@@ -383,13 +401,16 @@ class FrontierSearch:
         resolution, eviction) as Chrome trace events."""
         self.model = model
         self.batch_size = batch_size
-        self.table = HashTable(table_log2)
         if insert_variant not in self.INSERT_VARIANTS:
             raise ValueError(
                 f"insert_variant must be one of "
                 f"{sorted(self.INSERT_VARIANTS)}, got {insert_variant!r}"
             )
         self.insert_variant = insert_variant
+        # Variant-aware handle (PallasHashTable for "pallas", so seeding
+        # probes the variant's own slot layout) + the shared tiling guard —
+        # both defined once in tensor/inserts.py.
+        self.table = make_table(insert_variant, table_log2)
         if store not in STORE_KINDS:  # one knob universe: stateright_tpu/knobs.py
             raise ValueError(f"store must be one of {STORE_KINDS}, got {store!r}")
         self.store = store
@@ -445,13 +466,15 @@ class FrontierSearch:
         model = self.model
         K = self.batch_size
         props = self.properties
-        insert = self.INSERT_VARIANTS[self.insert_variant]
         tiered = self._store is not None
         if tiered:
-            from ..store.summary import maybe_contains
-
-            slog2 = self._store.config.summary_log2
-            khash = self._store.config.summary_hashes
+            s_cfg = (
+                self._store.config.summary_log2,
+                self._store.config.summary_hashes,
+            )
+        else:
+            s_cfg = None
+        insert = resolve_insert(self.insert_variant, summary_cfg=s_cfg)
 
         @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
         def step(t_lo, t_hi, p_lo, p_hi, states, lo, hi, active, summary):
@@ -461,27 +484,26 @@ class FrontierSearch:
                 if props
                 else jnp.zeros((0, K), dtype=bool)
             )
+            # Tiered store: a fresh device claim whose fingerprint hits the
+            # Bloom summary of the spilled set is a SUSPECT — possibly a
+            # revisit of an evicted state (expand_insert computes the mask,
+            # fused into the Pallas kernel's own partition pass when that
+            # variant is selected). The host resolves suspects exactly
+            # (store/host.py); a summary miss PROVES novelty, so the
+            # common path never leaves the device.
             (
                 t_lo, t_hi, p_lo, p_hi,
-                flat, slo, shi, is_new,
+                flat, slo, shi, is_new, suspect,
                 gen_rows, has_succ, ovf,
             ) = expand_insert(
                 model, t_lo, t_hi, p_lo, p_hi, states, lo, hi, active,
                 insert=insert,
+                summary=summary if tiered else None,
+                summary_cfg=s_cfg,
             )
             gen_count = gen_rows.sum()
             out_states, out_lo, out_hi, out_src, new_count = compact_new(
                 flat, slo, shi, is_new
-            )
-            # Tiered store: a fresh device claim whose fingerprint hits the
-            # Bloom summary of the spilled set is a SUSPECT — possibly a
-            # revisit of an evicted state. The host resolves suspects
-            # exactly (store/host.py); a summary miss PROVES novelty, so
-            # the common path never leaves the device.
-            suspect = (
-                is_new & maybe_contains(summary, slo, shi, slog2, khash)
-                if tiered
-                else jnp.zeros_like(is_new)
             )
             out_sus = compact_flags(suspect, is_new)
             return (

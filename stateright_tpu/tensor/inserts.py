@@ -1,0 +1,141 @@
+"""THE insert-variant dispatch table — one module, imported by all three
+engine spines and the check service.
+
+Before this module each spine hand-wired its own variant-name → insert-fn
+chain: `FrontierSearch.INSERT_VARIANTS` (a dict), `ResidentSearch._insert_fn`
+(an if/else plus a kv adapter), the sharded engine (hard-wired `_insert_impl`
+with no knob at all), and the service (re-pointing at FrontierSearch's dict).
+The r10 fix list showed what that costs — every new variant was wired three
+or four times, drifting independently. This module is the step-core pre-work
+(ROADMAP item 3): `knobs.py` owns the NAMES, this module owns the name → fn
+DISPATCH, and `knobs.check_registry()` pins the two against each other.
+
+Every entry shares one traced signature:
+
+    insert(t_lo, t_hi, p_lo, p_hi, lo, hi, parent_lo, parent_hi, active)
+        -> (t_lo, t_hi, p_lo, p_hi, is_new, overflow)
+
+(kv layout: t_lo carries the uint32[2S] interleaved array and t_hi a
+zero-length placeholder — the adapter below hides the narrower kv table
+signature). The Pallas variant additionally offers a FUSED form for the
+tiered store (`resolve_insert(..., summary_cfg=...)`): a 10th `summary`
+operand and a 7-tuple result whose extra element is the suspect mask,
+computed by the kernel's in-pass Bloom probe instead of a separate
+post-insert gather sweep (tensor/pallas_hashtable.py). Fused inserts are
+marked with `fn.fused_summary = True`; `frontier.expand_insert` keys on the
+marker.
+"""
+
+from __future__ import annotations
+
+from ..knobs import INSERT_VARIANTS, PHASED_VARIANTS, TABLE_LAYOUTS
+from .hashtable import (
+    HashTable,
+    _insert_impl,
+    _insert_impl_capped,
+    _insert_impl_kv,
+    _insert_impl_kv_capped,
+    _insert_impl_phased,
+    _insert_impl_phased_capped,
+)
+from .pallas_hashtable import PallasHashTable, make_engine_insert
+
+#: the uniform-signature Pallas insert (partition count and interpret mode
+#: resolved at trace time from the table shape / backend).
+_insert_impl_pallas = make_engine_insert()
+
+#: split-layout dispatch: keys are exactly knobs.INSERT_VARIANTS (pinned by
+#: knobs.check_registry()).
+INSERT_TABLE = {
+    "sort": _insert_impl,
+    "phased": _insert_impl_phased,
+    "capped": _insert_impl_capped,
+    "capped-phased": _insert_impl_phased_capped,
+    "pallas": _insert_impl_pallas,
+}
+
+
+def _kv_adapt(kv_insert):
+    """Lift a kv-table insert (3 table arrays) to the uniform 4-array
+    signature: t_lo is the uint32[2S] kv array, t_hi the placeholder."""
+
+    def kv_adapter(t_kv, t_empty, p_lo, p_hi, lo, hi, plo, phi, active):
+        r = kv_insert(t_kv, p_lo, p_hi, lo, hi, plo, phi, active)
+        return r.t_kv, t_empty, r.p_lo, r.p_hi, r.is_new, r.overflow
+
+    return kv_adapter
+
+
+#: kv-layout dispatch — only the variants with a kv lowering (the phased
+#: family and pallas are split-only; the engines enforce that before
+#: resolving).
+KV_INSERT_TABLE = {
+    "sort": _kv_adapt(_insert_impl_kv),
+    "capped": _kv_adapt(_insert_impl_kv_capped),
+}
+
+
+def check_table_log2(insert_variant: str, table_log2: int) -> None:
+    """Shared constructor guard — ONE spelling of the pallas tiling
+    precondition instead of one per engine (the drift class this module
+    exists to bound). Only pallas constrains the table size: its
+    partitioned table must tile into (8, 128) VMEM blocks
+    (pallas_hashtable.ROW_ALIGN); the XLA designs handle any size the
+    engines otherwise accept (tests deliberately run tiny overflow
+    tables)."""
+    if insert_variant == "pallas" and table_log2 < 10:
+        raise ValueError(
+            "insert_variant='pallas' needs table_log2 >= 10 (the pallas "
+            "partitioned table must tile into 8x128 VMEM blocks — "
+            "tensor/pallas_hashtable.py)"
+        )
+
+
+def make_table(insert_variant: str, table_log2: int):
+    """Host-side table handle for a variant (split layout). The Pallas
+    table probes its own slot layout (partition + in-partition row —
+    pallas_hashtable.py), so EVERY insert into it, seeding included, must
+    go through the Pallas path; the handle's insert() is that path for
+    the host-orchestrated engines' seed loops."""
+    check_table_log2(insert_variant, table_log2)
+    if insert_variant == "pallas":
+        return PallasHashTable(table_log2)
+    return HashTable(table_log2)
+
+
+def resolve_insert(
+    insert_variant: str,
+    table_layout: str = "split",
+    *,
+    summary_cfg=None,
+):
+    """variant name (+ layout) → traced insert fn; the ONE resolution point
+    all engines and the service call.
+
+    `summary_cfg=(summary_log2, hashes)` requests the tiered store's fused
+    suspect probe where the variant supports it (pallas only today): the
+    returned fn takes the summary as a 10th operand and returns the suspect
+    mask as a 7th result (marked `fused_summary=True`). Variants without a
+    fused form return their plain insert — callers probe the summary with
+    `store.summary.maybe_contains` after the insert, exactly as before.
+    """
+    if table_layout not in TABLE_LAYOUTS:  # knob universe: knobs.py
+        raise ValueError(
+            f"table_layout must be one of {TABLE_LAYOUTS}, "
+            f"got {table_layout!r}"
+        )
+    if insert_variant not in INSERT_VARIANTS:  # knob universe: knobs.py
+        raise ValueError(
+            f"insert_variant must be one of {INSERT_VARIANTS}, "
+            f"got {insert_variant!r}"
+        )
+    if table_layout == "kv":
+        if insert_variant in PHASED_VARIANTS or insert_variant == "pallas":
+            raise ValueError(
+                f"insert_variant={insert_variant!r} supports the split "
+                "table layout only"
+            )
+        return KV_INSERT_TABLE[insert_variant]
+    if insert_variant == "pallas" and summary_cfg is not None:
+        return make_engine_insert(summary_cfg=summary_cfg)
+    return INSERT_TABLE[insert_variant]

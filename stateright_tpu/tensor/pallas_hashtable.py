@@ -35,7 +35,9 @@ blocks, so every block here is (8,128)-tile-aligned):
   ref indexed by program_id (Mosaic's block validator rejects blocked
   (1, 1) SMEM specs too);
 - the chain-full (overflow) flag is folded into the per-key verdict code
-  (0 = not new, 1 = inserted, 2 = chain full) — no awkward scalar output.
+  (0 = not new, 1 = inserted, 2 = chain full, 3 = inserted AND
+  Bloom-summary-positive — the tiered store's fused suspect probe, see
+  `_make_kernel`) — no awkward scalar output.
 
 Hash-bit layout (disjoint, so routing cannot skew in-partition occupancy):
 partition id = hi mod P (low bits); in-partition bucket row = (hi div P) mod
@@ -65,15 +67,49 @@ nothing downstream can observe the layout.
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
+from ..faults.plan import maybe_fault
+
 LANES = 128  # bucket width: one VMEM row
 ROW_ALIGN = 1024  # 8 sublanes x 128 lanes — min tile-aligned 1D granularity
+
+#: default partition count (capped by the table size so tiny test tables
+#: still split into tile-aligned partitions — see pallas_partitions()).
+DEFAULT_PARTITIONS = 64
+
+#: bound on the spilled-lane re-offer loop (host handle and the engines'
+#: in-trace lax.while_loop retry alike). Each round drains up to W keys per
+#: partition, so B/W <= P/route_factor rounds suffice for any batch; lanes
+#: still pending past the bound surface as `overflow` (the engines' existing
+#: table-full abort), never a silent drop.
+MAX_RETRY_ROUNDS = 16
+
+
+def pallas_partitions(size: int) -> int:
+    """The partition count the engines use for a table of `size` slots:
+    DEFAULT_PARTITIONS, shrunk so every partition stays a whole number of
+    ROW_ALIGN tiles (power-of-two sizes always divide exactly). Tables
+    under ROW_ALIGN slots cannot be tiled at all — the engines reject
+    insert_variant="pallas" below table_log2=10."""
+    if size < ROW_ALIGN:
+        raise ValueError(
+            f"pallas table needs >= {ROW_ALIGN} slots (table_log2 >= 10); "
+            f"got {size}"
+        )
+    return max(1, min(DEFAULT_PARTITIONS, size // ROW_ALIGN))
+
+
+def default_interpret() -> bool:
+    """Interpret mode off only on real TPU backends: CPU (tier-1) and any
+    other backend run the kernel through the Pallas interpreter, which is
+    what keeps the variant selectable — and parity-testable — off-silicon."""
+    return jax.default_backend() != "tpu"
 
 
 class PallasInsertResult(NamedTuple):
@@ -84,11 +120,24 @@ class PallasInsertResult(NamedTuple):
     is_new: jnp.ndarray  # bool[B] — inserted by this call
     spilled: jnp.ndarray  # bool[B] — not processed (route overflow); retry
     overflow: jnp.ndarray  # bool — some partition's bucket chains are full
+    suspect: jnp.ndarray  # bool[B] — inserted AND Bloom-summary-positive
+    #                       (always all-False without a summary operand)
 
 
-def _make_kernel(V: int, W: int, P: int):
-    """Kernel over one partition: serial probe/claim of VMEM bucket rows."""
+def _make_kernel(V: int, W: int, P: int, summary_cfg=None):
+    """Kernel over one partition: serial probe/claim of VMEM bucket rows.
+
+    `summary_cfg=(summary_log2, hashes)` fuses the tiered store's Bloom
+    probe (store/summary.py) into the same partition pass: the whole word
+    array rides into VMEM once per partition, and each freshly-claimed key
+    tests its k probe bits right where it was claimed — verdict 3 marks
+    "inserted AND summary-positive" (a suspect), so the engines need no
+    separate post-insert gather pass over the summary."""
     from jax.experimental import pallas as pl
+
+    # Lazy import, matching the engines (the store package pulls in the
+    # spill tier; the kernel only needs the hash-pair helper).
+    from ..store.summary import _h1h2
 
     n_buckets = V // LANES  # bucket rows per partition
 
@@ -102,12 +151,13 @@ def _make_kernel(V: int, W: int, P: int):
         khi_ref,
         plo_ref,
         phi_ref,
-        tl_out,  # uint32[V/128, 128]
-        th_out,
-        pl_out,
-        ph_out,
-        new_ref,  # int32[W/128, 128] — 0 dup / 1 inserted / 2 chain full
+        *rest,  # [sum_ref?], tl_out, th_out, pl_out, ph_out, new_ref
     ):
+        if summary_cfg is not None:
+            sum_ref, tl_out, th_out, pl_out, ph_out, new_ref = rest
+            slog2, khash = summary_cfg
+        else:
+            tl_out, th_out, pl_out, ph_out, new_ref = rest
         tl_out[...] = tl_ref[...]
         th_out[...] = th_ref[...]
         pl_out[...] = pl_ref[...]
@@ -193,6 +243,27 @@ def _make_kernel(V: int, W: int, P: int):
             verdict = jnp.where(
                 found_new, jnp.int32(1), jnp.where(~done, jnp.int32(2), 0)
             )
+            if summary_cfg is not None:
+                # Fused Bloom probe (store/summary.py bit layout exactly):
+                # a freshly-claimed key whose k probe bits are all set might
+                # be a revisit of a spilled state — verdict 3 marks it a
+                # SUSPECT in the same pass, instead of a separate
+                # maybe_contains gather sweep after the insert. Word reads
+                # use the same whole-row + one-hot reduction as the key
+                # loads (no dynamic sub-row scalar access).
+                smask = jnp.uint32((1 << slog2) - 1)
+                h1, h2 = _h1h2(lo, hi)
+                bloom_hit = jnp.bool_(True)
+                for k in range(khash):
+                    pos = (h1 + jnp.uint32(k) * h2) & smask
+                    widx = (pos >> jnp.uint32(5)).astype(jnp.int32)
+                    wsel = lane == (widx % LANES)
+                    word = lane_pick(wsel, sum_ref[pl.ds(widx // LANES, 1), :])
+                    bit = (word >> (pos & jnp.uint32(31))) & jnp.uint32(1)
+                    bloom_hit = bloom_hit & (bit == jnp.uint32(1))
+                verdict = jnp.where(
+                    found_new & bloom_hit, jnp.int32(3), verdict
+                )
 
             @pl.when(verdict > 0)
             def _record():
@@ -207,12 +278,7 @@ def _make_kernel(V: int, W: int, P: int):
     return kernel
 
 
-@partial(
-    jax.jit,
-    static_argnames=("n_partitions", "route_factor", "interpret"),
-    donate_argnums=(0, 1, 2, 3),
-)
-def pallas_insert(
+def _pallas_insert(
     t_lo,
     t_hi,
     p_lo,
@@ -222,17 +288,27 @@ def pallas_insert(
     parent_lo,
     parent_hi,
     active,
+    summary=None,
     *,
-    n_partitions: int = 64,
+    n_partitions: int = DEFAULT_PARTITIONS,
     route_factor: int = 4,
     interpret: bool = False,
+    summary_cfg=None,
 ) -> PallasInsertResult:
-    """Batched insert-if-absent via the partitioned-VMEM Pallas kernel.
+    """Batched insert-if-absent via the partitioned-VMEM Pallas kernel
+    (pure/traceable — the engines inline it inside their jitted steps and
+    while_loop retry carries; `pallas_insert` below is the jitted host
+    entry).
 
     XLA routing pre-pass: one stable sort of the batch by partition id plus
     a searchsorted yields contiguous per-partition segments; each segment's
     first W lanes are scatter-packed into dense per-partition rows (W as in
     the module docstring); the rest spill and are retried by the caller.
+
+    `summary` (uint32 Bloom words, with `summary_cfg=(summary_log2,
+    hashes)`) fuses the tiered store's suspect probe into the partition
+    pass — see `_make_kernel`; the result's `suspect` mask is then
+    `is_new & maybe_contains(...)` bit-for-bit.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -283,10 +359,39 @@ def pallas_insert(
     def as_rows(x):
         return x.reshape(S // LANES, LANES)
 
+    in_specs = [smem_counts, part, part, part, part, row, row, row, row]
+    operands = [
+        counts.reshape(P, 1),
+        as_rows(t_lo),
+        as_rows(t_hi),
+        as_rows(p_lo),
+        as_rows(p_hi),
+        klo,
+        khi,
+        plo,
+        phi,
+    ]
+    if summary_cfg is not None:
+        if summary is None:
+            raise ValueError("summary_cfg given without a summary operand")
+        # The whole word array rides into VMEM once per partition, padded
+        # up to a tile-aligned row count (extra zero words are never
+        # probed: positions are masked to 2^summary_log2 bits). 2^20 bits
+        # is 128 KB — far inside the VMEM partition budget.
+        SW = max(ROW_ALIGN, summary.shape[0])
+        if summary.shape[0] < SW:
+            summary = jnp.zeros(SW, jnp.uint32).at[: summary.shape[0]].set(
+                summary
+            )
+        in_specs.append(
+            pl.BlockSpec((SW // LANES, LANES), lambda p: (0, 0))
+        )
+        operands.append(summary.reshape(SW // LANES, LANES))
+
     tl, th, pll, phh, new_rows = pl.pallas_call(
-        _make_kernel(V, W, P),
+        _make_kernel(V, W, P, summary_cfg),
         grid=(P,),
-        in_specs=[smem_counts, part, part, part, part, row, row, row, row],
+        in_specs=in_specs,
         out_specs=[part, part, part, part, row],
         out_shape=[
             jax.ShapeDtypeStruct((S // LANES, LANES), jnp.uint32),
@@ -297,23 +402,16 @@ def pallas_insert(
         ],
         input_output_aliases={1: 0, 2: 1, 3: 2, 4: 3},
         interpret=interpret,
-    )(
-        counts.reshape(P, 1),
-        as_rows(t_lo),
-        as_rows(t_hi),
-        as_rows(p_lo),
-        as_rows(p_hi),
-        klo,
-        khi,
-        plo,
-        phi,
-    )
+    )(*operands)
 
     # Un-route verdicts back to lane order: sorted lane k's verdict sits at
     # flat_pos[k]; invert the sort with one scatter.
     verdicts = new_rows.reshape(-1)
     gathered = verdicts.at[flat_pos].get(mode="fill", fill_value=0)
-    is_new = jnp.zeros(B, bool).at[order].set(gathered == 1)
+    is_new = jnp.zeros(B, bool).at[order].set(
+        (gathered == 1) | (gathered == 3)
+    )
+    suspect = jnp.zeros(B, bool).at[order].set(gathered == 3)
     spilled = jnp.zeros(B, bool).at[order].set(active[order] & ~in_row)
     return PallasInsertResult(
         tl.reshape(S),
@@ -323,7 +421,91 @@ def pallas_insert(
         is_new,
         spilled,
         jnp.any(verdicts == 2),
+        suspect,
     )
+
+
+pallas_insert = partial(
+    jax.jit,
+    static_argnames=("n_partitions", "route_factor", "interpret", "summary_cfg"),
+    donate_argnums=(0, 1, 2, 3),
+)(_pallas_insert)
+
+
+def make_engine_insert(
+    summary_cfg=None,
+    n_partitions: Optional[int] = None,
+    interpret: Optional[bool] = None,
+):
+    """The engine-facing traced insert: same 9-arg signature / 6-tuple
+    result as `hashtable._insert_impl` (10-arg / 7-tuple with the fused
+    Bloom probe — see tensor/inserts.py), with the spilled-lane re-offer
+    loop folded into the trace as a `lax.while_loop`, so the whole thing
+    lives inside the engines' jitted steps and device-resident search
+    loops. Lanes still pending after MAX_RETRY_ROUNDS fold into `overflow`
+    — the engines' existing table-full abort path (checkpoint + regrow),
+    never a silent drop.
+
+    `n_partitions` defaults to `pallas_partitions(table size)` at trace
+    time; `interpret` defaults to `default_interpret()` (on for every
+    non-TPU backend, which is what makes the variant runnable — and parity
+    -pinned — on the CPU tier-1 suite)."""
+
+    def insert(
+        t_lo, t_hi, p_lo, p_hi, lo, hi, parent_lo, parent_hi, active,
+        summary=None,
+    ):
+        P = (
+            n_partitions
+            if n_partitions is not None
+            else pallas_partitions(t_lo.shape[0])
+        )
+        interp = default_interpret() if interpret is None else interpret
+        B = lo.shape[0]
+
+        def cond(c):
+            return jnp.any(c[4]) & (c[7] < MAX_RETRY_ROUNDS)
+
+        def body(c):
+            t_lo, t_hi, p_lo, p_hi, pending, is_new, sus, rounds, ovf = c
+            res = _pallas_insert(
+                t_lo, t_hi, p_lo, p_hi,
+                lo, hi, parent_lo, parent_hi, pending,
+                summary,
+                n_partitions=P,
+                interpret=interp,
+                summary_cfg=summary_cfg,
+            )
+            return (
+                *res[:4],
+                res.spilled,
+                is_new | res.is_new,
+                sus | res.suspect,
+                rounds + 1,
+                ovf | res.overflow,
+            )
+
+        c = jax.lax.while_loop(
+            cond,
+            body,
+            (
+                t_lo, t_hi, p_lo, p_hi, active,
+                jnp.zeros(B, bool), jnp.zeros(B, bool),
+                jnp.int32(0), jnp.bool_(False),
+            ),
+        )
+        # Retry exhaustion is an overflow: the pending lanes were offered
+        # MAX_RETRY_ROUNDS times without draining.
+        overflow = c[8] | jnp.any(c[4])
+        if summary_cfg is not None:
+            return c[0], c[1], c[2], c[3], c[5], c[6], overflow
+        return c[0], c[1], c[2], c[3], c[5], overflow
+
+    if summary_cfg is not None:
+        # Marker the shared expand_insert dispatch keys on: this insert
+        # takes the summary operand and returns the suspect mask itself.
+        insert.fused_summary = True
+    return insert
 
 
 class PallasHashTable:
@@ -335,14 +517,20 @@ class PallasHashTable:
     def __init__(
         self,
         log2_size: int,
-        n_partitions: int = 64,
-        interpret: bool = False,
+        n_partitions: Optional[int] = None,
+        interpret: Optional[bool] = None,
     ):
         self.log2_size = log2_size
         self.size = 1 << log2_size
-        self.n_partitions = n_partitions
-        self.interpret = interpret
-        if self.size % (n_partitions * ROW_ALIGN):
+        self.n_partitions = (
+            n_partitions
+            if n_partitions is not None
+            else pallas_partitions(self.size)
+        )
+        self.interpret = (
+            interpret if interpret is not None else default_interpret()
+        )
+        if self.size % (self.n_partitions * ROW_ALIGN):
             raise ValueError(
                 "table too small for the partition count: need size % "
                 f"(n_partitions * {ROW_ALIGN}) == 0"
@@ -356,6 +544,7 @@ class PallasHashTable:
         is_new = jnp.zeros(lo.shape[0], bool)
         pending = active
         overflow = jnp.asarray(False)
+        rounds = 0
         while True:
             res = pallas_insert(
                 self.t_lo,
@@ -375,6 +564,24 @@ class PallasHashTable:
             overflow = overflow | res.overflow
             if not bool(res.spilled.any()):
                 break
+            rounds += 1
+            if rounds >= MAX_RETRY_ROUNDS:
+                # Route-spill retries never drained: surface as the same
+                # overflow signal as full bucket chains (callers abort with
+                # the table-full reason and recover via regrow).
+                overflow = jnp.asarray(True)
+                break
+            # Chaos-plane boundary (faults/plan.py `table.insert_retry`):
+            # the re-offer happens BEFORE any further table mutation, so a
+            # fault here is exactly retriable — the caller re-runs the whole
+            # insert (seed paths sit behind the engines' step retry; the
+            # table arrays updated above already hold the non-spilled lanes,
+            # and re-offering a committed key resolves as a duplicate).
+            maybe_fault(
+                "table.insert_retry",
+                pending=int(np.asarray(res.spilled).sum()),
+                round=rounds,
+            )
             pending = res.spilled
         return res._replace(is_new=is_new, spilled=res.spilled, overflow=overflow)
 

@@ -54,15 +54,8 @@ from .frontier import (
     record_discovery as _record,
     seed_init,
 )
-from .hashtable import (
-    KV_BUCKET,
-    _insert_impl,
-    _insert_impl_capped,
-    _insert_impl_kv,
-    _insert_impl_kv_capped,
-    _insert_impl_phased,
-    _insert_impl_phased_capped,
-)
+from .hashtable import KV_BUCKET, _insert_impl
+from .inserts import check_table_log2, resolve_insert
 from .model import TensorModel
 
 
@@ -170,6 +163,20 @@ def _resolve_chunking(budget, timeout, progress, carry):
 
 
 _ins_jit = jax.jit(_insert_impl)  # one compile cache shared by every regrow
+# The pallas table's slot layout is partition-relative (partition = hi mod P,
+# row = hi div P — tensor/pallas_hashtable.py), so a pallas run's regrow must
+# re-hash through the pallas insert itself; every XLA variant shares the
+# global bucket = hi mod n_buckets layout and regrows through _insert_impl.
+_ins_jit_pallas = None
+
+
+def _regrow_insert(insert_variant: str):
+    global _ins_jit_pallas
+    if insert_variant != "pallas":
+        return _ins_jit
+    if _ins_jit_pallas is None:
+        _ins_jit_pallas = jax.jit(resolve_insert("pallas"))
+    return _ins_jit_pallas
 
 
 # `.npz`-suffix normalization so `checkpoint(p)` / `load_checkpoint(..., p)`
@@ -201,6 +208,7 @@ def _validate_ckpt_meta(model, meta: dict) -> None:
 def _regrow(
     model, fields, old_log2: int, new_log2: int, K: int,
     queue_rows: Optional[int] = None,
+    insert_variant: str = "sort",
 ) -> dict:
     """Re-hash a checkpointed visited table into a larger one and pad the
     frontier queue to `queue_rows` (default: the new table size — what the
@@ -215,7 +223,7 @@ def _regrow(
     p_lo, p_hi = fields["p_lo"], fields["p_hi"]
     nz = t_lo != 0  # lo == 0 is the empty-slot sentinel (fingerprint.py)
     keys = [a[nz] for a in (t_lo, t_hi, p_lo, p_hi)]
-    ins = _ins_jit
+    ins = _regrow_insert(insert_variant)
     zero = jnp.zeros(S_new, dtype=jnp.uint32)
     tl, th, pl, ph = zero, zero, zero, zero
     n = keys[0].size
@@ -361,17 +369,24 @@ class ResidentSearch:
         #              with the populated lanes instead of the full
         #              expanded batch (hashtable.make_capped_insert);
         #              composes with table_layout="kv";
-        #   "capped-phased" — the same cap around the phased insert.
+        #   "capped-phased" — the same cap around the phased insert;
+        #   "pallas" — the partitioned-VMEM route-then-probe kernel
+        #              (tensor/pallas_hashtable.py; interpret mode on
+        #              non-TPU backends). Split layout only; the table must
+        #              tile into (8,128) VMEM blocks, so table_log2 >= 10.
         if insert_variant not in INSERT_VARIANTS:  # knob universe: knobs.py
             raise ValueError(
                 f"insert_variant must be one of {INSERT_VARIANTS}, "
                 f"got {insert_variant!r}"
             )
-        if insert_variant in PHASED_VARIANTS and table_layout == "kv":
+        if (
+            insert_variant in PHASED_VARIANTS or insert_variant == "pallas"
+        ) and table_layout == "kv":
             raise ValueError(
                 f"insert_variant={insert_variant!r} supports the split "
                 "table layout only"
             )
+        check_table_log2(insert_variant, table_log2)  # pallas tiling guard
         self.insert_variant = insert_variant
         # store="tiered": two-tier state store (stateright_tpu/store/) —
         # past `high_water` fill, cold non-full buckets spill to a host
@@ -463,44 +478,38 @@ class ResidentSearch:
         hot = int(self._carry.hot_claims) if self._carry is not None else 0
         return self._store.stats(hot)
 
-    def _insert_fn(self):
-        if self.table_layout == "split":
-            return {
-                "sort": _insert_impl,
-                "phased": _insert_impl_phased,
-                "capped": _insert_impl_capped,
-                "capped-phased": _insert_impl_phased_capped,
-            }[self.insert_variant]
-
-        kv_insert = (
-            _insert_impl_kv_capped
-            if self.insert_variant == "capped"
-            else _insert_impl_kv
+    def _insert_fn(self, summary_cfg=None):
+        """Resolve through THE dispatch table (tensor/inserts.py) — the one
+        name → insert-fn resolution point all three engines share.
+        `summary_cfg=(summary_log2, hashes)` requests the tiered store's
+        fused suspect probe where the variant has one (pallas)."""
+        return resolve_insert(
+            self.insert_variant, self.table_layout, summary_cfg=summary_cfg
         )
-
-        def kv_adapter(t_kv, t_empty, p_lo, p_hi, lo, hi, plo, phi, active):
-            r = kv_insert(t_kv, p_lo, p_hi, lo, hi, plo, phi, active)
-            return r.t_kv, t_empty, r.p_lo, r.p_hi, r.is_new, r.overflow
-
-        return kv_adapter
 
     def _build(self):
         model = self.model
         K = self.batch_size
         A = model.max_actions
         L = model.lanes
-        insert = self._insert_fn()
         _append = append_new if self.append == "scatter" else append_new_dus
         S = 1 << self.table_log2
         tiered = self._store is not None
         if tiered:
-            from ..store.summary import maybe_contains, summary_words
+            from ..store.summary import summary_words
 
             slog2 = self._store.config.summary_log2
             khash = self._store.config.summary_hashes
             W = summary_words(slog2)
+            s_cfg = (slog2, khash)
         else:
             W = 1
+            s_cfg = None
+        # Seed inserts run against a fresh (empty-summary) table — always
+        # the plain form; the step insert carries the fused Bloom probe
+        # when the variant supports it (expand_insert keys on the marker).
+        insert = self._insert_fn()
+        insert_step = self._insert_fn(summary_cfg=s_cfg)
         SQ = self._SQ
         TMR = self._TMR
         TRIGGER = jnp.int32(self._spill_trigger) if tiered else None
@@ -560,11 +569,13 @@ class ResidentSearch:
             # -- expand + fingerprint + dedup + insert (shared core) -----------
             (
                 t_lo, t_hi, p_lo, p_hi,
-                flat, slo, shi, is_new,
+                flat, slo, shi, is_new, suspect,
                 gen_rows, has_succ, ovf,
             ) = expand_insert(
                 model, c.t_lo, c.t_hi, c.p_lo, c.p_hi, states, lo, hi,
-                active, insert=insert,
+                active, insert=insert_step,
+                summary=c.summary if tiered else None,
+                summary_cfg=s_cfg,
             )
             gen = gen_rows.sum()
 
@@ -584,13 +595,9 @@ class ResidentSearch:
             # summary MISS proves novelty, so the common path never leaves
             # the device). The claim itself stays in the table either way —
             # that is what dedups further on-device probes of the same key.
-            if tiered:
-                suspect = is_new & maybe_contains(
-                    c.summary, slo, shi, slog2, khash
-                )
-                enq = is_new & ~suspect
-            else:
-                enq = is_new
+            # expand_insert computes the suspect mask (fused into the Pallas
+            # kernel's own partition pass when that variant is selected).
+            enq = is_new & ~suspect if tiered else is_new
 
             # -- append new states to the queue tail (cumsum compaction) -------
             src_row = jnp.arange(K * A, dtype=jnp.int32) // A
@@ -1601,6 +1608,7 @@ class ResidentSearch:
                 _regrow(
                     model, fields, meta["table_log2"], log2, rs.batch_size,
                     queue_rows=rs._Q,
+                    insert_variant=rs.insert_variant,
                 )
             )
             # Bucket residency changed wholesale; recount occupied slots

@@ -182,6 +182,26 @@ def test_device_detail_pins_faults_row_keys():
     assert validate_detail({"faults": stats}) == []
 
 
+def test_device_detail_pins_pallas_row_keys():
+    # The BENCH_PALLAS=1 insert A/B row is part of the artifact contract:
+    # the capped-insert wall time and the pallas-vs-capped speed ratio must
+    # survive into detail.device so the ROADMAP-item-2 "biggest raw-speed
+    # lever" claim is auditable in every BENCH_r*.json next to the
+    # costmodel's committed ranking (ROUND12_NOTES.md).
+    for key in ("sec_capped", "pallas_vs_capped"):
+        assert key in bench.DEVICE_DETAIL_FIELDS
+    row = bench.device_detail(
+        {
+            "states_per_sec": 33000.0,
+            "sec": 0.25,
+            "sec_capped": 0.26,
+            "pallas_vs_capped": 1.04,
+        }
+    )
+    assert row["sec_capped"] == 0.26
+    assert row["pallas_vs_capped"] == 1.04
+
+
 def test_analysis_row_pins_budget_keys():
     # The BENCH_ANALYSIS=1 static-analysis budget row is part of the
     # artifact contract: srlint finding count, knob-registry drift, and

@@ -160,3 +160,44 @@ def test_spill_term_composes_with_ranking():
         sorted(plain, key=lambda x: x["variant"]),
     ):
         assert with_spill["total_ms"] > without["total_ms"]
+
+
+def test_pallas_partition_mirror_matches_kernel():
+    # costmodel stays jax-free, so the pallas partitioning formula is
+    # restated, not imported — this pin is what keeps the two in sync
+    # (same contract as test_layout_constants_match_hashtable).
+    from stateright_tpu.tensor import pallas_hashtable as ph
+
+    assert cm.PALLAS_ROW_ALIGN == ph.ROW_ALIGN
+    assert cm.PALLAS_DEFAULT_PARTITIONS == ph.DEFAULT_PARTITIONS
+    for log2 in (10, 12, 16, 20, 22, 27):
+        assert cm.pallas_partition_count(1 << log2) == ph.pallas_partitions(
+            1 << log2
+        )
+
+
+def test_pallas_term_scales_with_table_and_ranks_the_crossover():
+    # The pallas kernel streams the whole partitioned table through VMEM
+    # once per insert call, so — uniquely among the variants — its cost
+    # must GROW with table_log2 at fixed batch, and the committed ranking
+    # (ROUND12_NOTES.md) must flip from pallas to capped as the table
+    # outgrows the batch.
+    small = cm.step_cost(21, 14, 3072, 16, variant="pallas")
+    big = cm.step_cost(21, 14, 3072, 22, variant="pallas")
+    assert big.total_ms > small.total_ms
+    stream = lambda s: next(  # noqa: E731
+        o for o in s.ops if o.name == "insert_stream"
+    )
+    assert stream(big).bytes == 64 * stream(small).bytes  # 32*S exactly
+    assert ("split", "pallas") in cm.ENGINE_VARIANTS
+    assert "pallas" in cm.INSERT_VARIANTS
+
+    def winner(table_log2, batch):
+        r = cm.predict_ranking(
+            21, 14, batch, table_log2, variants=("capped", "pallas")
+        )
+        return r[0]["variant"]
+
+    assert winner(16, 3072) == "pallas"  # table fits: no claim phase wins
+    assert winner(22, 3072) == "capped"  # r4 anchor: capped stays default
+    assert winner(22, 131072) == "pallas"  # batch amortizes the stream

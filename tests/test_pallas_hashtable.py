@@ -99,3 +99,284 @@ def test_inactive_lanes_ignored():
     r = t.insert(lo, hi, par, par, jnp.asarray([True, False]))
     assert np.asarray(r.is_new).tolist() == [True, False]
     assert len(t.dump()) == 1
+
+
+def test_salted_parity_and_routing_disjointness():
+    """The r8 service keys: salting (fingerprint.salt_fp) happens BEFORE
+    routing, so the kernel's disjoint hash-bit layout (partition = hi mod
+    P, in-partition row = hi div P) only ever sees salted bits. Pin (a) the
+    involution (same call salts and unsalts), (b) that the salt really
+    moves keys across partitions (routing is salt-sensitive, no degenerate
+    layout), and (c) set/is_new parity with the XLA table ON salted keys."""
+    from stateright_tpu.tensor.fingerprint import salt_fp
+
+    rng = np.random.default_rng(11)
+    B = 512
+    lo = rng.integers(1, 2**32, B, dtype=np.uint32)
+    hi = rng.integers(0, 2**32, B, dtype=np.uint32)
+    s_lo = np.full(B, 0x9E3779B9, dtype=np.uint32)
+    s_hi = np.full(B, 0x7F4A7C15, dtype=np.uint32)
+    k_lo, k_hi = salt_fp(lo, hi, s_lo, s_hi)
+    # (a) involution: unsalting with the same salt recovers the originals.
+    u_lo, u_hi = salt_fp(k_lo, k_hi, s_lo, s_hi)
+    assert (u_lo == lo).all() and (u_hi == hi).all()
+    assert (k_lo != 0).all()  # the empty-slot sentinel stays unreachable
+    # (b) routing-bit disjointness x salt: both the partition id (hi low
+    # bits) and the in-partition row (hi high bits) must move under the
+    # salt — a salt that left either half fixed would concentrate one
+    # job's keys wherever another job's landed.
+    P = 8
+    assert (k_hi % P != hi % P).any()
+    assert ((k_hi // P) != (hi // P)).any()
+    for p in range(P):  # salted keys still cover every partition
+        assert (k_hi % P == p).any()
+    # (c) parity with the XLA table on the salted keys.
+    xla = HashTable(13)
+    pls = PallasHashTable(13, n_partitions=P, interpret=True)
+    par = rng.integers(1, 2**31, B, dtype=np.uint32)
+    act = jnp.ones(B, bool)
+    args = (jnp.asarray(k_lo), jnp.asarray(k_hi),
+            jnp.asarray(par), jnp.asarray(par + 1), act)
+    rx, rp = xla.insert(*args), pls.insert(*args)
+    assert np.array_equal(np.asarray(rx.is_new), np.asarray(rp.is_new))
+    assert xla.dump().keys() == pls.dump().keys()
+
+
+def test_fused_bloom_probe_matches_maybe_contains():
+    """The r7 tiered-store probe, fused into the kernel's partition pass:
+    the engine insert built with `summary_cfg` must return a suspect mask
+    bit-identical to the separate `is_new & maybe_contains(...)` sweep the
+    other variants pay after their insert."""
+    from stateright_tpu.store.summary import (
+        host_insert,
+        maybe_contains,
+        summary_words,
+    )
+    from stateright_tpu.tensor.pallas_hashtable import make_engine_insert
+
+    slog2, khash = 14, 4
+    rng = np.random.default_rng(3)
+    B = 256
+    lo = rng.integers(1, 2**32, B, dtype=np.uint32)
+    hi = rng.integers(0, 2**32, B, dtype=np.uint32)
+    # Half the batch is "previously spilled": their bits are set host-side
+    # exactly as the tiered store's eviction does.
+    words = np.zeros(summary_words(slog2), dtype=np.uint32)
+    host_insert(words, lo[: B // 2], hi[: B // 2], slog2, khash)
+
+    insert = make_engine_insert(
+        summary_cfg=(slog2, khash), n_partitions=4, interpret=True
+    )
+    assert insert.fused_summary  # the expand_insert dispatch marker
+    S = 1 << 12
+    z = jnp.zeros(S, dtype=jnp.uint32)
+    par = jnp.asarray(rng.integers(1, 2**31, B, dtype=np.uint32))
+    t_lo, t_hi, p_lo, p_hi, is_new, suspect, ovf = insert(
+        z, z, z, z, jnp.asarray(lo), jnp.asarray(hi), par, par,
+        jnp.ones(B, bool), jnp.asarray(words),
+    )
+    assert not bool(ovf)
+    want = np.asarray(is_new) & np.asarray(
+        maybe_contains(words, lo, hi, slog2, khash)
+    )
+    assert np.array_equal(np.asarray(suspect), want)
+    # Every genuinely-spilled fresh claim is flagged (Bloom filters have no
+    # false negatives) — first occurrence of each key in the salted half.
+    first = np.zeros(B, bool)
+    seen: set = set()
+    for j in range(B // 2):
+        k = (int(lo[j]), int(hi[j]))
+        if k not in seen:
+            seen.add(k)
+            first[j] = True
+    assert (np.asarray(suspect)[: B // 2] >= first[: B // 2]).all()
+
+
+def test_chain_full_surfaces_as_overflow():
+    """verdict==2 (chain full): a partition offered more distinct keys than
+    it has slots claims exactly its capacity and reports overflow — the
+    signal the engines fold into the r6 table-full abort→checkpoint→regrow
+    path — and never silently drops a lane."""
+    t = PallasHashTable(10, n_partitions=1, interpret=True)  # 1024 slots
+    n = 1100
+    lo = jnp.asarray(np.arange(1, n + 1, dtype=np.uint32))
+    hi = jnp.asarray(np.arange(n, dtype=np.uint32) * 7)
+    par = jnp.ones(n, dtype=jnp.uint32)
+    r = t.insert(lo, hi, par, par, jnp.ones(n, bool))
+    assert bool(r.overflow)
+    assert int(np.asarray(r.is_new).sum()) == 1024  # full capacity claimed
+    assert len(t.dump()) == 1024
+
+
+def test_regrow_preserves_pallas_layout():
+    """Overflow recovery re-hashes the table into a bigger one through the
+    VARIANT'S OWN insert (resident._regrow(insert_variant="pallas")): the
+    pallas probe scheme is partition-relative, so a regrow through the XLA
+    insert would strand every key in un-probeable slots — pinned here by
+    re-offering the keys to the regrown table and requiring zero is_new."""
+    from stateright_tpu.tensor.resident import _regrow
+    from stateright_tpu.tensor.models import TensorTwoPhaseSys
+
+    rng = np.random.default_rng(5)
+    t = PallasHashTable(10, interpret=True)
+    B = 600
+    lo = rng.integers(1, 2**32, B, dtype=np.uint32)
+    hi = rng.integers(0, 2**32, B, dtype=np.uint32)
+    par = jnp.asarray(rng.integers(1, 2**31, B, dtype=np.uint32))
+    t.insert(jnp.asarray(lo), jnp.asarray(hi), par, par, jnp.ones(B, bool))
+    fields = {
+        "t_lo": np.asarray(t.t_lo), "t_hi": np.asarray(t.t_hi),
+        "p_lo": np.asarray(t.p_lo), "p_hi": np.asarray(t.p_hi),
+        **{
+            f: np.zeros((4,) if f != "q_states" else (4, 2), np.uint32)
+            for f in ("q_states", "q_lo", "q_hi", "q_ebits", "q_depth")
+        },
+    }
+    grown = _regrow(
+        TensorTwoPhaseSys(3), fields, 10, 12, 256, insert_variant="pallas"
+    )
+    big = PallasHashTable(12, interpret=True)
+    big.t_lo, big.t_hi = grown["t_lo"], grown["t_hi"]
+    big.p_lo, big.p_hi = grown["p_lo"], grown["p_hi"]
+    assert t.dump() == big.dump()  # same key→parent map, new layout
+    r = big.insert(
+        jnp.asarray(lo), jnp.asarray(hi), par, par, jnp.ones(B, bool)
+    )
+    assert int(np.asarray(r.is_new).sum()) == 0  # every key found in place
+
+
+def test_insert_retry_fault_point_is_exactly_retriable():
+    """The chaos-plane boundary on the spilled-lane re-offer
+    (faults/plan.py `table.insert_retry`, r10): a fault injected at the
+    retry leaves the table exactly retriable — re-running the whole insert
+    converges to the fault-free key set."""
+    from stateright_tpu.faults.plan import FaultPlan, SpillIOError, active
+
+    # >W lanes routed to ONE partition forces a route spill: P=8 and
+    # B=2100 gives W=2048 (route_factor 4, tile-rounded), so 52 lanes
+    # spill and re-offer. Keys cycle over 100 distinct values so bucket
+    # chains never fill (the spill is routing pressure, not table
+    # pressure).
+    B, P = 2100, 8
+    ks = np.arange(B, dtype=np.uint32) % 100
+    lo = jnp.asarray(ks + 1)
+    hi = jnp.asarray(ks * np.uint32(P))  # hi % P == 0: all partition 0
+    par = jnp.ones(B, dtype=jnp.uint32)
+    act = jnp.ones(B, bool)
+
+    t = PallasHashTable(13, n_partitions=P, interpret=True)
+    plan = FaultPlan().rule("table.insert_retry", "io")
+    with active(plan):
+        try:
+            t.insert(lo, hi, par, par, act)
+            raise AssertionError("expected the injected retry fault")
+        except SpillIOError:
+            pass
+    assert plan.injected.get("table.insert_retry:io") == 1
+    # Exactly retriable: the committed lanes resolve as duplicates on the
+    # re-run; the final set matches a fault-free table's.
+    t.insert(lo, hi, par, par, act)
+    ref = PallasHashTable(13, n_partitions=P, interpret=True)
+    ref.insert(lo, hi, par, par, act)
+    assert t.dump() == ref.dump()
+    assert len(t.dump()) == 100
+
+
+# -- engine-level goldens (insert_variant="pallas" on the 2pc-3 anchor) --------
+# Discovery fingerprints below are the capped-variant goldens (bit-identical
+# by the acceptance contract; they are pure functions of the tensor model +
+# fingerprint fn, independent of the insert design).
+
+_GOLD_2PC3 = (
+    1146, 288,
+    {
+        "abort agreement": 14909271599932699485,
+        "commit agreement": 13140927078735652351,
+    },
+)
+
+
+def _check_2pc3(r, fps_exact=True):
+    gen, uniq, disc = _GOLD_2PC3
+    assert (r.state_count, r.unique_state_count) == (gen, uniq)
+    if fps_exact:
+        assert r.discoveries == disc
+    else:  # witness fps are engine/batch-dependent on the sharded engine
+        assert set(r.discoveries) == set(disc)
+
+
+def test_frontier_pallas_golden_2pc3():
+    from stateright_tpu.tensor.frontier import FrontierSearch
+    from stateright_tpu.tensor.models import TensorTwoPhaseSys
+
+    r = FrontierSearch(
+        TensorTwoPhaseSys(3), 128, 10, insert_variant="pallas"
+    ).run()
+    _check_2pc3(r)
+
+
+def test_resident_pallas_golden_2pc3():
+    from stateright_tpu.tensor.models import TensorTwoPhaseSys
+    from stateright_tpu.tensor.resident import ResidentSearch
+
+    r = ResidentSearch(
+        TensorTwoPhaseSys(3), 128, 10, insert_variant="pallas"
+    ).run()
+    _check_2pc3(r)
+
+
+def test_resident_tiered_pallas_fused_probe_spills_2pc4():
+    """The fused Bloom probe IN AN ENGINE, against a summary that is
+    actually populated: 2pc-4 (1568 uniques) through a 2^11 table spills
+    past the water mark, so fresh claims meet set summary bits inside the
+    jitted chunk loop, suspects are buffered and host-resolved, and the
+    run must still land on the exact golden counts (a mishandled suspect
+    would change unique_count)."""
+    from stateright_tpu.tensor.models import TensorTwoPhaseSys
+    from stateright_tpu.tensor.resident import ResidentSearch
+
+    r = ResidentSearch(
+        TensorTwoPhaseSys(4), 32, 11, insert_variant="pallas",
+        store="tiered", high_water=0.6, summary_log2=14,
+    ).run()
+    assert (r.state_count, r.unique_state_count) == (8258, 1568)
+    assert r.detail["spilled_states"] > 0  # the summary was populated
+    assert r.detail["suspects_checked"] > 0  # the fused probe fired
+
+
+def test_service_tiered_pallas_salted_fused_probe_2pc4():
+    """The service is the most intricate pallas consumer: job seeding goes
+    through the PallasHashTable host handle, every key is job-salted
+    BEFORE the kernel's routing, and the fused Bloom probe runs on the
+    salted keys with suspects host-resolved against the shared spill tier.
+    Two concurrent jobs on a spilling shared table must both land on their
+    standalone goldens."""
+    from stateright_tpu.service import CheckService
+    from stateright_tpu.tensor.models import TensorTwoPhaseSys
+
+    svc = CheckService(
+        batch_size=48, table_log2=11, insert_variant="pallas",
+        store="tiered", high_water=0.6, summary_log2=14, background=False,
+    )
+    h4 = svc.submit(TensorTwoPhaseSys(4))
+    h3 = svc.submit(TensorTwoPhaseSys(3))
+    svc.drain()
+    r4, r3 = h4.result(), h3.result()
+    stats = svc.stats()
+    svc.close()
+    assert (r4.state_count, r4.unique_state_count) == (8258, 1568)
+    _check_2pc3(r3)
+    # The shared table really spilled, so the fused probe met set bits.
+    assert stats["store"]["spilled_states"] > 0
+    assert stats["store"]["suspects_checked"] > 0
+
+
+def test_sharded_pallas_golden_2pc3():
+    from stateright_tpu.parallel import ShardedSearch, make_mesh
+    from stateright_tpu.tensor.models import TensorTwoPhaseSys
+
+    r = ShardedSearch(
+        TensorTwoPhaseSys(3), mesh=make_mesh(8), batch_size=64,
+        table_log2=10, insert_variant="pallas",
+    ).run()
+    _check_2pc3(r, fps_exact=False)
