@@ -767,6 +767,86 @@ def device_search_service(n_jobs: int = 8):
     return out, err
 
 
+def device_search_fleet(n_replicas: int = 3):
+    """BENCH_FLEET=1 row: the mixed job set through an N-replica service
+    fleet (consistent-hash router, work stealing) vs the SAME jobs through
+    a 1-replica fleet — the scale-out A/B the ROADMAP item 1 acceptance
+    names. Reports jobs/s, the N-vs-1 ratio, and the p50/p99 submit→result
+    latency of the fleet run. Composition: 3x 2pc-3, 3x 2pc-4, 2x
+    inclock-4. Parity = every fleet job's counts and discovery
+    fingerprints equal its 1-replica twin's (bit-identical scale-out)."""
+    _pin_platform()
+    from stateright_tpu.service import ServiceFleet
+    from stateright_tpu.tensor.models import (
+        TensorIncrementLock,
+        TensorTwoPhaseSys,
+    )
+
+    m3, m4, mi = (
+        TensorTwoPhaseSys(3), TensorTwoPhaseSys(4), TensorIncrementLock(4)
+    )
+    jobs = [m3] * 3 + [m4] * 3 + [mi] * 2
+
+    def run_fleet(n):
+        fleet = ServiceFleet(
+            n_replicas=n,
+            background=True,
+            max_resident=2,
+            service_kwargs=dict(batch_size=1024, table_log2=17),
+        )
+        t0 = time.monotonic()
+        handles = [fleet.submit(m) for m in jobs]
+        fleet.drain(timeout=1800)
+        sec = time.monotonic() - t0
+        results = [h.result() for h in handles]
+        lat_ms = sorted(
+            (h._job.finished_at - h._job.submitted_at) * 1000.0
+            for h in handles
+        )
+        stats = fleet.stats()
+        fleet.close()
+        return sec, results, lat_ms, stats
+
+    one_sec, one_results, _one_lat, _ = run_fleet(1)
+    sec, results, lat_ms, stats = run_fleet(n_replicas)
+
+    err = None
+    for i, (r, s) in enumerate(zip(results, one_results)):
+        got = (r.state_count, r.unique_state_count, r.max_depth)
+        want = (s.state_count, s.unique_state_count, s.max_depth)
+        if got != want or sorted(r.discoveries.items()) != sorted(
+            s.discoveries.items()
+        ):
+            err = (
+                f"fleet parity failure on job {i}: {got} / "
+                f"{sorted(r.discoveries.items())} != 1-replica {want} / "
+                f"{sorted(s.discoveries.items())}"
+            )
+            break
+
+    def pct(sorted_ms, q):
+        return sorted_ms[min(int(q * (len(sorted_ms) - 1)), len(sorted_ms) - 1)]
+
+    states = sum(r.state_count for r in results)
+    out = {
+        "states": states,
+        "unique": sum(r.unique_state_count for r in results),
+        "sec": round(sec, 4),
+        "states_per_sec": states / max(sec, 1e-9),
+        "compile_sec": 0.0,  # compiles inside both wall clocks (A/B fair)
+        "n_replicas": n_replicas,
+        "n_jobs": len(jobs),
+        "fleet_jobs_per_sec": round(len(jobs) / max(sec, 1e-9), 4),
+        "sec_one_replica": round(one_sec, 4),
+        "vs_one_replica": round(one_sec / max(sec, 1e-9), 3),
+        "fleet_p50_ms": round(pct(lat_ms, 0.50), 1),
+        "fleet_p99_ms": round(pct(lat_ms, 0.99), 1),
+        "fleet_steals": stats["steals"],
+        "fleet_requeued": stats["requeued_jobs"],
+    }
+    return out, err
+
+
 def device_search_sharded(model_name: str, n: int, n_chips: int = 8):
     """Run the multi-chip sharded engine over a mesh of `n_chips` (virtual
     CPU devices when real multi-chip hardware is absent — the bench marks
@@ -915,6 +995,12 @@ DEVICE_DETAIL_FIELDS = (
     # Pallas insert A/B (BENCH_PALLAS=1 row): the capped-insert wall time
     # next to the pallas run's, and the speed ratio (>1 = pallas wins).
     "sec_capped", "pallas_vs_capped",
+    # Service fleet (BENCH_FLEET=1 row): N-replica mixed-set throughput vs
+    # the same jobs through one replica (>1 = scale-out wins), plus the
+    # fleet run's submit→result latency digest and robustness counters.
+    "n_replicas", "fleet_jobs_per_sec", "sec_one_replica",
+    "vs_one_replica", "fleet_p50_ms", "fleet_p99_ms",
+    "fleet_steals", "fleet_requeued",
 )
 
 
@@ -1131,6 +1217,12 @@ def main(argv: list | None = None) -> int:
                 ("2pc", 4, 2400.0, "--worker-pallas", None),
                 ("paxos", 2, 2400.0, "--worker-pallas", None),
             )
+        # BENCH_FLEET=1: add the N-replica fleet scale-out A/B on the mixed
+        # job set (the same composition as the service row, through a
+        # 3-replica fleet vs 1 replica; jobs/s ratio + p50/p99 latency land
+        # in detail.device["fleet-mixed-3"]).
+        if os.environ.get("BENCH_FLEET") == "1" and not smoke:
+            workloads += (("fleet-mixed", 3, 2400.0, "--worker-fleet", None),)
         for model, n, wl_timeout, mode, env_extra in workloads:
             key = f"{model}-{n}" + (
                 {
@@ -1138,6 +1230,7 @@ def main(argv: list | None = None) -> int:
                     "--worker-obs": "-obs",
                     "--worker-faults": "-faults",
                     "--worker-pallas": "-pallas",
+                    "--worker-fleet": "",
                 }.get(mode, "")
             )
             r, perr = device_search_subprocess(
@@ -1213,6 +1306,8 @@ def worker_main(model_name: str, n: int, mode: str = "--worker") -> int:
     try:
         if mode == "--worker-service":
             r, perr = device_search_service(n)
+        elif mode == "--worker-fleet":
+            r, perr = device_search_fleet(n)
         elif mode == "--worker-sharded":
             r, perr = device_search_sharded(model_name, n)
         elif mode == "--worker-obs":
@@ -1235,7 +1330,7 @@ def worker_main(model_name: str, n: int, mode: str = "--worker") -> int:
 if __name__ == "__main__":
     if len(sys.argv) == 4 and sys.argv[1] in (
         "--worker", "--worker-sharded", "--worker-service", "--worker-obs",
-        "--worker-faults", "--worker-pallas",
+        "--worker-faults", "--worker-pallas", "--worker-fleet",
     ):
         sys.exit(worker_main(sys.argv[2], int(sys.argv[3]), mode=sys.argv[1]))
     if len(sys.argv) == 2 and sys.argv[1] == "--worker-analysis":

@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Fleet load generator: hundreds of concurrent HTTP clients against the
+fleet front door, reporting p50/p99 submit→result latency and jobs/s.
+
+The ROADMAP item 1 acceptance harness: starts an N-replica ServiceFleet
+behind `serve_fleet`, drives `--clients` threads submitting `--jobs` mixed
+jobs (POST /jobs + poll GET /jobs/<id>), honors 503 `Retry-After` backoff,
+and verifies every job finished with its golden counts. `--compare` runs
+the same load twice — N replicas, then 1 — and prints the jobs/s ratio
+(the scale-out claim: N=3 beats N=1 on the mixed set).
+
+    JAX_PLATFORMS=cpu python scripts/fleet_load.py \
+        [--replicas 3] [--clients 100] [--jobs 200] [--compare] [--crash]
+
+`--crash` additionally kills one replica mid-load through the chaos plane
+(`fleet.replica_crash`) and asserts zero lost jobs.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: (registry model name, args, (golden generated, golden unique))
+MIX = (
+    ("2pc", {"n": 3}, (1_146, 288)),
+    ("inclock", {"n": 4}, (257, 257)),
+)
+
+
+def run_load(n_replicas, clients, jobs, crash=False):
+    from stateright_tpu.faults import FaultPlan, active
+    from stateright_tpu.service import ServiceFleet, serve_fleet
+
+    fleet = ServiceFleet(
+        n_replicas=n_replicas,
+        background=True,
+        max_resident=4,
+        service_kwargs=dict(batch_size=512, table_log2=16),
+    )
+    srv = serve_fleet(fleet, address="localhost:0")
+    base = "http://" + srv.address
+    latencies = []
+    failures = []
+    lock = threading.Lock()
+    per_client = max(jobs // clients, 1)
+
+    def post(path, body):
+        req = urllib.request.Request(
+            base + path, data=json.dumps(body).encode(), method="POST"
+        )
+        return json.loads(urllib.request.urlopen(req, timeout=30).read())
+
+    def get(path):
+        return json.loads(
+            urllib.request.urlopen(base + path, timeout=30).read()
+        )
+
+    def client(ci):
+        for j in range(per_client):
+            name, args, gold = MIX[(ci + j) % len(MIX)]
+            t0 = time.monotonic()
+            while True:  # submit with Retry-After backoff
+                try:
+                    jid = post("/jobs", {"model": name, "args": args})["job"]
+                    break
+                except urllib.error.HTTPError as e:
+                    if e.code != 503:
+                        raise
+                    time.sleep(float(e.headers.get("Retry-After") or 1))
+            while True:  # poll to completion
+                try:
+                    p = get(f"/jobs/{jid}")
+                except urllib.error.HTTPError as e:
+                    if e.code != 503:
+                        raise
+                    time.sleep(float(e.headers.get("Retry-After") or 1))
+                    continue
+                if p["status"] in ("done", "error", "cancelled"):
+                    break
+                time.sleep(0.01)
+            lat = time.monotonic() - t0
+            got = (p.get("state_count"), p.get("unique_state_count"))
+            with lock:
+                latencies.append(lat)
+                if p["status"] != "done" or got != gold:
+                    failures.append(
+                        f"client {ci} job {jid} ({name}): "
+                        f"status={p['status']} counts={got} != {gold}"
+                    )
+
+    plan = None
+    if crash and n_replicas > 1:
+        # Kill one replica a few driver turns in; the router must requeue
+        # its jobs from checkpoints — zero lost jobs under real load.
+        plan = FaultPlan().rule(
+            "fleet.replica_crash", "crash", after=20,
+            match={"replica": 0},
+        )
+
+    t0 = time.monotonic()
+    ctx = active(plan) if plan is not None else None
+    if ctx is not None:
+        ctx.__enter__()
+    try:
+        threads = [
+            threading.Thread(target=client, args=(i,)) for i in range(clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        if ctx is not None:
+            ctx.__exit__(None, None, None)
+    wall = time.monotonic() - t0
+    stats = fleet.stats()
+    srv.shutdown()
+    fleet.close()
+
+    lat_ms = sorted(x * 1000 for x in latencies) or [0.0]
+
+    def pct(q):
+        return lat_ms[min(int(q * (len(lat_ms) - 1)), len(lat_ms) - 1)]
+
+    done = len(latencies)
+    row = {
+        "replicas": n_replicas,
+        "clients": clients,
+        "jobs": done,
+        "sec": round(wall, 2),
+        "jobs_per_sec": round(done / max(wall, 1e-9), 2),
+        "p50_ms": round(pct(0.50), 1),
+        "p99_ms": round(pct(0.99), 1),
+        "steals": stats["steals"],
+        "requeued": stats["requeued_jobs"],
+        "restored": stats["restored_jobs"],
+        "replica_crashes": stats["replica_crashes"],
+    }
+    return row, failures
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--clients", type=int, default=100)
+    ap.add_argument("--jobs", type=int, default=200)
+    ap.add_argument("--compare", action="store_true",
+                    help="also run the same load on 1 replica; print ratio")
+    ap.add_argument("--crash", action="store_true",
+                    help="kill replica 0 mid-load via the chaos plane")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    p = os.environ.get("JAX_PLATFORMS")
+    if p:
+        # The image's site config re-registers the axon TPU platform over a
+        # plain env var; pin at the jax.config level (same move as bench.py).
+        jax.config.update("jax_platforms", p)
+
+    row, failures = run_load(
+        args.replicas, args.clients, args.jobs, crash=args.crash
+    )
+    print("fleet:", json.dumps(row))
+    bad = list(failures)
+    if args.compare:
+        row1, fail1 = run_load(1, args.clients, args.jobs)
+        print("one-replica:", json.dumps(row1))
+        ratio = row["jobs_per_sec"] / max(row1["jobs_per_sec"], 1e-9)
+        print(
+            f"scale-out: {args.replicas} replicas at {row['jobs_per_sec']} "
+            f"jobs/s vs 1 replica at {row1['jobs_per_sec']} jobs/s "
+            f"-> {ratio:.2f}x"
+        )
+        bad += fail1
+    if args.crash and row["replica_crashes"] < 1:
+        bad.append("crash requested but no replica crash was recorded")
+    if bad:
+        print("FAILURES:", "; ".join(bad[:10]), file=sys.stderr)
+        return 1
+    print("fleet load OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,6 +36,7 @@ from .plan import (
     HttpFault,
     PoisonFault,
     PreemptionFault,
+    ReplicaCrash,
     ShardFault,
     SpillIOError,
     WatchdogTimeout,
@@ -64,6 +65,7 @@ __all__ = [
     "ShardFault",
     "PoisonFault",
     "HttpFault",
+    "ReplicaCrash",
     "WatchdogTimeout",
     "KINDS",
     "maybe_fault",

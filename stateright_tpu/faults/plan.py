@@ -31,8 +31,21 @@ Injection points (the name is the contract; grep for `maybe_fault(`):
 - ``ckpt.write``      — checkpoint write; the ``torn`` kind CORRUPTS the
                         just-written file instead of raising
 - ``service.step``    — check-service fused step (ctx ``jobs=[ids]``)
-- ``service.http``    — service HTTP front end (converted to a 503)
+- ``service.http``    — service/fleet HTTP front end (converted to a 503
+                        with a ``Retry-After`` header)
 - ``checker.run``     — TpuChecker search-thread entry
+- ``fleet.replica_crash`` — fleet replica driver entry (ctx ``replica=i``);
+                        the ``crash`` kind kills that replica for good —
+                        the router requeues its jobs from checkpoints
+- ``fleet.replica_hang``  — fleet replica health probe (ctx ``replica=i``);
+                        a ``hang`` here parks the probe until the router's
+                        probe deadline expires (suspect accounting)
+- ``router.timeout``  — fleet router submit path to one replica (ctx
+                        ``replica=i``), BEFORE the replica is touched —
+                        retried with deterministic backoff on a survivor
+- ``fleet.steal``     — cross-replica work-steal boundary (ctx ``src=i,
+                        dst=j``), BEFORE the queued job is withdrawn, so a
+                        fault here leaves the job exactly where it was
 
 Determinism: every decision is a pure function of (plan seed, per-point hit
 counter, rule spec) — no RNG state, no wall clock — so a failing chaos run
@@ -91,6 +104,11 @@ class HttpFault(FaultError):
     """Simulated service HTTP front-end failure (rendered as a 503)."""
 
 
+class ReplicaCrash(FaultError):
+    """Simulated fleet replica death: the replica's driver stops for good
+    and the router must recover its jobs from the checkpoint plane."""
+
+
 class WatchdogTimeout(FaultError):
     """A hang converted into a retriable fault (by the supervisor watchdog
     cancelling the hang gate, or the gate's own self-limit)."""
@@ -106,6 +124,7 @@ KINDS = {
     "shard": ShardFault,
     "poison": PoisonFault,
     "http": HttpFault,
+    "crash": ReplicaCrash,
 }
 
 _SPECIAL_KINDS = ("hang", "torn")

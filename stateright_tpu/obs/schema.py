@@ -103,6 +103,30 @@ REGISTRY_SOURCES = {
     "sharded": "multi-chip engine (parallel/sharded.py)",
     "service": "check service scheduler (service/api.py)",
     "supervisor": "self-healing supervisor (faults/supervisor.py)",
+    "fleet": "multi-replica fleet router (service/router.py)",
+}
+
+
+#: Keys of the fleet router's `stats()` (service/router.py) — the fleet
+#: `/.status` body and the "fleet" `/metrics` source. Pinned by
+#: tests/test_bench_contract.py exactly like the detail schemas above;
+#: `per_replica` is the one intentionally-dynamic sub-dict (one row per
+#: replica index, fleet.Replica.snapshot_row).
+FLEET_COUNTER_KEYS = {
+    "replicas": "replicas the fleet was built with",
+    "healthy": "replicas currently passing health probes",
+    "jobs": "fleet jobs by status sub-dict (routed/done/cancelled/error)",
+    "queued": "inner jobs waiting in replica admission queues, fleet-wide",
+    "jobs_routed": "successful job placements (initial + requeue + steal)",
+    "router_retries": "submissions retried after a replica timeout/fault",
+    "router_backoff_ms": "cumulative deterministic submit backoff, ms",
+    "probe_failures": "health probes that failed or timed out",
+    "replica_crashes": "replicas declared dead and removed from the ring",
+    "requeued_jobs": "jobs moved off a dead replica (zero-lost-jobs ledger)",
+    "restored_jobs": "requeued jobs resumed from an intact checkpoint "
+                     "generation (the rest restarted fresh)",
+    "steals": "queued jobs pulled to an idle replica (work stealing)",
+    "per_replica": "one status row per replica sub-dict",
 }
 
 

@@ -30,11 +30,27 @@ Pieces:
                 /jobs/<id>, cancel, `/.status` with per-job metrics.
 - `metrics`   — per-job queue wait / device steps / lanes held /
                 preemptions / spill share.
+- `router`    — the fleet front door: consistent-hash routing across N
+                replicas, health probes, bounded retry, replica failure →
+                checkpoint requeue-resume, cross-replica work stealing,
+                and the fleet HTTP server (`serve_fleet`).
+- `fleet`     — `Replica` crash-only drivers + the `ServiceFleet`
+                assembly (one router + N CheckService replicas).
 """
 
 from .api import CheckService, JobHandle, ServiceChecker
+from .fleet import Replica, ServiceFleet
 from .metrics import JobMetrics
-from .queue import Job, JobStatus
+from .queue import Job, JobResume, JobStatus
+from .router import (
+    FleetJobHandle,
+    FleetJobStatus,
+    FleetRouter,
+    HashRing,
+    NoHealthyReplica,
+    ReplicaDead,
+    serve_fleet,
+)
 from .scheduler import ServiceEngine, ServiceError
 from .server import ModelRegistry, default_registry, serve_service, status_view
 
@@ -44,6 +60,7 @@ __all__ = [
     "ServiceChecker",
     "JobMetrics",
     "Job",
+    "JobResume",
     "JobStatus",
     "ServiceEngine",
     "ServiceError",
@@ -51,4 +68,13 @@ __all__ = [
     "default_registry",
     "serve_service",
     "status_view",
+    "Replica",
+    "ServiceFleet",
+    "FleetRouter",
+    "FleetJobHandle",
+    "FleetJobStatus",
+    "HashRing",
+    "NoHealthyReplica",
+    "ReplicaDead",
+    "serve_fleet",
 ]

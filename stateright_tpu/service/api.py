@@ -158,10 +158,18 @@ class CheckService:
         target_max_depth: Optional[int] = None,
         timeout: Optional[float] = None,
         priority: int = 0,
+        journal: bool = False,
+        resume=None,
     ) -> JobHandle:
         """Enqueue a check job; returns immediately. The model must be a
         TensorModel; submit the SAME model instance for jobs that should
-        share a compiled step (and batch lanes) with each other."""
+        share a compiled step (and batch lanes) with each other.
+
+        `journal=True` records the job's (fp, parent fp) claims host-side
+        so a fleet replica can checkpoint it for requeue-resume; `resume`
+        (a queue.JobResume) admits the job mid-search from such a
+        checkpoint — both are the service fleet's plumbing (service/
+        fleet.py), not a client-facing knob."""
         from ..tensor.model import TensorModel
 
         if not isinstance(model, TensorModel):
@@ -183,12 +191,31 @@ class CheckService:
                 target_max_depth=target_max_depth,
                 timeout=timeout,
                 priority=priority,
+                journal=journal,
+                resume=resume,
             )
             self._next_id += 1
             self._jobs[job.id] = job
             self._adm.push(job)
             self._work.notify_all()
             return JobHandle(self, job)
+
+    def withdraw(self, job_id: int) -> bool:
+        """Atomically remove a still-QUEUED job (the fleet work-stealing
+        primitive: a queued job has no table state, so moving it to another
+        replica is a clean cancel-here/submit-there). Returns False once
+        the job was admitted (or finished) — stealing running jobs is the
+        checkpoint plane's business, not the queue's."""
+        job = self._get(job_id)
+        with self._work:
+            if job.status != JobStatus.QUEUED:
+                return False
+            self._adm.remove(job)
+            job.status = JobStatus.CANCELLED
+            job.metrics.finished_at = time.monotonic()
+            job.event.set()
+            self._idle.notify_all()
+            return True
 
     def poll(self, job_id: int) -> dict:
         job = self._get(job_id)

@@ -1,0 +1,350 @@
+"""Replica drivers + the ServiceFleet assembly (router front door over N
+CheckService replicas).
+
+A `Replica` wraps one CheckService (one device / device-mesh worth of
+shared table) in a crash-only driver: it pumps the service's scheduling
+rounds, checkpoints its journaled jobs through the r10 atomic checkpoint
+plane (faults/ckptio.py — every write leaves a verified `.prev`
+generation), and DIES on the first unhandled fault — including the
+injected `fleet.replica_crash` chaos kind and the service-wide
+`ServiceError` class the single-service deployment could only abort on.
+Recovery is never the replica's business: the `FleetRouter`
+(service/router.py) detects the death through its health probes and
+requeues the replica's jobs onto survivors from their newest intact
+checkpoint generation.
+
+`ServiceFleet` is the assembly: N replicas + one router + (background
+mode) one driver thread per replica and one router supervision thread.
+Foreground mode (`background=False`) runs no threads at all — tests drive
+the whole fleet deterministically with `pump()` / `drain()`, the same
+discipline CheckService itself uses.
+
+    fleet = ServiceFleet(n_replicas=3, service_kwargs=dict(
+        batch_size=4096, table_log2=22))
+    h = fleet.submit(model, timeout=600)
+    r = h.result()          # survives any single replica's death
+    serve_fleet(fleet)      # HTTP front door: POST /jobs, /.status, /metrics
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+from typing import Callable, Optional
+
+from ..faults.ckptio import atomic_savez
+from ..faults.plan import maybe_fault
+from ..obs import as_tracer
+from .api import CheckService
+from .queue import JobStatus
+from .router import FleetRouter, ReplicaDead, serve_fleet  # noqa: F401
+
+__all__ = ["Replica", "ServiceFleet", "serve_fleet"]
+
+
+class Replica:
+    """One CheckService behind a crash-only driver. The service always runs
+    foreground (`background=False`) — THIS object owns the pumping, so the
+    chaos plane has one seam (`fleet.replica_crash`) through which to kill
+    the whole replica, and the fleet's foreground mode can drive it
+    deterministically."""
+
+    def __init__(
+        self,
+        idx: int,
+        service_factory: Callable[[], CheckService],
+        ckpt_every_spins: int = 1,
+        pump_rounds: int = 4,
+        tracer=None,
+    ):
+        self.idx = idx
+        self.service = service_factory()
+        self.ckpt_every_spins = ckpt_every_spins
+        self.pump_rounds = pump_rounds
+        self.error: Optional[str] = None
+        self._dead = False
+        self._spins = 0
+        self._ckpt_paths: dict[int, str] = {}  # inner job id -> ckpt path
+        self._tracer = as_tracer(tracer)
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self._wake = threading.Condition()
+
+    # -- router-facing surface -------------------------------------------------
+
+    @property
+    def alive(self) -> bool:
+        return not self._dead
+
+    def submit(self, spec: dict, ckpt_path: Optional[str] = None):
+        """Submit one job spec (CheckService.submit kwargs + journal/
+        resume) to this replica; registers its checkpoint path with the
+        driver. Raises ReplicaDead instead of touching a dead service."""
+        if self._dead:
+            raise ReplicaDead(
+                f"replica {self.idx} is dead ({self.error})"
+            )
+        handle = self.service.submit(**spec)
+        if ckpt_path is not None:
+            self._ckpt_paths[handle.id] = ckpt_path
+        with self._wake:
+            self._wake.notify_all()
+        return handle
+
+    def withdraw(self, inner_job_id: int) -> bool:
+        """Work-stealing primitive: atomically remove a still-QUEUED job
+        (see CheckService.withdraw)."""
+        if self._dead:
+            return False
+        return self.service.withdraw(inner_job_id)
+
+    def probe(self) -> dict:
+        """Health probe (the router's `/.status`-plane check): raises on a
+        dead replica, answers cheap live counters otherwise. Deliberately
+        lock-free — a replica mid-compile must read as healthy, and a
+        truly wedged one is caught by the router's probe deadline (the
+        `fleet.replica_hang` chaos point parks right here)."""
+        maybe_fault("fleet.replica_hang", replica=self.idx)
+        if self._dead:
+            raise ReplicaDead(
+                f"replica {self.idx} is dead ({self.error})"
+            )
+        failed = self.service._failed
+        if failed:
+            raise ReplicaDead(f"replica {self.idx} service failed: {failed}")
+        return {
+            "replica": self.idx,
+            "queued": len(self.service._adm),
+            "device_steps": self.service._engine.total_steps,
+        }
+
+    def idle(self) -> bool:
+        """True iff this replica has nothing queued and nothing runnable —
+        the steal-eligibility test (mirrors CheckService._has_work without
+        taking the service lock)."""
+        if self._dead:
+            return False
+        svc = self.service
+        if len(svc._adm):
+            return False
+        try:
+            return not any(
+                g.runnable() for g in svc._engine.groups.values()
+            )
+        except RuntimeError:  # srlint: fault-ok racy dict walk reads as busy
+            return False
+
+    def snapshot_row(self) -> dict:
+        """One `/.status` row. Dead replicas report liveness only — crash
+        semantics say their service state is gone."""
+        if self._dead:
+            return {"alive": 0, "error": self.error}
+        svc = self.service
+        return {
+            "alive": 1,
+            "queued": len(svc._adm),
+            "jobs": len(svc._jobs),
+            "device_steps": svc._engine.total_steps,
+            "spins": self._spins,
+        }
+
+    # -- the crash-only driver -------------------------------------------------
+
+    def spin(self) -> int:
+        """One driver turn: the chaos seam, a bounded pump, and the
+        checkpoint cadence. Returns rounds that dispatched work; a fault
+        anywhere kills the replica (recovery is the router's job)."""
+        if self._dead:
+            return 0
+        try:
+            # Chaos-plane boundary: `fleet.replica_crash` (kind `crash`)
+            # kills this replica for good — BEFORE the pump, so the last
+            # written checkpoint generation is a sound resume point.
+            maybe_fault("fleet.replica_crash", replica=self.idx)
+            ran = self.service.pump(self.pump_rounds)
+            self._spins += 1
+            if self._spins % self.ckpt_every_spins == 0:
+                self._checkpoint_jobs()
+            return ran
+        except Exception as e:  # noqa: BLE001 — crash-only: die, never limp
+            self._die(e)
+            return 0
+
+    def _die(self, e: BaseException) -> None:
+        self._dead = True
+        self.error = f"{type(e).__name__}: {e}"
+        self._tracer.instant(
+            "fleet.replica_crash", cat="fleet", replica=self.idx,
+            error=type(e).__name__,
+        )
+
+    def _checkpoint_jobs(self) -> None:
+        """Write one atomic generation per RUNNING journaled job. The
+        snapshot is taken under the service lock (no step mutates
+        mid-copy); the write happens outside it."""
+        for jid, path in list(self._ckpt_paths.items()):
+            job = self.service._jobs.get(jid)
+            if job is None or job.status in JobStatus.FINISHED:
+                self._ckpt_paths.pop(jid, None)
+                continue
+            if job.status != JobStatus.RUNNING or job.journal is None:
+                continue
+            with self.service._lock:
+                arrays = job.fleet_snapshot()
+            atomic_savez(path, arrays)
+
+    def _drive(self) -> None:
+        while not self._stop and not self._dead:
+            ran = self.spin()
+            if not ran and not self._stop:
+                with self._wake:
+                    self._wake.wait(timeout=0.002)
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._drive, daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop = True
+        with self._wake:
+            self._wake.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def close(self) -> None:
+        self.stop()
+        if not self._dead:
+            self.service.close()
+
+
+class ServiceFleet:
+    """N CheckService replicas behind one consistent-hash router — the
+    production deployment of the check service (ROADMAP item 1): replica
+    death is routine (requeue-resume from the checkpoint plane), imbalance
+    is routine (cross-replica work stealing), and the whole fleet reports
+    through one `/.status` + `/metrics` plane."""
+
+    def __init__(
+        self,
+        n_replicas: int = 2,
+        service_kwargs: Optional[dict] = None,
+        router_kwargs: Optional[dict] = None,
+        ckpt_dir: Optional[str] = None,
+        ckpt_every_spins: int = 1,
+        pump_rounds: int = 4,
+        max_resident: Optional[int] = 8,
+        background: bool = True,
+        tracer=None,
+    ):
+        """`service_kwargs` configure every replica's CheckService
+        (batch_size, table_log2, store, ...). `max_resident` bounds each
+        replica's admitted jobs so overload is visible as queue depth —
+        what work stealing feeds on (None disables the bound AND
+        stealing's signal). `ckpt_dir` (default: a managed tempdir) holds
+        the per-job requeue-resume generations."""
+        if n_replicas < 1:
+            raise ValueError("a fleet needs at least one replica")
+        self._tracer = as_tracer(tracer)
+        self._tmpdir = None
+        if ckpt_dir is None:
+            self._tmpdir = tempfile.TemporaryDirectory(prefix="srtpu-fleet-")
+            ckpt_dir = self._tmpdir.name
+        os.makedirs(ckpt_dir, exist_ok=True)
+        kw = dict(service_kwargs or {})
+        kw.setdefault("max_resident", max_resident)
+        kw["background"] = False  # the Replica driver owns the pumping
+
+        def factory():
+            return CheckService(**kw)
+
+        self.replicas = [
+            Replica(
+                i,
+                factory,
+                ckpt_every_spins=ckpt_every_spins,
+                pump_rounds=pump_rounds,
+                tracer=tracer,
+            )
+            for i in range(n_replicas)
+        ]
+        self.router = FleetRouter(
+            self.replicas,
+            background=background,
+            ckpt_dir=ckpt_dir,
+            tracer=tracer,
+            **(router_kwargs or {}),
+        )
+        self.background = background
+        self._closed = False
+        self._router_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        if background:
+            for r in self.replicas:
+                r.start()
+            self._router_thread = threading.Thread(
+                target=self._supervise, daemon=True
+            )
+            self._router_thread.start()
+
+    # -- client surface --------------------------------------------------------
+
+    def submit(self, model, **opts):
+        return self.router.submit(model, **opts)
+
+    def stats(self) -> dict:
+        return self.router.stats()
+
+    def store_stats(self) -> Optional[dict]:
+        rows = [
+            r.service.store_stats() for r in self.replicas if r.alive
+        ]
+        rows = [s for s in rows if s]
+        return rows[0] if len(rows) == 1 else (rows or None)
+
+    # -- foreground driving ----------------------------------------------------
+
+    def pump(self, rounds: int = 1) -> int:
+        """Foreground mode: drive every live replica and one router tick
+        per round; returns how many replica pumps dispatched work."""
+        ran = 0
+        for _ in range(rounds):
+            for r in self.replicas:
+                if r.alive:
+                    ran += 1 if r.spin() else 0
+            self.router.tick()
+        return ran
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Block until every fleet job has finished (requeues included)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not self.router.all_done():
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("fleet drain timed out")
+            if self.background:
+                time.sleep(0.005)  # router/replica threads make progress
+            else:
+                self.pump(4)
+
+    def _supervise(self) -> None:
+        while not self._stop.is_set():
+            self.router.tick()
+            self._stop.wait(timeout=0.01)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        if self._router_thread is not None:
+            self._router_thread.join(timeout=5.0)
+            self._router_thread = None
+        for r in self.replicas:
+            r.close()
+        self.router.close()
+        if self._tmpdir is not None:
+            self._tmpdir.cleanup()
+            self._tmpdir = None

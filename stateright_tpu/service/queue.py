@@ -14,6 +14,18 @@ uses for its queue (q_states / q_lo / q_hi / q_ebits / q_lens / q_depths),
 so a parked job's host memory drops to its counters while its visited set
 stays resident (shared device table — eviction of that is the tiered
 store's business, not the scheduler's).
+
+Fleet requeue goes further: a job submitted with ``journal=True``
+additionally records every unique (fingerprint, parent fingerprint) pair it
+ever claimed — host-side rows the scheduler already fetched, so the journal
+adds no device work. `fleet_snapshot` packages frontier + journal +
+counters + discoveries into one checkpoint payload (written through
+faults/ckptio.py by the fleet replica driver), and `JobResume.from_npz`
+turns the newest intact generation back into a submission the scheduler can
+admit on a DIFFERENT replica: the journal re-seeds the new table (re-salted
+with the new job's salt, parent chains intact), the frontier resumes at the
+exact pop order, and BFS determinism makes the finished counts and
+discoveries bit-identical to an uninterrupted run.
 """
 
 from __future__ import annotations
@@ -58,6 +70,57 @@ class _Chunk:
         return len(self.lo)
 
 
+class JobResume:
+    """A fleet requeue payload: everything a job needs to continue on a
+    FRESH replica (whose table has none of the job's visited set). Built
+    from a `fleet_snapshot` checkpoint by `from_npz`."""
+
+    __slots__ = (
+        "chunks", "journal", "state_count", "unique_count", "max_depth",
+        "discoveries",
+    )
+
+    def __init__(self, chunks, journal, state_count, unique_count,
+                 max_depth, discoveries):
+        self.chunks = chunks  # [(states, lo, hi, ebits, depth), ...]
+        self.journal = journal  # (j_lo, j_hi, jp_lo, jp_hi) uint32 arrays
+        self.state_count = state_count
+        self.unique_count = unique_count
+        self.max_depth = max_depth
+        self.discoveries = discoveries  # {property name: packed unsalted fp}
+
+    @classmethod
+    def from_npz(cls, data) -> "JobResume":
+        chunks = []
+        off = 0
+        for ln in data["q_lens"]:
+            ln = int(ln)
+            chunks.append(
+                (
+                    data["q_states"][off : off + ln],
+                    data["q_lo"][off : off + ln],
+                    data["q_hi"][off : off + ln],
+                    data["q_ebits"][off : off + ln],
+                    data["q_depths"][off : off + ln],
+                )
+            )
+            off += ln
+        counts = data["c_counts"]
+        return cls(
+            chunks=chunks,
+            journal=(
+                data["j_lo"], data["j_hi"], data["jp_lo"], data["jp_hi"]
+            ),
+            state_count=int(counts[0]),
+            unique_count=int(counts[1]),
+            max_depth=int(counts[2]),
+            discoveries={
+                str(n): int(f)
+                for n, f in zip(data["d_names"], data["d_fps"])
+            },
+        )
+
+
 class Job:
     def __init__(
         self,
@@ -68,6 +131,8 @@ class Job:
         target_max_depth: Optional[int] = None,
         timeout: Optional[float] = None,
         priority: int = 0,
+        journal: bool = False,
+        resume: Optional[JobResume] = None,
     ):
         self.id = job_id
         self.model = model
@@ -98,6 +163,12 @@ class Job:
         self._chunks: deque[_Chunk] = deque()
         self._pending = 0
         self._spill_path: Optional[str] = None
+        # Fleet requeue plane: the journal records every unique
+        # (fp, parent fp) pair the job claims (unsalted — the resuming
+        # replica re-salts with ITS job salt) so a crashed replica's jobs
+        # re-seed a fresh table instead of restarting from scratch.
+        self.journal: Optional[list] = [] if journal or resume else None
+        self.resume = resume
 
     # -- frontier --------------------------------------------------------------
 
@@ -162,17 +233,27 @@ class Job:
         self._chunks.clear()
         self._pending = 0
 
+    def journal_append(self, lo, hi, p_lo, p_hi) -> None:
+        """Record freshly-claimed unique states (unsalted fp + unsalted
+        parent fp; init states carry parent 0)."""
+        if self.journal is None or len(lo) == 0:
+            return
+        self.journal.append(
+            (
+                np.asarray(lo, np.uint32), np.asarray(hi, np.uint32),
+                np.asarray(p_lo, np.uint32), np.asarray(p_hi, np.uint32),
+            )
+        )
+
     # -- preemption spill (checkpoint machinery) --------------------------------
 
-    def spill_frontier(self, path: str) -> None:
-        """Park the pending frontier on disk (same array schema as the
-        engines' checkpoint queue section) and free the host memory. The
-        write is crash-atomic with a CRC32 footer (faults/ckptio.py) — a
-        torn spill must not poison the job's resumption."""
+    def _frontier_arrays(self) -> dict:
+        """The pending frontier in the engines' checkpoint queue schema
+        (q_states / q_lo / q_hi / q_ebits / q_depths / q_lens)."""
         chunks = list(self._chunks)
         P = chunks[0].ebits.shape[1] if chunks else 0
         L = chunks[0].states.shape[1] if chunks else self.model.lanes
-        arrays = dict(
+        return dict(
             q_states=(
                 np.concatenate([c.states for c in chunks])
                 if chunks else np.zeros((0, L), np.uint32)
@@ -195,8 +276,55 @@ class Job:
             ),
             q_lens=np.asarray([len(c) for c in chunks], np.int64),
         )
-        self._spill_path = atomic_savez(path, arrays, keep_prev=False)
+
+    def spill_frontier(self, path: str) -> None:
+        """Park the pending frontier on disk (same array schema as the
+        engines' checkpoint queue section) and free the host memory. The
+        write is crash-atomic with a CRC32 footer (faults/ckptio.py) — a
+        torn spill must not poison the job's resumption."""
+        self._spill_path = atomic_savez(
+            path, self._frontier_arrays(), keep_prev=False
+        )
         self.drop_frontier()
+
+    # -- fleet requeue snapshot --------------------------------------------------
+
+    def fleet_snapshot(self) -> dict:
+        """Checkpoint payload for fleet requeue-resume: pending frontier +
+        the full journal + counters + discoveries. Call under the owning
+        service's lock (a step must not mutate mid-snapshot); the caller
+        writes it through faults/ckptio.atomic_savez, whose `.prev`
+        generation is what makes a torn write survivable."""
+        j = self.journal or []
+        arrays = self._frontier_arrays()
+        names = sorted(self.discoveries)
+        arrays.update(
+            j_lo=(
+                np.concatenate([c[0] for c in j])
+                if j else np.zeros(0, np.uint32)
+            ),
+            j_hi=(
+                np.concatenate([c[1] for c in j])
+                if j else np.zeros(0, np.uint32)
+            ),
+            jp_lo=(
+                np.concatenate([c[2] for c in j])
+                if j else np.zeros(0, np.uint32)
+            ),
+            jp_hi=(
+                np.concatenate([c[3] for c in j])
+                if j else np.zeros(0, np.uint32)
+            ),
+            c_counts=np.asarray(
+                [self.state_count, self.unique_count, self.max_depth],
+                np.int64,
+            ),
+            d_names=np.asarray(names, dtype=np.str_),
+            d_fps=np.asarray(
+                [self.discoveries[n] for n in names], np.uint64
+            ),
+        )
+        return arrays
 
     def load_frontier(self) -> None:
         """Reload a spilled frontier for resumption (CRC-verified)."""

@@ -1,0 +1,859 @@
+"""Fleet front door: consistent-hash routing, health, requeue, stealing.
+
+The r8 CheckService multiplexes every job onto ONE device — a single
+replica crash is the whole fleet crashing. `FleetRouter` is the production
+layer above it (ROADMAP item 1): N CheckService replicas (service/fleet.py
+wraps each in a `Replica` driver) behind one submission surface that
+survives replica death with zero lost jobs.
+
+Routing policy:
+
+- **Consistent hashing** (`HashRing`): jobs are placed by a stable route
+  key — by default the model's registry/type name, so same-model jobs land
+  on the same replica and share its compiled step and batch lanes (the
+  cache-affinity argument for consistent hashing, which is also the
+  continuous-batching win). When a replica dies, only ITS keys move; every
+  other job keeps its warm replica.
+- **Bounded retry with deterministic backoff**: a submission that times out
+  or faults (`router.timeout` on the chaos plane) is retried against the
+  ring's successor replicas, with the same seeded-jitter backoff the
+  supervisor uses — replayable run to run.
+- **Health probes**: the router probes each replica's status surface on a
+  cadence (the `/.status` plane, in-proc); `unhealthy_after` consecutive
+  probe failures — or a dead driver — declares the replica crashed.
+- **Failure → requeue-resume**: a dead replica's unfinished jobs are
+  requeued onto ring survivors. When the replica's driver checkpointed the
+  job (faults/ckptio.py atomic generations), `load_latest` restores the
+  newest intact one and the job RESUMES mid-search (queue.JobResume seeds
+  the survivor's table from the journal) instead of restarting; with no
+  intact generation the job restarts fresh — either way BFS determinism
+  keeps results bit-identical, and either way the job is never lost.
+- **Work stealing** (`fleet.steal`): an idle replica pulls still-QUEUED
+  jobs from the most-loaded replica's admission queue (the TPU analogue of
+  the reference's `job_market.rs` thread stealing — a queued job has no
+  table state, so the move is a clean withdraw-here/submit-there).
+
+`serve_fleet` is the HTTP front door (`POST /jobs`, `GET /jobs/<id>`,
+cancel, fleet-level `/.status` + Prometheus `/metrics` aggregating every
+replica through the obs registry). Overload and injected `service.http`
+faults degrade to 503 + `Retry-After` — clients back off, never hot-loop.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+from typing import Optional
+
+from ..core.discovery import HasDiscoveries
+from ..faults.ckptio import CheckpointCorrupt, load_latest
+from ..faults.plan import FaultError, _u01, maybe_fault
+from ..obs import REGISTRY, as_tracer
+from .queue import JobResume, JobStatus
+
+
+class ReplicaDead(RuntimeError):
+    """The targeted replica's driver has stopped (crash, hang past the
+    probe policy, or shutdown); the router must place the work elsewhere."""
+
+
+class NoHealthyReplica(RuntimeError):
+    """Every replica is dead; rendered as a 503 + Retry-After over HTTP."""
+
+
+class FleetJobStatus:
+    ROUTED = "routed"  # bound to a replica (queued or running there)
+    DONE = "done"
+    CANCELLED = "cancelled"
+    ERROR = "error"
+
+    FINISHED = (DONE, CANCELLED, ERROR)
+
+
+# -- consistent hashing --------------------------------------------------------
+
+
+class HashRing:
+    """crc32 consistent-hash ring with virtual nodes. `lookup(key)` is the
+    owner; `preference(key)` is the owner followed by distinct successors —
+    the retry/failover order. Removing a member moves ONLY its keys."""
+
+    def __init__(self, members, vnodes: int = 64):
+        self.vnodes = vnodes
+        self._points: list = []  # sorted [(hash, member)]
+        self._members: set = set()
+        for m in members:
+            self.add(m)
+
+    @staticmethod
+    def _hash(s: str) -> int:
+        return zlib.crc32(s.encode()) & 0xFFFFFFFF
+
+    def add(self, member) -> None:
+        if member in self._members:
+            return
+        self._members.add(member)
+        # Rebind (never mutate in place): concurrent readers snapshot
+        # self._points once and must never observe a mid-sort list.
+        self._points = sorted(
+            self._points
+            + [(self._hash(f"{member}#{v}"), member) for v in range(self.vnodes)]
+        )
+
+    def remove(self, member) -> None:
+        if member not in self._members:
+            return
+        self._members.discard(member)
+        self._points = [(h, m) for h, m in self._points if m != member]
+
+    def members(self) -> list:
+        return sorted(self._members)
+
+    def lookup(self, key: str):
+        order = self.preference(key)
+        return order[0] if order else None
+
+    def preference(self, key: str) -> list:
+        """Every member, ordered by ring distance from `key`'s point —
+        index 0 is the owner, the rest are the failover walk."""
+        points = self._points  # one snapshot: remove() may rebind mid-walk
+        if not points:
+            return []
+        h = self._hash(key)
+        # First point at or after h (wrap), then walk clockwise.
+        lo, hi = 0, len(points)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if points[mid][0] < h:
+                lo = mid + 1
+            else:
+                hi = mid
+        seen: list = []
+        n = len(points)
+        for i in range(n):
+            m = points[(lo + i) % n][1]
+            if m not in seen:
+                seen.append(m)
+        return seen
+
+
+# -- fleet jobs ----------------------------------------------------------------
+
+
+class FleetJob:
+    """Router-side record of one submitted job: the spec (enough to
+    resubmit it anywhere), its current binding, and its completion state."""
+
+    def __init__(self, fleet_id: int, model, key: str, opts: dict,
+                 ckpt_path: Optional[str]):
+        self.id = fleet_id
+        self.model = model
+        self.key = key
+        self.opts = opts  # finish_when/targets/timeout/priority
+        self.ckpt_path = ckpt_path
+        self.status = FleetJobStatus.ROUTED
+        self.replica: Optional[int] = None
+        self.handle = None  # inner JobHandle on the bound replica
+        self.requeues = 0
+        self.steals = 0
+        self.result = None
+        self.error: Optional[str] = None
+        self.event = threading.Event()
+        self.submitted_at = time.monotonic()
+        self.finished_at: Optional[float] = None
+
+
+class FleetJobHandle:
+    """Client-side handle (the fleet twin of api.JobHandle). The handle
+    survives requeues and steals — it tracks the job, not a replica."""
+
+    def __init__(self, router: "FleetRouter", job: FleetJob):
+        self._router = router
+        self._job = job
+
+    @property
+    def id(self) -> int:
+        return self._job.id
+
+    def status(self) -> str:
+        return self._job.status
+
+    def poll(self) -> dict:
+        return self._router.poll(self._job.id)
+
+    def result(self, wait: bool = True, timeout: Optional[float] = None):
+        return self._router.result(self._job.id, wait=wait, timeout=timeout)
+
+    def cancel(self) -> bool:
+        return self._router.cancel(self._job.id)
+
+    def discoveries(self) -> dict:
+        return self._router.discovery_paths(self._job.id)
+
+
+# -- the router ----------------------------------------------------------------
+
+
+class FleetRouter:
+    def __init__(
+        self,
+        replicas,
+        seed: int = 0,
+        retry_limit: int = 2,
+        backoff_base_s: float = 0.02,
+        backoff_cap_s: float = 0.5,
+        probe_timeout_s: float = 1.0,
+        unhealthy_after: int = 3,
+        steal: bool = True,
+        background: bool = False,
+        ckpt_dir: Optional[str] = None,
+        tracer=None,
+    ):
+        """`replicas` are service/fleet.py `Replica` drivers (one
+        CheckService each). `background=True` makes probes run under a
+        deadline thread (a hung replica must not hang the router);
+        foreground mode (deterministic tests) probes inline. `ckpt_dir`
+        enables the requeue-resume plane (per-job checkpoint generations
+        written by the replica drivers, restored here on replica death)."""
+        self.replicas = {r.idx: r for r in replicas}
+        self.ckpt_dir = ckpt_dir
+        self.ring = HashRing(list(self.replicas))
+        self.seed = seed
+        self.retry_limit = retry_limit
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self.probe_timeout_s = probe_timeout_s
+        self.unhealthy_after = unhealthy_after
+        self.steal = steal
+        self.background = background
+        self._tracer = as_tracer(tracer)
+        self._jobs: dict[int, FleetJob] = {}
+        self._next_id = 1
+        self._lock = threading.RLock()
+        self._suspect: dict[int, int] = {r: 0 for r in self.replicas}
+        self._dead: set = set()
+        self.counters = {
+            "jobs_routed": 0,
+            "router_retries": 0,
+            "router_backoff_ms": 0,
+            "probe_failures": 0,
+            "replica_crashes": 0,
+            "requeued_jobs": 0,
+            "restored_jobs": 0,
+            "steals": 0,
+        }
+        self._metrics_name = REGISTRY.register("fleet", self.metrics)
+
+    # -- client surface --------------------------------------------------------
+
+    def submit(
+        self,
+        model,
+        route_key: Optional[str] = None,
+        finish_when: HasDiscoveries = HasDiscoveries.ALL,
+        target_state_count: Optional[int] = None,
+        target_max_depth: Optional[int] = None,
+        timeout: Optional[float] = None,
+        priority: int = 0,
+    ) -> FleetJobHandle:
+        """Route one job onto the fleet; returns immediately. `route_key`
+        defaults to the model's type name — same-key jobs share a replica
+        (and so a compiled step); distinct keys spread over the ring."""
+        if not self._healthy():
+            raise NoHealthyReplica(
+                "every fleet replica is dead; resubmit after recovery"
+            )
+        key = route_key if route_key is not None else type(model).__name__
+        opts = dict(
+            finish_when=finish_when,
+            target_state_count=target_state_count,
+            target_max_depth=target_max_depth,
+            timeout=timeout,
+            priority=priority,
+        )
+        with self._lock:
+            fj = FleetJob(
+                self._next_id, model, key, opts,
+                self._ckpt_path_for(self._next_id),
+            )
+            self._next_id += 1
+            self._jobs[fj.id] = fj
+        self._place(fj)
+        return FleetJobHandle(self, fj)
+
+    def _ckpt_path_for(self, fleet_id: int) -> Optional[str]:
+        if self.ckpt_dir is None:
+            return None
+        import os
+
+        return os.path.join(self.ckpt_dir, f"fleetjob{fleet_id}.npz")
+
+    def poll(self, job_id: int) -> dict:
+        fj = self._get(job_id)
+        with self._lock:
+            out = {
+                "id": fj.id,
+                "status": fj.status,
+                "replica": fj.replica,
+                "requeues": fj.requeues,
+                "steals": fj.steals,
+                "error": fj.error,
+            }
+            if fj.handle is not None:
+                try:
+                    inner = fj.handle.poll()
+                except Exception:  # noqa: BLE001 — a dead replica's poll
+                    inner = None
+                if inner is not None:
+                    for k in (
+                        "state_count", "unique_state_count", "max_depth",
+                        "discoveries",
+                    ):
+                        out[k] = inner.get(k)
+                    out["replica_status"] = inner.get("status")
+            return out
+
+    def result(
+        self, job_id: int, wait: bool = True, timeout: Optional[float] = None
+    ):
+        fj = self._get(job_id)
+        if wait:
+            if not fj.event.wait(timeout):
+                raise TimeoutError(f"fleet job {job_id} still running")
+        elif not fj.event.is_set():
+            return None
+        if fj.status == FleetJobStatus.CANCELLED:
+            # srlint: fault-ok caller-contract guard (cancellation is the caller's own act)
+            raise RuntimeError(f"fleet job {job_id} was cancelled")
+        if fj.status == FleetJobStatus.ERROR:
+            # srlint: fault-ok re-raising a job failure the fleet already absorbed
+            raise RuntimeError(fj.error or f"fleet job {job_id} failed")
+        return fj.result
+
+    def cancel(self, job_id: int) -> bool:
+        fj = self._get(job_id)
+        with self._lock:
+            if fj.status in FleetJobStatus.FINISHED:
+                return False
+            if fj.handle is not None:
+                try:
+                    fj.handle.cancel()
+                except Exception:  # noqa: BLE001 — dead replica: job dies here
+                    pass
+            self._finish(fj, FleetJobStatus.CANCELLED)
+            return True
+
+    def discovery_paths(self, job_id: int) -> dict:
+        fj = self._get(job_id)
+        if fj.handle is None:
+            return {}
+        return fj.handle.discoveries()
+
+    def job_ids(self) -> list:
+        with self._lock:
+            return sorted(self._jobs)
+
+    def _get(self, job_id: int) -> FleetJob:
+        with self._lock:
+            try:
+                return self._jobs[job_id]
+            except KeyError:
+                raise KeyError(f"no such fleet job {job_id}") from None
+
+    # -- placement -------------------------------------------------------------
+
+    def _healthy(self) -> list:
+        return [
+            r for r in self.replicas.values()
+            if r.idx not in self._dead and r.alive
+        ]
+
+    def _spec(self, fj: FleetJob, resume=None) -> dict:
+        return dict(
+            fj.opts,
+            model=fj.model,
+            journal=fj.ckpt_path is not None,
+            resume=resume,
+        )
+
+    def _backoff(self, attempt: int) -> None:
+        base = self.backoff_base_s
+        if base <= 0:
+            return
+        delay = min(base * 2.0 ** attempt, self.backoff_cap_s)
+        delay *= 0.5 + _u01(self.seed, "router.backoff", attempt)
+        with self._lock:
+            self.counters["router_backoff_ms"] += int(delay * 1000)
+        time.sleep(delay)
+
+    def _place(self, fj: FleetJob, resume=None) -> bool:
+        """Bind `fj` to a replica along its ring preference, retrying
+        faults with deterministic backoff. On exhaustion the job is failed
+        (never silently dropped)."""
+        last: Optional[BaseException] = None
+        for attempt in range(self.retry_limit + 1):
+            order = [
+                i for i in self.ring.preference(fj.key)
+                if i not in self._dead and self.replicas[i].alive
+            ]
+            if not order:
+                break
+            r = self.replicas[order[attempt % len(order)]]
+            try:
+                # Chaos-plane boundary: an injected `router.timeout` fires
+                # BEFORE the replica is touched, so the retry is exact.
+                maybe_fault("router.timeout", replica=r.idx, job=fj.id)
+                handle = r.submit(self._spec(fj, resume), fj.ckpt_path)
+            except (FaultError, ReplicaDead) as e:
+                last = e
+                with self._lock:
+                    self.counters["router_retries"] += 1
+                self._tracer.instant(
+                    "router.retry", cat="fleet", job=fj.id, replica=r.idx
+                )
+                self._backoff(attempt)
+                continue
+            with self._lock:
+                if fj.status in FleetJobStatus.FINISHED:
+                    # A cancel raced the (re)placement: reap the copy.
+                    try:
+                        handle.cancel()
+                    except Exception:  # noqa: BLE001 — best-effort reap
+                        pass
+                    return False
+                if r.idx in self._dead or not r.alive:
+                    # The replica died between submit and bind: binding now
+                    # would park the job on a corpse forever (the death
+                    # handler already scanned for orphans and missed this
+                    # still-unbound job). Treat it as a failed attempt.
+                    last = ReplicaDead(
+                        f"replica {r.idx} died during placement"
+                    )
+                    continue
+                fj.replica = r.idx
+                fj.handle = handle
+                self.counters["jobs_routed"] += 1
+            return True
+        with self._lock:
+            if fj.status in FleetJobStatus.FINISHED:
+                return False  # cancelled while no replica would take it
+            fj.error = (
+                f"no healthy replica accepted fleet job {fj.id}"
+                + (f" (last: {type(last).__name__}: {last})" if last else "")
+            )
+            self._finish(fj, FleetJobStatus.ERROR)
+        return False
+
+    def _finish(self, fj: FleetJob, status: str) -> None:
+        fj.status = status
+        fj.finished_at = time.monotonic()
+        fj.event.set()
+
+    # -- supervision tick ------------------------------------------------------
+
+    def tick(self) -> None:
+        """One supervision round: probe health (dead → requeue), harvest
+        finished inner jobs, steal for idle replicas. Driven by the fleet's
+        router thread (background) or `ServiceFleet.pump` (foreground)."""
+        self._probe_all()
+        self._harvest()
+        if self.steal:
+            self._steal()
+
+    def _probe_all(self) -> None:
+        for r in list(self.replicas.values()):
+            if r.idx in self._dead:
+                continue
+            if not r.alive:
+                self._on_replica_death(r)
+                continue
+            ok = self._probe(r)
+            if ok:
+                self._suspect[r.idx] = 0
+                continue
+            self.counters["probe_failures"] += 1
+            self._suspect[r.idx] += 1
+            if self._suspect[r.idx] >= self.unhealthy_after or not r.alive:
+                self._on_replica_death(r)
+
+    def _probe(self, r) -> bool:
+        """True iff the replica answered its status probe in time. In
+        background mode the probe runs under a deadline thread — a hung
+        replica (injected `fleet.replica_hang` or a real wedge) shows up as
+        a timeout, not a hung router."""
+        if not self.background:
+            try:
+                r.probe()
+                return True
+            except Exception:  # noqa: BLE001 — any probe failure counts
+                return False
+        box: list = []
+
+        def work():
+            try:
+                box.append(("ok", r.probe()))
+            except BaseException as e:  # noqa: BLE001 — reported as unhealthy
+                box.append(("err", e))
+
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        t.join(self.probe_timeout_s)
+        return bool(box) and box[0][0] == "ok"
+
+    def _on_replica_death(self, r) -> None:
+        """Remove the replica from the ring and requeue every unfinished
+        job it held — resumed from its newest intact checkpoint generation
+        when one exists, restarted fresh otherwise. Zero lost jobs either
+        way."""
+        with self._lock:
+            if r.idx in self._dead:
+                return
+            self._dead.add(r.idx)
+            orphans = [
+                fj for fj in self._jobs.values()
+                if fj.replica == r.idx
+                and fj.status not in FleetJobStatus.FINISHED
+            ]
+        self.counters["replica_crashes"] += 1
+        self.ring.remove(r.idx)
+        self._tracer.instant(
+            "fleet.replica_dead", cat="fleet", replica=r.idx,
+            orphans=len(orphans),
+        )
+        for fj in orphans:
+            with self._lock:
+                fj.requeues += 1
+                fj.replica = None
+                fj.handle = None
+                self.counters["requeued_jobs"] += 1
+            resume = self._load_resume(fj)
+            if resume is not None:
+                self.counters["restored_jobs"] += 1
+            self._place(fj, resume=resume)
+
+    def _load_resume(self, fj: FleetJob) -> Optional[JobResume]:
+        if fj.ckpt_path is None:
+            return None
+        try:
+            data, src = load_latest(fj.ckpt_path)
+        except (CheckpointCorrupt, FileNotFoundError, OSError):
+            return None  # no intact generation: restart fresh (still exact)
+        self._tracer.instant(
+            "fleet.restore", cat="fleet", job=fj.id, src=src
+        )
+        return JobResume.from_npz(data)
+
+    def _harvest(self) -> None:
+        """Fold finished inner jobs into their fleet jobs. ERROR on a DEAD
+        replica is left alone — the death handler requeues it; ERROR on a
+        live replica (quarantine, bad model) is a real job failure."""
+        with self._lock:
+            open_jobs = [
+                fj for fj in self._jobs.values()
+                if fj.status not in FleetJobStatus.FINISHED
+                and fj.handle is not None
+            ]
+        for fj in open_jobs:
+            inner = fj.handle._job
+            if not inner.event.is_set():
+                continue
+            if fj.replica in self._dead:
+                continue
+            with self._lock:
+                if fj.status in FleetJobStatus.FINISHED:
+                    continue
+                if inner.status == JobStatus.DONE:
+                    fj.result = inner.result
+                    self._finish(fj, FleetJobStatus.DONE)
+                elif inner.status == JobStatus.ERROR:
+                    r = self.replicas.get(fj.replica)
+                    if r is not None and not r.alive:
+                        continue  # death handler will requeue
+                    fj.error = inner.error
+                    self._finish(fj, FleetJobStatus.ERROR)
+                # inner CANCELLED: either our own cancel (already finished)
+                # or a steal withdrawal that rebound the handle first.
+
+    def _steal(self) -> None:
+        """Idle replicas pull still-QUEUED jobs from the most-loaded
+        replica (the `job_market.rs` split_and_push analogue at fleet
+        scale). A queued job has no table state: the move is an atomic
+        withdraw + fresh submit, and the `fleet.steal` fault point fires
+        BEFORE the withdrawal so an injected fault leaves the job exactly
+        where it was."""
+        healthy = sorted(self._healthy(), key=lambda r: r.idx)
+        if len(healthy) < 2:
+            return
+        idle = [r for r in healthy if r.idle()]
+        if not idle:
+            return
+        with self._lock:
+            queued_by_replica: dict[int, list] = {}
+            for fj in self._jobs.values():
+                if (
+                    fj.status in FleetJobStatus.FINISHED
+                    or fj.handle is None
+                    or fj.replica is None
+                ):
+                    continue
+                if fj.handle._job.status == JobStatus.QUEUED:
+                    queued_by_replica.setdefault(fj.replica, []).append(fj)
+        for thief in idle:
+            victims = [
+                (len(v), idx) for idx, v in queued_by_replica.items()
+                if v and idx != thief.idx
+            ]
+            if not victims:
+                return
+            qlen, v_idx = max(victims)
+            victim = self.replicas[v_idx]
+            pool = queued_by_replica[v_idx]
+            want = max(1, qlen // 2)
+            moved = 0
+            # Steal from the BACK of the queue (newest first) — the front
+            # is about to be admitted where it already sits.
+            while pool and moved < want:
+                fj = pool.pop()
+                try:
+                    maybe_fault("fleet.steal", src=v_idx, dst=thief.idx)
+                except FaultError:
+                    return  # injected steal fault: job stays put
+                if not victim.withdraw(fj.handle.id):
+                    continue  # admitted meanwhile: not stealable
+                # A stolen job may itself be a requeue carrying checkpointed
+                # progress (queued on the victim behind max_resident): the
+                # thief must resume from the newest intact generation, not
+                # restart the search (None when no generation exists yet).
+                resume = self._load_resume(fj)
+                try:
+                    handle = thief.submit(
+                        self._spec(fj, resume), fj.ckpt_path
+                    )
+                except (FaultError, ReplicaDead):
+                    # Thief died mid-steal: the job was already withdrawn,
+                    # so place it like any orphan (never lost).
+                    with self._lock:
+                        fj.replica = None
+                        fj.handle = None
+                    self._place(fj, resume=resume)
+                    continue
+                with self._lock:
+                    if fj.status in FleetJobStatus.FINISHED:
+                        # A fleet-level cancel raced the steal: don't leave
+                        # the fresh inner copy running orphaned.
+                        try:
+                            handle.cancel()
+                        except Exception:  # noqa: BLE001 — best-effort reap
+                            pass
+                        continue
+                    fj.replica = thief.idx
+                    fj.handle = handle
+                    fj.steals += 1
+                    self.counters["steals"] += 1
+                self._tracer.instant(
+                    "fleet.steal", cat="fleet", job=fj.id,
+                    src=v_idx, dst=thief.idx,
+                )
+                moved += 1
+
+    # -- reporting -------------------------------------------------------------
+
+    def all_done(self) -> bool:
+        with self._lock:
+            return all(
+                fj.status in FleetJobStatus.FINISHED
+                for fj in self._jobs.values()
+            )
+
+    def stats(self) -> dict:
+        """Fleet-level counters (obs/schema.py FLEET_COUNTER_KEYS) — the
+        router's `/.status` body and `/metrics` source."""
+        with self._lock:
+            by_status: dict[str, int] = {}
+            for fj in self._jobs.values():
+                by_status[fj.status] = by_status.get(fj.status, 0) + 1
+            per_replica = {
+                str(r.idx): r.snapshot_row()
+                for r in self.replicas.values()
+            }
+            return {
+                "replicas": len(self.replicas),
+                "healthy": len(self._healthy()),
+                "jobs": by_status,
+                "queued": sum(
+                    row.get("queued", 0) for row in per_replica.values()
+                ),
+                **self.counters,
+                "per_replica": per_replica,
+            }
+
+    def metrics(self) -> dict:
+        return self.stats()
+
+    def close(self) -> None:
+        REGISTRY.unregister(self._metrics_name)
+
+
+# -- HTTP front door -----------------------------------------------------------
+
+
+def fleet_status_view(router: FleetRouter) -> dict:
+    return {
+        **router.stats(),
+        "job_rows": [router.poll(jid) for jid in router.job_ids()],
+    }
+
+
+def serve_fleet(
+    fleet,
+    address: str = "localhost:3500",
+    registry=None,
+    block: bool = False,
+):
+    """Start the fleet HTTP front door; same handle shape as
+    `serve_service`. `fleet` is a ServiceFleet (or anything exposing
+    `.router`); models are named through the same ModelRegistry."""
+    import json
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from ..explorer.server import ExplorerServer
+    from ..obs import render_prometheus
+    from .server import RETRY_AFTER_S, ModelRegistry
+
+    router = fleet.router
+    reg = registry if registry is not None else ModelRegistry()
+    host, _, port = address.partition(":")
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # quiet by default
+            pass
+
+        def _json(self, obj, code=200, headers=None):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _text(self, body: str, code=200):
+            data = body.encode()
+            self.send_response(code)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _503(self, msg: str) -> None:
+            self._json(
+                {"error": msg}, 503, headers={"Retry-After": RETRY_AFTER_S}
+            )
+
+        def _injected_503(self, method: str) -> bool:
+            try:
+                maybe_fault("service.http", method=method, path=self.path)
+            except FaultError as e:
+                self._503(f"injected fault: {e}")
+                return True
+            return False
+
+        def _job_id(self, suffix: str = "") -> Optional[int]:
+            raw = self.path[len("/jobs/"):]
+            if suffix:
+                if not raw.endswith(suffix):
+                    return None
+                raw = raw[: -len(suffix)]
+            try:
+                return int(raw.strip("/"))
+            except ValueError:
+                return None
+
+        def do_GET(self):
+            if self._injected_503("GET"):
+                return
+            try:
+                if self.path == "/.status":
+                    self._json(fleet_status_view(router))
+                    return
+                if self.path == "/metrics":
+                    self._text(render_prometheus(REGISTRY.collect()))
+                    return
+                if self.path.startswith("/jobs/"):
+                    jid = self._job_id()
+                    if jid is not None:
+                        self._json(router.poll(jid))
+                        return
+                self._json({"error": "not found"}, 404)
+            except KeyError as e:
+                self._json({"error": str(e)}, 404)
+
+        def do_POST(self):
+            if self._injected_503("POST"):
+                return
+            try:
+                if self.path == "/jobs":
+                    n = int(self.headers.get("Content-Length") or 0)
+                    try:
+                        payload = json.loads(self.rfile.read(n) or b"{}")
+                    except ValueError:
+                        self._json({"error": "bad JSON body"}, 400)
+                        return
+                    if "model" not in payload:
+                        self._json({"error": "missing 'model'"}, 400)
+                        return
+                    name = payload["model"]
+                    args = dict(payload.get("args") or {})
+                    opts = dict(payload.get("opts") or {})
+                    fw = opts.pop("finish_when", None)
+                    if fw is not None:
+                        opts["finish_when"] = {
+                            "all": HasDiscoveries.ALL,
+                            "any": HasDiscoveries.ANY,
+                            "all_failures": HasDiscoveries.ALL_FAILURES,
+                            "any_failures": HasDiscoveries.ANY_FAILURES,
+                        }[fw]
+                    model = reg.get(name, args)
+                    # Stable HTTP route key: registry name + args, so
+                    # same-config submissions share a replica's compiled
+                    # step across unrelated clients.
+                    key = name + "".join(
+                        f":{k}={v}" for k, v in sorted(args.items())
+                    )
+                    try:
+                        h = router.submit(model, route_key=key, **opts)
+                    except NoHealthyReplica as e:
+                        self._503(str(e))
+                        return
+                    self._json({"job": h.id})
+                    return
+                if self.path.startswith("/jobs/") and self.path.endswith(
+                    "/cancel"
+                ):
+                    jid = self._job_id("/cancel")
+                    if jid is not None:
+                        self._json({"cancelled": router.cancel(jid)})
+                        return
+                self._json({"error": "not found"}, 404)
+            except KeyError as e:
+                self._json({"error": str(e)}, 404)
+            except Exception as e:  # noqa: BLE001 — bad submits must not kill
+                self._json({"error": f"{type(e).__name__}: {e}"}, 400)
+
+    httpd = ThreadingHTTPServer(
+        (host or "localhost", int(port or 3500)), Handler
+    )
+    if block:
+        server = ExplorerServer(httpd, fleet, None)
+        try:
+            httpd.serve_forever()
+        finally:
+            httpd.server_close()
+        return server
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    return ExplorerServer(httpd, fleet, thread)

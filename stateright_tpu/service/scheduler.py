@@ -284,7 +284,11 @@ class ServiceEngine:
     def admit(self, job: Job) -> Optional[Job]:
         """Seed a job's init states into the shared table (salted) and hand
         its frontier to the scheduler. Returns the job if it finished
-        immediately (vacuous finish policy / empty space), else None."""
+        immediately (vacuous finish policy / empty space), else None. A job
+        carrying a `resume` payload (fleet requeue after a replica death)
+        is re-seeded from its journal instead of its init states."""
+        if job.resume is not None:
+            return self._admit_resumed(job)
         g = self.group_of(job)
         model = job.model
         props = g.props
@@ -332,9 +336,78 @@ class ServiceEngine:
             init, init_lo, init_hi, ebits0,
             np.ones(n0, dtype=np.uint32),
         )
+        job.journal_append(
+            init_lo, init_hi,
+            np.zeros(n0, np.uint32), np.zeros(n0, np.uint32),
+        )
         g.jobs.append(job)
         if job.pending_lanes == 0:
             return job  # empty reachable space: complete immediately
+        return None
+
+    def _admit_resumed(self, job: Job) -> Optional[Job]:
+        """Fleet requeue admission: re-seed the job's ENTIRE visited set
+        (the checkpointed journal, re-salted with THIS job's salt, parent
+        chains intact) into the shared table, then restore the pending
+        frontier at its exact pop order. From here the normal step path
+        continues the search bit-identically to an uninterrupted run — the
+        restored table deduplicates exactly what the dead replica's did,
+        and restored discoveries are never re-scanned."""
+        g = self.group_of(job)
+        rz = job.resume
+        job.state_count = rz.state_count
+        job.max_depth = rz.max_depth
+        job.discoveries = dict(rz.discoveries)
+        K = self.batch_size
+        j_lo, j_hi, jp_lo, jp_hi = (np.asarray(a) for a in rz.journal)
+        n_j = len(j_lo)
+        slo, shi = salt_fp(j_lo, j_hi, job.salt_lo, job.salt_hi)
+        # Parent 0 is the root sentinel: it must survive salting as 0 or
+        # reconstruct_path's chain walk would never terminate. Real parent
+        # fingerprints never have lo == 0 (the sentinel contract).
+        root = (jp_lo == 0) & (jp_hi == 0)
+        plo, phi = salt_fp(jp_lo, jp_hi, job.salt_lo, job.salt_hi)
+        plo = np.where(root, np.uint32(0), plo).astype(np.uint32)
+        phi = np.where(root, np.uint32(0), phi).astype(np.uint32)
+        for b0 in range(0, n_j, K):
+            sl = slice(b0, min(b0 + K, n_j))
+            n = sl.stop - sl.start
+            lo_pad = np.zeros(K, dtype=np.uint32)
+            hi_pad = np.zeros(K, dtype=np.uint32)
+            plo_pad = np.zeros(K, dtype=np.uint32)
+            phi_pad = np.zeros(K, dtype=np.uint32)
+            lo_pad[:n] = slo[sl]
+            hi_pad[:n] = shi[sl]
+            plo_pad[:n] = plo[sl]
+            phi_pad[:n] = phi[sl]
+            res = self.table.insert(
+                jnp.asarray(lo_pad),
+                jnp.asarray(hi_pad),
+                jnp.asarray(plo_pad),
+                jnp.asarray(phi_pad),
+                jnp.asarray(np.arange(K) < n),
+            )
+            if bool(res.overflow):
+                self._fail_all("shared hash table full; raise table_log2")
+                raise ServiceError("shared hash table full; raise table_log2")
+            self.hot_claims += int(np.asarray(res.is_new).sum())
+        self._table_stamp += 1
+        # Counters continue from the checkpoint (the journal rows are
+        # distinct by construction, so the insert claims agree).
+        job.unique_count = rz.unique_count
+        job.journal = [(j_lo, j_hi, jp_lo, jp_hi)] if n_j else []
+        for chunk in rz.chunks:
+            job.push(*chunk)
+        job.resume = None
+        props = g.props
+        if not props or job.finish_when.matches(
+            props, set(job.discoveries)
+        ):
+            job.early_exit = True
+            return job  # finish policy was already satisfied at crash time
+        g.jobs.append(job)
+        if job.pending_lanes == 0:
+            return job  # frontier was already exhausted at checkpoint time
         return None
 
     def retire(self, job: Job) -> None:
@@ -616,6 +689,9 @@ class ServiceEngine:
                     ebits[pr] if P else np.zeros((n_j, 0), dtype=bool),
                     depth[pr] + 1,
                 )
+                # Fleet requeue journal: the claimed (fp, parent fp) pairs,
+                # unsalted — all four arrays are already host-side.
+                job.journal_append(o_lo[rows], o_hi[rows], lo[pr], hi[pr])
 
         # -- spill eviction (tiered) -------------------------------------------
         if self._store is not None and self.hot_claims >= self._spill_trigger:

@@ -35,6 +35,11 @@ from ..faults.plan import FaultError, maybe_fault
 from ..obs import REGISTRY, render_prometheus
 from .api import CheckService
 
+#: `Retry-After` seconds on every 503 this plane emits (injected faults,
+#: router overload) — deterministic, so load clients back off identically
+#: run to run instead of hot-looping.
+RETRY_AFTER_S = "1"
+
 
 def default_registry() -> dict:
     """Name -> model-builder callables for the bundled tensor workloads.
@@ -162,11 +167,13 @@ def serve_service(
         def log_message(self, fmt, *args):  # quiet by default
             pass
 
-        def _json(self, obj, code=200):
+        def _json(self, obj, code=200, headers=None):
             body = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json; charset=utf-8")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -195,11 +202,16 @@ def serve_service(
             """Chaos-plane boundary for the HTTP plane: an injected
             `service.http` fault degrades to a 503 (the retryable status
             clients already understand) instead of crashing the handler —
-            the front end must stay up through its own faults."""
+            the front end must stay up through its own faults. The
+            `Retry-After` header is what lets the fleet router and load
+            clients back off deterministically instead of hot-looping."""
             try:
                 maybe_fault("service.http", method=method, path=self.path)
             except FaultError as e:
-                self._json({"error": f"injected fault: {e}"}, 503)
+                self._json(
+                    {"error": f"injected fault: {e}"}, 503,
+                    headers={"Retry-After": RETRY_AFTER_S},
+                )
                 return True
             return False
 

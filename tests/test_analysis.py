@@ -380,6 +380,26 @@ def test_cli_lint_only_exits_zero():
     assert main(["--skip-audit", "--skip-tools"]) == 0
 
 
+def test_cli_shell_skip_audit_exits_zero():
+    # The CI gate as CI actually invokes it: shell the module entry point
+    # itself. This is what keeps every new raise surface (the fleet router
+    # and replica drivers included) SR004-gated at TEST time — off-plane
+    # failure surfaces fail this test, not a by-hand CLI run three rounds
+    # later.
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "stateright_tpu.analysis",
+            "--skip-audit", "--skip-tools",
+        ],
+        cwd=str(ROOT), capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "analysis: clean" in proc.stdout
+
+
 def test_cli_lint_only_never_imports_jax():
     # The jax-free contract behind --skip-audit: srlint AND the knob-drift
     # pass must run without jax (check_registry skips only the engine

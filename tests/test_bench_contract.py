@@ -202,6 +202,53 @@ def test_device_detail_pins_pallas_row_keys():
     assert row["pallas_vs_capped"] == 1.04
 
 
+def test_device_detail_pins_fleet_row_keys():
+    # The BENCH_FLEET=1 scale-out A/B row is part of the artifact
+    # contract: N-replica jobs/s, the vs-one-replica ratio, the p50/p99
+    # submit→result latency digest, and the robustness counters (steals,
+    # requeues) must survive into detail.device so the ROADMAP-item-1
+    # "N beats 1, zero lost jobs" claim is auditable in every BENCH_r*.json.
+    for key in (
+        "n_replicas", "fleet_jobs_per_sec", "sec_one_replica",
+        "vs_one_replica", "fleet_p50_ms", "fleet_p99_ms",
+        "fleet_steals", "fleet_requeued",
+    ):
+        assert key in bench.DEVICE_DETAIL_FIELDS
+    row = bench.device_detail(
+        {
+            "states_per_sec": 3100.0,
+            "sec": 9.1,
+            "n_replicas": 3,
+            "fleet_jobs_per_sec": 0.88,
+            "sec_one_replica": 14.2,
+            "vs_one_replica": 1.56,
+            "fleet_p50_ms": 4100.0,
+            "fleet_p99_ms": 8900.0,
+            "fleet_steals": 2,
+            "fleet_requeued": 0,
+        }
+    )
+    assert row["n_replicas"] == 3
+    assert row["vs_one_replica"] == 1.56
+    assert row["fleet_p99_ms"] == 8900.0
+
+
+def test_fleet_counter_keys_conform_to_obs_schema():
+    # The fleet router's stats() vocabulary (its `/.status` body and the
+    # "fleet" /metrics source) is the documented obs schema's — renames
+    # break this pin, not a dashboard three rounds later. A replica-less
+    # router is enough to pin the shape without compiling anything.
+    from stateright_tpu.obs.schema import FLEET_COUNTER_KEYS, REGISTRY_SOURCES
+    from stateright_tpu.service.router import FleetRouter
+
+    assert "fleet" in REGISTRY_SOURCES
+    router = FleetRouter([])
+    try:
+        assert set(router.stats()) == set(FLEET_COUNTER_KEYS)
+    finally:
+        router.close()
+
+
 def test_analysis_row_pins_budget_keys():
     # The BENCH_ANALYSIS=1 static-analysis budget row is part of the
     # artifact contract: srlint finding count, knob-registry drift, and

@@ -158,6 +158,37 @@ def test_ckpt_write_torn_injection_consumed_by_writer(tmp_path):
     assert data["x"].sum() == 3
 
 
+def test_cross_process_load_latest_mid_write_falls_back_to_prev(tmp_path):
+    # The fleet requeue sequence, exactly: replica A dies mid-checkpoint
+    # (current generation torn, a torn `.tmp` left behind), and a SECOND
+    # process — the router placing the job on replica B — calls
+    # `load_latest` on the path. It must serve `.prev` (the last verified
+    # generation), unaffected by process-local state like the
+    # _WRITTEN_INTACT rotation cache.
+    import subprocess
+    import sys
+
+    path = str(tmp_path / "fleetjob1.npz")
+    atomic_savez(path, {"gen": np.asarray([1])})  # verified generation
+    atomic_savez(path, {"gen": np.asarray([2])})  # gen 1 rotates to .prev
+    _corrupt_file(path, seed=0)  # gen 2 torn mid-write
+    with open(path + ".tmp", "wb") as f:  # srlint: ckpt-ok simulated torn tmp fixture, not a checkpoint write
+        f.write(b"torn half-written next generation")
+    code = (
+        "import sys\n"
+        "from stateright_tpu.faults.ckptio import load_latest\n"
+        f"data, src = load_latest({path!r})\n"
+        f"assert src == {path + '.prev'!r}, src\n"
+        "assert int(data['gen'][0]) == 1, data['gen']\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
 def test_frontier_checkpoint_torn_file_falls_back_to_prev(tmp_path):
     # The satellite bugfix pin: a partial write must not poison resume.
     ck = str(tmp_path / "f.npz")

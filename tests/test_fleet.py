@@ -1,0 +1,349 @@
+"""Service fleet: router front door, replica failure -> requeue,
+cross-replica work stealing (stateright_tpu/service/{router,fleet}.py).
+
+The contract under test is FAULT-TOLERANT SCALE-OUT: N CheckService
+replicas behind a consistent-hash router complete a mixed concurrent job
+set with results bit-identical to the single-replica goldens — through a
+replica crash mid-run (requeue-resume from the r10 checkpoint plane, zero
+lost jobs), through router submission faults (bounded deterministic
+retry), and through load imbalance (idle replicas steal queued jobs, the
+TPU analogue of the reference's job_market.rs).
+
+Tests drive foreground fleets (pump()/drain(), no threads) wherever
+determinism matters; the hang-probe test uses background mode because a
+probe deadline IS a threading claim. All anchors are 2pc-3-scale and all
+polling uses tight deadlines — no sleeps (tier-1 budget).
+"""
+
+import numpy as np
+import pytest
+
+from stateright_tpu.faults import FaultPlan, active
+from stateright_tpu.service import ServiceFleet
+from stateright_tpu.service.router import HashRing
+from stateright_tpu.tensor.models import (
+    TensorIncrementLock,
+    TensorTwoPhaseSys,
+)
+
+GOLD_2PC3 = (1_146, 288)
+GOLD_INCLOCK4 = (257, 257)
+
+# Module-level model instances: same-instance jobs share one compiled step
+# per replica (the service's continuous-batching contract, unchanged).
+M3 = TensorTwoPhaseSys(3)
+MI = TensorIncrementLock(4)
+
+SVC_KW = dict(batch_size=128, table_log2=14)
+
+
+# -- consistent hashing (no jax) -----------------------------------------------
+
+
+def test_hash_ring_moves_only_the_dead_members_keys():
+    ring = HashRing([0, 1, 2])
+    keys = [f"model-{i}" for i in range(200)]
+    before = {k: ring.lookup(k) for k in keys}
+    assert set(before.values()) == {0, 1, 2}  # vnodes spread the keyspace
+    ring.remove(1)
+    after = {k: ring.lookup(k) for k in keys}
+    for k in keys:
+        if before[k] != 1:
+            # The consistent-hashing promise: survivors keep their keys.
+            assert after[k] == before[k]
+        else:
+            assert after[k] in (0, 2)
+
+
+def test_hash_ring_preference_starts_at_owner_and_covers_all():
+    ring = HashRing([0, 1, 2])
+    for k in ("a", "b", "c", "paxos-2"):
+        pref = ring.preference(k)
+        assert pref[0] == ring.lookup(k)
+        assert sorted(pref) == [0, 1, 2]
+
+
+# -- queue requeue invariant (satellite: the r10 lane-unwind pin) --------------
+
+
+def _mk_job(n0=10, journal=False):
+    from stateright_tpu.service.queue import Job
+
+    class _M:
+        lanes = 2
+
+    job = Job(1, _M(), journal=journal)
+    states = np.arange(n0 * 2, dtype=np.uint32).reshape(n0, 2)
+    lo = np.arange(1, n0 + 1, dtype=np.uint32)
+    hi = np.arange(100, 100 + n0, dtype=np.uint32)
+    ebits = np.zeros((n0, 1), dtype=bool)
+    depth = np.ones(n0, dtype=np.uint32)
+    return job, (states, lo, hi, ebits, depth)
+
+
+def test_requeued_lanes_pop_exactly_once_in_original_order():
+    # The fleet requeue path reuses the r10 lane-unwind invariant: lanes a
+    # faulted step took are push_front'ed and the retry pops the IDENTICAL
+    # lanes in the IDENTICAL order — each lane runs exactly once.
+    job, (states, lo, hi, ebits, depth) = _mk_job(10)
+    job.push(states[:6], lo[:6], hi[:6], ebits[:6], depth[:6])
+    job.push(states[6:], lo[6:], hi[6:], ebits[6:], depth[6:])
+    # A step takes 4 lanes, faults, and unwinds them to the FRONT.
+    t_states, t_lo, t_hi, t_eb, t_dp = job.take(4)
+    assert list(t_lo) == [1, 2, 3, 4]
+    job.push_front(t_states, t_lo, t_hi, t_eb, t_dp)
+    # The retry (and every pop after it) sees the original global order —
+    # each fingerprint exactly once, no lane lost, no lane doubled.
+    popped = []
+    while job.pending_lanes:
+        _, p_lo, _, _, _ = job.take(3)
+        popped.extend(int(x) for x in p_lo)
+    assert popped == list(range(1, 11))
+
+
+def test_admission_queue_priority_order_survives_requeue():
+    from stateright_tpu.service.queue import AdmissionQueue, Job
+
+    class _M:
+        lanes = 1
+
+    q = AdmissionQueue()
+    lowa = Job(1, _M(), priority=0)
+    high = Job(2, _M(), priority=5)
+    lowb = Job(3, _M(), priority=0)
+    for j in (lowa, high, lowb):
+        q.push(j)
+    first = q.pop_next()
+    assert first is high
+    # Requeue (replica failure / steal / preemption): re-enters BEHIND
+    # queued peers of the same priority, ahead of lower priorities.
+    high2 = Job(4, _M(), priority=5)
+    q.push(high2)
+    q.push(high)
+    assert [q.pop_next().id for _ in range(4)] == [4, 2, 1, 3]
+
+
+# -- the acceptance bar: replica crash mid-run, zero lost jobs -----------------
+
+
+def test_replica_crash_mid_run_zero_lost_jobs_bit_identical():
+    fleet = ServiceFleet(
+        n_replicas=3, background=False, service_kwargs=SVC_KW
+    )
+    try:
+        handles = [fleet.submit(m) for m in (M3, M3, MI, M3, MI)]
+        in_use = sorted({h._job.replica for h in handles})
+        victim = in_use[0]
+        # Let some progress + checkpoint generations accumulate, then kill
+        # the busiest-seeded replica through the chaos plane.
+        plan = FaultPlan().rule(
+            "fleet.replica_crash", "crash", after=6,
+            match={"replica": victim},
+        )
+        with active(plan):
+            fleet.drain(timeout=600)
+        assert plan.injected_total() == 1
+        gold = {id(M3): GOLD_2PC3, id(MI): GOLD_INCLOCK4}
+        for h in handles:
+            r = h.result()  # zero lost jobs: every handle resolves
+            assert r.complete
+            assert (r.state_count, r.unique_state_count) == gold[
+                id(h._job.model)
+            ]
+        # Same-model results bit-identical to each other (and the counts
+        # above ARE the single-replica goldens test_service.py pins).
+        m3_results = [
+            h.result() for h in handles if h._job.model is M3
+        ]
+        for r in m3_results[1:]:
+            assert r.discoveries == m3_results[0].discoveries
+            assert r.max_depth == m3_results[0].max_depth
+        s = fleet.stats()
+        assert s["replica_crashes"] == 1
+        assert s["healthy"] == 2
+        assert s["requeued_jobs"] >= 1  # the victim really held jobs
+        # At least one requeued job resumed from an intact checkpoint
+        # generation instead of restarting (the ckptio plane engaged).
+        assert s["restored_jobs"] >= 1
+        requeued = [h for h in handles if h._job.requeues]
+        assert requeued and all(
+            h._job.replica != victim for h in requeued
+        )
+    finally:
+        fleet.close()
+
+
+# -- shared foreground fleet (steal / retry / resume-impossible paths) ---------
+
+
+@pytest.fixture(scope="module")
+def fleet2():
+    f = ServiceFleet(
+        n_replicas=2, background=False, max_resident=1,
+        service_kwargs=SVC_KW,
+    )
+    yield f
+    f.close()
+
+
+def test_idle_replica_steals_queued_jobs(fleet2):
+    # Same route key -> every job hashes to ONE replica; max_resident=1
+    # leaves the rest QUEUED there, and the idle replica must pull them.
+    handles = [fleet2.submit(M3) for _ in range(4)]
+    owners = {h._job.replica for h in handles}
+    assert len(owners) == 1  # consistent hashing: one owner for one key
+    fleet2.drain(timeout=600)
+    for h in handles:
+        r = h.result()
+        assert (r.state_count, r.unique_state_count) == GOLD_2PC3
+    s = fleet2.stats()
+    assert s["steals"] >= 1
+    assert len({h._job.replica for h in handles}) == 2  # both replicas ran
+    # The stolen jobs' results are bit-identical to the stay-home jobs'.
+    first = handles[0].result()
+    for h in handles[1:]:
+        assert h.result().discoveries == first.discoveries
+
+
+def test_router_timeout_retries_with_deterministic_backoff(fleet2):
+    before = fleet2.stats()["router_retries"]
+    plan = FaultPlan().rule("router.timeout", "io", times=1)
+    with active(plan):
+        h = fleet2.submit(M3)
+    fleet2.drain(timeout=600)
+    r = h.result()
+    assert (r.state_count, r.unique_state_count) == GOLD_2PC3
+    assert fleet2.stats()["router_retries"] == before + 1
+    assert plan.injected_total() == 1
+
+
+def test_steal_fault_leaves_job_where_it_was(fleet2):
+    # `fleet.steal` fires BEFORE the withdrawal: an injected fault there
+    # must abort the steal and lose nothing.
+    before = fleet2.stats()["steals"]
+    plan = FaultPlan().rule("fleet.steal", "io", times=-1)
+    handles = [fleet2.submit(M3) for _ in range(3)]
+    with active(plan):
+        fleet2.drain(timeout=600)
+    for h in handles:
+        r = h.result()
+        assert (r.state_count, r.unique_state_count) == GOLD_2PC3
+    assert plan.injected_total() >= 1
+    assert fleet2.stats()["steals"] == before  # no steal went through
+
+
+# -- hang probes (background mode: a probe deadline IS a thread claim) ---------
+
+
+def test_hung_replica_detected_and_jobs_requeued():
+    # Probe deadline well under the hang gate but generous enough that a
+    # LOADED host can't starve the healthy replica's (trivial, lock-free)
+    # probe past it — this test must detect the hang, not the scheduler.
+    fleet = ServiceFleet(
+        n_replicas=2, background=True, service_kwargs=SVC_KW,
+        router_kwargs=dict(probe_timeout_s=0.3, unhealthy_after=3),
+    )
+    try:
+        handles = [fleet.submit(M3) for _ in range(2)]
+        victim = handles[0]._job.replica
+        plan = FaultPlan(hang_limit_s=2.0).rule(
+            "fleet.replica_hang", "hang", times=-1,
+            match={"replica": victim},
+        )
+        with active(plan):
+            fleet.drain(timeout=600)
+        for h in handles:
+            r = h.result()
+            assert (r.state_count, r.unique_state_count) == GOLD_2PC3
+            assert h._job.replica != victim
+        s = fleet.stats()
+        assert s["probe_failures"] >= 3
+        assert s["replica_crashes"] >= 1
+        assert victim in fleet.router._dead  # the HUNG one was declared dead
+    finally:
+        fleet.close()
+
+
+# -- HTTP front door -----------------------------------------------------------
+
+
+def test_fleet_http_front_door_and_retry_after(fleet2):
+    import json
+    import urllib.error
+    import urllib.request
+
+    from stateright_tpu.service import serve_fleet
+    from stateright_tpu.service.server import ModelRegistry
+
+    srv = serve_fleet(
+        fleet2, address="localhost:0",
+        registry=ModelRegistry({"2pc3": lambda: M3}),
+    )
+    try:
+        base = "http://" + srv.address
+
+        def get(p):
+            return json.loads(
+                urllib.request.urlopen(base + p, timeout=10).read()
+            )
+
+        # Injected HTTP fault: 503 WITH a Retry-After header (satellite:
+        # clients back off deterministically instead of hot-looping).
+        plan = FaultPlan().rule("service.http", "http", times=1)
+        with active(plan):
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(base + "/.status", timeout=10)
+            assert exc.value.code == 503
+            assert exc.value.headers.get("Retry-After") == "1"
+
+        req = urllib.request.Request(
+            base + "/jobs",
+            data=json.dumps({"model": "2pc3"}).encode(),
+            method="POST",
+        )
+        jid = json.loads(urllib.request.urlopen(req, timeout=10).read())["job"]
+        fleet2.drain(timeout=600)
+        p = get(f"/jobs/{jid}")
+        assert p["status"] == "done"
+        assert (p["state_count"], p["unique_state_count"]) == GOLD_2PC3
+        st = get("/.status")
+        assert st["healthy"] == 2
+        assert any(row["id"] == jid for row in st["job_rows"])
+        text = urllib.request.urlopen(base + "/metrics", timeout=10).read()
+        assert b"stateright_fleet_healthy 2" in text
+    finally:
+        srv.shutdown()
+
+
+# -- service.http 503 on the single-service front end carries Retry-After ------
+
+
+def test_service_503_carries_retry_after():
+    from stateright_tpu.service import CheckService, serve_service
+    import urllib.error
+    import urllib.request
+
+    svc = CheckService(batch_size=64, table_log2=12, background=False)
+    server = serve_service(svc, address="localhost:0")
+    try:
+        plan = FaultPlan().rule("service.http", "http", times=1)
+        with active(plan):
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(
+                    "http://" + server.address + "/.status", timeout=10
+                )
+        assert exc.value.code == 503
+        assert exc.value.headers.get("Retry-After") == "1"
+    finally:
+        server.shutdown()
+        svc.close()
+
+
+# -- schema pins ---------------------------------------------------------------
+
+
+def test_fleet_stats_conform_to_obs_schema(fleet2):
+    from stateright_tpu.obs.schema import FLEET_COUNTER_KEYS
+
+    s = fleet2.stats()
+    assert set(s) == set(FLEET_COUNTER_KEYS)
