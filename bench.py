@@ -556,6 +556,79 @@ def device_search_obs(model_name: str, n: int):
     return out, perr
 
 
+def device_search_journal(model_name: str, n: int):
+    """BENCH_OBS=1 journal sub-row: the anchor workload through a
+    foreground CheckService twice — flight recorder OFF then ON
+    (events_out= JSONL journal, obs/events.py) — pricing the journal's
+    per-step emit + bounded-flush cost on the service path where it
+    actually runs (acceptance: <= 5%, expected within noise: one dict +
+    one buffered JSON line per fused step and per job transition).
+    Cold-vs-cold like the faults row: each side builds a fresh service
+    (fresh jit closures), best-of-2. Returns (result dict for the
+    journal-ON run plus `sec_journal_off`, `journal_overhead_pct`, and
+    the recorded `journal_events` count, parity error or None)."""
+    _pin_platform()
+    import tempfile
+
+    from stateright_tpu.obs.events import read_journal
+    from stateright_tpu.service import CheckService
+
+    model, batch, table_log2, run_kwargs, engine_kwargs, golden, closure_s = (
+        _build_workload(model_name, n)
+    )
+    svc_kw = {
+        k: v for k, v in engine_kwargs.items()
+        if k in ("store", "high_water", "summary_log2")
+    }
+    runs = {}
+    journal_events = 0
+    with tempfile.TemporaryDirectory(prefix="srtpu-bench-journal-") as td:
+        for journal in (False, True):
+            best, best_sec = None, None
+            for rep in range(2):
+                jpath = os.path.join(td, f"j{rep}.jsonl")
+                extra = {"events_out": jpath} if journal else {}
+                svc = CheckService(
+                    batch_size=batch, table_log2=table_log2,
+                    background=False, **svc_kw, **extra,
+                )
+                try:
+                    t0 = time.monotonic()
+                    h = svc.submit(model, **{
+                        k: v for k, v in run_kwargs.items()
+                        if k in ("target_state_count", "target_max_depth")
+                    })
+                    svc.drain()
+                    r = h.result()
+                    sec = time.monotonic() - t0
+                finally:
+                    svc.close()
+                if best_sec is None or sec < best_sec:
+                    best, best_sec = r, sec
+                if journal:
+                    journal_events = max(
+                        journal_events, len(read_journal(jpath))
+                    )
+            runs[journal] = (best, best_sec)
+    best_on, sec_on = runs[True]
+    sec_off = runs[False][1]
+    out = {
+        "states": best_on.state_count,
+        "unique": best_on.unique_state_count,
+        "sec": round(sec_on, 4),
+        "states_per_sec": best_on.state_count / max(sec_on, 1e-9),
+        "sec_journal_off": round(sec_off, 4),
+        "journal_overhead_pct": round(
+            100.0 * (sec_on - sec_off) / max(sec_off, 1e-9), 2
+        ),
+        "journal_events": journal_events,
+    }
+    perr = _parity_err(model_name, n, best_on, golden) or _parity_err(
+        model_name, n, runs[False][0], golden
+    )
+    return out, perr
+
+
 def device_search_pallas(model_name: str, n: int):
     """BENCH_PALLAS=1 row: the anchor workload run twice on the resident
     engine — insert_variant="capped" (the r6 winner) then "pallas" (the
@@ -988,6 +1061,11 @@ DEVICE_DETAIL_FIELDS = (
     # the run, and — on the BENCH_OBS=1 A/B row — the telemetry-off wall
     # time plus the measured on-vs-off overhead (acceptance: <= 2%).
     "telemetry", "sec_off", "telemetry_overhead_pct",
+    # Flight recorder (obs/events.py, BENCH_OBS=1 journal sub-row): the
+    # journal-off wall time, the measured journal-on overhead through the
+    # check service (acceptance: <= 5%), and how many events the run
+    # recorded.
+    "sec_journal_off", "journal_overhead_pct", "journal_events",
     # Chaos plane / supervisor (BENCH_FAULTS=1 A/B row): the recovery
     # digest plus the unsupervised wall time and the measured supervisor
     # overhead with injection disabled (expected within noise).
@@ -1201,6 +1279,13 @@ def main(argv: list | None = None) -> int:
         # detail.device["paxos-3-obs"].telemetry_overhead_pct.
         if os.environ.get("BENCH_OBS") == "1" and not smoke:
             workloads += (("paxos", 3, 2400.0, "--worker-obs", None),)
+            # ...and the flight-recorder journal on/off A/B on the 2pc-4
+            # anchor THROUGH the check service (where the journal actually
+            # emits: one event per fused step + job transitions; the
+            # measured overhead lands in
+            # detail.device["2pc-4-journal"].journal_overhead_pct,
+            # acceptance <= 5%).
+            workloads += (("2pc", 4, 2400.0, "--worker-journal", None),)
         # BENCH_FAULTS=1: add the supervisor-overhead A/B on the 2pc-4
         # anchor (plain resident vs run_supervised with injection off; the
         # measured overhead lands in
@@ -1228,6 +1313,7 @@ def main(argv: list | None = None) -> int:
                 {
                     "--worker-sharded": "-sharded8",
                     "--worker-obs": "-obs",
+                    "--worker-journal": "-journal",
                     "--worker-faults": "-faults",
                     "--worker-pallas": "-pallas",
                     "--worker-fleet": "",
@@ -1312,6 +1398,8 @@ def worker_main(model_name: str, n: int, mode: str = "--worker") -> int:
             r, perr = device_search_sharded(model_name, n)
         elif mode == "--worker-obs":
             r, perr = device_search_obs(model_name, n)
+        elif mode == "--worker-journal":
+            r, perr = device_search_journal(model_name, n)
         elif mode == "--worker-faults":
             r, perr = device_search_faults(model_name, n)
         elif mode == "--worker-pallas":
@@ -1330,7 +1418,8 @@ def worker_main(model_name: str, n: int, mode: str = "--worker") -> int:
 if __name__ == "__main__":
     if len(sys.argv) == 4 and sys.argv[1] in (
         "--worker", "--worker-sharded", "--worker-service", "--worker-obs",
-        "--worker-faults", "--worker-pallas", "--worker-fleet",
+        "--worker-journal", "--worker-faults", "--worker-pallas",
+        "--worker-fleet",
     ):
         sys.exit(worker_main(sys.argv[2], int(sys.argv[3]), mode=sys.argv[1]))
     if len(sys.argv) == 2 and sys.argv[1] == "--worker-analysis":

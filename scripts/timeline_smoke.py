@@ -1,0 +1,112 @@
+"""Flight-recorder smoke: chaos fleet run -> journals -> timeline CLI ->
+anomaly-free verdict.
+
+The end-to-end the observability plane promises (ISSUE 9): an N=3
+foreground fleet runs a mixed job set through an injected mid-load
+replica crash AND a work steal with the recorder attached
+(`journal_dir=` + a flushing Tracer); then the forensic CLI
+(`python -m stateright_tpu.obs.timeline`) must reconstruct every job's
+full lifecycle from the journals alone — zero anomalies, event counts
+consistent with the fleet counters, and a Perfetto-loadable merged
+Chrome trace. Exercises BOTH the in-process API and the installed
+console entry (a subprocess run of the module), so the CLI contract
+itself is smoked, not just the library.
+
+    JAX_PLATFORMS=cpu python scripts/timeline_smoke.py
+
+Exit 0 = recorded, reconstructed, clean. Anything else is a regression.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from stateright_tpu.faults import FaultPlan, active
+    from stateright_tpu.obs import Tracer
+    from stateright_tpu.obs import timeline as tl
+    from stateright_tpu.service import ServiceFleet
+    from stateright_tpu.tensor.models import (
+        TensorIncrementLock,
+        TensorTwoPhaseSys,
+    )
+
+    td = tempfile.mkdtemp(prefix="srtpu-timeline-smoke-")
+    journal_dir = os.path.join(td, "journal")
+    trace_path = os.path.join(td, "trace.json")
+    m3, mi = TensorTwoPhaseSys(3), TensorIncrementLock(4)
+
+    print("== chaos fleet run (N=3, crash + steal, recorder on) ==")
+    tracer = Tracer(out=trace_path, flush_every=20)
+    fleet = ServiceFleet(
+        n_replicas=3, background=False, max_resident=1,
+        service_kwargs=dict(batch_size=128, table_log2=14),
+        journal_dir=journal_dir, tracer=tracer,
+    )
+    handles = [fleet.submit(m) for m in (m3, m3, mi, m3, mi)]
+    victim = sorted({h._job.replica for h in handles})[0]
+    plan = FaultPlan().rule(
+        "fleet.replica_crash", "crash", after=6, match={"replica": victim}
+    )
+    with active(plan):
+        fleet.drain(timeout=600)
+    stats = fleet.stats()
+    for h in handles:
+        r = h.result()
+        assert r.complete, f"job {h.id} incomplete"
+    assert plan.injected_total() == 1, plan.spec()
+    assert stats["replica_crashes"] == 1, stats
+    assert stats["steals"] >= 1, stats
+    fleet.close()
+    print(
+        f"   crash replica {victim}, requeued {stats['requeued_jobs']}, "
+        f"restored {stats['restored_jobs']}, steals {stats['steals']} "
+        f"(plan: {plan.spec()})"
+    )
+
+    print("== timeline reconstruction (library) ==")
+    events = tl.load_events([journal_dir])
+    traces, _untraced = tl.group_traces(events)
+    anomalies = tl.find_anomalies(traces)
+    counts = tl.event_counts(events)
+    assert len(traces) == len(handles), (len(traces), len(handles))
+    assert anomalies == [], anomalies
+    assert counts.get("job.requeued", 0) == stats["requeued_jobs"], counts
+    assert counts.get("fleet.steal", 0) == stats["steals"], counts
+    assert counts.get("replica.crash", 0) == stats["replica_crashes"]
+    print(f"   {len(events)} events, {len(traces)} traces, 0 anomalies")
+
+    print("== timeline CLI (subprocess) + merged Chrome trace ==")
+    merged = os.path.join(td, "merged.json")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "stateright_tpu.obs.timeline",
+            journal_dir, "--traces", trace_path, "--chrome-out", merged,
+            "--json",
+        ],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, (proc.returncode, proc.stderr[-500:])
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["anomalies"] == []
+    assert all(
+        lc["terminal"] == "job.done" for lc in report["traces"].values()
+    )
+    env = json.load(open(merged))
+    assert isinstance(env["traceEvents"], list) and env["traceEvents"]
+    print(
+        f"   CLI verdict clean; merged Chrome trace "
+        f"{len(env['traceEvents'])} events at {merged}"
+    )
+    print("TIMELINE SMOKE PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

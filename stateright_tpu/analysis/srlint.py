@@ -16,9 +16,12 @@ error):
   outside ``faults/ckptio.py``. r10 found every checkpoint writer torn;
   the atomic CRC writer is the only sanctioned path.
 - **SR003 undeclared-detail-key** (`key-ok`): every string-literal
-  ``detail[...]`` subscript and every ``REGISTRY.register("<source>")``
-  must use a key declared in ``obs/schema.py`` (DETAIL_KEYS + sub-schemas +
-  REGISTRY_SOURCES).
+  ``detail[...]`` subscript, every ``REGISTRY.register("<source>")``, and
+  every flight-recorder ``events.emit("<type>", ...)`` (any receiver named
+  ``events``/``_events``/``journal``/``_journal``) must use a key declared
+  in ``obs/schema.py`` (DETAIL_KEYS + sub-schemas + REGISTRY_SOURCES +
+  EVENT_TYPES) — journal event names are a cross-replica forensic
+  contract exactly like the counter vocabulary.
 - **SR004 unguarded-failure-surface** (`fault-ok`): a
   ``raise RuntimeError/OSError`` in engine/store/service code must sit in a
   function that also calls ``maybe_fault()`` (i.e. the failure surface is
@@ -140,6 +143,7 @@ class Linter:
         self.findings: list = []
         self._detail_paths = self.schema.all_detail_key_paths()
         self._detail_subs = {s for s, _ in self.schema.DETAIL_SUBSCHEMAS}
+        self._event_types = getattr(self.schema, "EVENT_TYPES", {}) or {}
 
     # -- helpers ---------------------------------------------------------------
 
@@ -287,6 +291,18 @@ class Linter:
 
     # -- SR003: undeclared detail / registry keys ------------------------------
 
+    @staticmethod
+    def _events_receiver(node: ast.expr) -> bool:
+        """True when a call receiver is journal-shaped — `events.emit`,
+        `self._events.emit`, `plan.events.emit`, `journal.emit` — so the
+        emit vocabulary check doesn't fire on unrelated emit() methods."""
+        names = {"events", "_events", "journal", "_journal"}
+        if isinstance(node, ast.Name):
+            return node.id in names
+        if isinstance(node, ast.Attribute):
+            return node.attr in names
+        return False
+
     def _detail_base(self, node: ast.expr) -> Optional[str]:
         """'' for `detail[...]`/`x.detail[...]`, the sub-dict name for
         `detail["service"][...]` chains, None when not detail-shaped."""
@@ -347,6 +363,25 @@ class Linter:
                             "SR003",
                             f"REGISTRY source {src!r} is not declared in "
                             "obs/schema.py REGISTRY_SOURCES",
+                        )
+                elif (
+                    isinstance(f, ast.Attribute)
+                    and f.attr == "emit"
+                    and self._events_receiver(f.value)
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    etype = node.args[0].value
+                    if etype not in self._event_types:
+                        self._emit(
+                            mi,
+                            node,
+                            "SR003",
+                            f"journal event type {etype!r} is not declared "
+                            "in obs/schema.py EVENT_TYPES — pin the "
+                            "vocabulary (name + required fields) before "
+                            "emitting it",
                         )
 
     # -- SR004: failure surfaces off the chaos plane ---------------------------

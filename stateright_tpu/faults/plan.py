@@ -202,6 +202,11 @@ class FaultPlan:
         self._cancel_cond = threading.Condition()
         self._cancel_gen = 0
         self.tracer = None  # optional obs.Tracer, set by the supervisor
+        # Optional obs.EventJournal: adopted by the flight recorder (the
+        # fleet router / check service set it when they journal) so every
+        # injection lands in the run's journal as a `fault.injected`
+        # event — chaos runs become auditable recordings.
+        self.events = None
 
     # -- construction ----------------------------------------------------------
 
@@ -287,6 +292,11 @@ service.step:poison:job=3:times=-1"
             self.tracer.instant(
                 "fault_injected", cat="faults", point=point, kind=kind
             )
+        if self.events is not None:
+            try:
+                self.events.emit("fault.injected", point=point, kind=kind)
+            except Exception:  # noqa: BLE001 — recording never blocks a fault
+                pass
 
     def fire(self, point: str, ctx: dict) -> None:
         """Account one hit of `point`; raise the matching fault (if any).

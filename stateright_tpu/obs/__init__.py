@@ -15,9 +15,23 @@ store, check service, HTTP servers):
    register metric providers into `REGISTRY`; both HTTP servers render it as
    Prometheus text at `GET /metrics`; `schema.py` pins the one documented
    `SearchResult.detail` vocabulary.
+4. **Flight recorder** (`events.py`, `timeline.py`) — a crash-durable
+   JSONL event journal with a schema'd vocabulary (`EVENT_TYPES`) and
+   job-scoped `trace` ids minted at submission, plus the forensic CLI
+   (`python -m stateright_tpu.obs.timeline`) that reconstructs per-job
+   lifecycles across replicas, flags anomalies, and merges Chrome traces.
 """
 
 from .ring import N_COLS, STEP_COLS, StepRing, build_detail
+from .events import (
+    NULL_EVENTS,
+    EventJournal,
+    as_events,
+    merge_events,
+    mint_trace_id,
+    read_journal,
+    read_journals,
+)
 from .registry import (
     REGISTRY,
     CounterRegistry,
@@ -26,9 +40,12 @@ from .registry import (
 )
 from .schema import (
     DETAIL_KEYS,
+    EVENT_TYPES,
     FAULTS_DETAIL_KEYS,
     SERVICE_DETAIL_KEYS,
     TELEMETRY_KEYS,
+    TERMINAL_EVENT_BY_STATUS,
+    TERMINAL_EVENTS,
     validate_detail,
 )
 from .trace import NULL_TRACER, Tracer, as_tracer
@@ -43,11 +60,21 @@ __all__ = [
     "flatten_metrics",
     "render_prometheus",
     "DETAIL_KEYS",
+    "EVENT_TYPES",
     "FAULTS_DETAIL_KEYS",
     "SERVICE_DETAIL_KEYS",
     "TELEMETRY_KEYS",
+    "TERMINAL_EVENT_BY_STATUS",
+    "TERMINAL_EVENTS",
     "validate_detail",
     "NULL_TRACER",
     "Tracer",
     "as_tracer",
+    "NULL_EVENTS",
+    "EventJournal",
+    "as_events",
+    "merge_events",
+    "mint_trace_id",
+    "read_journal",
+    "read_journals",
 ]

@@ -1,0 +1,336 @@
+"""Structured event journal: the fleet flight recorder's durable plane.
+
+The r9 telemetry spine answers "how fast" (counters, step rings, spans);
+nothing answered "what happened, in what order, across which replicas"
+once the r13 fleet made jobs HOP — router → replica A → crash → replica B
+leaves three disconnected per-process views and no durable record of the
+choreography. This module is the recorder:
+
+- `EventJournal` — an append-only JSONL file of schema'd events
+  (obs/schema.py EVENT_TYPES pins the vocabulary and each type's required
+  fields). Every record is stamped with wall-clock `ts`, a monotonic
+  per-writer `seq`, the `writer` name, and `pid`; job-scoped events carry
+  the `trace` id minted at submission, which is what joins one job's
+  records across every journal it touched.
+- **Bounded-flush durability**: emissions buffer in memory and hit the
+  file every `flush_every` events or `flush_interval_s` seconds — a crash
+  loses at most one flush window, and because a JSONL append can only
+  tear the FINAL line, `read_journal` applies the same torn-tail
+  discipline as faults/ckptio.py: a torn or garbage last line is skipped,
+  never raised on. An empty or missing file reads as an empty journal.
+- **Live tails**: the journal keeps an in-memory ring of recent events
+  with a global cursor; `tail(since=, job=, wait_s=)` is the long-poll
+  primitive behind `GET /jobs/<id>/events` on both HTTP front doors, and
+  `recent()` feeds the fleet `/.status` last-N ring.
+
+`NULL_EVENTS` is the default collaborator everywhere (the NULL_TRACER
+pattern): call sites emit unconditionally at ~zero cost when recording is
+off.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from .schema import EVENT_TYPES
+
+_mint_lock = threading.Lock()
+_mint_n = 0
+
+
+def mint_trace_id() -> str:
+    """A process-unique job trace id (pid + microsecond epoch + counter).
+    Minted once per job at its submission front door and carried through
+    every replica / journal / span the job touches — correlation, not
+    cryptography, so short and readable beats random."""
+    global _mint_n
+    with _mint_lock:
+        _mint_n += 1
+        n = _mint_n
+    return f"{os.getpid():x}-{int(time.time() * 1e6) & 0xFFFFFFFF:08x}-{n:x}"
+
+
+class EventJournal:
+    """Append-only JSONL event journal with a schema'd vocabulary, bounded
+    flushing, and an in-memory tail ring. Thread-safe: the service
+    scheduler, replica drivers, and HTTP long-pollers share one instance.
+
+    `path=None` keeps the journal memory-only (ring + tail still work —
+    what a test or an ephemeral service wants); with a path the file is
+    opened for append, so a restarted writer continues the same journal
+    (its `seq` restarts, which readers treat as a new writer incarnation,
+    not an anomaly)."""
+
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        writer: Optional[str] = None,
+        flush_every: int = 64,
+        flush_interval_s: float = 0.5,
+        ring: int = 4096,
+        fsync: bool = False,
+    ):
+        self.path = path
+        self.writer = writer if writer is not None else f"pid{os.getpid()}"
+        self.flush_every = max(int(flush_every), 1)
+        self.flush_interval_s = flush_interval_s
+        self.fsync = fsync
+        self.write_errors = 0  # I/O failures absorbed (recording must not kill)
+        self._f = open(path, "a") if path is not None else None
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        # File writes run OUTSIDE self._lock (an emit on the scheduler's
+        # hot path must never stall behind disk I/O); _io_lock serializes
+        # the writers and is ALWAYS acquired while still holding _lock
+        # (then released after the unlocked write), so flushed buffers
+        # reach the file in emit order. Lock order: _lock -> _io_lock,
+        # never the reverse.
+        self._io_lock = threading.Lock()
+        self._buf: list[str] = []
+        self._seq = 0
+        self._count = 0  # global cursor: events ever emitted here
+        self._ring: deque = deque(maxlen=max(int(ring), 1))
+        self._last_flush = time.monotonic()
+        self._pid = os.getpid()
+        self._closed = False
+
+    @property
+    def enabled(self) -> bool:
+        return True
+
+    @property
+    def closed(self) -> bool:
+        """True once `close()` ran — adopters (FaultPlan.events) check
+        this so a plan outliving one recorded run re-adopts the NEXT live
+        journal instead of emitting into a dead one forever."""
+        return self._closed
+
+    def emit(self, etype: str, **fields) -> dict:
+        """Append one event. `etype` must be declared in obs/schema.py
+        EVENT_TYPES and carry that type's required fields — vocabulary
+        drift is a ValueError here (and an srlint SR003 finding at lint
+        time), not a dashboard surprise later. None-valued fields are
+        dropped (so `trace=None` call sites stay unconditional). Returns
+        the stamped record."""
+        required = EVENT_TYPES.get(etype)
+        if required is None:
+            raise ValueError(
+                f"event type {etype!r} is not declared in obs/schema.py "
+                "EVENT_TYPES — pin the vocabulary before emitting it"
+            )
+        fields = {k: v for k, v in fields.items() if v is not None}
+        missing = [k for k in required if k not in fields]
+        if missing:
+            raise ValueError(
+                f"event {etype!r} is missing required fields {missing} "
+                f"(schema: {list(required)})"
+            )
+        batch = None
+        with self._cond:
+            self._seq += 1
+            rec = {
+                "event": etype,
+                "ts": round(time.time(), 6),
+                "seq": self._seq,
+                "writer": self.writer,
+                "pid": self._pid,
+                **fields,
+            }
+            self._ring.append((self._count, rec))
+            self._count += 1
+            if self._f is not None and not self._closed:
+                self._buf.append(json.dumps(rec, default=str))
+                now = time.monotonic()
+                if (
+                    len(self._buf) >= self.flush_every
+                    or now - self._last_flush >= self.flush_interval_s
+                ):
+                    batch = self._take_batch_locked(now)
+            self._cond.notify_all()
+        self._write_batch(batch)
+        return rec
+
+    def _take_batch_locked(self, now: Optional[float] = None):
+        """Hand the pending buffer to the caller for writing OUTSIDE the
+        journal lock. Acquires _io_lock while _lock is still held (see
+        __init__) so concurrent flushes write their batches in order; the
+        caller MUST pass the batch to `_write_batch`, which releases it."""
+        if not self._buf or self._f is None:
+            return None
+        self._io_lock.acquire()
+        batch, self._buf = self._buf, []
+        self._last_flush = now if now is not None else time.monotonic()
+        return batch
+
+    def _write_batch(self, batch) -> None:
+        if batch is None:
+            return
+        try:
+            self._f.write("\n".join(batch) + "\n")
+            self._f.flush()
+            if self.fsync:
+                os.fsync(self._f.fileno())
+        except (OSError, ValueError, AttributeError):
+            # Recording must never kill the host component; the loss is
+            # visible as a counter instead. (AttributeError: a close()
+            # racing the unlocked write NULLed the file object.)
+            self.write_errors += 1
+        finally:
+            self._io_lock.release()
+
+    def flush(self) -> None:
+        with self._lock:
+            batch = self._take_batch_locked()
+        self._write_batch(batch)
+
+    def close(self) -> None:
+        with self._lock:
+            batch = self._take_batch_locked()
+        self._write_batch(batch)
+        with self._lock:
+            self._closed = True
+            if self._f is not None:
+                with self._io_lock:  # no in-flight write holds the file
+                    try:
+                        self._f.close()
+                    except OSError:
+                        self.write_errors += 1
+                    self._f = None
+
+    # -- live tails ------------------------------------------------------------
+
+    @staticmethod
+    def _matches(rec: dict, job) -> bool:
+        if job is None:
+            return True
+        if rec.get("job") == job:
+            return True
+        jobs = rec.get("jobs")
+        return isinstance(jobs, (list, tuple)) and job in jobs
+
+    def tail(
+        self, since: int = 0, job=None, wait_s: float = 0.0
+    ) -> tuple:
+        """Events with global cursor >= `since` (optionally only those
+        naming `job`), long-polling up to `wait_s` for a first match.
+        Returns `(events, next_cursor)` — pass `next_cursor` back as
+        `since` to resume. The ring is bounded: a cursor older than the
+        ring yields what the ring still holds (the file has the rest)."""
+        deadline = time.monotonic() + max(wait_s, 0.0)
+        with self._cond:
+            while True:
+                out = [
+                    rec for idx, rec in self._ring
+                    if idx >= since and self._matches(rec, job)
+                ]
+                if out or self._closed:
+                    return out, self._count
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return out, self._count
+                self._cond.wait(timeout=min(left, 0.2))
+
+    def recent(self, n: int = 16) -> list:
+        """The last `n` events (any job) — the fleet `/.status` ring."""
+        with self._lock:
+            return [rec for _idx, rec in list(self._ring)[-n:]]
+
+    def cursor(self) -> int:
+        with self._lock:
+            return self._count
+
+
+class _NullEvents:
+    """emit/flush/tail no-ops; the default `events` collaborator."""
+
+    enabled = False
+    closed = False
+    writer = "null"
+    path = None
+
+    def emit(self, etype: str, **fields) -> None:
+        return None
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def tail(self, since: int = 0, job=None, wait_s: float = 0.0) -> tuple:
+        return [], since
+
+    def recent(self, n: int = 16) -> list:
+        return []
+
+    def cursor(self) -> int:
+        return 0
+
+
+NULL_EVENTS = _NullEvents()
+
+
+def as_events(events) -> "EventJournal | _NullEvents":
+    return events if events is not None else NULL_EVENTS
+
+
+# -- readers (the forensic side: never raise on a torn journal) ----------------
+
+
+def read_journal(path: str) -> list:
+    """Every intact event in one journal file, in file order. The torn-tail
+    discipline: an append-only JSONL writer can only tear the FINAL line
+    (a crash mid-append), so an unparseable or truncated line is skipped —
+    this reader NEVER raises on journal content, and a missing or empty
+    file is just an empty journal. Non-final garbage lines are skipped the
+    same way (a forensic reader takes what it can prove)."""
+    try:
+        with open(path, "r") as f:
+            data = f.read()
+    except OSError:
+        return []
+    events = []
+    for line in data.split("\n"):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue  # torn tail / partial interleave: skip, never raise
+        if isinstance(rec, dict) and "event" in rec:
+            events.append(rec)
+    return events
+
+
+def merge_events(event_lists) -> list:
+    """One global order over events from many journals. Each writer's own
+    order is preserved EXACTLY (sorted by its monotonic seq, never by
+    wall clock — a backwards NTP step must not invert a writer's causal
+    chain and fake a timeline anomaly); across writers, events interleave
+    by ts clamped monotonic within each writer's stream."""
+    streams: dict = {}
+    for evs in event_lists:
+        for e in evs:
+            streams.setdefault(str(e.get("writer", "")), []).append(e)
+    keyed = []
+    for w, evs in streams.items():
+        evs.sort(key=lambda e: e.get("seq", 0))
+        t = float("-inf")
+        for e in evs:
+            ts = e.get("ts")
+            if isinstance(ts, (int, float)):
+                t = max(t, ts)
+            keyed.append((t, w, e.get("seq", 0), e))
+    keyed.sort(key=lambda k: k[:3])
+    return [e for _t, _w, _s, e in keyed]
+
+
+def read_journals(paths) -> list:
+    """`read_journal` over many files, merged into one global order."""
+    return merge_events(read_journal(p) for p in paths)

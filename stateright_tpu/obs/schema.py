@@ -37,6 +37,10 @@ DETAIL_KEYS = {
     # chaos plane + supervisor (stateright_tpu/faults/)
     "faults": "fault-injection/recovery counters sub-dict "
               "(FAULTS_DETAIL_KEYS)",
+    # flight recorder (obs/events.py): the job-scoped trace id minted at
+    # submission and carried through every replica the job touched — the
+    # key that joins this result to its journal events and Chrome spans.
+    "trace": "job-scoped trace correlation id (service/fleet jobs)",
 }
 
 #: Keys of `detail["service"]` (service/metrics.py JobMetrics.to_dict).
@@ -127,6 +131,58 @@ FLEET_COUNTER_KEYS = {
                      "generation (the rest restarted fresh)",
     "steals": "queued jobs pulled to an idle replica (work stealing)",
     "per_replica": "one status row per replica sub-dict",
+    "events_recent": "last-N flight-recorder events (obs/events.py ring; "
+                     "[] when the fleet journals nothing)",
+}
+
+
+#: The flight-recorder event vocabulary (obs/events.py journals): event
+#: type -> the field names every emission of that type MUST carry (beyond
+#: the stamps the journal adds itself: ts / seq / writer / pid, and the
+#: job-scoped `trace` correlation id where one exists). `EventJournal.emit`
+#: rejects an undeclared type or a missing required field, and the srlint
+#: SR003 pass rejects a literal `events.emit("<name>", ...)` whose name is
+#: not spelled here — the journal is a cross-replica forensic contract
+#: (obs/timeline.py reconstructs job lifecycles from it), so the
+#: vocabulary drifts only through this map.
+EVENT_TYPES = {
+    # job lifecycle (the timeline CLI's per-trace spine)
+    "job.submitted": ("job",),       # accepted by a router or service
+    "replica.admit": ("job",),       # granted lanes on a service/replica
+    "job.preempted": ("job",),       # parked for waiting jobs (re-admits)
+    "job.requeued": ("job", "src"),  # moved off a dead replica
+    "job.resumed": ("job",),         # re-admitted from a checkpoint journal
+    "job.quarantined": ("job",),     # poison job parked by the retry policy
+    "job.done": ("job",),
+    "job.cancelled": ("job",),
+    "job.error": ("job",),
+    # router / fleet choreography
+    "router.route": ("job", "replica"),    # placement bound job -> replica
+    "router.failover": ("job", "replica"), # submit attempt failed; walking on
+    "router.probe": ("replica", "ok"),     # health-probe FAILURE accounting
+    "router.unavailable": ("reason",),     # 503 surface (no healthy replica)
+    "replica.crash": ("replica",),         # declared dead, removed from ring
+    "fleet.steal": ("job", "src", "dst"),  # queued job pulled to idle replica
+    # engine / durability plane
+    "engine.chunk": ("jobs",),       # one fused service step (jobs: id list)
+    "ckpt.write": ("job",),          # atomic checkpoint generation written
+    "fault.injected": ("point", "kind"),  # chaos plane (faults/plan.py)
+}
+
+#: Event types that end a job's timeline — obs/timeline.py flags a trace
+#: with none of these as the `no_terminal` anomaly.
+TERMINAL_EVENTS = ("job.done", "job.cancelled", "job.error",
+                   "job.quarantined")
+
+#: Finish-status string -> terminal event name. Both job vocabularies
+#: (service JobStatus and fleet FleetJobStatus) spell their terminal
+#: statuses "done"/"cancelled"/"error", so this is the ONE map their
+#: finalizers emit through — a rename edits the vocabulary here, not in
+#: per-layer copies.
+TERMINAL_EVENT_BY_STATUS = {
+    "done": "job.done",
+    "cancelled": "job.cancelled",
+    "error": "job.error",
 }
 
 

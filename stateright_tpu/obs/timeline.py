@@ -1,0 +1,393 @@
+"""Forensic timeline CLI over flight-recorder journals.
+
+    python -m stateright_tpu.obs.timeline <journal.jsonl | dir> ... \
+        [--gap-s 30] [--traces t1.json t2.json] [--chrome-out merged.json] \
+        [--trace TRACE_ID] [--json]
+
+The reference crate answers "what happened" with an interactive Explorer
+over the state graph; this is the operational twin for the FLEET: given
+the JSONL journals a run left behind (router + one per replica,
+obs/events.py), it
+
+1. merges them into one global order (ts, tie-broken per-writer by seq —
+   each writer's own order is preserved exactly),
+2. groups events by the job-scoped `trace` id minted at submission, so a
+   job that hopped router → replica A → crash → replica B reads as ONE
+   lifecycle (submit → route → admit → requeue → resume → done),
+3. flags anomalies — jobs with no terminal event, duplicate admissions
+   (two lane grants with no preempt/requeue/steal between them), and
+   admission gaps longer than the watchdog budget (`--gap-s`),
+4. optionally merges per-process Chrome traces (`--traces`) into one
+   Perfetto-loadable file (`--chrome-out`), remapping colliding pids so
+   replicas land on separate tracks; with no `--traces`, the journal
+   events themselves are synthesized into instant markers per writer.
+
+Exit code: 0 = every lifecycle clean, 2 = anomalies found (the
+`scripts/timeline_smoke.py` verdict), 1 = no journal events to read.
+
+Everything here is stdlib-only over JSONL — a crashed fleet's journals
+are readable on any machine, no jax required (import this module
+directly, or pay the package import once for `-m`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional
+
+from .events import merge_events, read_journal
+from .schema import TERMINAL_EVENTS
+
+#: Events that grant a job lanes on a replica (an "admission").
+ADMIT_EVENTS = ("replica.admit", "job.resumed")
+#: Events after which a second admission is EXPECTED, not an anomaly.
+REQUEUE_EVENTS = ("job.requeued", "job.preempted", "fleet.steal")
+#: Events that open an admission wait (the gap clock starts here).
+WAIT_EVENTS = ("job.submitted", "job.requeued")
+
+
+# -- loading -------------------------------------------------------------------
+
+
+def expand_paths(paths) -> list:
+    """Journal files from a mix of file and directory arguments (a
+    directory contributes its *.jsonl members, sorted)."""
+    out: list = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(
+                os.path.join(p, n)
+                for n in sorted(os.listdir(p))
+                if n.endswith(".jsonl")
+            )
+        else:
+            out.append(p)
+    return out
+
+
+def load_events(paths) -> list:
+    """Merged global event order from journal files/directories (torn
+    tails skipped by the reader; a missing file is an empty journal)."""
+    return merge_events(read_journal(p) for p in expand_paths(paths))
+
+
+# -- per-trace timelines -------------------------------------------------------
+
+
+def group_traces(events) -> tuple:
+    """Split a merged event stream into `(traces, untraced)`: `traces`
+    maps each job trace id to its event list (global order preserved; an
+    `engine.chunk` carrying a `traces` list is attributed to every trace
+    it stepped), `untraced` keeps fleet-global events (probe failures,
+    replica crashes, injected faults) that belong to no single job."""
+    traces: dict = {}
+    untraced: list = []
+    for ev in events:
+        t = ev.get("trace")
+        if t:
+            traces.setdefault(t, []).append(ev)
+            continue
+        ts = ev.get("traces")
+        if isinstance(ts, (list, tuple)) and ts:
+            for t in ts:
+                if t:
+                    traces.setdefault(t, []).append(ev)
+            continue
+        untraced.append(ev)
+    return traces, untraced
+
+
+def lifecycle(evs: list) -> dict:
+    """One trace's summary row: the hop story the CLI prints."""
+    names = [e.get("event") for e in evs]
+    jobs = {}  # writer -> job ids it knew this trace as
+    for e in evs:
+        if "job" in e:
+            jobs.setdefault(str(e.get("writer")), set()).add(e["job"])
+    terminal = next(
+        (n for n in reversed(names) if n in TERMINAL_EVENTS), None
+    )
+    ts0 = evs[0].get("ts")
+    ts1 = evs[-1].get("ts")
+    return {
+        "events": len(evs),
+        "first": names[0],
+        "terminal": terminal,
+        "duration_s": (
+            round(ts1 - ts0, 3)
+            if isinstance(ts0, (int, float)) and isinstance(ts1, (int, float))
+            else None
+        ),
+        "writers": sorted({str(e.get("writer")) for e in evs}),
+        "jobs": {w: sorted(ids) for w, ids in sorted(jobs.items())},
+        "requeues": names.count("job.requeued"),
+        "steals": names.count("fleet.steal"),
+        "admissions": sum(1 for n in names if n in ADMIT_EVENTS),
+    }
+
+
+def find_anomalies(traces: dict, gap_s: float = 30.0) -> list:
+    """The forensic verdicts: per-trace lifecycle violations.
+
+    - `no_terminal` — the job's story just stops (lost job, dead handle).
+    - `duplicate_admission` — two lane grants with no preempt / requeue /
+      steal between them (the orphan-copy bug class: a hung-but-alive
+      replica still stepping a job another replica also runs).
+    - `admission_gap` — a submit/requeue waited longer than `gap_s` for
+      its admission (or terminal) — the watchdog-budget smell.
+    """
+    out: list = []
+    for trace, evs in sorted(traces.items()):
+        names = [e.get("event") for e in evs]
+        if not set(names) & set(TERMINAL_EVENTS):
+            out.append(
+                {
+                    "kind": "no_terminal",
+                    "trace": trace,
+                    "detail": f"last event {names[-1]!r}; no terminal "
+                              f"({'/'.join(TERMINAL_EVENTS)})",
+                }
+            )
+        admitted = False
+        for e in evs:
+            n = e.get("event")
+            if n in ADMIT_EVENTS:
+                if admitted:
+                    out.append(
+                        {
+                            "kind": "duplicate_admission",
+                            "trace": trace,
+                            "detail": f"{n} on {e.get('writer')} without an "
+                                      "intervening preempt/requeue/steal",
+                        }
+                    )
+                admitted = True
+            elif n in REQUEUE_EVENTS:
+                admitted = False
+        waiting_since: Optional[float] = None
+        for e in evs:
+            n = e.get("event")
+            ts = e.get("ts")
+            if n in WAIT_EVENTS:
+                if waiting_since is None and isinstance(ts, (int, float)):
+                    waiting_since = ts
+            elif n in ADMIT_EVENTS or n in TERMINAL_EVENTS:
+                if (
+                    waiting_since is not None
+                    and isinstance(ts, (int, float))
+                    and ts - waiting_since > gap_s
+                ):
+                    out.append(
+                        {
+                            "kind": "admission_gap",
+                            "trace": trace,
+                            "detail": f"waited {ts - waiting_since:.1f}s "
+                                      f"for {n} (budget {gap_s:.1f}s)",
+                        }
+                    )
+                waiting_since = None
+    return out
+
+
+def event_counts(events) -> dict:
+    counts: dict = {}
+    for e in events:
+        n = e.get("event")
+        counts[n] = counts.get(n, 0) + 1
+    return counts
+
+
+# -- Chrome trace merge --------------------------------------------------------
+
+
+def merge_chrome_traces(paths) -> dict:
+    """Merge per-process Chrome trace files (obs/trace.py envelopes or
+    bare event arrays) into one Perfetto-loadable envelope. Files sharing
+    a pid (e.g. an in-proc fleet's replicas, or two runs of the same pid)
+    are remapped onto distinct pid tracks so they don't interleave."""
+    merged: list = []
+    used_pids: set = set()
+    sources: list = []
+    for i, path in enumerate(paths):
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            sources.append({"path": path, "error": "unreadable"})
+            continue
+        evs = data.get("traceEvents", data) if isinstance(data, dict) else data
+        if not isinstance(evs, list):
+            sources.append({"path": path, "error": "no traceEvents"})
+            continue
+        remap: dict = {}
+        for pid in {e.get("pid") for e in evs if isinstance(e, dict)}:
+            new = pid
+            while new in used_pids:
+                new = (new if isinstance(new, int) else 0) + 100_000 * (i + 1)
+            remap[pid] = new
+            used_pids.add(new)
+        for e in evs:
+            if not isinstance(e, dict):
+                continue
+            e = dict(e)
+            if e.get("pid") in remap:
+                e["pid"] = remap[e["pid"]]
+            merged.append(e)
+        sources.append({"path": path, "events": len(evs)})
+    return {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "otherData": {"merged_from": sources},
+    }
+
+
+def synthesize_chrome(events) -> dict:
+    """A Chrome trace from journal events alone (no span files): one pid
+    track per writer, every event an instant marker — the poor man's
+    Perfetto view of a run that only journaled."""
+    writers = sorted({str(e.get("writer")) for e in events})
+    pid_of = {w: i + 1 for i, w in enumerate(writers)}
+    t0 = min(
+        (e["ts"] for e in events if isinstance(e.get("ts"), (int, float))),
+        default=0.0,
+    )
+    out = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": pid_of[w],
+            "args": {"name": f"journal:{w}"},
+        }
+        for w in writers
+    ]
+    for e in events:
+        ts = e.get("ts")
+        if not isinstance(ts, (int, float)):
+            continue
+        args = {
+            k: v for k, v in e.items()
+            if k not in ("event", "ts", "writer", "pid")
+        }
+        out.append(
+            {
+                "name": e.get("event"),
+                "cat": "journal",
+                "ph": "i",
+                "s": "p",
+                "ts": (ts - t0) * 1e6,
+                "pid": pid_of[str(e.get("writer"))],
+                "tid": 1,
+                "args": args,
+            }
+        )
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+# -- CLI -----------------------------------------------------------------------
+
+
+def _fmt_ev(e: dict) -> str:
+    extra = {
+        k: v for k, v in e.items()
+        if k not in ("event", "ts", "seq", "writer", "pid", "trace", "traces")
+    }
+    body = " ".join(f"{k}={v}" for k, v in extra.items())
+    return (
+        f"  {e.get('ts', 0):.6f} [{e.get('writer')}:{e.get('seq')}] "
+        f"{e.get('event')}" + (f" {body}" if body else "")
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m stateright_tpu.obs.timeline",
+        description="Reconstruct per-job lifecycles from flight-recorder "
+                    "journals; flag anomalies; merge Chrome traces.",
+    )
+    ap.add_argument("journals", nargs="*",
+                    help="journal .jsonl files or directories of them")
+    ap.add_argument("--gap-s", type=float, default=30.0,
+                    help="admission-gap anomaly budget, seconds (the "
+                    "watchdog discipline; default 30)")
+    ap.add_argument("--traces", nargs="*", default=[],
+                    help="per-process Chrome trace JSON files to merge")
+    ap.add_argument("--chrome-out", default=None,
+                    help="write the merged (or journal-synthesized) Chrome "
+                    "trace here — loads in Perfetto")
+    ap.add_argument("--trace", default=None,
+                    help="print the full event list of ONE trace id")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    args = ap.parse_args(argv)
+
+    events = load_events(args.journals)
+    if not events and not args.traces:
+        print("no journal events found", file=sys.stderr)
+        return 1
+    traces, untraced = group_traces(events)
+    anomalies = find_anomalies(traces, gap_s=args.gap_s)
+    counts = event_counts(events)
+
+    chrome_path = None
+    if args.chrome_out:
+        env = (
+            merge_chrome_traces(args.traces)
+            if args.traces
+            else synthesize_chrome(events)
+        )
+        with open(args.chrome_out, "w") as f:
+            json.dump(env, f)
+        chrome_path = args.chrome_out
+
+    if args.json:
+        json.dump(
+            {
+                "events": len(events),
+                "counts": counts,
+                "traces": {t: lifecycle(evs) for t, evs in traces.items()},
+                "untraced": len(untraced),
+                "anomalies": anomalies,
+                "chrome_out": chrome_path,
+            },
+            sys.stdout,
+        )
+        print()
+        return 2 if anomalies else 0
+
+    print(
+        f"{len(events)} events, {len(traces)} job traces, "
+        f"{len(untraced)} fleet-global events "
+        f"from {len(expand_paths(args.journals))} journal(s)"
+    )
+    for t, evs in sorted(
+        traces.items(), key=lambda kv: kv[1][0].get("ts", 0)
+    ):
+        lc = lifecycle(evs)
+        hops = "+".join(lc["writers"])
+        print(
+            f"trace {t}: {lc['first']} -> {lc['terminal'] or '???'} "
+            f"({lc['events']} events, {lc['admissions']} admissions, "
+            f"{lc['requeues']} requeues, {lc['steals']} steals, "
+            f"{lc['duration_s']}s, writers {hops})"
+        )
+        if args.trace == t:
+            for e in evs:
+                print(_fmt_ev(e))
+    top = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+    print("event counts: " + ", ".join(f"{k}={v}" for k, v in top))
+    if chrome_path:
+        print(f"chrome trace written to {chrome_path}")
+    if anomalies:
+        print(f"{len(anomalies)} ANOMALIES:")
+        for a in anomalies:
+            print(f"  [{a['kind']}] trace {a['trace']}: {a['detail']}")
+        return 2
+    print("verdict: clean (every job lifecycle complete and consistent)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -77,16 +77,41 @@ class _Span:
 
 class Tracer:
     """Collects trace events; thread-safe (the check service spans from its
-    scheduler thread while clients span from theirs)."""
+    scheduler thread while clients span from theirs).
 
-    def __init__(self, annotate: bool = False, max_events: int = 200_000):
+    With `out=` set the tracer ALSO flushes itself to that path every
+    `flush_every` recorded events or `flush_interval_s` seconds (atomic
+    tmp+rename, so the file is always loadable JSON) — a crashed replica
+    leaves a usable partial trace instead of nothing, which is what lets
+    obs/timeline.py merge a fleet's per-process traces after a chaos run.
+    Before this, the only write was the owner's `save()` at clean close
+    (service/api.py), so every crash erased its own evidence."""
+
+    def __init__(
+        self,
+        annotate: bool = False,
+        max_events: int = 200_000,
+        out: Optional[str] = None,
+        flush_every: int = 256,
+        flush_interval_s: float = 2.0,
+    ):
         self.annotate = annotate
         self.max_events = max_events
+        self.out = out
+        self.flush_every = max(int(flush_every), 1)
+        self.flush_interval_s = flush_interval_s
         self.events: list[dict] = []
         self.dropped = 0
         self._lock = threading.Lock()
+        # Flush I/O runs OUTSIDE self._lock (recording threads must never
+        # block on disk); this second lock only serializes concurrent
+        # writers of the out file.
+        self._io_lock = threading.Lock()
         self._epoch = time.monotonic()
         self._pid = os.getpid()
+        self._unflushed = 0
+        self._flush_threshold = self.flush_every
+        self._last_flush = time.monotonic()
 
     @property
     def enabled(self) -> bool:
@@ -113,6 +138,8 @@ class Tracer:
                     **({"args": args} if args else {}),
                 }
             )
+            snap = self._maybe_flush_locked()
+        self._write_snapshot(snap)
 
     def _record(self, name, cat, t0, t1, args) -> None:
         with self._lock:
@@ -131,12 +158,10 @@ class Tracer:
                     **({"args": args} if args else {}),
                 }
             )
+            snap = self._maybe_flush_locked()
+        self._write_snapshot(snap)
 
-    def to_json(self) -> dict:
-        """The Chrome trace-event envelope (object form, the variant every
-        consumer accepts)."""
-        with self._lock:
-            events = list(self.events)
+    def _envelope(self, events: list) -> dict:
         meta = {
             "name": "process_name",
             "ph": "M",
@@ -146,13 +171,81 @@ class Tracer:
         return {
             "traceEvents": [meta] + events,
             "displayTimeUnit": "ms",
-            "otherData": {"dropped_events": self.dropped},
+            "otherData": {"dropped_events": self.dropped, "pid": self._pid},
         }
 
-    def save(self, path: str) -> str:
-        """Write the trace JSON; returns the path (load it in Perfetto)."""
-        with open(path, "w") as f:
-            json.dump(self.to_json(), f)
+    def _maybe_flush_locked(self) -> Optional[list]:
+        """The crash-durability cadence decision (called with self._lock
+        held): returns the event snapshot to persist, or None. The actual
+        serialization + write happens in the CALLER, outside the lock —
+        recording threads must never stall behind disk I/O. The
+        event-count trigger grows with the log (each rewrite is
+        O(events), so a fixed cadence would cost O(n^2) over a long run);
+        the time trigger stays fixed — a crash loses at most
+        `flush_interval_s` of recording, which is the durability
+        contract."""
+        if self.out is None:
+            return None
+        self._unflushed += 1
+        now = time.monotonic()
+        # The time trigger also backs off as the trace grows (up to 16x):
+        # a trickle of events into a huge trace would otherwise rewrite
+        # the whole file every interval for O(1) new data. The loss
+        # window stays bounded (16 * flush_interval_s worst case).
+        eff_interval = self.flush_interval_s * min(
+            max(len(self.events) / (4.0 * self.flush_every), 1.0), 16.0
+        )
+        if (
+            self._unflushed < self._flush_threshold
+            and now - self._last_flush < eff_interval
+        ):
+            return None
+        self._unflushed = 0
+        self._last_flush = now
+        self._flush_threshold = max(self.flush_every, len(self.events) // 2)
+        return list(self.events)
+
+    def _write_snapshot(self, snap: Optional[list]) -> None:
+        if snap is None:
+            return
+        with self._io_lock:
+            self._write(self.out, self._envelope(snap))
+
+    @staticmethod
+    def _write(path: str, envelope: dict) -> None:
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(envelope, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass  # tracing must never fail its host
+
+    def to_json(self) -> dict:
+        """The Chrome trace-event envelope (object form, the variant every
+        consumer accepts)."""
+        with self._lock:
+            events = list(self.events)
+        return self._envelope(events)
+
+    def flush(self) -> Optional[str]:
+        """Force one durability flush to `out` (None when no out path)."""
+        if self.out is None:
+            return None
+        with self._io_lock:
+            self._write(self.out, self.to_json())
+        return self.out
+
+    def save(self, path: Optional[str] = None) -> Optional[str]:
+        """Write the trace JSON to `path` (default: the `out` path);
+        returns the path written (load it in Perfetto). Serialized with
+        the periodic flusher — a close()-time save racing a cadence flush
+        must not interleave writes to the same tmp file."""
+        path = path if path is not None else self.out
+        if path is None:
+            return None
+        with self._io_lock:
+            self._write(path, self.to_json())
         return path
 
 
@@ -172,7 +265,10 @@ class _NullTracer:
     def to_json(self) -> dict:
         return {"traceEvents": [], "displayTimeUnit": "ms"}
 
-    def save(self, path: str) -> Optional[str]:
+    def flush(self) -> Optional[str]:
+        return None
+
+    def save(self, path: Optional[str] = None) -> Optional[str]:
         return None
 
 

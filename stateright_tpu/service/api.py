@@ -37,7 +37,16 @@ from typing import Optional
 from ..core.discovery import HasDiscoveries
 from ..checker.base import Checker
 from ..faults.ckptio import CheckpointCorrupt
-from ..obs import REGISTRY, Tracer, as_tracer
+from ..faults.plan import active_plan
+from ..obs import (
+    REGISTRY,
+    TERMINAL_EVENT_BY_STATUS,
+    EventJournal,
+    Tracer,
+    as_events,
+    as_tracer,
+    mint_trace_id,
+)
 from .queue import AdmissionQueue, Job, JobStatus
 from .scheduler import ServiceEngine, ServiceError, StepFault
 
@@ -98,12 +107,24 @@ class CheckService:
         telemetry_log2: int = 12,
         trace_out: Optional[str] = None,
         retry_limit: int = 2,
+        events=None,
+        events_out: Optional[str] = None,
     ):
         """`telemetry=True` records one step-metrics row per fused device
         step (obs/ring.py; digest in `stats()["telemetry"]`, `/.status`,
         and `/metrics`). `trace_out=<path>` records the service lifecycle
         (admission, fused steps, eviction, preemption, finalize) as Chrome
-        trace-event JSON saved on `close()` — load it in Perfetto.
+        trace-event JSON — flushed periodically (obs/trace.py cadence) so
+        a crash leaves a loadable partial trace, and saved on `close()` —
+        load it in Perfetto.
+
+        `events` / `events_out=<path>` attach the flight recorder
+        (obs/events.py): every job lifecycle transition (submit, admit,
+        preempt, resume, quarantine, done/cancelled/error) and every fused
+        step lands in the append-only JSONL journal, keyed by the job's
+        `trace` id; `GET /jobs/<id>/events` on the HTTP front end tails
+        it live. Pass an `EventJournal` to share one (the fleet's
+        per-replica wiring) or a path to own one.
 
         `retry_limit` is the per-group step-fault budget: a group whose
         fused step keeps failing is retried that many times (the faulted
@@ -113,8 +134,14 @@ class CheckService:
         service (see scheduler.StepFault)."""
         self._trace_out = trace_out
         self._tracer = as_tracer(
-            Tracer(annotate=True) if trace_out else None
+            Tracer(annotate=True, out=trace_out) if trace_out else None
         )
+        self._events_owned = None
+        if events is None and events_out:
+            events = self._events_owned = EventJournal(
+                events_out, writer="service"
+            )
+        self._events = as_events(events)
         self._engine = ServiceEngine(
             batch_size=batch_size,
             table_log2=table_log2,
@@ -126,6 +153,7 @@ class CheckService:
             telemetry=telemetry,
             telemetry_log2=telemetry_log2,
             tracer=self._tracer if trace_out else None,
+            events=events,
         )
         # Central counter registry (obs/registry.py): both HTTP front ends'
         # `/metrics` render every registered source; weakly held, so a
@@ -160,6 +188,7 @@ class CheckService:
         priority: int = 0,
         journal: bool = False,
         resume=None,
+        trace: Optional[str] = None,
     ) -> JobHandle:
         """Enqueue a check job; returns immediately. The model must be a
         TensorModel; submit the SAME model instance for jobs that should
@@ -169,7 +198,10 @@ class CheckService:
         so a fleet replica can checkpoint it for requeue-resume; `resume`
         (a queue.JobResume) admits the job mid-search from such a
         checkpoint — both are the service fleet's plumbing (service/
-        fleet.py), not a client-facing knob."""
+        fleet.py), not a client-facing knob. `trace` is the flight-recorder
+        correlation id: the fleet router mints one at ITS front door and
+        passes it through here, so the job's events on every replica key
+        to one timeline; a direct submission mints its own."""
         from ..tensor.model import TensorModel
 
         if not isinstance(model, TensorModel):
@@ -193,10 +225,15 @@ class CheckService:
                 priority=priority,
                 journal=journal,
                 resume=resume,
+                trace=trace or mint_trace_id(),
             )
             self._next_id += 1
             self._jobs[job.id] = job
             self._adm.push(job)
+            self._events.emit(
+                "job.submitted", job=job.id, trace=job.trace,
+                resumed=bool(resume) or None,
+            )
             self._work.notify_all()
             return JobHandle(self, job)
 
@@ -205,7 +242,9 @@ class CheckService:
         primitive: a queued job has no table state, so moving it to another
         replica is a clean cancel-here/submit-there). Returns False once
         the job was admitted (or finished) — stealing running jobs is the
-        checkpoint plane's business, not the queue's."""
+        checkpoint plane's business, not the queue's. Deliberately emits
+        no journal event: the router's `fleet.steal` records the move, and
+        the job's trace continues on the thief replica."""
         job = self._get(job_id)
         with self._work:
             if job.status != JobStatus.QUEUED:
@@ -223,6 +262,7 @@ class CheckService:
             return {
                 "id": job.id,
                 "status": job.status,
+                "trace": job.trace,
                 "state_count": job.state_count,
                 "unique_state_count": job.unique_count,
                 "max_depth": job.max_depth,
@@ -262,6 +302,9 @@ class CheckService:
             self._engine.retire(job)
             job.status = JobStatus.CANCELLED
             job.metrics.finished_at = time.monotonic()
+            self._events.emit(
+                "job.cancelled", job=job.id, trace=job.trace
+            )
             job.event.set()
             self._work.notify_all()
             self._idle.notify_all()
@@ -326,6 +369,15 @@ class CheckService:
         Prometheus gauges)."""
         return self.stats()
 
+    def events_tail(
+        self, job_id: Optional[int] = None, since: int = 0,
+        wait_s: float = 0.0,
+    ) -> tuple:
+        """Flight-recorder tail (the `GET /jobs/<id>/events` long-poll
+        primitive): `(events, next_cursor)` with cursor >= `since`,
+        filtered to `job_id` when given. ([], since) with no recorder."""
+        return self._events.tail(since=since, job=job_id, wait_s=wait_s)
+
     # -- scheduling ------------------------------------------------------------
 
     def _get(self, job_id: int) -> Job:
@@ -354,12 +406,19 @@ class CheckService:
 
     def _finalize(self, job: Job, status: str = JobStatus.DONE) -> None:
         self._tracer.instant(
-            "service.finalize", cat="service", job=job.id, status=status
+            "service.finalize", cat="service", job=job.id, status=status,
+            trace=job.trace,
         )
         job.status = status
         job.metrics.finished_at = time.monotonic()
         self._engine.retire(job)
         job.result = self._engine.build_result(job)
+        self._events.emit(
+            TERMINAL_EVENT_BY_STATUS[status],
+            job=job.id, trace=job.trace,
+            states=job.state_count, unique=job.unique_count,
+            timed_out=job.timed_out or None,
+        )
         job.event.set()
         self._idle.notify_all()
 
@@ -386,16 +445,30 @@ class CheckService:
                     job.status = JobStatus.ERROR
                     job.error = f"preemption spill unreadable: {e}"
                     job.metrics.finished_at = time.monotonic()
+                    self._events.emit(
+                        "job.error", job=job.id, trace=job.trace,
+                        error=job.error,
+                    )
                     job.event.set()
                     self._idle.notify_all()
                     continue
                 job.status = JobStatus.RUNNING
                 job.steps_since_admit = 0
                 self._engine.group_of(job).jobs.append(job)
+                # Re-admission after a preempt: legal because the timeline
+                # saw `job.preempted` in between (obs/timeline.py treats a
+                # second admit WITHOUT one as the duplicate-admission
+                # anomaly).
+                self._events.emit(
+                    "replica.admit", job=job.id, trace=job.trace,
+                    preempted=True,
+                )
                 continue
+            resumed = job.resume is not None
             try:
                 with self._tracer.span(
-                    "service.admit", cat="service", job=job.id
+                    "service.admit", cat="service", job=job.id,
+                    trace=job.trace,
                 ):
                     done = self._engine.admit(job)
             except ServiceError:
@@ -404,12 +477,22 @@ class CheckService:
                 job.status = JobStatus.ERROR
                 job.error = f"admission failed: {e}"
                 job.metrics.finished_at = time.monotonic()
+                self._events.emit(
+                    "job.error", job=job.id, trace=job.trace, error=job.error
+                )
                 job.event.set()
                 self._idle.notify_all()
                 continue
             job.metrics.admitted_at = time.monotonic()
             job.status = JobStatus.RUNNING
             job.steps_since_admit = 0
+            # `job.resumed` (a fleet requeue continuing from its journal
+            # checkpoint) vs a first admission — the timeline's crash →
+            # requeue → resume hop is exactly this pair of spellings.
+            self._events.emit(
+                "job.resumed" if resumed else "replica.admit",
+                job=job.id, trace=job.trace,
+            )
             if done is not None:
                 self._finalize(job)
 
@@ -433,8 +516,9 @@ class CheckService:
             return
         job = max(due, key=lambda j: j.steps_since_admit)
         self._tracer.instant(
-            "service.preempt", cat="service", job=job.id
+            "service.preempt", cat="service", job=job.id, trace=job.trace
         )
+        self._events.emit("job.preempted", job=job.id, trace=job.trace)
         g = self._engine.groups.get(id(job.model))
         if g is not None and job in g.jobs:
             g.jobs.remove(job)
@@ -483,7 +567,7 @@ class CheckService:
         table entries stay (salted — they shadow nothing) and its lanes
         free up at the next round."""
         self._tracer.instant(
-            "service.quarantine", cat="service", job=job.id
+            "service.quarantine", cat="service", job=job.id, trace=job.trace
         )
         job.quarantined = True
         job.status = JobStatus.ERROR
@@ -493,6 +577,9 @@ class CheckService:
         job.metrics.finished_at = time.monotonic()
         self._engine.retire(job)
         self._engine.fault_counters["quarantined_jobs"] += 1
+        self._events.emit(
+            "job.quarantined", job=job.id, trace=job.trace, error=job.error
+        )
         job.event.set()
         self._idle.notify_all()
 
@@ -501,6 +588,18 @@ class CheckService:
         step of the next runnable group. Returns True if a step ran. A
         `StepFault` is absorbed here (retry/quarantine policy) — one bad
         group or job never takes the scheduler down."""
+        plan = active_plan()
+        if (
+            plan is not None
+            and self._events.enabled
+            and (plan.events is None or plan.events.closed)
+        ):
+            # The flight recorder adopts the active chaos plan: every
+            # injected fault is journaled as `fault.injected`, so a chaos
+            # run is an auditable recording, not just a survived one. A
+            # plan outliving a previous recorded run (its journal closed)
+            # is re-adopted here rather than emitting into the dead one.
+            plan.events = self._events
         self._expire_timeouts()
         self._admit_waiting()
         self._preempt_if_due()
@@ -599,6 +698,10 @@ class CheckService:
                     self._adm.remove(job)
                     self._engine.retire(job)
                     job.status = JobStatus.CANCELLED
+                    self._events.emit(
+                        "job.cancelled", job=job.id, trace=job.trace,
+                        shutdown=True,
+                    )
                     job.event.set()
             self._work.notify_all()
             self._idle.notify_all()
@@ -611,6 +714,17 @@ class CheckService:
                 self._tracer.save(self._trace_out)
             except OSError:
                 pass  # tracing must never fail a clean shutdown
+        # Release a chaos plan that adopted this recorder — the plan may
+        # outlive us, and its next journaled run must re-adopt a LIVE one.
+        plan = active_plan()
+        if plan is not None and plan.events is self._events:
+            plan.events = None
+        # The recorder outlives the service only when it was handed in
+        # (the fleet owns its per-replica journals); an owned one closes.
+        if self._events_owned is not None:
+            self._events_owned.close()
+        else:
+            self._events.flush()
 
 
 class ServiceChecker(Checker):

@@ -36,7 +36,7 @@ from typing import Callable, Optional
 
 from ..faults.ckptio import atomic_savez
 from ..faults.plan import maybe_fault
-from ..obs import as_tracer
+from ..obs import EventJournal, as_events, as_tracer
 from .api import CheckService
 from .queue import JobStatus
 from .router import FleetRouter, ReplicaDead, serve_fleet  # noqa: F401
@@ -58,6 +58,7 @@ class Replica:
         ckpt_every_spins: int = 1,
         pump_rounds: int = 4,
         tracer=None,
+        events=None,
     ):
         self.idx = idx
         self.service = service_factory()
@@ -68,6 +69,11 @@ class Replica:
         self._spins = 0
         self._ckpt_paths: dict[int, str] = {}  # inner job id -> ckpt path
         self._tracer = as_tracer(tracer)
+        # Flight-recorder journal (obs/events.py) shared with this
+        # replica's CheckService: the driver adds the durability events
+        # (`ckpt.write`) and flushes on death so a crash's journal tail
+        # survives for the forensic pass.
+        self._events = as_events(events)
         self._stop = False
         self._thread: Optional[threading.Thread] = None
         self._wake = threading.Condition()
@@ -179,6 +185,14 @@ class Replica:
             "fleet.replica_crash", cat="fleet", replica=self.idx,
             error=type(e).__name__,
         )
+        # Crash-durability: push the journal tail and the partial trace to
+        # disk NOW — this driver never runs again, and the flight recorder
+        # exists exactly for this moment. (The `replica.crash` journal
+        # event itself is the router's to write: it is the single
+        # authority on fleet membership, so event counts match its
+        # `replica_crashes` counter.)
+        self._events.flush()
+        self._tracer.flush()
 
     def _checkpoint_jobs(self) -> None:
         """Write one atomic generation per RUNNING journaled job. The
@@ -193,7 +207,14 @@ class Replica:
                 continue
             with self.service._lock:
                 arrays = job.fleet_snapshot()
-            atomic_savez(path, arrays)
+            with self._tracer.span(
+                "ckpt.write", cat="fleet", job=jid, replica=self.idx,
+                trace=job.trace,
+            ):
+                atomic_savez(path, arrays)
+            self._events.emit(
+                "ckpt.write", job=jid, trace=job.trace, replica=self.idx
+            )
 
     def _drive(self) -> None:
         while not self._stop and not self._dead:
@@ -239,13 +260,20 @@ class ServiceFleet:
         max_resident: Optional[int] = 8,
         background: bool = True,
         tracer=None,
+        journal_dir: Optional[str] = None,
     ):
         """`service_kwargs` configure every replica's CheckService
         (batch_size, table_log2, store, ...). `max_resident` bounds each
         replica's admitted jobs so overload is visible as queue depth —
         what work stealing feeds on (None disables the bound AND
         stealing's signal). `ckpt_dir` (default: a managed tempdir) holds
-        the per-job requeue-resume generations."""
+        the per-job requeue-resume generations.
+
+        `journal_dir` turns on the flight recorder (obs/events.py): the
+        router journals to `<journal_dir>/router.jsonl` and each replica
+        (driver + its CheckService) to `<journal_dir>/replica<i>.jsonl`,
+        all keyed by the per-job trace id the router mints — the input
+        set for `python -m stateright_tpu.obs.timeline`."""
         if n_replicas < 1:
             raise ValueError("a fleet needs at least one replica")
         self._tracer = as_tracer(tracer)
@@ -254,28 +282,43 @@ class ServiceFleet:
             self._tmpdir = tempfile.TemporaryDirectory(prefix="srtpu-fleet-")
             ckpt_dir = self._tmpdir.name
         os.makedirs(ckpt_dir, exist_ok=True)
+        self.journal_dir = journal_dir
+        self._journals: list = []
+        router_journal = None
+        if journal_dir is not None:
+            os.makedirs(journal_dir, exist_ok=True)
+            router_journal = EventJournal(
+                os.path.join(journal_dir, "router.jsonl"), writer="router"
+            )
+            self._journals.append(router_journal)
         kw = dict(service_kwargs or {})
         kw.setdefault("max_resident", max_resident)
         kw["background"] = False  # the Replica driver owns the pumping
 
-        def factory():
-            return CheckService(**kw)
-
-        self.replicas = [
-            Replica(
+        def make_replica(i: int) -> Replica:
+            journal = None
+            if journal_dir is not None:
+                journal = EventJournal(
+                    os.path.join(journal_dir, f"replica{i}.jsonl"),
+                    writer=f"replica{i}",
+                )
+                self._journals.append(journal)
+            return Replica(
                 i,
-                factory,
+                lambda: CheckService(events=journal, **kw),
                 ckpt_every_spins=ckpt_every_spins,
                 pump_rounds=pump_rounds,
                 tracer=tracer,
+                events=journal,
             )
-            for i in range(n_replicas)
-        ]
+
+        self.replicas = [make_replica(i) for i in range(n_replicas)]
         self.router = FleetRouter(
             self.replicas,
             background=background,
             ckpt_dir=ckpt_dir,
             tracer=tracer,
+            events=router_journal,
             **(router_kwargs or {}),
         )
         self.background = background
@@ -345,6 +388,8 @@ class ServiceFleet:
         for r in self.replicas:
             r.close()
         self.router.close()
+        for j in self._journals:
+            j.close()
         if self._tmpdir is not None:
             self._tmpdir.cleanup()
             self._tmpdir = None

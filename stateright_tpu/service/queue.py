@@ -133,9 +133,15 @@ class Job:
         priority: int = 0,
         journal: bool = False,
         resume: Optional[JobResume] = None,
+        trace: Optional[str] = None,
     ):
         self.id = job_id
         self.model = model
+        # Flight-recorder correlation id (obs/events.py): minted at the
+        # outermost submission front door (fleet router or this service)
+        # and carried through every replica hop — the key that joins this
+        # job's journal events, spans, and result detail across processes.
+        self.trace = trace
         self.salt_lo, self.salt_hi = job_salt(job_id)
         self.finish_when = finish_when
         self.target_state_count = target_state_count

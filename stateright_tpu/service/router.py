@@ -34,9 +34,17 @@ Routing policy:
   table state, so the move is a clean withdraw-here/submit-there).
 
 `serve_fleet` is the HTTP front door (`POST /jobs`, `GET /jobs/<id>`,
-cancel, fleet-level `/.status` + Prometheus `/metrics` aggregating every
-replica through the obs registry). Overload and injected `service.http`
-faults degrade to 503 + `Retry-After` — clients back off, never hot-loop.
+cancel, `GET /jobs/<id>/events` flight-recorder long-poll, fleet-level
+`/.status` + Prometheus `/metrics` aggregating every replica through the
+obs registry). Overload and injected `service.http` faults degrade to
+503 + `Retry-After` — clients back off, never hot-loop.
+
+Every routing decision is also journaled (obs/events.py, wired by
+`ServiceFleet(journal_dir=...)`): submissions mint a job-scoped trace id
+here and carry it through every replica hop, so `job.submitted` →
+`router.route` → `replica.admit` → crash → `job.requeued` →
+`job.resumed` → `job.done` reads as ONE timeline in the forensic CLI
+(`python -m stateright_tpu.obs.timeline`).
 """
 
 from __future__ import annotations
@@ -48,8 +56,14 @@ from typing import Optional
 
 from ..core.discovery import HasDiscoveries
 from ..faults.ckptio import CheckpointCorrupt, load_latest
-from ..faults.plan import FaultError, _u01, maybe_fault
-from ..obs import REGISTRY, as_tracer
+from ..faults.plan import FaultError, _u01, active_plan, maybe_fault
+from ..obs import (
+    REGISTRY,
+    TERMINAL_EVENT_BY_STATUS,
+    as_events,
+    as_tracer,
+    mint_trace_id,
+)
 from .queue import JobResume, JobStatus
 
 
@@ -150,6 +164,10 @@ class FleetJob:
         self.id = fleet_id
         self.model = model
         self.key = key
+        # Flight-recorder trace id: minted HERE (the outermost front door)
+        # and carried through every replica the job ever touches, so the
+        # timeline CLI reads the whole hop story as one lifecycle.
+        self.trace = mint_trace_id()
         self.opts = opts  # finish_when/targets/timeout/priority
         self.ckpt_path = ckpt_path
         self.status = FleetJobStatus.ROUTED
@@ -209,13 +227,19 @@ class FleetRouter:
         background: bool = False,
         ckpt_dir: Optional[str] = None,
         tracer=None,
+        events=None,
     ):
         """`replicas` are service/fleet.py `Replica` drivers (one
         CheckService each). `background=True` makes probes run under a
         deadline thread (a hung replica must not hang the router);
         foreground mode (deterministic tests) probes inline. `ckpt_dir`
         enables the requeue-resume plane (per-job checkpoint generations
-        written by the replica drivers, restored here on replica death)."""
+        written by the replica drivers, restored here on replica death).
+        `events` is the router's flight-recorder journal (obs/events.py,
+        usually `ServiceFleet(journal_dir=...)`'s `router.jsonl`): every
+        routing decision, failover, requeue, and steal is journaled keyed
+        by the job's trace id, the fleet `/.status` carries the last-N
+        event ring, and `GET /jobs/<id>/events` tails it live."""
         self.replicas = {r.idx: r for r in replicas}
         self.ckpt_dir = ckpt_dir
         self.ring = HashRing(list(self.replicas))
@@ -228,6 +252,7 @@ class FleetRouter:
         self.steal = steal
         self.background = background
         self._tracer = as_tracer(tracer)
+        self._events = as_events(events)
         self._jobs: dict[int, FleetJob] = {}
         self._next_id = 1
         self._lock = threading.RLock()
@@ -261,6 +286,12 @@ class FleetRouter:
         defaults to the model's type name — same-key jobs share a replica
         (and so a compiled step); distinct keys spread over the ring."""
         if not self._healthy():
+            # One of the satellite 503/Retry-After surfaces: journaled so
+            # a forensic pass can see WHY clients were bounced.
+            self._events.emit(
+                "router.unavailable", reason="no healthy replica"
+            )
+            self._tracer.instant("router.unavailable", cat="fleet")
             raise NoHealthyReplica(
                 "every fleet replica is dead; resubmit after recovery"
             )
@@ -279,6 +310,9 @@ class FleetRouter:
             )
             self._next_id += 1
             self._jobs[fj.id] = fj
+        self._events.emit(
+            "job.submitted", job=fj.id, trace=fj.trace, key=key
+        )
         self._place(fj)
         return FleetJobHandle(self, fj)
 
@@ -295,6 +329,7 @@ class FleetRouter:
             out = {
                 "id": fj.id,
                 "status": fj.status,
+                "trace": fj.trace,
                 "replica": fj.replica,
                 "requeues": fj.requeues,
                 "steals": fj.steals,
@@ -375,6 +410,7 @@ class FleetRouter:
             model=fj.model,
             journal=fj.ckpt_path is not None,
             resume=resume,
+            trace=fj.trace,  # one timeline across every replica hop
         )
 
     def _backoff(self, attempt: int) -> None:
@@ -390,51 +426,69 @@ class FleetRouter:
     def _place(self, fj: FleetJob, resume=None) -> bool:
         """Bind `fj` to a replica along its ring preference, retrying
         faults with deterministic backoff. On exhaustion the job is failed
-        (never silently dropped)."""
+        (never silently dropped). The whole walk is one `router.place`
+        span; every failed attempt is a `router.failover` journal event,
+        every binding a `router.route`."""
         last: Optional[BaseException] = None
-        for attempt in range(self.retry_limit + 1):
-            order = [
-                i for i in self.ring.preference(fj.key)
-                if i not in self._dead and self.replicas[i].alive
-            ]
-            if not order:
-                break
-            r = self.replicas[order[attempt % len(order)]]
-            try:
-                # Chaos-plane boundary: an injected `router.timeout` fires
-                # BEFORE the replica is touched, so the retry is exact.
-                maybe_fault("router.timeout", replica=r.idx, job=fj.id)
-                handle = r.submit(self._spec(fj, resume), fj.ckpt_path)
-            except (FaultError, ReplicaDead) as e:
-                last = e
-                with self._lock:
-                    self.counters["router_retries"] += 1
-                self._tracer.instant(
-                    "router.retry", cat="fleet", job=fj.id, replica=r.idx
-                )
-                self._backoff(attempt)
-                continue
-            with self._lock:
-                if fj.status in FleetJobStatus.FINISHED:
-                    # A cancel raced the (re)placement: reap the copy.
-                    try:
-                        handle.cancel()
-                    except Exception:  # noqa: BLE001 — best-effort reap
-                        pass
-                    return False
-                if r.idx in self._dead or not r.alive:
-                    # The replica died between submit and bind: binding now
-                    # would park the job on a corpse forever (the death
-                    # handler already scanned for orphans and missed this
-                    # still-unbound job). Treat it as a failed attempt.
-                    last = ReplicaDead(
-                        f"replica {r.idx} died during placement"
+        with self._tracer.span(
+            "router.place", cat="fleet", job=fj.id, trace=fj.trace,
+            resumed=resume is not None,
+        ):
+            for attempt in range(self.retry_limit + 1):
+                order = [
+                    i for i in self.ring.preference(fj.key)
+                    if i not in self._dead and self.replicas[i].alive
+                ]
+                if not order:
+                    break
+                r = self.replicas[order[attempt % len(order)]]
+                try:
+                    # Chaos-plane boundary: an injected `router.timeout`
+                    # fires BEFORE the replica is touched, so the retry is
+                    # exact.
+                    maybe_fault("router.timeout", replica=r.idx, job=fj.id)
+                    handle = r.submit(self._spec(fj, resume), fj.ckpt_path)
+                except (FaultError, ReplicaDead) as e:
+                    last = e
+                    with self._lock:
+                        self.counters["router_retries"] += 1
+                    self._tracer.instant(
+                        "router.failover", cat="fleet", job=fj.id,
+                        replica=r.idx, trace=fj.trace,
                     )
+                    self._events.emit(
+                        "router.failover", job=fj.id, trace=fj.trace,
+                        replica=r.idx, error=type(e).__name__,
+                    )
+                    self._backoff(attempt)
                     continue
-                fj.replica = r.idx
-                fj.handle = handle
-                self.counters["jobs_routed"] += 1
-            return True
+                with self._lock:
+                    if fj.status in FleetJobStatus.FINISHED:
+                        # A cancel raced the (re)placement: reap the copy.
+                        try:
+                            handle.cancel()
+                        except Exception:  # noqa: BLE001 — best-effort reap
+                            pass
+                        return False
+                    if r.idx in self._dead or not r.alive:
+                        # The replica died between submit and bind: binding
+                        # now would park the job on a corpse forever (the
+                        # death handler already scanned for orphans and
+                        # missed this still-unbound job). Treat it as a
+                        # failed attempt.
+                        last = ReplicaDead(
+                            f"replica {r.idx} died during placement"
+                        )
+                        continue
+                    fj.replica = r.idx
+                    fj.handle = handle
+                    self.counters["jobs_routed"] += 1
+                self._events.emit(
+                    "router.route", job=fj.id, trace=fj.trace,
+                    replica=r.idx, resumed=bool(resume) or None,
+                    attempt=attempt or None,
+                )
+                return True
         with self._lock:
             if fj.status in FleetJobStatus.FINISHED:
                 return False  # cancelled while no replica would take it
@@ -448,6 +502,13 @@ class FleetRouter:
     def _finish(self, fj: FleetJob, status: str) -> None:
         fj.status = status
         fj.finished_at = time.monotonic()
+        # Every fleet job's timeline ends with exactly one router-side
+        # terminal event (the timeline CLI's no_terminal anomaly guard).
+        self._events.emit(
+            TERMINAL_EVENT_BY_STATUS[status], job=fj.id, trace=fj.trace,
+            error=fj.error, requeues=fj.requeues or None,
+            steals=fj.steals or None,
+        )
         fj.event.set()
 
     # -- supervision tick ------------------------------------------------------
@@ -456,6 +517,17 @@ class FleetRouter:
         """One supervision round: probe health (dead → requeue), harvest
         finished inner jobs, steal for idle replicas. Driven by the fleet's
         router thread (background) or `ServiceFleet.pump` (foreground)."""
+        plan = active_plan()
+        if (
+            plan is not None
+            and self._events.enabled
+            and (plan.events is None or plan.events.closed)
+        ):
+            # Flight-recorder adoption of the active chaos plan: every
+            # injection anywhere in the process lands in this journal as
+            # `fault.injected` — a chaos run is an auditable recording.
+            # A closed adoptee (a previous run's journal) is replaced.
+            plan.events = self._events
         self._probe_all()
         self._harvest()
         if self.steal:
@@ -474,6 +546,17 @@ class FleetRouter:
                 continue
             self.counters["probe_failures"] += 1
             self._suspect[r.idx] += 1
+            # Journal/span only probe FAILURES: healthy probes fire every
+            # tick per replica and would drown both planes in no-ops —
+            # the suspect counter is the forensic story a failure tells.
+            self._tracer.instant(
+                "router.probe_failure", cat="fleet", replica=r.idx,
+                suspect=self._suspect[r.idx],
+            )
+            self._events.emit(
+                "router.probe", replica=r.idx, ok=0,
+                suspect=self._suspect[r.idx],
+            )
             if self._suspect[r.idx] >= self.unhealthy_after or not r.alive:
                 self._on_replica_death(r)
 
@@ -521,16 +604,31 @@ class FleetRouter:
             "fleet.replica_dead", cat="fleet", replica=r.idx,
             orphans=len(orphans),
         )
-        for fj in orphans:
-            with self._lock:
-                fj.requeues += 1
-                fj.replica = None
-                fj.handle = None
-                self.counters["requeued_jobs"] += 1
-            resume = self._load_resume(fj)
-            if resume is not None:
-                self.counters["restored_jobs"] += 1
-            self._place(fj, resume=resume)
+        # The router is the single authority on fleet membership, so it
+        # (not the replica driver) writes the one `replica.crash` event —
+        # event counts stay consistent with the `replica_crashes` counter.
+        self._events.emit(
+            "replica.crash", replica=r.idx, error=r.error,
+            orphans=len(orphans),
+        )
+        with self._tracer.span(
+            "fleet.requeue", cat="fleet", replica=r.idx,
+            orphans=len(orphans),
+        ):
+            for fj in orphans:
+                with self._lock:
+                    fj.requeues += 1
+                    fj.replica = None
+                    fj.handle = None
+                    self.counters["requeued_jobs"] += 1
+                resume = self._load_resume(fj)
+                if resume is not None:
+                    self.counters["restored_jobs"] += 1
+                self._events.emit(
+                    "job.requeued", job=fj.id, trace=fj.trace, src=r.idx,
+                    restored=resume is not None,
+                )
+                self._place(fj, resume=resume)
 
     def _load_resume(self, fj: FleetJob) -> Optional[JobResume]:
         if fj.ckpt_path is None:
@@ -540,7 +638,7 @@ class FleetRouter:
         except (CheckpointCorrupt, FileNotFoundError, OSError):
             return None  # no intact generation: restart fresh (still exact)
         self._tracer.instant(
-            "fleet.restore", cat="fleet", job=fj.id, src=src
+            "fleet.restore", cat="fleet", job=fj.id, src=src, trace=fj.trace
         )
         return JobResume.from_npz(data)
 
@@ -619,25 +717,45 @@ class FleetRouter:
                     maybe_fault("fleet.steal", src=v_idx, dst=thief.idx)
                 except FaultError:
                     return  # injected steal fault: job stays put
-                if not victim.withdraw(fj.handle.id):
-                    continue  # admitted meanwhile: not stealable
-                # A stolen job may itself be a requeue carrying checkpointed
-                # progress (queued on the victim behind max_resident): the
-                # thief must resume from the newest intact generation, not
-                # restart the search (None when no generation exists yet).
-                resume = self._load_resume(fj)
-                try:
-                    handle = thief.submit(
-                        self._spec(fj, resume), fj.ckpt_path
-                    )
-                except (FaultError, ReplicaDead):
-                    # Thief died mid-steal: the job was already withdrawn,
-                    # so place it like any orphan (never lost).
-                    with self._lock:
-                        fj.replica = None
-                        fj.handle = None
-                    self._place(fj, resume=resume)
-                    continue
+                with self._tracer.span(
+                    "router.steal", cat="fleet", job=fj.id, src=v_idx,
+                    dst=thief.idx, trace=fj.trace,
+                ):
+                    if not victim.withdraw(fj.handle.id):
+                        continue  # admitted meanwhile: not stealable
+                    # A stolen job may itself be a requeue carrying
+                    # checkpointed progress (queued on the victim behind
+                    # max_resident): the thief must resume from the newest
+                    # intact generation, not restart the search (None when
+                    # no generation exists yet). Count the restore so the
+                    # journal's job.resumed events stay equal to the
+                    # restored_jobs counter (the flight-recorder
+                    # consistency pin).
+                    resume = self._load_resume(fj)
+                    if resume is not None:
+                        with self._lock:
+                            self.counters["restored_jobs"] += 1
+                    try:
+                        handle = thief.submit(
+                            self._spec(fj, resume), fj.ckpt_path
+                        )
+                    except (FaultError, ReplicaDead):
+                        # Thief died mid-steal: the job was already
+                        # withdrawn, so place it like any orphan (never
+                        # lost) — and account it like one too, so the
+                        # journal's job.requeued events stay equal to the
+                        # requeued_jobs counter.
+                        with self._lock:
+                            fj.replica = None
+                            fj.handle = None
+                            fj.requeues += 1
+                            self.counters["requeued_jobs"] += 1
+                        self._events.emit(
+                            "job.requeued", job=fj.id, trace=fj.trace,
+                            src=v_idx, reason="thief died mid-steal",
+                        )
+                        self._place(fj, resume=resume)
+                        continue
                 with self._lock:
                     if fj.status in FleetJobStatus.FINISHED:
                         # A fleet-level cancel raced the steal: don't leave
@@ -653,6 +771,10 @@ class FleetRouter:
                     self.counters["steals"] += 1
                 self._tracer.instant(
                     "fleet.steal", cat="fleet", job=fj.id,
+                    src=v_idx, dst=thief.idx, trace=fj.trace,
+                )
+                self._events.emit(
+                    "fleet.steal", job=fj.id, trace=fj.trace,
                     src=v_idx, dst=thief.idx,
                 )
                 moved += 1
@@ -686,13 +808,32 @@ class FleetRouter:
                 ),
                 **self.counters,
                 "per_replica": per_replica,
+                # Last-N flight-recorder events — the `/.status` at-a-
+                # glance ring ([] when the fleet journals nothing; the
+                # registry's flatten drops it from /metrics, where
+                # unbounded label text does not belong).
+                "events_recent": self._events.recent(16),
             }
 
     def metrics(self) -> dict:
         return self.stats()
 
+    def events_tail(
+        self, job_id: Optional[int] = None, since: int = 0,
+        wait_s: float = 0.0,
+    ) -> tuple:
+        """Flight-recorder tail over the ROUTER journal (fleet-level job
+        ids) — the `GET /jobs/<id>/events` long-poll primitive; replica
+        journals are merged offline by obs/timeline.py."""
+        return self._events.tail(since=since, job=job_id, wait_s=wait_s)
+
     def close(self) -> None:
         REGISTRY.unregister(self._metrics_name)
+        # Release a chaos plan that adopted this router's recorder (the
+        # plan may outlive the fleet; see CheckService.close).
+        plan = active_plan()
+        if plan is not None and plan.events is self._events:
+            plan.events = None
 
 
 # -- HTTP front door -----------------------------------------------------------
@@ -719,7 +860,7 @@ def serve_fleet(
 
     from ..explorer.server import ExplorerServer
     from ..obs import render_prometheus
-    from .server import RETRY_AFTER_S, ModelRegistry
+    from .server import RETRY_AFTER_S, ModelRegistry, events_view
 
     router = fleet.router
     reg = registry if registry is not None else ModelRegistry()
@@ -758,12 +899,21 @@ def serve_fleet(
             try:
                 maybe_fault("service.http", method=method, path=self.path)
             except FaultError as e:
+                # The 503 surface is part of the flight recording: the
+                # forensic pass can see the front door bouncing clients.
+                router._events.emit(
+                    "router.unavailable",
+                    reason=f"injected http fault ({method})",
+                )
+                router._tracer.instant(
+                    "router.unavailable", cat="fleet", method=method
+                )
                 self._503(f"injected fault: {e}")
                 return True
             return False
 
         def _job_id(self, suffix: str = "") -> Optional[int]:
-            raw = self.path[len("/jobs/"):]
+            raw = self.path.partition("?")[0][len("/jobs/"):]
             if suffix:
                 if not raw.endswith(suffix):
                     return None
@@ -776,14 +926,21 @@ def serve_fleet(
         def do_GET(self):
             if self._injected_503("GET"):
                 return
+            path, _, query = self.path.partition("?")
             try:
-                if self.path == "/.status":
+                if path == "/.status":
                     self._json(fleet_status_view(router))
                     return
-                if self.path == "/metrics":
+                if path == "/metrics":
                     self._text(render_prometheus(REGISTRY.collect()))
                     return
-                if self.path.startswith("/jobs/"):
+                if path.startswith("/jobs/"):
+                    if path.endswith("/events"):
+                        jid = self._job_id("/events")
+                        if jid is not None:
+                            router._get(jid)  # 404 on unknown jobs
+                            self._json(events_view(router, jid, query))
+                            return
                     jid = self._job_id()
                     if jid is not None:
                         self._json(router.poll(jid))

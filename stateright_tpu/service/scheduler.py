@@ -46,7 +46,7 @@ import jax.numpy as jnp
 from ..core.model import Expectation
 from ..faults.plan import maybe_fault
 from ..knobs import STORE_KINDS
-from ..obs import StepRing, as_tracer
+from ..obs import StepRing, as_events, as_tracer
 from ..tensor.fingerprint import pack_fp, salt_fp, unpack_fp
 from ..tensor.frontier import (
     FrontierSearch,
@@ -184,6 +184,7 @@ class ServiceEngine:
         telemetry: bool = True,
         telemetry_log2: int = 12,
         tracer=None,
+        events=None,
     ):
         self.batch_size = batch_size
         if insert_variant not in self.INSERT_VARIANTS:
@@ -205,6 +206,10 @@ class ServiceEngine:
         # keeps the last 2^telemetry_log2 step rows).
         self._ring = StepRing(1 << telemetry_log2) if telemetry else None
         self._tracer = as_tracer(tracer)
+        # Flight recorder (obs/events.py): one `engine.chunk` journal event
+        # per fused device step — the engine-level rung of a job's
+        # cross-replica timeline (NULL_EVENTS = free when off).
+        self._events = as_events(events)
         if store not in STORE_KINDS:  # knob universe: knobs.py
             raise ValueError(f"store must be one of {STORE_KINDS}, got {store!r}")
         self.store = store
@@ -712,6 +717,17 @@ class ServiceEngine:
             self.table.p_lo, self.table.p_hi = pl, ph
             self.hot_claims -= n_ev
 
+        # -- flight-recorder chunk event (scalars already host-side) -----------
+        if self._events.enabled:
+            self._events.emit(
+                "engine.chunk",
+                jobs=[j.id for j, _s, _e in segments],
+                traces=[j.trace for j, _s, _e in segments if j.trace],
+                step=self.total_steps,
+                lanes=m,
+                claimed=nc,
+            )
+
         # -- step telemetry row (every scalar above is already host-side) ------
         if self._ring is not None:
             self._ring.append(
@@ -762,6 +778,8 @@ class ServiceEngine:
             detail["telemetry"] = t
         if job.timed_out:
             detail["timed_out"] = True
+        if job.trace:
+            detail["trace"] = job.trace
         ref = job.metrics.admitted_at or job.metrics.submitted_at
         return SearchResult(
             state_count=job.state_count,
@@ -796,6 +814,9 @@ class ServiceEngine:
             job.error = msg
             job.metrics.finished_at = time.monotonic()
             job.drop_frontier()
+            self._events.emit(
+                "job.error", job=job.id, trace=job.trace, error=msg
+            )
             job.event.set()
         group.jobs.clear()
 

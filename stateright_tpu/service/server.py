@@ -19,6 +19,12 @@ Endpoints:
 - ``POST /jobs/<id>/cancel`` / ``DELETE /jobs/<id>`` — cancel.
 - ``GET /jobs/<id>/discoveries`` — the reconstructed discovery paths of a
   finished job (action-label lists, the `assert_discovery` currency).
+- ``GET /jobs/<id>/events?since=N&wait=S`` — live flight-recorder tail
+  (obs/events.py; the service must be built with ``events``/
+  ``events_out``): journal events naming the job with cursor >= ``since``,
+  long-polling up to ``wait`` seconds for the first match. The response's
+  ``next`` is the cursor to pass back — the dashboard follow-a-job
+  primitive.
 
 The view builders are pure functions over the service, the same
 test-without-sockets strategy as explorer/server.py.
@@ -29,6 +35,7 @@ from __future__ import annotations
 import json
 import threading
 from typing import Callable, Optional
+from urllib.parse import parse_qs
 
 from ..explorer.server import ExplorerServer
 from ..faults.plan import FaultError, maybe_fault
@@ -134,6 +141,25 @@ def submit_view(
     return {"job": handle.id}
 
 
+def events_view(service, job_id: int, query: str) -> dict:
+    """JSON for `GET /jobs/<id>/events?since=N&wait=S`: the flight-recorder
+    long-poll (shared by serve_service and serve_fleet — `service` is
+    anything with `events_tail`). Malformed cursors degrade to defaults —
+    an observability endpoint must never 500 over a bad query."""
+    q = parse_qs(query)
+    try:
+        since = int(q.get("since", ["0"])[0])
+    except ValueError:
+        since = 0
+    try:
+        # Cap the long-poll under common proxy/client timeouts.
+        wait_s = max(0.0, min(float(q.get("wait", ["0"])[0]), 25.0))
+    except ValueError:
+        wait_s = 0.0
+    events, nxt = service.events_tail(job_id, since=since, wait_s=wait_s)
+    return {"events": events, "next": nxt}
+
+
 def discoveries_view(service: CheckService, job_id: int) -> dict:
     job = service._get(job_id)
     paths = service.discovery_paths(job_id)
@@ -178,7 +204,7 @@ def serve_service(
             self.wfile.write(body)
 
         def _job_id(self, suffix: str = "") -> Optional[int]:
-            raw = self.path[len("/jobs/"):]
+            raw = self.path.partition("?")[0][len("/jobs/"):]
             if suffix:
                 if not raw.endswith(suffix):
                     return None
@@ -218,18 +244,25 @@ def serve_service(
         def do_GET(self):
             if self._injected_503("GET"):
                 return
+            path, _, query = self.path.partition("?")
             try:
-                if self.path == "/.status":
+                if path == "/.status":
                     self._json(status_view(service))
                     return
-                if self.path == "/metrics":
+                if path == "/metrics":
                     self._text(metrics_view(service))
                     return
-                if self.path.startswith("/jobs/"):
-                    if self.path.endswith("/discoveries"):
+                if path.startswith("/jobs/"):
+                    if path.endswith("/discoveries"):
                         jid = self._job_id("/discoveries")
                         if jid is not None:
                             self._json(discoveries_view(service, jid))
+                            return
+                    if path.endswith("/events"):
+                        jid = self._job_id("/events")
+                        if jid is not None:
+                            service._get(jid)  # 404 on unknown jobs
+                            self._json(events_view(service, jid, query))
                             return
                     jid = self._job_id()
                     if jid is not None:

@@ -143,6 +143,51 @@ def test_detail_counter_keys_conform_to_obs_schema():
     assert validate_detail(detail) == ["telemetry.renamed_counter"]
 
 
+def test_device_detail_pins_journal_row_keys():
+    # The BENCH_OBS=1 flight-recorder journal A/B sub-row is part of the
+    # artifact contract: the journal-off wall time, the measured
+    # journal-on overhead through the check service (acceptance <= 5%),
+    # and the recorded event count must survive into detail.device so the
+    # "recording is free" claim is auditable in every BENCH_r*.json.
+    for key in ("sec_journal_off", "journal_overhead_pct", "journal_events"):
+        assert key in bench.DEVICE_DETAIL_FIELDS
+    row = bench.device_detail(
+        {
+            "states_per_sec": 6600.0,
+            "sec": 1.25,
+            "sec_journal_off": 1.24,
+            "journal_overhead_pct": 0.8,
+            "journal_events": 17,
+        }
+    )
+    assert row["sec_journal_off"] == 1.24
+    assert row["journal_overhead_pct"] == 0.8
+    assert row["journal_events"] == 17
+
+
+def test_event_vocabulary_conforms_to_obs_schema():
+    # The flight-recorder event vocabulary is the documented obs schema's
+    # (obs/schema.py EVENT_TYPES): every emit site in the library must use
+    # a declared name (srlint SR003 enforces the literal sites; this pins
+    # the schema's own shape), and the timeline CLI's lifecycle logic
+    # depends on these exact spellings.
+    from stateright_tpu.obs.schema import EVENT_TYPES, TERMINAL_EVENTS
+
+    for name in (
+        "job.submitted", "router.route", "router.failover", "replica.admit",
+        "engine.chunk", "ckpt.write", "fault.injected", "fleet.steal",
+        "job.requeued", "job.resumed", "job.done",
+    ):
+        assert name in EVENT_TYPES
+        assert isinstance(EVENT_TYPES[name], tuple)
+    for name in TERMINAL_EVENTS:
+        assert name in EVENT_TYPES
+    # Required-field maps name real correlation currency.
+    assert "job" in EVENT_TYPES["job.submitted"]
+    assert set(EVENT_TYPES["fleet.steal"]) == {"job", "src", "dst"}
+    assert set(EVENT_TYPES["fault.injected"]) == {"point", "kind"}
+
+
 def test_device_detail_pins_faults_row_keys():
     # The BENCH_FAULTS=1 supervisor-overhead A/B row is part of the
     # artifact contract: the recovery digest plus the unsupervised wall

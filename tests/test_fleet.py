@@ -236,12 +236,19 @@ def test_steal_fault_leaves_job_where_it_was(fleet2):
 
 
 def test_hung_replica_detected_and_jobs_requeued():
-    # Probe deadline well under the hang gate but generous enough that a
-    # LOADED host can't starve the healthy replica's (trivial, lock-free)
-    # probe past it — this test must detect the hang, not the scheduler.
+    # Probe deadline well under the hang gate (2.0s) but generous enough
+    # that a LOADED host can't starve the healthy replica's (trivial,
+    # lock-free) probe past it — this test must detect the hang, not the
+    # scheduler. 0.3s/after-3 flaked rarely on 2-core CI boxes mid-suite
+    # (compile threads starve the probe worker); 0.5s/after-2 widens the
+    # margin while keeping detection at ~1s. NOTE an in-proc "hung"
+    # replica only hangs its PROBE — its driver keeps stepping (the
+    # ROADMAP fencing residue), so a fast job can legitimately finish on
+    # the victim before the router declares it dead; only REQUEUED jobs
+    # are guaranteed off it.
     fleet = ServiceFleet(
         n_replicas=2, background=True, service_kwargs=SVC_KW,
-        router_kwargs=dict(probe_timeout_s=0.3, unhealthy_after=3),
+        router_kwargs=dict(probe_timeout_s=0.5, unhealthy_after=2),
     )
     try:
         handles = [fleet.submit(M3) for _ in range(2)]
@@ -255,9 +262,10 @@ def test_hung_replica_detected_and_jobs_requeued():
         for h in handles:
             r = h.result()
             assert (r.state_count, r.unique_state_count) == GOLD_2PC3
-            assert h._job.replica != victim
+            if h._job.requeues:
+                assert h._job.replica != victim
         s = fleet.stats()
-        assert s["probe_failures"] >= 3
+        assert s["probe_failures"] >= 2
         assert s["replica_crashes"] >= 1
         assert victim in fleet.router._dead  # the HUNG one was declared dead
     finally:
