@@ -920,6 +920,117 @@ def device_search_fleet(n_replicas: int = 3):
     return out, err
 
 
+def device_search_corpus(model_name: str = "2pc", n: int = 4):
+    """BENCH_CORPUS=1 row: cold-vs-warm A/B of the cross-job warm-start
+    corpus (store/corpus.py, ROADMAP item 4). Two tiered services with a
+    pre-compiled step each (a throwaway first submission absorbs the
+    compile on BOTH sides, so the ratio is pure search time): the cold
+    side re-explores the anchor from scratch; the corpus side's first
+    submission published the visited set, so its measured submission
+    preloads the entry and completes warm. Acceptance: warm >= 5x faster,
+    results bit-identical, and a corrupted entry (one flipped byte) is
+    detected by the ckptio CRC and ignored — the third submission runs
+    cold and still completes correctly."""
+    _pin_platform()
+    import tempfile
+
+    from stateright_tpu.service import CheckService
+
+    model, _batch, _tl2, _run_kwargs, _ekw, golden, _cs = _build_workload(
+        model_name, n
+    )
+    svc_kw = dict(
+        batch_size=1024,
+        table_log2=18,
+        store="tiered",
+        high_water=0.9,
+        summary_log2=18,
+        background=False,
+    )
+
+    def timed_submit(svc):
+        t0 = time.monotonic()
+        h = svc.submit(model)
+        svc.drain(timeout=1800)
+        return time.monotonic() - t0, h.result()
+
+    # Cold reference: corpus-less service, post-compile second submission.
+    cold_svc = CheckService(**svc_kw)
+    timed_submit(cold_svc)  # compile warm-up (timing discarded)
+    cold_sec, cold_r = timed_submit(cold_svc)
+    cold_svc.close()
+
+    with tempfile.TemporaryDirectory(prefix="srtpu-corpus-") as corpus_dir:
+        warm_svc = CheckService(corpus_dir=corpus_dir, **svc_kw)
+        timed_submit(warm_svc)  # compile warm-up + corpus publish
+        warm_sec, warm_r = timed_submit(warm_svc)
+        warm_corpus = dict(warm_r.detail.get("corpus") or {})
+
+        # Satellite: flip one payload byte in the published entry — the
+        # CRC footer must catch it and the next submission must complete
+        # correctly COLD (never wrong results).
+        import glob as _glob
+
+        from stateright_tpu.faults.ckptio import corrupt_one_byte
+
+        corrupt_one_byte(
+            _glob.glob(os.path.join(corpus_dir, "corpus-*.npz"))[0]
+        )
+        _sec3, third_r = timed_submit(warm_svc)
+        stats = warm_svc.stats()
+        corrupt_detected = stats.get("corpus", {}).get(
+            "corrupt_entries", 0
+        ) >= 1
+        warm_svc.close()
+
+    err = None
+    for name, r in (("warm", warm_r), ("corrupt-cold", third_r)):
+        got = (r.state_count, r.unique_state_count, r.max_depth)
+        want = (
+            cold_r.state_count, cold_r.unique_state_count, cold_r.max_depth,
+        )
+        if got != want or sorted(r.discoveries.items()) != sorted(
+            cold_r.discoveries.items()
+        ):
+            err = (
+                f"corpus parity failure ({name}): {got} / "
+                f"{sorted(r.discoveries.items())} != cold {want} / "
+                f"{sorted(cold_r.discoveries.items())}"
+            )
+            break
+    if err is None and golden is not None and (
+        warm_r.state_count, warm_r.unique_state_count,
+    ) != golden:
+        err = (
+            f"corpus golden failure: "
+            f"{(warm_r.state_count, warm_r.unique_state_count)} != {golden}"
+        )
+    if err is None and not warm_corpus.get("warm_start"):
+        err = "corpus warm submission did not take the warm path"
+    if err is None and not corrupt_detected:
+        err = "corrupted corpus entry was not detected by the CRC check"
+    warm_speedup = round(cold_sec / max(warm_sec, 1e-9), 2)
+    if err is None and warm_speedup < 5.0:
+        # The acceptance bar is part of the row contract, not just prose.
+        err = (
+            f"warm submission only {warm_speedup}x faster than cold "
+            "(acceptance >= 5x)"
+        )
+
+    out = {
+        "states": warm_r.state_count,
+        "unique": warm_r.unique_state_count,
+        "sec": round(warm_sec, 4),
+        "states_per_sec": warm_r.state_count / max(warm_sec, 1e-9),
+        "compile_sec": 0.0,  # both sides measured post-compile (A/B fair)
+        "sec_cold": round(cold_sec, 4),
+        "warm_speedup": warm_speedup,
+        "corpus_preloaded": int(warm_corpus.get("preloaded_states", 0)),
+        "corrupt_detected": corrupt_detected,
+    }
+    return out, err
+
+
 def device_search_sharded(model_name: str, n: int, n_chips: int = 8):
     """Run the multi-chip sharded engine over a mesh of `n_chips` (virtual
     CPU devices when real multi-chip hardware is absent — the bench marks
@@ -1079,6 +1190,11 @@ DEVICE_DETAIL_FIELDS = (
     "n_replicas", "fleet_jobs_per_sec", "sec_one_replica",
     "vs_one_replica", "fleet_p50_ms", "fleet_p99_ms",
     "fleet_steals", "fleet_requeued",
+    # Warm-start corpus (BENCH_CORPUS=1 row): the cold wall time next to
+    # the warm submission's (`sec`), the cold/warm ratio (acceptance >=
+    # 5x), the preloaded-state count, and the corrupted-entry CRC verdict
+    # (True = a flipped byte was detected and the run fell back cold).
+    "sec_cold", "warm_speedup", "corpus_preloaded", "corrupt_detected",
 )
 
 
@@ -1308,6 +1424,14 @@ def main(argv: list | None = None) -> int:
         # in detail.device["fleet-mixed-3"]).
         if os.environ.get("BENCH_FLEET") == "1" and not smoke:
             workloads += (("fleet-mixed", 3, 2400.0, "--worker-fleet", None),)
+        # BENCH_CORPUS=1: add the cross-job warm-start cold-vs-warm A/B on
+        # the 2pc-4 anchor (second submission of the same content key
+        # through a corpus-enabled tiered service; the measured ratio
+        # lands in detail.device["2pc-4-corpus"].warm_speedup — acceptance
+        # >= 5x with bit-identical results — next to the corrupted-entry
+        # CRC verdict).
+        if os.environ.get("BENCH_CORPUS") == "1" and not smoke:
+            workloads += (("2pc", 4, 2400.0, "--worker-corpus", None),)
         for model, n, wl_timeout, mode, env_extra in workloads:
             key = f"{model}-{n}" + (
                 {
@@ -1316,6 +1440,7 @@ def main(argv: list | None = None) -> int:
                     "--worker-journal": "-journal",
                     "--worker-faults": "-faults",
                     "--worker-pallas": "-pallas",
+                    "--worker-corpus": "-corpus",
                     "--worker-fleet": "",
                 }.get(mode, "")
             )
@@ -1404,6 +1529,8 @@ def worker_main(model_name: str, n: int, mode: str = "--worker") -> int:
             r, perr = device_search_faults(model_name, n)
         elif mode == "--worker-pallas":
             r, perr = device_search_pallas(model_name, n)
+        elif mode == "--worker-corpus":
+            r, perr = device_search_corpus(model_name, n)
         else:
             r, perr = device_search(model_name, n)
         print(json.dumps({"result": r, "error": perr}), flush=True)
@@ -1419,7 +1546,7 @@ if __name__ == "__main__":
     if len(sys.argv) == 4 and sys.argv[1] in (
         "--worker", "--worker-sharded", "--worker-service", "--worker-obs",
         "--worker-journal", "--worker-faults", "--worker-pallas",
-        "--worker-fleet",
+        "--worker-fleet", "--worker-corpus",
     ):
         sys.exit(worker_main(sys.argv[2], int(sys.argv[3]), mode=sys.argv[1]))
     if len(sys.argv) == 2 and sys.argv[1] == "--worker-analysis":

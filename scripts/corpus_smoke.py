@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Warm-start corpus smoke: publish -> warm hit -> corrupt -> cold fallback
+-> re-publish -> warm again, end to end through the check service.
+
+CI-shaped: exercises the whole cross-job warm-start path (store/corpus.py)
+in one command — content-key derivation, corpus publish on completion,
+tiered preload + device Bloom dedup on the second submission, the CRC
+corrupt-entry fallback (one flipped byte => detected, ignored, correct cold
+run), and the re-publish that heals the corpus. Exit code 0 iff every
+submission returned the golden counts, the warm submissions actually took
+the warm path (fewer fused steps), and the corruption was detected.
+
+    JAX_PLATFORMS=cpu python scripts/corpus_smoke.py
+"""
+
+import glob
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+GOLD_2PC3 = (1_146, 288)
+
+
+def main() -> int:
+    import jax
+
+    p = os.environ.get("JAX_PLATFORMS")
+    if p:
+        # The image's site config re-registers the axon TPU platform over a
+        # plain env var; pin at the jax.config level (same move as bench.py).
+        jax.config.update("jax_platforms", p)
+
+    from stateright_tpu.service import CheckService
+    from stateright_tpu.tensor.models import TensorTwoPhaseSys
+
+    model = TensorTwoPhaseSys(3)
+    failures = []
+
+    def submit(svc, label, expect_warm):
+        t0 = time.monotonic()
+        h = svc.submit(model)
+        svc.drain(timeout=600)
+        sec = time.monotonic() - t0
+        r = h.result()
+        corpus = r.detail.get("corpus") or {}
+        print(
+            f"{label}: states={r.state_count} unique={r.unique_state_count} "
+            f"steps={r.steps} sec={sec:.2f} corpus={corpus}"
+        )
+        if (r.state_count, r.unique_state_count) != GOLD_2PC3:
+            failures.append(f"{label}: counts != {GOLD_2PC3}")
+        if corpus.get("warm_start", False) != expect_warm:
+            failures.append(
+                f"{label}: warm_start={corpus.get('warm_start')} "
+                f"(expected {expect_warm})"
+            )
+        return r
+
+    with tempfile.TemporaryDirectory(prefix="srtpu-corpus-") as corpus_dir:
+        svc = CheckService(
+            batch_size=256, table_log2=15, store="tiered",
+            summary_log2=16, corpus_dir=corpus_dir, background=False,
+        )
+        r_cold = submit(svc, "cold (publishes)", expect_warm=False)
+        if not (r_cold.detail.get("corpus") or {}).get("published"):
+            failures.append("cold run did not publish a corpus entry")
+
+        r_warm = submit(svc, "warm (corpus hit)", expect_warm=True)
+        if r_warm.steps >= r_cold.steps:
+            failures.append(
+                f"warm run used {r_warm.steps} steps vs cold {r_cold.steps}"
+            )
+        if r_warm.discoveries != r_cold.discoveries:
+            failures.append("warm discoveries != cold discoveries")
+
+        # Corrupt the published entry (one flipped payload byte): the
+        # ckptio CRC footer must catch it and the next submission must
+        # fall back to a CORRECT cold run, then re-publish.
+        from stateright_tpu.faults.ckptio import corrupt_one_byte
+
+        (entry,) = glob.glob(os.path.join(corpus_dir, "corpus-*.npz"))
+        corrupt_one_byte(entry)
+        print(f"corrupted one byte of {os.path.basename(entry)}")
+
+        r_corrupt = submit(svc, "corrupt (cold fallback)", expect_warm=False)
+        stats = svc.stats().get("corpus") or {}
+        print("corpus stats:", stats)
+        if stats.get("corrupt_entries", 0) < 1:
+            failures.append("corrupted entry was not detected by the CRC")
+        if not (r_corrupt.detail.get("corpus") or {}).get("published"):
+            failures.append("cold fallback did not re-publish the entry")
+
+        submit(svc, "re-warm (healed corpus)", expect_warm=True)
+        svc.close()
+
+    if failures:
+        print("FAILURES:", "; ".join(failures), file=sys.stderr)
+        return 1
+    print("corpus smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

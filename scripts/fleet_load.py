@@ -10,10 +10,15 @@ the same load twice — N replicas, then 1 — and prints the jobs/s ratio
 (the scale-out claim: N=3 beats N=1 on the mixed set).
 
     JAX_PLATFORMS=cpu python scripts/fleet_load.py \
-        [--replicas 3] [--clients 100] [--jobs 200] [--compare] [--crash]
+        [--replicas 3] [--clients 100] [--jobs 200] [--compare] [--crash] \
+        [--warm]
 
 `--crash` additionally kills one replica mid-load through the chaos plane
-(`fleet.replica_crash`) and asserts zero lost jobs.
+(`fleet.replica_crash`) and asserts zero lost jobs. `--warm` pre-publishes
+the mixed model set into a shared warm-start corpus (store/corpus.py) and
+runs the load against it, then runs the SAME load cold and prints
+warm-vs-cold jobs/s and p50 side by side (with `--compare` both modes also
+get their 1-replica baseline).
 """
 
 import argparse
@@ -34,15 +39,48 @@ MIX = (
 )
 
 
-def run_load(n_replicas, clients, jobs, crash=False):
+def prepublish_corpus(corpus_dir):
+    """Pre-publish the mixed model set: one cold submission per model
+    through a corpus-enabled 1-replica fleet fills the shared directory
+    the warm load then hits."""
+    from stateright_tpu.service import ServiceFleet
+    from stateright_tpu.service.server import ModelRegistry
+
+    fleet = ServiceFleet(
+        n_replicas=1,
+        background=True,
+        service_kwargs=dict(batch_size=512, table_log2=16),
+        corpus_dir=corpus_dir,
+    )
+    registry = ModelRegistry()
+    try:
+        handles = [
+            fleet.submit(registry.get(name, args)) for name, args, _ in MIX
+        ]
+        fleet.drain(timeout=600)
+        for h in handles:
+            h.result()
+    finally:
+        fleet.close()
+
+
+def run_load(n_replicas, clients, jobs, crash=False, corpus_dir=None,
+             tiered=False):
     from stateright_tpu.faults import FaultPlan, active
     from stateright_tpu.service import ServiceFleet, serve_fleet
 
+    svc_kw = dict(batch_size=512, table_log2=16)
+    if tiered or corpus_dir is not None:
+        # Warm A/B fairness: the cold side of --warm runs the SAME tiered
+        # store config as the corpus side, so the ratio measures the
+        # corpus, not the store kind.
+        svc_kw["store"] = "tiered"
     fleet = ServiceFleet(
         n_replicas=n_replicas,
         background=True,
         max_resident=4,
-        service_kwargs=dict(batch_size=512, table_log2=16),
+        service_kwargs=svc_kw,
+        corpus_dir=corpus_dir,
     )
     srv = serve_fleet(fleet, address="localhost:0")
     base = "http://" + srv.address
@@ -155,6 +193,9 @@ def main(argv=None) -> int:
                     help="also run the same load on 1 replica; print ratio")
     ap.add_argument("--crash", action="store_true",
                     help="kill replica 0 mid-load via the chaos plane")
+    ap.add_argument("--warm", action="store_true",
+                    help="pre-publish the mixed set into a shared corpus, "
+                         "then report warm-vs-cold jobs/s side by side")
     args = ap.parse_args(argv)
 
     import jax
@@ -165,13 +206,51 @@ def main(argv=None) -> int:
         # plain env var; pin at the jax.config level (same move as bench.py).
         jax.config.update("jax_platforms", p)
 
-    row, failures = run_load(
-        args.replicas, args.clients, args.jobs, crash=args.crash
-    )
-    print("fleet:", json.dumps(row))
-    bad = list(failures)
+    if args.warm:
+        # Warm-vs-cold A/B: pre-publish the mixed set into one shared
+        # corpus, run the load against it, then run the identical load
+        # cold (same tiered store config) and report side by side. With
+        # --compare the 1-replica baseline is ALSO warm (same corpus) so
+        # the scale-out ratio stays a replicas-only comparison instead of
+        # conflating warm-start speedup into it.
+        import tempfile
+
+        with tempfile.TemporaryDirectory(prefix="srtpu-corpus-") as d:
+            prepublish_corpus(d)
+            row, failures = run_load(
+                args.replicas, args.clients, args.jobs, crash=args.crash,
+                corpus_dir=d,
+            )
+            row1, fail1 = (
+                run_load(1, args.clients, args.jobs, corpus_dir=d)
+                if args.compare
+                else (None, [])
+            )
+        cold_row, cold_fail = run_load(
+            args.replicas, args.clients, args.jobs, tiered=True
+        )
+        print("warm:", json.dumps(row))
+        print("cold:", json.dumps(cold_row))
+        ratio = row["jobs_per_sec"] / max(cold_row["jobs_per_sec"], 1e-9)
+        print(
+            f"warm-start: {row['jobs_per_sec']} jobs/s p50 {row['p50_ms']}ms "
+            f"warm vs {cold_row['jobs_per_sec']} jobs/s p50 "
+            f"{cold_row['p50_ms']}ms cold -> {ratio:.2f}x"
+        )
+        bad = list(failures) + cold_fail + fail1
+    else:
+        row, failures = run_load(
+            args.replicas, args.clients, args.jobs, crash=args.crash
+        )
+        print("fleet:", json.dumps(row))
+        bad = list(failures)
+        row1, fail1 = (
+            run_load(1, args.clients, args.jobs)
+            if args.compare
+            else (None, [])
+        )
+        bad += fail1
     if args.compare:
-        row1, fail1 = run_load(1, args.clients, args.jobs)
         print("one-replica:", json.dumps(row1))
         ratio = row["jobs_per_sec"] / max(row1["jobs_per_sec"], 1e-9)
         print(
@@ -179,7 +258,6 @@ def main(argv=None) -> int:
             f"jobs/s vs 1 replica at {row1['jobs_per_sec']} jobs/s "
             f"-> {ratio:.2f}x"
         )
-        bad += fail1
     if args.crash and row["replica_crashes"] < 1:
         bad.append("crash requested but no replica crash was recorded")
     if bad:

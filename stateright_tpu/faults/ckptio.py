@@ -62,6 +62,24 @@ def normalize_ckpt_path(path: str) -> str:
     return path if path.endswith(".npz") else path + ".npz"
 
 
+def content_path(root: str, key: str, kind: str = "corpus") -> str:
+    """Content-addressed generation name under `root`: the stable path for
+    a checkpoint ADDRESSED BY WHAT IT CONTAINS rather than by who wrote it
+    (store/corpus.py warm-start entries; any future shared-generation
+    store). Every process that derives the same content key resolves the
+    same file, which is what lets fleet replicas share one generation —
+    with `.prev` rotation and CRC verification riding along for free,
+    since the result is an ordinary `atomic_savez` path. The key is
+    sanitized to hex (content keys are blake2b hexdigests; anything else
+    is re-hashed) so a key can never escape `root`."""
+    key = str(key)
+    if not key or any(c not in "0123456789abcdef" for c in key):
+        import hashlib
+
+        key = hashlib.blake2b(key.encode(), digest_size=16).hexdigest()
+    return os.path.join(root, f"{kind}-{key}.npz")
+
+
 def atomic_savez(path: str, arrays: dict, keep_prev: bool = True) -> str:
     """Write `arrays` as a compressed npz at `path`, crash-atomically, with
     a CRC32 footer. Rotates an existing `path` to ``path + ".prev"`` first
@@ -110,6 +128,16 @@ def atomic_savez(path: str, arrays: dict, keep_prev: bool = True) -> str:
     return path
 
 
+def _flip_byte_at(path: str, pos: int) -> None:
+    """XOR one byte of `path` in place (shared by the chaos plane's torn
+    write and the deliberate test probe)."""
+    with open(path, "r+b") as f:
+        f.seek(pos)
+        b = f.read(1)
+        f.seek(pos)
+        f.write(bytes([(b[0] ^ 0xFF) if b else 0xFF]))
+
+
 def _corrupt_file(path: str, seed: int) -> None:
     """Deterministically simulate a torn write on `path`: truncate to half
     on even seeds, flip a payload byte on odd seeds. Both must be caught by
@@ -120,12 +148,16 @@ def _corrupt_file(path: str, seed: int) -> None:
         with open(path, "r+b") as f:
             f.truncate(max(size // 2, 1))
     else:
-        pos = max((size - _FOOTER.size) // 2, 0)
-        with open(path, "r+b") as f:
-            f.seek(pos)
-            b = f.read(1)
-            f.seek(pos)
-            f.write(bytes([(b[0] ^ 0xFF) if b else 0xFF]))
+        _flip_byte_at(path, max((size - _FOOTER.size) // 2, 0))
+
+
+def corrupt_one_byte(path: str, frac: float = 0.33) -> None:
+    """Flip one payload byte at `frac` of the file — the test/smoke/bench
+    corruption probe (the deliberate counterpart of `_corrupt_file`'s
+    chaos-plane torn write). Anything protected by the CRC footer must
+    detect the flip on its next read."""
+    _WRITTEN_INTACT.discard(path)  # no longer trustworthy for rotation
+    _flip_byte_at(path, int(os.path.getsize(path) * frac))
 
 
 def read_verified(path: str):

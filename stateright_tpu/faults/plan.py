@@ -46,6 +46,14 @@ Injection points (the name is the contract; grep for `maybe_fault(`):
 - ``fleet.steal``     — cross-replica work-steal boundary (ctx ``src=i,
                         dst=j``), BEFORE the queued job is withdrawn, so a
                         fault here leaves the job exactly where it was
+- ``corpus.load``     — warm-start corpus lookup (store/corpus.py; ctx
+                        ``key=<prefix>``), BEFORE the entry file is read —
+                        a fault degrades the submission to a COLD run
+                        (correct, just slower), never to wrong results
+- ``corpus.publish``  — warm-start corpus publish (ctx ``key=<prefix>,
+                        states=n``), BEFORE the atomic write — a fault
+                        leaves no partial entry and the publishing job's
+                        own result is unaffected
 
 Determinism: every decision is a pure function of (plan seed, per-point hit
 counter, rule spec) — no RNG state, no wall clock — so a failing chaos run

@@ -41,6 +41,17 @@ DETAIL_KEYS = {
     # submission and carried through every replica the job touched — the
     # key that joins this result to its journal events and Chrome spans.
     "trace": "job-scoped trace correlation id (service/fleet jobs)",
+    # warm-start corpus (store/corpus.py)
+    "corpus": "cross-job warm-start sub-dict (CORPUS_DETAIL_KEYS)",
+}
+
+#: Keys of `detail["corpus"]` (service/scheduler.py `build_result`, the
+#: frontier engine's warm_start) — present only on corpus-enabled runs.
+CORPUS_DETAIL_KEYS = {
+    "warm_start": "True when the job preloaded a published visited set",
+    "preloaded_states": "states preloaded into the spill tier + summary",
+    "published": "True when this job published a NEW corpus entry",
+    "key": "content-key prefix (model definition + lowering + finish hash)",
 }
 
 #: Keys of `detail["service"]` (service/metrics.py JobMetrics.to_dict).
@@ -108,6 +119,9 @@ REGISTRY_SOURCES = {
     "service": "check service scheduler (service/api.py)",
     "supervisor": "self-healing supervisor (faults/supervisor.py)",
     "fleet": "multi-replica fleet router (service/router.py)",
+    "corpus": "cross-job warm-start corpus store (store/corpus.py)",
+    "semantics": "consistency-tester verdict caches "
+                 "(semantics/linearizability.py)",
 }
 
 
@@ -152,6 +166,7 @@ EVENT_TYPES = {
     "job.preempted": ("job",),       # parked for waiting jobs (re-admits)
     "job.requeued": ("job", "src"),  # moved off a dead replica
     "job.resumed": ("job",),         # re-admitted from a checkpoint journal
+    "job.warm_start": ("job",),      # corpus preloaded at admission (states=n)
     "job.quarantined": ("job",),     # poison job parked by the retry policy
     "job.done": ("job",),
     "job.cancelled": ("job",),
@@ -193,6 +208,7 @@ DETAIL_SUBSCHEMAS = (
     ("service", SERVICE_DETAIL_KEYS),
     ("telemetry", TELEMETRY_KEYS),
     ("faults", FAULTS_DETAIL_KEYS),
+    ("corpus", CORPUS_DETAIL_KEYS),
 )
 
 

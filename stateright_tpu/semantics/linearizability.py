@@ -169,6 +169,28 @@ def _serialized_cached(tester: "LinearizabilityTester"):
     return None if result is None else tuple(result)
 
 
+def verdict_cache_stats() -> dict:
+    """The verdict cache's hit/miss counters (ROADMAP item 5 fold-in): the
+    register models evaluate linearizability on every post-dedup state, but
+    distinct states share histories wholesale — every hit here is one
+    exponential backtracking search NOT re-run. Exported through the obs
+    REGISTRY ("semantics" source) and pinned by tests/test_semantics.py."""
+    info = _serialized_cached.cache_info()
+    return {
+        "verdict_cache_hits": info.hits,
+        "verdict_cache_misses": info.misses,
+        "verdict_cache_entries": info.currsize,
+    }
+
+
+# Module-level registration: the cache is process-global (the lru_cache
+# above), so its counters register once at import — `/metrics` on any
+# Explorer/service server then reports cache effectiveness live.
+from ..obs import REGISTRY  # noqa: E402  (after the cache it exports)
+
+REGISTRY.register("semantics", verdict_cache_stats)
+
+
 def _violates_real_time(last_completed, remaining) -> bool:
     """An op cannot serialize before its prerequisites: every peer op up to the
     recorded index must already be consumed (ref: linearizability.rs:221-233)."""

@@ -109,6 +109,7 @@ class CheckService:
         retry_limit: int = 2,
         events=None,
         events_out: Optional[str] = None,
+        corpus_dir: Optional[str] = None,
     ):
         """`telemetry=True` records one step-metrics row per fused device
         step (obs/ring.py; digest in `stats()["telemetry"]`, `/.status`,
@@ -125,6 +126,17 @@ class CheckService:
         `trace` id; `GET /jobs/<id>/events` on the HTTP front end tails
         it live. Pass an `EventJournal` to share one (the fleet's
         per-replica wiring) or a path to own one.
+
+        `corpus_dir=<path>` turns on the cross-job warm-start corpus
+        (store/corpus.py; requires `store="tiered"`): completed exhaustive
+        jobs publish their visited set as a content-addressed, CRC-checked
+        generation there, and a later submission whose content key (model
+        definition x lowering config x finish policy) matches preloads it
+        into the spill tier + Bloom summary — the repeat check completes
+        ≥5x faster with bit-identical results. Fleet replicas pointed at
+        ONE directory share generations (ServiceFleet(corpus_dir=...)).
+        Corrupt entries are detected by the ckptio CRC footer and ignored
+        (cold run, never wrong results).
 
         `retry_limit` is the per-group step-fault budget: a group whose
         fused step keeps failing is retried that many times (the faulted
@@ -154,6 +166,7 @@ class CheckService:
             telemetry_log2=telemetry_log2,
             tracer=self._tracer if trace_out else None,
             events=events,
+            corpus_dir=corpus_dir,
         )
         # Central counter registry (obs/registry.py): both HTTP front ends'
         # `/metrics` render every registered source; weakly held, so a
@@ -223,7 +236,10 @@ class CheckService:
                 target_max_depth=target_max_depth,
                 timeout=timeout,
                 priority=priority,
-                journal=journal,
+                # The warm-start corpus publishes from the journal (the
+                # job's full unsalted visited set), so a corpus-enabled
+                # service journals every job.
+                journal=journal or self._engine.has_corpus,
                 resume=resume,
                 trace=trace or mint_trace_id(),
             )
@@ -302,6 +318,7 @@ class CheckService:
             self._engine.retire(job)
             job.status = JobStatus.CANCELLED
             job.metrics.finished_at = time.monotonic()
+            job.journal = None  # finished: no checkpoint/publish consumer
             self._events.emit(
                 "job.cancelled", job=job.id, trace=job.trace
             )
@@ -328,7 +345,7 @@ class CheckService:
             by_status: dict[str, int] = {}
             for j in self._jobs.values():
                 by_status[j.status] = by_status.get(j.status, 0) + 1
-            return {
+            out = {
                 "jobs": by_status,
                 "queued": len(self._adm),
                 "device_steps": self._engine.total_steps,
@@ -345,6 +362,13 @@ class CheckService:
                 # the chaos plane's accounting.
                 "faults": dict(self._engine.fault_counters),
             }
+            # Warm-start corpus counters (store/corpus.py) — present only
+            # on corpus-enabled services so plain deployments' `/.status`
+            # stays byte-identical to before.
+            corpus = self._engine.corpus_stats()
+            if corpus is not None:
+                out["corpus"] = corpus
+            return out
 
     def store_stats(self) -> Optional[dict]:
         with self._lock:
@@ -412,7 +436,18 @@ class CheckService:
         job.status = status
         job.metrics.finished_at = time.monotonic()
         self._engine.retire(job)
+        # Corpus publish before the result is built so detail["corpus"]
+        # reflects it; gated inside (complete exhaustive cold runs only)
+        # and never raising — a publish failure is a counter, not a job
+        # failure.
+        self._engine.maybe_publish(job)
         job.result = self._engine.build_result(job)
+        # The journal (the job's full visited set, ~16 B/state) has no
+        # consumer past this point — finished jobs are never checkpointed
+        # or resumed — and finished Job objects stay in self._jobs for the
+        # service lifetime, so release it or a long-lived corpus-enabled
+        # service (journal forced on) grows with every job ever served.
+        job.journal = None
         self._events.emit(
             TERMINAL_EVENT_BY_STATUS[status],
             job=job.id, trace=job.trace,

@@ -261,6 +261,7 @@ class ServiceFleet:
         background: bool = True,
         tracer=None,
         journal_dir: Optional[str] = None,
+        corpus_dir: Optional[str] = None,
     ):
         """`service_kwargs` configure every replica's CheckService
         (batch_size, table_log2, store, ...). `max_resident` bounds each
@@ -273,7 +274,16 @@ class ServiceFleet:
         router journals to `<journal_dir>/router.jsonl` and each replica
         (driver + its CheckService) to `<journal_dir>/replica<i>.jsonl`,
         all keyed by the per-job trace id the router mints — the input
-        set for `python -m stateright_tpu.obs.timeline`."""
+        set for `python -m stateright_tpu.obs.timeline`.
+
+        `corpus_dir` turns on the cross-job warm-start corpus on EVERY
+        replica over the one shared directory (store/corpus.py): the
+        first replica to finish a content key publishes its visited set
+        as a content-addressed ckptio generation, every replica's next
+        same-key submission — fresh, requeued after a crash, or stolen —
+        preloads that shared generation instead of re-deriving it.
+        Implies `store="tiered"` on the replica services (set here as a
+        default when service_kwargs doesn't choose a store)."""
         if n_replicas < 1:
             raise ValueError("a fleet needs at least one replica")
         self._tracer = as_tracer(tracer)
@@ -293,6 +303,11 @@ class ServiceFleet:
             self._journals.append(router_journal)
         kw = dict(service_kwargs or {})
         kw.setdefault("max_resident", max_resident)
+        if corpus_dir is not None:
+            os.makedirs(corpus_dir, exist_ok=True)
+            kw["corpus_dir"] = corpus_dir
+            kw.setdefault("store", "tiered")
+        self.corpus_dir = corpus_dir
         kw["background"] = False  # the Replica driver owns the pumping
 
         def make_replica(i: int) -> Replica:

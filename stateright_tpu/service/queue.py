@@ -77,17 +77,25 @@ class JobResume:
 
     __slots__ = (
         "chunks", "journal", "state_count", "unique_count", "max_depth",
-        "discoveries",
+        "discoveries", "was_warm",
     )
 
     def __init__(self, chunks, journal, state_count, unique_count,
-                 max_depth, discoveries):
+                 max_depth, discoveries, was_warm=False):
         self.chunks = chunks  # [(states, lo, hi, ebits, depth), ...]
         self.journal = journal  # (j_lo, j_hi, jp_lo, jp_hi) uint32 arrays
         self.state_count = state_count
         self.unique_count = unique_count
         self.max_depth = max_depth
         self.discoveries = discoveries  # {property name: packed unsalted fp}
+        # The checkpoint came from a WARM run (store/corpus.py): its
+        # journal/frontier cover only the re-expanded slice — the corpus
+        # dedup dropped everything else — so it is a valid resume point
+        # ONLY on a replica that warm-starts from the same corpus entry.
+        # A resuming engine that cannot re-warm must restart the job
+        # fresh (cold) instead of draining this partial payload to a
+        # silently wrong DONE (scheduler._admit_resumed enforces it).
+        self.was_warm = was_warm
 
     @classmethod
     def from_npz(cls, data) -> "JobResume":
@@ -106,6 +114,10 @@ class JobResume:
             )
             off += ln
         counts = data["c_counts"]
+        try:
+            was_warm = bool(int(np.asarray(data["w_warm"]).reshape(-1)[0]))
+        except KeyError:
+            was_warm = False  # pre-corpus checkpoint generation
         return cls(
             chunks=chunks,
             journal=(
@@ -118,6 +130,7 @@ class JobResume:
                 str(n): int(f)
                 for n, f in zip(data["d_names"], data["d_fps"])
             },
+            was_warm=was_warm,
         )
 
 
@@ -165,6 +178,16 @@ class Job:
         self.result = None  # SearchResult once finished
         self.error: Optional[str] = None
         self.event = threading.Event()
+        # Warm-start corpus plane (store/corpus.py): the job's content key
+        # (model definition + lowering + finish-policy hash, computed at
+        # admission), the publisher's result metadata when a corpus entry
+        # was preloaded (replayed into the result on natural completion),
+        # how many states that preload seeded, and whether THIS job
+        # published a new entry on completion.
+        self.content_key: Optional[str] = None
+        self.warm: Optional[dict] = None
+        self.warm_states = 0
+        self.published = False
 
         self._chunks: deque[_Chunk] = deque()
         self._pending = 0
@@ -325,6 +348,10 @@ class Job:
                 [self.state_count, self.unique_count, self.max_depth],
                 np.int64,
             ),
+            # Warm marker (see JobResume.was_warm): a warm run's journal
+            # is a partial record by design, so the resume payload is
+            # tagged and the resuming engine enforces warm-or-restart.
+            w_warm=np.asarray([1 if self.warm is not None else 0], np.int64),
             d_names=np.asarray(names, dtype=np.str_),
             d_fps=np.asarray(
                 [self.discoveries[n] for n in names], np.uint64

@@ -35,6 +35,7 @@ can differ from a standalone run by the lanes that shared its last batch.
 from __future__ import annotations
 
 import time
+import weakref
 from functools import partial
 from typing import Optional
 
@@ -185,6 +186,7 @@ class ServiceEngine:
         telemetry_log2: int = 12,
         tracer=None,
         events=None,
+        corpus_dir: Optional[str] = None,
     ):
         self.batch_size = batch_size
         if insert_variant not in self.INSERT_VARIANTS:
@@ -247,6 +249,27 @@ class ServiceEngine:
             ),
         )
         self._no_summary = jnp.zeros(1, dtype=jnp.uint32)
+        # Cross-job warm-start corpus (store/corpus.py, ROADMAP item 4):
+        # published visited sets keyed by (model definition, lowering,
+        # finish policy), preloaded into the tiered store at admission.
+        # The dedup mechanism IS the tiered suspect path, so the corpus
+        # requires the tiered store.
+        self._corpus = None
+        self._corpus_keys: dict = {}
+        if corpus_dir is not None:
+            if self._store is None:
+                raise ValueError(
+                    "corpus_dir warm-start requires store='tiered' (known "
+                    "states are dedup-filtered through the spill tier's "
+                    "Bloom suspect path)"
+                )
+            from ..store.corpus import CorpusStore
+
+            self._corpus = CorpusStore(
+                corpus_dir,
+                summary_log2=self._store.config.summary_log2,
+                summary_hashes=self._store.config.summary_hashes,
+            )
         self.hot_claims = 0
         self.groups: dict[int, _Group] = {}
         self._group_rr: list[int] = []
@@ -286,6 +309,125 @@ class ServiceEngine:
                     )
         return g
 
+    # -- warm-start corpus -----------------------------------------------------
+
+    @property
+    def has_corpus(self) -> bool:
+        return self._corpus is not None
+
+    def corpus_stats(self) -> Optional[dict]:
+        return None if self._corpus is None else self._corpus.metrics()
+
+    def _content_key_for(self, job: Job) -> str:
+        """The job's corpus content address: model definition hash x the
+        engine lowering/table config x the job's finish policy — exactly
+        the inputs that determine a cold run's visited set and result.
+        Cached per (model instance, finish signature): the jaxpr trace
+        behind the definition hash costs milliseconds and submissions
+        repeat."""
+        from ..store.corpus import content_key, finish_signature
+
+        fin = finish_signature(
+            job.finish_when, job.target_state_count, job.target_max_depth
+        )
+        sig = (id(job.model), fin)
+        hit = self._corpus_keys.get(sig)
+        # Same recycled-id() guard as corpus._DEF_HASH_CACHE: the cached
+        # key only serves if the weakly-held model is the SAME object —
+        # a stale hit after id reuse would preload the wrong corpus.
+        if hit is not None and hit[0]() is job.model:
+            return hit[1]
+        cfg = self._store.config
+        key = content_key(
+            job.model,
+            lowering={
+                "batch_size": self.batch_size,
+                "table_log2": self.table.size.bit_length() - 1,
+                "insert_variant": self.insert_variant,
+                "store": self.store,
+                "summary_log2": cfg.summary_log2,
+                "summary_hashes": cfg.summary_hashes,
+                "finish": fin,
+            },
+        )
+        try:
+            self._corpus_keys[sig] = (weakref.ref(job.model), key)
+        except TypeError:
+            pass  # weakref-less exotic model: re-derive next time
+        return key
+
+    def _maybe_warm(self, job: Job) -> None:
+        """Corpus lookup + tiered preload at admission. On a hit, the
+        published visited set lands in the spill tier + Bloom summary
+        RE-SALTED with this job's salt (so co-resident jobs never see each
+        other's preload) and the publisher's result metadata is kept on
+        the job for the completion-time replay. Every failure mode —
+        miss, corrupt entry, injected `corpus.load` fault — degrades to a
+        cold run."""
+        if self._corpus is None:
+            return
+        if job.content_key is None:
+            job.content_key = self._content_key_for(job)
+        if job.warm is not None:
+            return  # already preloaded (re-admission path)
+        entry = self._corpus.lookup(job.content_key)
+        if entry is None:
+            return
+        with self._tracer.span(
+            "corpus.preload", cat="store", job=job.id, trace=job.trace,
+            states=entry.states,
+        ):
+            n = self._store.preload(
+                entry.fps,
+                entry.parents,
+                salt_lo=job.salt_lo,
+                salt_hi=job.salt_hi,
+            )
+        self._corpus.note_preload(n)
+        job.warm = entry.meta
+        job.warm_states = n
+        self._events.emit(
+            "job.warm_start", job=job.id, trace=job.trace, states=n,
+            key=job.content_key[:16],
+        )
+
+    def maybe_publish(self, job: Job) -> bool:
+        """Publish a finished job's visited set into the corpus. Gated on
+        a COMPLETE exhaustive run (never early-exited, timed out, or
+        cancelled): only then is the journal the full reachable set, valid
+        for any later submission of the same content key. Warm jobs never
+        publish (their journal covers only the re-expanded frontier; the
+        content-address skip would reject the write anyway). Never raises
+        — a publish failure is a counter, not a job failure."""
+        if (
+            self._corpus is None
+            or job.content_key is None
+            or job.warm is not None
+            or job.journal is None
+            or not job.journal
+            or job.status != JobStatus.DONE
+            or job.early_exit
+            or job.timed_out
+            or job.pending_lanes != 0
+        ):
+            return False
+        j_lo = np.concatenate([c[0] for c in job.journal])
+        j_hi = np.concatenate([c[1] for c in job.journal])
+        jp_lo = np.concatenate([c[2] for c in job.journal])
+        jp_hi = np.concatenate([c[3] for c in job.journal])
+        job.published = self._corpus.publish(
+            job.content_key,
+            pack_fp(j_lo, j_hi),
+            pack_fp(jp_lo, jp_hi),
+            {
+                "state_count": job.state_count,
+                "unique_count": job.unique_count,
+                "max_depth": job.max_depth,
+                "discoveries": dict(job.discoveries),
+            },
+        )
+        return job.published
+
     def admit(self, job: Job) -> Optional[Job]:
         """Seed a job's init states into the shared table (salted) and hand
         its frontier to the scheduler. Returns the job if it finished
@@ -309,6 +451,11 @@ class ServiceEngine:
             job.max_depth = 1 if n0 else 0
             job.early_exit = True
             return job
+
+        # Warm-start: preload a published visited set for this content key
+        # into the spill tier + Bloom summary BEFORE seeding, so the very
+        # first expansion's successors already dedup-filter against it.
+        self._maybe_warm(job)
 
         K = self.batch_size
         slo, shi = salt_fp(init_lo, init_hi, job.salt_lo, job.salt_hi)
@@ -359,7 +506,25 @@ class ServiceEngine:
         restored table deduplicates exactly what the dead replica's did,
         and restored discoveries are never re-scanned."""
         g = self.group_of(job)
+        # A requeued job re-checks the corpus on its NEW replica: the
+        # shared corpus directory means the survivor warm-starts the
+        # not-yet-explored remainder exactly like a fresh submission.
+        self._maybe_warm(job)
         rz = job.resume
+        if rz.was_warm and job.warm is None:
+            # The checkpoint came from a WARM run, but THIS replica could
+            # not re-warm (entry corrupt/missing, injected corpus.load
+            # fault). A warm run's journal/frontier cover only the
+            # re-expanded slice — the corpus dedup dropped every known
+            # subtree — so draining the payload cold would finish DONE
+            # with silently wrong counts. Restart the job fresh instead:
+            # slower, never wrong.
+            self._tracer.instant(
+                "corpus.resume_restart", cat="store", job=job.id,
+                trace=job.trace,
+            )
+            job.resume = None
+            return self.admit(job)
         job.state_count = rz.state_count
         job.max_depth = rz.max_depth
         job.discoveries = dict(rz.discoveries)
@@ -763,8 +928,35 @@ class ServiceEngine:
     # -- results / failure -----------------------------------------------------
 
     def build_result(self, job: Job) -> SearchResult:
+        if (
+            job.warm is not None
+            and job.status == JobStatus.DONE
+            and job.pending_lanes == 0
+            and not job.early_exit
+            and not job.timed_out
+        ):
+            # Warm-start replay: the run itself only re-expanded the init
+            # frontier (everything else dedup-filtered against the
+            # preloaded corpus), so the result bookkeeping comes from the
+            # publisher's cold run — which, for this content key, is
+            # bit-identical to what THIS submission's cold run would have
+            # produced. Discovery fingerprints replay onto `job` (not just
+            # the result) so `discovery_paths` walks the preloaded salted
+            # parent chains.
+            w = job.warm
+            job.state_count = w["state_count"]
+            job.unique_count = w["unique_count"]
+            job.max_depth = w["max_depth"]
+            job.discoveries = dict(w["discoveries"])
         detail = dict(self.store_stats() or {})
         detail["service"] = job.metrics.to_dict(job.unique_count)
+        if self._corpus is not None and job.content_key is not None:
+            detail["corpus"] = {
+                "warm_start": job.warm is not None,
+                "preloaded_states": job.warm_states,
+                "published": job.published,
+                "key": job.content_key[:16],
+            }
         if any(self.fault_counters.values()):
             # Engine-wide recovery counters (documented schema:
             # obs/schema.py FAULTS_DETAIL_KEYS) — present only once a

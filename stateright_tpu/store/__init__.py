@@ -24,19 +24,33 @@ Pieces:
   tiered.py for the safety argument), suspect resolution, per-tier
   counters, checkpoint serialization.
 
+- `corpus` — `CorpusStore`: the cross-job warm-start corpus (ROADMAP item
+  4): completed jobs publish their visited set (packed host-tier arrays +
+  a serialized Bloom summary) as content-addressed, CRC-checked ckptio
+  generations; a later submission with the same content key preloads the
+  corpus into the spill tier + summary (`TieredStore.preload`) and known
+  states dedup-filter on device before expansion.
+
 Engines opt in with `store="tiered"` (FrontierSearch / ResidentSearch /
-ShardedSearch, and through `spawn_tpu(store="tiered", ...)`).
+ShardedSearch, and through `spawn_tpu(store="tiered", ...)`); the corpus
+is wired through `CheckService(corpus_dir=...)` / `ServiceFleet` and
+`FrontierSearch.warm_start`.
 """
 
+from .corpus import CorpusEntry, CorpusStore, content_key, model_def_hash
 from .host import HostSpillStore
 from .summary import host_insert, maybe_contains, summary_words
 from .tiered import TieredConfig, TieredStore
 
 __all__ = [
+    "CorpusEntry",
+    "CorpusStore",
     "HostSpillStore",
     "TieredConfig",
     "TieredStore",
+    "content_key",
     "host_insert",
     "maybe_contains",
+    "model_def_hash",
     "summary_words",
 ]

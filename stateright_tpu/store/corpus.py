@@ -1,0 +1,368 @@
+"""Cross-job warm-start corpus: a persistent, content-addressed store of
+completed jobs' visited-set fingerprints (ROADMAP item 4).
+
+Millions of users re-checking near-identical models re-explore the same
+state spaces from scratch. This module closes that loop at the service
+level: when a job runs its model to exhaustion, the service publishes the
+job's full visited set — packed (fingerprint, parent-fingerprint) uint64
+arrays in exactly the host spill tier's on-disk shape (store/host.py), plus
+a serialized Bloom summary of the set — as one crash-atomic, CRC-checked
+`faults/ckptio.py` generation addressed by a CONTENT key. A later
+submission with the same key preloads the corpus into the tiered store's
+spill tier + device Bloom summary before seeding, so every known state is
+dedup-filtered on device at its first re-appearance (Bloom-positive probes
+resolve exactly on host, reusing the r7 suspect path) and the search
+collapses to re-expanding only the init frontier, while result bookkeeping
+replays the publisher's counts/discoveries/parent chains — bit-identical
+to a cold run, ≥5x faster.
+
+The content key is a blake2b digest of the MODEL DEFINITION (init states,
+the abstract jaxprs of expand / within_boundary / every property condition
+/ the symmetry representative — i.e. the lowered transition system itself,
+not the Python object identity) combined with the lowering + table-layout
+config and the finish policy. Two submissions share a corpus entry iff a
+cold run of both would provably produce the same visited set and the same
+result.
+
+Addressing is content-addressed ckptio (`faults/ckptio.content_path`):
+entries are plain atomic_savez generations named by the key, so fleet
+replicas pointed at one shared corpus directory SHARE generations — the
+first replica to finish a key publishes it, every other replica's publish
+of the same key is skipped (`publish_skipped`), and all of them warm-start
+from the one file. Robustness is never traded for speed: a corpus entry
+with a bad CRC or a truncated tail is detected by the ckptio footer check,
+counted (`corrupt_entries`, exported through the obs REGISTRY "corpus"
+source), and IGNORED — the job simply runs cold, it never returns wrong
+results. Both sides of the corpus are chaos-plane boundaries
+(``corpus.load`` / ``corpus.publish`` in faults/plan.py): an injected
+fault at either degrades to a cold run / an unpublished entry, proven by
+tests/test_corpus.py.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import weakref
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ..faults.ckptio import (
+    CheckpointCorrupt,
+    atomic_savez,
+    content_path,
+    latest_generation,
+    load_latest,
+)
+from ..faults.plan import FaultError, maybe_fault
+from ..obs import REGISTRY
+from .summary import host_insert, summary_words
+
+#: Corpus payload format version (bumped on incompatible array layouts; a
+#: mismatched entry is treated exactly like a corrupt one: ignored, cold).
+FORMAT = 1
+
+#: Per-model definition-hash cache: tracing jaxprs costs milliseconds, and
+#: the service computes a key per submission. Keyed by id() with a weakref
+#: death callback (models override __eq__ without __hash__, so a
+#: WeakKeyDictionary cannot hold them) — caching never keeps a model alive
+#: and a recycled id can never serve a stale digest (the liveness check
+#: compares the referent by identity).
+_DEF_HASH_CACHE: dict = {}
+
+
+def model_def_hash(model) -> str:
+    """blake2b digest of a TensorModel's DEFINITION: class name, lane
+    geometry, concrete init states, and the abstract jaxprs of `expand`,
+    `within_boundary`, every property condition, and the symmetry
+    representative (when present). Abstract tracing only — nothing
+    executes on a device — and jaxpr printing is deterministic for a
+    given jax version (which is folded into the digest), so equal-config
+    model instances hash equal across processes and fleet replicas while
+    any change to the transition system, the properties, or the state
+    encoding changes the key."""
+    cache_key = id(model)
+    cached = _DEF_HASH_CACHE.get(cache_key)
+    if cached is not None and cached[0]() is model:
+        return cached[1]
+    import jax
+    import jax.numpy as jnp
+
+    h = hashlib.blake2b(digest_size=16)
+
+    def feed(part) -> None:
+        h.update(repr(part).encode())
+        h.update(b"\x00")
+
+    feed(("jax", jax.__version__, FORMAT))
+    feed((type(model).__name__, int(model.lanes), int(model.max_actions)))
+    init = np.asarray(model.init_states(), dtype=np.uint32)
+    feed(("init", init.shape))
+    h.update(init.tobytes())
+    probe = jax.ShapeDtypeStruct((4, int(model.lanes)), jnp.uint32)
+    feed(("expand", str(jax.make_jaxpr(model.expand)(probe))))
+    feed(
+        ("boundary", str(jax.make_jaxpr(model.within_boundary)(probe)))
+    )
+    for p in model.properties():
+        cond = p.condition
+        feed(
+            (
+                "prop",
+                p.name,
+                p.expectation.value,
+                str(jax.make_jaxpr(lambda s: cond(model, s))(probe)),
+            )
+        )
+    if model.representative is not None:
+        feed(
+            (
+                "repr",
+                str(jax.make_jaxpr(model.representative)(probe)),
+            )
+        )
+    digest = h.hexdigest()
+    try:
+        ref = weakref.ref(
+            model, lambda _r, k=cache_key: _DEF_HASH_CACHE.pop(k, None)
+        )
+        _DEF_HASH_CACHE[cache_key] = (ref, digest)
+    except TypeError:
+        pass  # weakref-less exotic model: just re-trace next time
+    return digest
+
+
+def content_key(model, lowering: dict) -> str:
+    """The corpus content address for (model definition, lowering config).
+
+    `lowering` must hold every knob that can change the visited set, the
+    claim/pop order, or the finish point of a run: batch_size, table_log2,
+    insert_variant, summary config, and the finish policy (finish_when
+    kind+names, target_state_count, target_max_depth). Values must be
+    repr-stable scalars/tuples."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(model_def_hash(model).encode())
+    h.update(repr(sorted(lowering.items())).encode())
+    return h.hexdigest()
+
+
+def finish_signature(finish_when, target_state_count, target_max_depth):
+    """The finish-policy component of a content key (HasDiscoveries is a
+    frozen dataclass; its kind + sorted names identify it exactly)."""
+    return (
+        finish_when.kind,
+        tuple(sorted(finish_when.names)),
+        target_state_count,
+        target_max_depth,
+    )
+
+
+@dataclass
+class CorpusEntry:
+    """One published visited set: packed host-tier arrays + the serialized
+    Bloom summary + the result metadata a warm run replays."""
+
+    key: str
+    fps: np.ndarray  # uint64[n] packed unsalted fingerprints
+    parents: np.ndarray  # uint64[n] packed unsalted parent fps (0 = root)
+    summary: np.ndarray  # uint32 Bloom words over the unsalted set
+    summary_log2: int
+    summary_hashes: int
+    meta: dict  # state_count / unique_count / max_depth / discoveries
+
+    @property
+    def states(self) -> int:
+        return int(self.fps.size)
+
+
+class CorpusStore:
+    """The content-addressed corpus directory. Thread-safe; one instance
+    per service engine (fleet replicas each build one over the SHARED
+    directory — the content addressing is what de-duplicates their
+    writes). Counters are exported through the obs REGISTRY ("corpus"
+    source) so hit/miss/corrupt rates are scrapeable at `/metrics`."""
+
+    def __init__(
+        self,
+        root: str,
+        summary_log2: int = 20,
+        summary_hashes: int = 4,
+    ):
+        summary_words(summary_log2)  # validates >= 5
+        self.root = root
+        self.summary_log2 = summary_log2
+        self.summary_hashes = summary_hashes
+        os.makedirs(root, exist_ok=True)
+        self._lock = threading.Lock()
+        self.counters = {
+            "hits": 0,
+            "misses": 0,
+            "publishes": 0,
+            "publish_skipped": 0,
+            "publish_faults": 0,
+            "load_faults": 0,
+            "corrupt_entries": 0,
+            "preload_states": 0,
+        }
+        self._metrics_name = REGISTRY.register("corpus", self.metrics)
+
+    def path_for(self, key: str) -> str:
+        return content_path(self.root, key)
+
+    def _count(self, counter: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[counter] += n
+
+    # -- read side -------------------------------------------------------------
+
+    def lookup(self, key: str) -> Optional[CorpusEntry]:
+        """The newest intact generation for `key`, or None. NEVER raises:
+        a missing entry is a miss, a corrupt one (CRC/container failure on
+        every generation) is counted and ignored, and an injected
+        ``corpus.load`` fault degrades to a miss — warm-start is an
+        optimization, so every failure mode here means "run cold"."""
+        path = self.path_for(key)
+        try:
+            # Chaos-plane boundary: fires before any file is touched, so a
+            # faulted load leaves the corpus (and the job) untouched.
+            maybe_fault("corpus.load", key=key[:16])
+            if not (
+                os.path.exists(path) or os.path.exists(path + ".prev")
+            ):
+                self._count("misses")
+                return None
+            data, _src = load_latest(path)
+            entry = self._decode(key, data)
+        except (FaultError, OSError) as e:
+            self._count("load_faults")
+            self._count("misses")
+            del e
+            return None
+        except CheckpointCorrupt:
+            # Torn tail / flipped byte / truncated entry: the ckptio CRC
+            # footer caught it. Ignore the entry — cold, never wrong.
+            self._count("corrupt_entries")
+            self._count("misses")
+            return None
+        if entry is None:
+            self._count("corrupt_entries")
+            self._count("misses")
+            return None
+        self._count("hits")
+        return entry
+
+    def _decode(self, key: str, data) -> Optional[CorpusEntry]:
+        """npz -> CorpusEntry; None when the payload is not a corpus entry
+        for this key (schema drift, hash collision defense)."""
+        try:
+            stored_key = str(np.asarray(data["key"]).reshape(-1)[0])
+            fmt = int(np.asarray(data["format"]).reshape(-1)[0])
+            if stored_key != key or fmt != FORMAT:
+                return None
+            cfg = np.asarray(data["cfg"], dtype=np.int64)
+            counts = np.asarray(data["counts"], dtype=np.int64)
+            discoveries = {
+                str(n): int(f)
+                for n, f in zip(data["d_names"], data["d_fps"])
+            }
+            return CorpusEntry(
+                key=key,
+                fps=np.asarray(data["fps"], dtype=np.uint64),
+                parents=np.asarray(data["parents"], dtype=np.uint64),
+                summary=np.asarray(data["summary"], dtype=np.uint32),
+                summary_log2=int(cfg[0]),
+                summary_hashes=int(cfg[1]),
+                meta={
+                    "state_count": int(counts[0]),
+                    "unique_count": int(counts[1]),
+                    "max_depth": int(counts[2]),
+                    "discoveries": discoveries,
+                },
+            )
+        except (KeyError, ValueError, IndexError):
+            return None
+
+    def note_preload(self, n: int) -> None:
+        """Account states actually preloaded into a tiered store."""
+        self._count("preload_states", n)
+
+    # -- write side ------------------------------------------------------------
+
+    def publish(
+        self,
+        key: str,
+        fps: np.ndarray,
+        parents: np.ndarray,
+        meta: dict,
+    ) -> bool:
+        """Publish one completed visited set under `key`. Idempotent by
+        content address: when an intact generation already exists the
+        write is SKIPPED — that is the fleet-sharing contract (N replicas
+        finishing the same key keep ONE generation, not N private
+        copies). Crash-atomic through faults/ckptio.atomic_savez (CRC32
+        footer, tmp/fsync/rename). Never raises: a publish failure
+        (injected ``corpus.publish`` fault or real I/O error) is counted
+        and the job's own result is unaffected."""
+        path = self.path_for(key)
+        try:
+            if latest_generation(path) is not None:
+                self._count("publish_skipped")
+                return False
+            # Chaos-plane boundary: fires before the write, so a faulted
+            # publish leaves no partial entry behind.
+            maybe_fault("corpus.publish", key=key[:16], states=int(len(fps)))
+            fps = np.asarray(fps, dtype=np.uint64)
+            parents = np.asarray(parents, dtype=np.uint64)
+            summary = np.zeros(
+                summary_words(self.summary_log2), dtype=np.uint32
+            )
+            host_insert(
+                summary,
+                (fps & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+                (fps >> np.uint64(32)).astype(np.uint32),
+                self.summary_log2,
+                self.summary_hashes,
+            )
+            names = sorted(meta.get("discoveries", {}))
+            atomic_savez(
+                path,
+                {
+                    "key": np.asarray([key], dtype=np.str_),
+                    "format": np.asarray([FORMAT], dtype=np.int64),
+                    "fps": fps,
+                    "parents": parents,
+                    "summary": summary,
+                    "cfg": np.asarray(
+                        [self.summary_log2, self.summary_hashes],
+                        dtype=np.int64,
+                    ),
+                    "counts": np.asarray(
+                        [
+                            meta["state_count"],
+                            meta["unique_count"],
+                            meta["max_depth"],
+                        ],
+                        dtype=np.int64,
+                    ),
+                    "d_names": np.asarray(names, dtype=np.str_),
+                    "d_fps": np.asarray(
+                        [meta["discoveries"][n] for n in names],
+                        dtype=np.uint64,
+                    ),
+                },
+            )
+        except (FaultError, OSError):
+            self._count("publish_faults")
+            return False
+        self._count("publishes")
+        return True
+
+    # -- reporting -------------------------------------------------------------
+
+    def metrics(self) -> dict:
+        """Flat counters for the obs REGISTRY "corpus" source."""
+        with self._lock:
+            return dict(self.counters)

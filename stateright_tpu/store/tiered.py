@@ -44,7 +44,7 @@ from typing import Optional
 import numpy as np
 
 from ..faults.plan import maybe_fault
-from ..tensor.fingerprint import pack_fp
+from ..tensor.fingerprint import pack_fp, salt_fp
 from ..tensor.hashtable import BUCKET
 from .host import HostSpillStore
 from .summary import DEFAULT_HASHES, host_insert, summary_words
@@ -299,6 +299,74 @@ class TieredStore:
             self.spill_events += 1
             self._summary_dev = None
         return t_lo, t_hi, p_lo, p_hi, freed
+
+    # -- warm-start preload (store/corpus.py) ----------------------------------
+
+    def preload(
+        self,
+        fps,
+        parents,
+        salt_lo=None,
+        salt_hi=None,
+        summary_words_arr: Optional[np.ndarray] = None,
+        summary_cfg: Optional[tuple] = None,
+    ) -> int:
+        """Seed the spill tier + Bloom summary with a PUBLISHED visited set
+        (packed unsalted uint64 fps/parents — the corpus entry shape, which
+        is by construction the host tier's own shape) BEFORE the engine's
+        first step, so every known state is dedup-filtered on device at its
+        first re-appearance and resolved exactly on host via the normal r7
+        suspect path.
+
+        `salt_lo`/`salt_hi` re-key the set for a service job (the spill
+        tier stores TABLE keys; salting is what keeps one job's preloaded
+        states from shadowing a co-resident job's) — root parents (0)
+        survive salting as 0, preserving the chain-walk sentinel. Unsalted
+        callers (standalone engines) that pass the entry's serialized
+        Bloom `summary_words_arr` with a matching `summary_cfg` get the
+        fast path: the words are OR-ed straight into the summary instead
+        of re-hashing every fingerprint. Returns the state count
+        preloaded."""
+        fps = np.asarray(fps, dtype=np.uint64)
+        parents = np.asarray(parents, dtype=np.uint64)
+        if fps.size == 0:
+            return 0
+        m32 = np.uint64(0xFFFFFFFF)
+        lo = (fps & m32).astype(np.uint32)
+        hi = (fps >> np.uint64(32)).astype(np.uint32)
+        plo = (parents & m32).astype(np.uint32)
+        phi = (parents >> np.uint64(32)).astype(np.uint32)
+        salted = salt_lo is not None and salt_hi is not None and (
+            int(salt_lo) or int(salt_hi)
+        )
+        if salted:
+            lo, hi = salt_fp(lo, hi, salt_lo, salt_hi)
+            root = (plo == 0) & (phi == 0)
+            plo, phi = salt_fp(plo, phi, salt_lo, salt_hi)
+            plo = np.where(root, np.uint32(0), plo).astype(np.uint32)
+            phi = np.where(root, np.uint32(0), phi).astype(np.uint32)
+        cfg = (self.config.summary_log2, self.config.summary_hashes)
+        if (
+            not salted
+            and summary_words_arr is not None
+            and summary_cfg == cfg
+            and summary_words_arr.size == self.summary_np.size
+        ):
+            # Serialized-summary fast path: the publisher already hashed
+            # every fingerprint at this exact geometry.
+            np.bitwise_or(
+                self.summary_np,
+                np.asarray(summary_words_arr, dtype=np.uint32),
+                out=self.summary_np,
+            )
+        else:
+            host_insert(self.summary_np, lo, hi, *cfg)
+        # The spill tier dedups by first writer, so re-preloading the same
+        # (salted) set — a requeued job re-admitted on the same replica —
+        # costs one compaction, not duplicate membership.
+        self.store.append(pack_fp(lo, hi), pack_fp(plo, phi))
+        self._summary_dev = None
+        return int(fps.size)
 
     # -- suspect resolution ----------------------------------------------------
 

@@ -459,6 +459,9 @@ class FrontierSearch:
         self._q = None
         self._counts = None
         self._disc: dict = {}
+        # Warm-start corpus payload (store/corpus.py; see warm_start).
+        self._warm: Optional[dict] = None
+        self._warm_states = 0
 
     # -- the fused device step -------------------------------------------------
 
@@ -596,6 +599,40 @@ class FrontierSearch:
             ebits0[:, i] = True
         self._q = deque()
         self._q.append(_Chunk(init, init_lo, init_hi, ebits0, depth=1))
+
+    def warm_start(self, entry) -> int:
+        """Preload a published corpus entry (store/corpus.py CorpusEntry:
+        packed unsalted fps/parents + serialized Bloom summary) into the
+        tiered store BEFORE the first run() — the standalone-engine half of
+        the cross-job warm-start: known states dedup-filter on device from
+        the very first expansion (the seeding inserts init states into the
+        device table as usual; their successors hit the pre-warmed summary
+        and resolve as spilled duplicates on host), the search collapses to
+        the init frontier, and the result replays the publisher's
+        bookkeeping bit-identically. Standalone engines run unsalted, so a
+        matching summary geometry takes the serialized-summary fast path
+        (no re-hash). Call before run(); applies to an uninterrupted run
+        (checkpoints do not carry the replay payload). The caller owns key
+        discipline here: the entry must have been published for THIS model
+        + lowering config, and run() must use the publisher's finish
+        policy — the service path (service/scheduler.py) derives and
+        checks the content key for you. Returns the state count
+        preloaded."""
+        if self._store is None:
+            raise ValueError(
+                "warm_start requires store='tiered' (known states are "
+                "dedup-filtered through the spill tier's Bloom suspect "
+                "path)"
+            )
+        n = self._store.preload(
+            entry.fps,
+            entry.parents,
+            summary_words_arr=entry.summary,
+            summary_cfg=(entry.summary_log2, entry.summary_hashes),
+        )
+        self._warm = dict(entry.meta)
+        self._warm_states = n
+        return n
 
     def run(
         self,
@@ -857,10 +894,36 @@ class FrontierSearch:
                 continue
             break
 
+        if (
+            self._warm is not None
+            and complete
+            and not queue
+            and not counts.get("early_exit", False)
+        ):
+            # Warm-start replay (store/corpus.py): the run only
+            # re-expanded the init frontier (everything deeper
+            # dedup-filtered against the preloaded corpus), so the result
+            # bookkeeping is the publisher's — bit-identical to what this
+            # search's own cold run would have produced for this content
+            # key. Discoveries replay into self._disc so reconstruct_path
+            # walks the preloaded spill-tier parent chains.
+            w = self._warm
+            state_count = w["state_count"]
+            unique_count = w["unique_count"]
+            max_depth = w["max_depth"]
+            discoveries.clear()
+            discoveries.update(w["discoveries"])
         counts["state_count"] = state_count
         counts["unique_count"] = unique_count
         counts["max_depth"] = max_depth
         counts["steps"] = steps
+        detail = self._detail()
+        if self._warm is not None:
+            detail = dict(detail or {})
+            detail["corpus"] = {
+                "warm_start": True,
+                "preloaded_states": self._warm_states,
+            }
         return SearchResult(
             state_count=state_count,
             unique_state_count=unique_count,
@@ -873,7 +936,7 @@ class FrontierSearch:
             and not counts.get("early_exit", False),
             duration=time.monotonic() - start,
             steps=steps,
-            detail=self._detail(),
+            detail=detail,
         )
 
     def store_stats(self) -> Optional[dict]:

@@ -294,6 +294,48 @@ def test_fleet_counter_keys_conform_to_obs_schema():
         router.close()
 
 
+def test_device_detail_pins_corpus_row_keys():
+    # The BENCH_CORPUS=1 warm-start A/B row is part of the artifact
+    # contract: the cold wall time, the cold/warm ratio (ROADMAP item 4
+    # acceptance >= 5x with bit-identical results), the preloaded-state
+    # count, and the corrupted-entry CRC verdict must survive into
+    # detail.device so the "repeat checks are ~free and never wrong"
+    # claim is auditable in every BENCH_r*.json.
+    for key in (
+        "sec_cold", "warm_speedup", "corpus_preloaded", "corrupt_detected",
+    ):
+        assert key in bench.DEVICE_DETAIL_FIELDS
+    row = bench.device_detail(
+        {
+            "states_per_sec": 60000.0,
+            "sec": 0.14,
+            "sec_cold": 1.9,
+            "warm_speedup": 13.6,
+            "corpus_preloaded": 1568,
+            "corrupt_detected": True,
+        }
+    )
+    assert row["warm_speedup"] == 13.6
+    assert row["corpus_preloaded"] == 1568
+    assert row["corrupt_detected"] is True
+    # And the corpus vocabulary itself is the documented obs schema's:
+    # detail["corpus"] keys, the REGISTRY source, and the warm-start
+    # event all resolve through obs/schema.py (srlint SR003 gates the
+    # literal sites; this pins the schema's own shape).
+    from stateright_tpu.obs.schema import (
+        CORPUS_DETAIL_KEYS,
+        DETAIL_KEYS,
+        EVENT_TYPES,
+        REGISTRY_SOURCES,
+        validate_detail,
+    )
+
+    assert "corpus" in DETAIL_KEYS and "corpus" in REGISTRY_SOURCES
+    assert EVENT_TYPES["job.warm_start"] == ("job",)
+    detail = {"corpus": {k: 1 for k in CORPUS_DETAIL_KEYS}}
+    assert validate_detail(detail) == []
+
+
 def test_analysis_row_pins_budget_keys():
     # The BENCH_ANALYSIS=1 static-analysis budget row is part of the
     # artifact contract: srlint finding count, knob-registry drift, and

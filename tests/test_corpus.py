@@ -1,0 +1,534 @@
+"""Cross-job warm-start corpus (stateright_tpu/store/corpus.py, ROADMAP
+item 4).
+
+The contract under test is CACHED RE-CHECKING WITHOUT WRONG ANSWERS: a
+completed exhaustive job publishes its visited set as a content-addressed,
+CRC-checked ckptio generation; a second submission of the same content key
+(model definition x lowering config x finish policy) preloads it into the
+tiered store's spill tier + Bloom summary, collapses the search to the init
+frontier (device-side dedup through the r7 suspect path), and returns a
+result BIT-IDENTICAL to the cold run — counts, discovery fingerprints, and
+reconstructed parent chains — in a fraction of the device steps. Every
+degraded mode must fall back to a correct cold run: a corrupted entry (CRC),
+an injected `corpus.load`/`corpus.publish` fault, and a replica crash
+mid-warm-start (fleet requeue onto a survivor that re-warms from the shared
+corpus directory).
+
+Compile budget (tier-1 is timeout-bound): one module-scoped cold publish is
+shared by the service-warm and frontier-warm tests; the fault-injection
+sequence rides ONE service; anchors are 2pc-3 scale. The paxos-2 parity
+case is `slow`.
+"""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from stateright_tpu.faults import FaultPlan, active
+from stateright_tpu.faults.ckptio import corrupt_one_byte
+from stateright_tpu.service import CheckService, ServiceFleet
+from stateright_tpu.store.corpus import (
+    CorpusStore,
+    content_key,
+    finish_signature,
+    model_def_hash,
+)
+from stateright_tpu.tensor.fingerprint import pack_fp, salt_fp
+from stateright_tpu.tensor.frontier import FrontierSearch
+from stateright_tpu.tensor.models import TensorTwoPhaseSys
+
+GOLD_2PC3 = (1_146, 288)
+
+# Module-level instances: same-instance submissions share a compiled step.
+M3 = TensorTwoPhaseSys(3)
+
+SVC_KW = dict(
+    batch_size=128, table_log2=14, store="tiered", high_water=0.85,
+    summary_log2=16, background=False,
+)
+FLEET_SVC_KW = dict(batch_size=128, table_log2=14, summary_log2=16)
+
+
+def _run(svc, model, **opts):
+    h = svc.submit(model, **opts)
+    svc.drain(timeout=600)
+    return h
+
+
+@pytest.fixture(scope="module")
+def published(tmp_path_factory):
+    """ONE cold 2pc-3 submission through a corpus-enabled service: the
+    shared publisher every warm-consumption test reads from."""
+    corpus_dir = str(tmp_path_factory.mktemp("corpus"))
+    svc = CheckService(corpus_dir=corpus_dir, **SVC_KW)
+    try:
+        h = _run(svc, M3)
+        r = h.result()
+        paths = {k: v.actions() for k, v in h.discoveries().items()}
+        key = h._job.content_key
+    finally:
+        svc.close()
+    assert (r.state_count, r.unique_state_count) == GOLD_2PC3
+    assert r.detail["corpus"]["published"] is True
+    assert r.detail["corpus"]["warm_start"] is False
+    return {"dir": corpus_dir, "cold": r, "paths": paths, "key": key}
+
+
+# -- content addressing --------------------------------------------------------
+
+
+def test_content_key_stable_across_equal_models_and_sensitive_to_config():
+    # Equal-config fresh instances hash equal (the cross-process /
+    # cross-replica sharing contract: the key is the DEFINITION, not the
+    # Python object).
+    assert model_def_hash(TensorTwoPhaseSys(3)) == model_def_hash(
+        TensorTwoPhaseSys(3)
+    )
+    # A different model definition changes the key...
+    assert model_def_hash(TensorTwoPhaseSys(3)) != model_def_hash(
+        TensorTwoPhaseSys(4)
+    )
+    low = dict(batch_size=128, table_log2=14, finish=("all", (), None, None))
+    k = content_key(M3, low)
+    assert k == content_key(TensorTwoPhaseSys(3), low)
+    # ...and so does any lowering / finish-policy knob (each determines
+    # the visited set or the stop point of a cold run).
+    assert k != content_key(M3, dict(low, table_log2=15))
+    assert k != content_key(M3, dict(low, finish=("all", (), 100, None)))
+
+
+def test_finish_signature_distinguishes_policies():
+    from stateright_tpu.core.discovery import HasDiscoveries
+
+    a = finish_signature(HasDiscoveries.ALL, None, None)
+    b = finish_signature(HasDiscoveries.ANY, None, None)
+    c = finish_signature(HasDiscoveries.all_of(["x"]), None, None)
+    assert len({a, b, c}) == 3
+
+
+# -- corpus store roundtrip (no device work) -----------------------------------
+
+
+def test_publish_lookup_roundtrip_and_content_addressed_skip(tmp_path):
+    store = CorpusStore(str(tmp_path), summary_log2=12)
+    fps = np.arange(1, 100, dtype=np.uint64) * np.uint64(0x9E3779B97F4A7C15)
+    parents = np.zeros(99, dtype=np.uint64)
+    parents[1:] = fps[:-1]
+    meta = {
+        "state_count": 400, "unique_count": 99, "max_depth": 7,
+        "discoveries": {"prop a": int(fps[42])},
+    }
+    assert store.publish("ab12" * 8, fps, parents, meta) is True
+    entry = store.lookup("ab12" * 8)
+    assert entry is not None
+    assert (entry.fps == fps).all() and (entry.parents == parents).all()
+    assert entry.meta == meta
+    assert entry.summary_log2 == 12 and entry.summary.any()
+    # Content-addressed idempotency: the second publisher of the same key
+    # (another fleet replica finishing the same model) SHARES the
+    # generation instead of writing a private copy.
+    assert store.publish("ab12" * 8, fps, parents, meta) is False
+    m = store.metrics()
+    assert m["publishes"] == 1 and m["publish_skipped"] == 1
+    assert m["hits"] == 1
+    # A different key is a miss, not an error.
+    assert store.lookup("cd34" * 8) is None
+    assert store.metrics()["misses"] == 1
+
+
+def test_corrupt_entry_detected_counted_and_ignored(tmp_path):
+    store = CorpusStore(str(tmp_path), summary_log2=12)
+    fps = np.arange(1, 50, dtype=np.uint64)
+    meta = {
+        "state_count": 49, "unique_count": 49, "max_depth": 3,
+        "discoveries": {},
+    }
+    store.publish("ef56" * 8, fps, np.zeros(49, np.uint64), meta)
+    (path,) = glob.glob(str(tmp_path / "corpus-*.npz"))
+    corrupt_one_byte(path)  # the shared ckptio corruption probe
+    # The ckptio CRC footer catches the flip; the lookup degrades to a
+    # MISS (cold run, never wrong results) and the REGISTRY-exported
+    # counter records the detection.
+    assert store.lookup("ef56" * 8) is None
+    m = store.metrics()
+    assert m["corrupt_entries"] == 1 and m["hits"] == 0
+    # ...and the truncated-tail flavor too.
+    store2 = CorpusStore(str(tmp_path / "t2"), summary_log2=12)
+    store2.publish("ef56" * 8, fps, np.zeros(49, np.uint64), meta)
+    (p2,) = glob.glob(str(tmp_path / "t2" / "corpus-*.npz"))
+    with open(p2, "r+b") as f:
+        f.truncate(os.path.getsize(p2) // 2)
+    assert store2.lookup("ef56" * 8) is None
+    assert store2.metrics()["corrupt_entries"] == 1
+
+
+def test_tiered_preload_salted_membership_and_chains():
+    from stateright_tpu.store.summary import maybe_contains
+    from stateright_tpu.store.tiered import TieredConfig, TieredStore
+    from stateright_tpu.tensor.fingerprint import job_salt
+
+    ts = TieredStore(1 << 10, TieredConfig(summary_log2=12), background=False)
+    rng = np.random.default_rng(5)
+    lo = rng.integers(1, 2**32, 200, dtype=np.uint32)
+    hi = rng.integers(0, 2**32, 200, dtype=np.uint32)
+    fps = pack_fp(lo, hi)
+    parents = np.zeros(200, dtype=np.uint64)
+    parents[1:] = fps[:-1]
+    sl, sh = job_salt(9)
+    assert ts.preload(fps, parents, salt_lo=sl, salt_hi=sh) == 200
+    klo, khi = salt_fp(lo, hi, sl, sh)
+    # Exact membership on the SALTED keys (what the service's suspect
+    # resolution probes)...
+    assert ts.resolve_suspects(klo, khi).all()
+    # ...the Bloom summary has no false negatives on them...
+    assert maybe_contains(ts.summary_np, klo, khi, 12).all()
+    # ...and the parent chains survive salting with the root sentinel
+    # intact (parent 0 stays 0; others map to the salted parent key).
+    pm = ts.parent_map()
+    assert pm[int(pack_fp(klo[0], khi[0]))] == 0
+    assert pm[int(pack_fp(klo[5], khi[5]))] == int(
+        pack_fp(*salt_fp(lo[4], hi[4], sl, sh))
+    )
+
+
+# -- the acceptance bar: warm second submission, bit-identical -----------------
+
+
+def test_service_warm_start_bit_identical_2pc3(published, tmp_path):
+    r_cold = published["cold"]
+    events_path = str(tmp_path / "events.jsonl")
+    # A FRESH service over the same corpus directory: the second
+    # submission of the same content key warm-starts.
+    svc = CheckService(
+        corpus_dir=published["dir"], events_out=events_path, **SVC_KW
+    )
+    try:
+        h_warm = _run(svc, M3)
+        r_warm = h_warm.result()
+        warm_paths = {
+            k: v.actions() for k, v in h_warm.discoveries().items()
+        }
+        stats = svc.stats()["corpus"]
+    finally:
+        svc.close()
+    # Bit-identical: counts, discovery fingerprints, AND the replayed
+    # parent chains (reconstructed through the preloaded spill tier).
+    assert (
+        r_warm.state_count, r_warm.unique_state_count, r_warm.max_depth,
+    ) == (
+        r_cold.state_count, r_cold.unique_state_count, r_cold.max_depth,
+    )
+    assert r_warm.discoveries == r_cold.discoveries
+    assert warm_paths == published["paths"]
+    assert r_warm.complete
+    # The warm run really took the warm path: corpus preloaded, far fewer
+    # fused steps than the cold run (init frontier only).
+    assert r_warm.detail["corpus"]["warm_start"] is True
+    assert r_warm.detail["corpus"]["preloaded_states"] == GOLD_2PC3[1]
+    assert r_warm.steps < r_cold.steps
+    assert stats["hits"] == 1 and stats["preload_states"] == GOLD_2PC3[1]
+    # The result detail conforms to the documented schema.
+    from stateright_tpu.obs.schema import validate_detail
+
+    assert validate_detail(r_warm.detail) == []
+    # The flight recorder journaled the warm admission.
+    events = [
+        json.loads(line)
+        for line in open(events_path, encoding="utf-8")
+        if line.strip()
+    ]
+    warm_events = [e for e in events if e["event"] == "job.warm_start"]
+    assert len(warm_events) == 1
+    assert warm_events[0]["job"] == h_warm.id
+    assert warm_events[0]["states"] == GOLD_2PC3[1]
+
+
+@pytest.mark.slow
+def test_service_warm_start_bit_identical_paxos2(tmp_path):
+    from stateright_tpu.tensor.paxos import TensorPaxos
+
+    corpus_dir = str(tmp_path / "corpus")
+    kw = dict(
+        batch_size=2048, table_log2=17, store="tiered", high_water=0.9,
+        summary_log2=18, background=False,
+    )
+    mp = TensorPaxos(client_count=2)
+    svc = CheckService(corpus_dir=corpus_dir, **kw)
+    try:
+        r_cold = _run(svc, mp).result()
+        # Same service, second submission: warm (the shared table's
+        # leftover salted keys from job 1 don't shadow job 2's).
+        r_warm = _run(svc, mp).result()
+    finally:
+        svc.close()
+    assert r_cold.unique_state_count == 16_668  # the reference golden
+    assert (
+        r_warm.state_count, r_warm.unique_state_count, r_warm.max_depth,
+    ) == (
+        r_cold.state_count, r_cold.unique_state_count, r_cold.max_depth,
+    )
+    assert r_warm.discoveries == r_cold.discoveries
+    assert r_warm.detail["corpus"]["warm_start"] is True
+    assert r_warm.steps < r_cold.steps / 2
+    # ...and through a 2-replica fleet over the SAME corpus directory:
+    # the replica's first paxos-2 submission ever is already warm.
+    fleet = ServiceFleet(
+        n_replicas=2, background=False,
+        service_kwargs=dict(
+            batch_size=2048, table_log2=17, high_water=0.9, summary_log2=18,
+        ),
+        corpus_dir=corpus_dir,
+    )
+    try:
+        rf = _run(fleet, mp).result()
+    finally:
+        fleet.close()
+    assert (
+        rf.state_count, rf.unique_state_count, rf.max_depth,
+    ) == (
+        r_cold.state_count, r_cold.unique_state_count, r_cold.max_depth,
+    )
+    assert rf.discoveries == r_cold.discoveries
+    assert rf.detail["corpus"]["warm_start"] is True
+
+
+# -- standalone engine: frontier seeding against a pre-warmed summary ----------
+
+
+def test_frontier_warm_start_from_service_published_entry(published):
+    entry = CorpusStore(published["dir"], summary_log2=16).lookup(
+        published["key"]
+    )
+    assert entry is not None and entry.states == GOLD_2PC3[1]
+
+    cold = FrontierSearch(
+        M3, batch_size=128, table_log2=14, store="tiered", summary_log2=16
+    )
+    r_cold = cold.run()
+    warm = FrontierSearch(
+        M3, batch_size=128, table_log2=14, store="tiered", summary_log2=16
+    )
+    # Matching summary geometry: the serialized-Bloom fast path applies
+    # (no re-hash); preload count is the whole set either way.
+    assert warm.warm_start(entry) == GOLD_2PC3[1]
+    r_warm = warm.run()
+    assert (
+        r_warm.state_count, r_warm.unique_state_count, r_warm.max_depth,
+    ) == (
+        r_cold.state_count, r_cold.unique_state_count, r_cold.max_depth,
+    )
+    assert r_warm.discoveries == r_cold.discoveries
+    assert r_warm.steps < r_cold.steps
+    assert r_warm.detail["corpus"]["warm_start"] is True
+    for name, fp in r_warm.discoveries.items():
+        assert (
+            warm.reconstruct_path(fp).actions()
+            == cold.reconstruct_path(fp).actions()
+        )
+
+
+def test_frontier_warm_start_requires_tiered_store():
+    fs = FrontierSearch(M3, batch_size=128, table_log2=14)
+    with pytest.raises(ValueError, match="tiered"):
+        fs.warm_start(object())
+
+
+# -- degraded modes: every failure falls back to a correct cold run ------------
+
+
+def test_corpus_fault_points_degrade_to_correct_cold_runs(tmp_path):
+    """One service, four submissions: (1) publish faulted -> no entry,
+    job unharmed; (2) cold -> publishes; (3) load faulted -> cold; (4)
+    clean -> warm. Both new chaos points, one compile."""
+    corpus_dir = str(tmp_path / "corpus")
+    svc = CheckService(corpus_dir=corpus_dir, **SVC_KW)
+    try:
+        plan = FaultPlan().rule("corpus.publish", "io", times=1)
+        with active(plan):
+            r1 = _run(svc, M3).result()
+        assert plan.injected_total() == 1
+        # The job itself is untouched; the corpus simply was not written.
+        assert (r1.state_count, r1.unique_state_count) == GOLD_2PC3
+        assert r1.detail["corpus"]["published"] is False
+        assert glob.glob(os.path.join(corpus_dir, "corpus-*.npz")) == []
+        assert svc.stats()["corpus"]["publish_faults"] == 1
+
+        r2 = _run(svc, M3).result()
+        assert r2.detail["corpus"]["warm_start"] is False
+        assert r2.detail["corpus"]["published"] is True
+
+        plan = FaultPlan().rule("corpus.load", "io", times=1)
+        with active(plan):
+            r3 = _run(svc, M3).result()
+        assert plan.injected_total() == 1
+        # The injected load fault degraded the submission to a COLD run —
+        # correct results, no warm path, counter recorded.
+        assert (r3.state_count, r3.unique_state_count) == GOLD_2PC3
+        assert r3.detail["corpus"]["warm_start"] is False
+        assert svc.stats()["corpus"]["load_faults"] == 1
+
+        r4 = _run(svc, M3).result()
+        assert r4.detail["corpus"]["warm_start"] is True
+        assert (r4.state_count, r4.unique_state_count) == GOLD_2PC3
+        assert r4.discoveries == r2.discoveries
+
+        # (5) A WARM run's checkpoint is a partial record by design (the
+        # corpus dedup drops every known subtree from journal and
+        # frontier), so a survivor that cannot re-warm must RESTART the
+        # job fresh instead of draining the payload to a silently wrong
+        # DONE. Worst-case payload: frontier already empty, counts 1/1/1
+        # — submitted under a finish policy with NO published entry
+        # (different content key), so the re-warm misses.
+        from stateright_tpu.core.discovery import HasDiscoveries
+        from stateright_tpu.service.queue import JobResume
+
+        rz = JobResume(
+            chunks=[],
+            journal=(
+                np.asarray([123], np.uint32), np.asarray([456], np.uint32),
+                np.zeros(1, np.uint32), np.zeros(1, np.uint32),
+            ),
+            state_count=1, unique_count=1, max_depth=1,
+            discoveries={},
+            was_warm=True,
+        )
+        h5 = svc.submit(
+            M3, resume=rz, journal=True,
+            finish_when=HasDiscoveries.ALL_FAILURES,
+        )
+        svc.drain(timeout=600)
+        r5 = h5.result()
+        # The partial payload was discarded and the search re-ran cold
+        # from the init states — full golden counts, not the 1/1/1.
+        assert (r5.state_count, r5.unique_state_count) == GOLD_2PC3
+        assert r5.complete
+        assert r5.detail["corpus"]["warm_start"] is False
+    finally:
+        svc.close()
+
+
+def test_warm_marker_round_trips_through_checkpoint_arrays():
+    from stateright_tpu.service.queue import Job, JobResume
+
+    class _M:
+        lanes = 2
+
+    warm_job = Job(7, _M(), journal=True)
+    warm_job.warm = {"state_count": 0}
+    snap = warm_job.fleet_snapshot()
+    assert int(snap["w_warm"][0]) == 1
+    cold_job = Job(8, _M(), journal=True)
+    assert int(cold_job.fleet_snapshot()["w_warm"][0]) == 0
+    # Pre-corpus generations (no w_warm key) read back as cold.
+    legacy = {
+        k: v for k, v in cold_job.fleet_snapshot().items() if k != "w_warm"
+    }
+    assert JobResume.from_npz(legacy).was_warm is False
+
+
+# -- fleet: shared corpus directory across replicas ----------------------------
+
+
+def test_fleet_warm_start_cross_replica_and_crash_requeue(tmp_path):
+    """One 2-replica fleet, three acts: (1) replica A publishes; (2)
+    replica B warm-starts from the SHARED corpus directory — the
+    content-addressed-generation sharing the ckptio layer provides (no
+    per-replica private copies); (3) the requeue-mid-warm-start chaos
+    case — a warm-capable job's replica dies, the router requeues it onto
+    the survivor, whose admission re-checks the shared corpus: the job
+    still completes warm and bit-identical (zero lost jobs, zero wrong
+    answers)."""
+    fleet = ServiceFleet(
+        n_replicas=2, background=False, service_kwargs=FLEET_SVC_KW,
+        corpus_dir=str(tmp_path / "corpus"),
+    )
+    try:
+        h1 = fleet.submit(M3)  # default route key: model type name
+        owner = h1._job.replica
+        fleet.drain(timeout=600)
+        r1 = h1.result()
+        assert (r1.state_count, r1.unique_state_count) == GOLD_2PC3
+        assert r1.detail["corpus"]["published"] is True
+        # Act 2: find a route key the OTHER replica owns, resubmit there.
+        other_key = next(
+            f"k{i}" for i in range(64)
+            if fleet.router.ring.lookup(f"k{i}") != owner
+        )
+        h2 = fleet.submit(M3, route_key=other_key)
+        assert h2._job.replica != owner
+        fleet.drain(timeout=600)
+        r2 = h2.result()
+        assert (
+            r2.state_count, r2.unique_state_count, r2.max_depth,
+        ) == (
+            r1.state_count, r1.unique_state_count, r1.max_depth,
+        )
+        assert r2.discoveries == r1.discoveries
+        assert r2.detail["corpus"]["warm_start"] is True
+        # Shared generation: the warm replica never re-published.
+        assert len(glob.glob(str(tmp_path / "corpus" / "*.npz"))) == 1
+
+        # Act 3: crash the routed replica before it can pump the next
+        # warm-capable job — requeue onto the survivor, still warm.
+        h3 = fleet.submit(M3)
+        victim = h3._job.replica
+        plan = FaultPlan().rule(
+            "fleet.replica_crash", "crash", times=1,
+            match={"replica": victim},
+        )
+        with active(plan):
+            fleet.drain(timeout=600)
+        assert plan.injected_total() == 1
+        r3 = h3.result()
+        assert (r3.state_count, r3.unique_state_count) == GOLD_2PC3
+        assert r3.discoveries == r1.discoveries
+        assert h3._job.requeues >= 1 and h3._job.replica != victim
+        # The survivor's admission warm-started from the shared corpus.
+        assert r3.detail["corpus"]["warm_start"] is True
+        s = fleet.stats()
+        assert s["replica_crashes"] == 1 and s["requeued_jobs"] >= 1
+    finally:
+        fleet.close()
+
+
+# -- guardrails / schema -------------------------------------------------------
+
+
+def test_corpus_requires_tiered_store(tmp_path):
+    with pytest.raises(ValueError, match="tiered"):
+        CheckService(
+            batch_size=64, table_log2=12, corpus_dir=str(tmp_path),
+            background=False,
+        )
+
+
+def test_corpus_schema_registered():
+    # The CI/tooling satellite: detail["corpus"] keys, the REGISTRY
+    # source, and the job.warm_start event are all part of the documented
+    # obs schema, so srlint SR003 and the bench contract gate them.
+    from stateright_tpu.obs.schema import (
+        CORPUS_DETAIL_KEYS,
+        DETAIL_KEYS,
+        EVENT_TYPES,
+        REGISTRY_SOURCES,
+        validate_detail,
+    )
+
+    assert "corpus" in DETAIL_KEYS
+    assert "corpus" in REGISTRY_SOURCES
+    assert "job.warm_start" in EVENT_TYPES
+    assert "job" in EVENT_TYPES["job.warm_start"]
+    for key in ("warm_start", "preloaded_states", "published", "key"):
+        assert key in CORPUS_DETAIL_KEYS
+    detail = {
+        "corpus": {
+            "warm_start": True, "preloaded_states": 288,
+            "published": False, "key": "ab12cd34ef56ab12",
+        }
+    }
+    assert validate_detail(detail) == []
+    detail["corpus"]["renamed"] = 1
+    assert validate_detail(detail) == ["corpus.renamed"]

@@ -15,6 +15,8 @@ probe deadline IS a threading claim. All anchors are 2pc-3-scale and all
 polling uses tight deadlines — no sleeps (tier-1 budget).
 """
 
+import time
+
 import numpy as np
 import pytest
 
@@ -259,6 +261,19 @@ def test_hung_replica_detected_and_jobs_requeued():
         )
         with active(plan):
             fleet.drain(timeout=600)
+            # On a fast host every job can finish BEFORE the second
+            # consecutive probe failure lands (the victim only hangs its
+            # PROBE — its driver keeps stepping), so drain() returning is
+            # not detection. The background router thread keeps probing;
+            # hold the plan active and wait for the actual death
+            # declaration instead of racing it (~1 s: two 0.5 s probe
+            # timeouts).
+            deadline = time.monotonic() + 30.0
+            while (
+                victim not in fleet.router._dead
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.02)
         for h in handles:
             r = h.result()
             assert (r.state_count, r.unique_state_count) == GOLD_2PC3

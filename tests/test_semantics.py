@@ -253,3 +253,36 @@ def test_tester_is_stably_encodable_and_hashable():
     t3 = t1.on_return(0, WriteOk())
     assert t1 != t3
     assert fingerprint(t1) != fingerprint(t3)
+
+
+def test_linearizability_verdict_cache_hit_counter():
+    # ROADMAP item 5 fold-in (the warm-start round's perf satellite):
+    # identical post-dedup histories must NOT re-run the exponential
+    # backtracking serialize — equal testers share one memoized verdict,
+    # and the hit counter (exported through the obs REGISTRY "semantics"
+    # source) proves it.
+    from stateright_tpu.semantics.linearizability import verdict_cache_stats
+
+    before = verdict_cache_stats()
+    # Distinct-but-equal testers: the second serialized_history is a hit.
+    ta = (
+        LinearizabilityTester(Register("A"))
+        .on_invret(0, Write("B"), WriteOk())
+        .on_invret(1, Read(), ReadOk("B"))
+    )
+    tb = (
+        LinearizabilityTester(Register("A"))
+        .on_invret(0, Write("B"), WriteOk())
+        .on_invret(1, Read(), ReadOk("B"))
+    )
+    assert ta is not tb and ta == tb
+    assert ta.serialized_history() is not None
+    assert tb.serialized_history() is not None
+    after = verdict_cache_stats()
+    assert after["verdict_cache_hits"] >= before["verdict_cache_hits"] + 1
+    assert after["verdict_cache_misses"] >= before["verdict_cache_misses"] + 1
+    # The counter is a registered /metrics source (obs/schema.py pins the
+    # "semantics" source name for srlint SR003).
+    from stateright_tpu.obs import REGISTRY
+
+    assert any(s.startswith("semantics") for s in REGISTRY.sources())
