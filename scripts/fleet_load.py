@@ -11,14 +11,18 @@ the same load twice — N replicas, then 1 — and prints the jobs/s ratio
 
     JAX_PLATFORMS=cpu python scripts/fleet_load.py \
         [--replicas 3] [--clients 100] [--jobs 200] [--compare] [--crash] \
-        [--warm]
+        [--warm] [--procs]
 
-`--crash` additionally kills one replica mid-load through the chaos plane
-(`fleet.replica_crash`) and asserts zero lost jobs. `--warm` pre-publishes
-the mixed model set into a shared warm-start corpus (store/corpus.py) and
-runs the load against it, then runs the SAME load cold and prints
-warm-vs-cold jobs/s and p50 side by side (with `--compare` both modes also
-get their 1-replica baseline).
+`--crash` additionally kills one replica mid-load and asserts zero lost
+jobs: in-proc through the chaos plane (`fleet.replica_crash`), with
+`--procs` by a real `kill -9` of one replica subprocess. `--warm`
+pre-publishes the mixed model set into a shared warm-start corpus
+(store/corpus.py) and runs the load against it, then runs the SAME load
+cold and prints warm-vs-cold jobs/s and p50 side by side (with `--compare`
+both modes also get their 1-replica baseline). `--procs` runs the fleet
+CROSS-PROCESS (`ServiceFleet(remote=True)`): one `replica_main` subprocess
+per replica over a shared store root, with the epoch-fence lease plane on
+— the load (and the crash) then exercises real process boundaries.
 """
 
 import argparse
@@ -65,7 +69,7 @@ def prepublish_corpus(corpus_dir):
 
 
 def run_load(n_replicas, clients, jobs, crash=False, corpus_dir=None,
-             tiered=False):
+             tiered=False, procs=False):
     from stateright_tpu.faults import FaultPlan, active
     from stateright_tpu.service import ServiceFleet, serve_fleet
 
@@ -81,6 +85,7 @@ def run_load(n_replicas, clients, jobs, crash=False, corpus_dir=None,
         max_resident=4,
         service_kwargs=svc_kw,
         corpus_dir=corpus_dir,
+        remote=procs,
     )
     srv = serve_fleet(fleet, address="localhost:0")
     base = "http://" + srv.address
@@ -134,18 +139,36 @@ def run_load(n_replicas, clients, jobs, crash=False, corpus_dir=None,
                     )
 
     plan = None
+    killer = None
     if crash and n_replicas > 1:
-        # Kill one replica a few driver turns in; the router must requeue
-        # its jobs from checkpoints — zero lost jobs under real load.
-        plan = FaultPlan().rule(
-            "fleet.replica_crash", "crash", after=20,
-            match={"replica": 0},
-        )
+        if procs:
+            # Cross-process crash: a REAL kill -9 of one replica
+            # subprocess mid-load — the router must revoke its lease and
+            # requeue from checkpoints, zero lost jobs.
+            import signal
+
+            def kill_one():
+                time.sleep(1.0)
+                try:
+                    os.kill(fleet.replicas[0].proc.pid, signal.SIGKILL)
+                except OSError:
+                    pass
+
+            killer = threading.Thread(target=kill_one, daemon=True)
+        else:
+            # In-proc: kill one replica a few driver turns in through the
+            # chaos plane.
+            plan = FaultPlan().rule(
+                "fleet.replica_crash", "crash", after=20,
+                match={"replica": 0},
+            )
 
     t0 = time.monotonic()
     ctx = active(plan) if plan is not None else None
     if ctx is not None:
         ctx.__enter__()
+    if killer is not None:
+        killer.start()
     try:
         threads = [
             threading.Thread(target=client, args=(i,)) for i in range(clients)
@@ -196,6 +219,10 @@ def main(argv=None) -> int:
     ap.add_argument("--warm", action="store_true",
                     help="pre-publish the mixed set into a shared corpus, "
                          "then report warm-vs-cold jobs/s side by side")
+    ap.add_argument("--procs", action="store_true",
+                    help="cross-process fleet: one replica_main subprocess "
+                         "per replica over a shared store root (lease "
+                         "plane on; --crash becomes a real kill -9)")
     args = ap.parse_args(argv)
 
     import jax
@@ -219,15 +246,17 @@ def main(argv=None) -> int:
             prepublish_corpus(d)
             row, failures = run_load(
                 args.replicas, args.clients, args.jobs, crash=args.crash,
-                corpus_dir=d,
+                corpus_dir=d, procs=args.procs,
             )
             row1, fail1 = (
-                run_load(1, args.clients, args.jobs, corpus_dir=d)
+                run_load(1, args.clients, args.jobs, corpus_dir=d,
+                         procs=args.procs)
                 if args.compare
                 else (None, [])
             )
         cold_row, cold_fail = run_load(
-            args.replicas, args.clients, args.jobs, tiered=True
+            args.replicas, args.clients, args.jobs, tiered=True,
+            procs=args.procs,
         )
         print("warm:", json.dumps(row))
         print("cold:", json.dumps(cold_row))
@@ -240,12 +269,13 @@ def main(argv=None) -> int:
         bad = list(failures) + cold_fail + fail1
     else:
         row, failures = run_load(
-            args.replicas, args.clients, args.jobs, crash=args.crash
+            args.replicas, args.clients, args.jobs, crash=args.crash,
+            procs=args.procs,
         )
         print("fleet:", json.dumps(row))
         bad = list(failures)
         row1, fail1 = (
-            run_load(1, args.clients, args.jobs)
+            run_load(1, args.clients, args.jobs, procs=args.procs)
             if args.compare
             else (None, [])
         )

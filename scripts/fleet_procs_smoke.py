@@ -1,0 +1,187 @@
+"""Cross-process fleet smoke: real subprocesses, kill -9, a zombie, and a
+partition — then the forensic timeline must read clean.
+
+The ISSUE 12 acceptance run, end to end. Each phase starts a 3-process
+fleet (`ServiceFleet(remote=True)`: one `replica_main` subprocess per
+replica over a shared store root, epoch-fence lease plane + flight
+recorder on), pins a same-route-key job backlog on one victim replica
+(steal disabled, max_resident=1 — so the victim still holds running AND
+queued jobs when it is interrupted), then:
+
+1. **kill -9** — SIGKILL the victim mid-job: lease revoked, orphans
+   requeued onto survivors from re-sealed checkpoint generations;
+2. **zombie** — SIGSTOP the victim until the router declares it dead,
+   then SIGCONT: the resurrected zombie keeps stepping orphaned job
+   copies and every write it attempts is fenced (refused write-side,
+   rejected read-side), counted as lease.rejected > 0, never read back;
+3. **partition** — inject `fleet.partition` against the victim: the
+   router sees it dead while the PROCESS keeps running — the
+   false-positive death, fenced exactly like the zombie.
+
+In every phase all jobs complete with counts bit-identical to the
+single-replica goldens and the merged journals reconstruct to ZERO
+anomalies through the timeline CLI (run as a real subprocess).
+
+    JAX_PLATFORMS=cpu python scripts/fleet_procs_smoke.py
+
+Exit 0 = fenced, recovered, reconstructed. Anything else is a regression.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+GOLD_2PC3 = (1_146, 288)
+REF = ("2pc", {"n": 3})
+
+
+def start_fleet(root, n_jobs=5):
+    from stateright_tpu.service import ServiceFleet
+    from stateright_tpu.service.server import ModelRegistry
+
+    fleet = ServiceFleet(
+        n_replicas=3, remote=True, store_root=root, max_resident=1,
+        service_kwargs=dict(batch_size=128, table_log2=14),
+        router_kwargs=dict(
+            probe_timeout_s=0.5, unhealthy_after=2, steal=False,
+        ),
+    )
+    reg = ModelRegistry()
+    handles = [
+        fleet.submit(reg.get(*REF), model_ref=REF) for _ in range(n_jobs)
+    ]
+    victim = fleet.replicas[handles[0]._job.replica]
+    # Wait for the victim to be mid-work (compiled + >= 1 device step):
+    # the interruption must land while it still holds a backlog.
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        try:
+            p = victim._get_json("/.probe", timeout=1.0)
+            if p.get("device_steps", 0) >= 1:
+                break
+        except Exception:
+            pass
+        time.sleep(0.02)
+    else:
+        raise TimeoutError("victim never stepped")
+    return fleet, handles, victim
+
+
+def wait_crashes(fleet, n, timeout=90.0):
+    deadline = time.monotonic() + timeout
+    while fleet.stats()["replica_crashes"] < n:
+        assert time.monotonic() < deadline, fleet.stats()
+        time.sleep(0.05)
+
+
+def check_golden(handles):
+    for h in handles:
+        r = h.result()
+        got = (r.state_count, r.unique_state_count)
+        assert got == GOLD_2PC3, (got, GOLD_2PC3)
+
+
+def zombie_rejections(victim, timeout=30.0):
+    """The victim process's own lease.rejected_total, over its
+    still-serving HTTP plane (that it still answers is the point)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            st = json.loads(urllib.request.urlopen(
+                victim.base_url + "/.status", timeout=2).read())
+            rej = st.get("lease", {}).get("rejected_total", 0)
+            if rej > 0:
+                return rej
+        except Exception:
+            pass
+        time.sleep(0.1)
+    return 0
+
+
+def run_timeline(journal_dir):
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "stateright_tpu.obs.timeline",
+            journal_dir, "--json",
+        ],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, (proc.returncode, proc.stderr[-800:])
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def main() -> int:
+    from stateright_tpu.faults import FaultPlan, active
+
+    print("== phase 1: 3-proc fleet, kill -9 the victim mid-backlog ==")
+    root = tempfile.mkdtemp(prefix="srtpu-procs-kill9-")
+    fleet, handles, victim = start_fleet(root)
+    os.kill(victim.proc.pid, signal.SIGKILL)
+    wait_crashes(fleet, 1)
+    fleet.drain(timeout=300)
+    check_golden(handles)
+    s = fleet.stats()
+    assert s["lease_revokes"] == 1 and s["requeued_jobs"] >= 1, s
+    fleet.close()
+    report = run_timeline(os.path.join(root, "journal"))
+    assert report["anomalies"] == [], report["anomalies"]
+    print(f"   kill -9 survived: requeued={s['requeued_jobs']} "
+          f"restored={s['restored_jobs']} reseals={s['lease_reseals']}; "
+          "timeline clean")
+
+    print("== phase 2: SIGSTOP -> declared dead -> SIGCONT zombie ==")
+    root = tempfile.mkdtemp(prefix="srtpu-procs-zombie-")
+    fleet, handles, victim = start_fleet(root)
+    os.kill(victim.proc.pid, signal.SIGSTOP)
+    wait_crashes(fleet, 1)
+    os.kill(victim.proc.pid, signal.SIGCONT)  # the zombie rises
+    fleet.drain(timeout=300)
+    check_golden(handles)
+    rejected = zombie_rejections(victim)
+    assert rejected > 0, "zombie wrote nothing / was not fenced"
+    s = fleet.stats()
+    fleet.close()
+    report = run_timeline(os.path.join(root, "journal"))
+    assert report["anomalies"] == [], report["anomalies"]
+    print(f"   zombie fenced: lease.rejected={rejected}, "
+          f"requeued={s['requeued_jobs']} restored={s['restored_jobs']}; "
+          "timeline clean")
+
+    print("== phase 3: injected router<->replica partition ==")
+    root = tempfile.mkdtemp(prefix="srtpu-procs-part-")
+    fleet, handles, victim = start_fleet(root)
+    plan = FaultPlan().rule(
+        "fleet.partition", "io", times=-1, match={"replica": victim.idx}
+    )
+    with active(plan):
+        wait_crashes(fleet, 1)
+        fleet.drain(timeout=300)
+    check_golden(handles)
+    assert plan.injected_total() >= 1
+    # The partitioned process never died: it is a zombie by another name,
+    # and the shared-filesystem lease fences it the same way.
+    rejected = zombie_rejections(victim)
+    assert rejected > 0, "partitioned replica was not fenced"
+    s = fleet.stats()
+    assert s["lease_revokes"] == 1, s
+    fleet.close()
+    report = run_timeline(os.path.join(root, "journal"))
+    assert report["anomalies"] == [], report["anomalies"]
+    print(f"   partition survived + fenced: lease.rejected={rejected}, "
+          f"probe_failures={s['probe_failures']} "
+          f"probe_skipped={s['probe_skipped']}; timeline clean")
+
+    print("FLEET PROCS SMOKE PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

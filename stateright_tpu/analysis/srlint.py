@@ -12,9 +12,13 @@ error):
   a while_loop body turns a fused device step into a per-step PCIe round
   trip.
 - **SR002 bare-checkpoint-write** (`ckpt-ok`): checkpoint-shaped writes —
-  ``np.savez``/``np.savez_compressed`` or ``open(..., "wb")`` — anywhere
-  outside ``faults/ckptio.py``. r10 found every checkpoint writer torn;
-  the atomic CRC writer is the only sanctioned path.
+  ``np.savez``/``np.savez_compressed``, ``open(..., "wb")``, or a bare
+  ``atomic_savez`` — anywhere outside ``faults/ckptio.py`` or the lease
+  module (``service/lease.py``). r10 found every checkpoint writer torn;
+  the atomic CRC writer is the only sanctioned path — and since the
+  epoch-fence PR, `ckptio.fenced_savez` is the only sanctioned CALLER of
+  it: a write that skips the wrapper also skips the lease stamp + the
+  write-side revocation check, which is exactly the zombie-writer hole.
 - **SR003 undeclared-detail-key** (`key-ok`): every string-literal
   ``detail[...]`` subscript, every ``REGISTRY.register("<source>")``, and
   every flight-recorder ``events.emit("<type>", ...)`` (any receiver named
@@ -76,7 +80,19 @@ HOST_DOTTED_CALLS = {
 HOST_ATTR_CALLS = {"item", "block_until_ready"}
 
 CKPT_WRITERS = {"numpy.save", "numpy.savez", "numpy.savez_compressed"}
+#: Callables only the blessed modules may invoke directly: everyone else
+#: goes through `ckptio.fenced_savez`, the seam that carries the epoch
+#: fence (stamp + write-side revocation check).
+CKPT_RAW_ATOMIC = {
+    "atomic_savez",
+    "ckptio.atomic_savez",
+    "stateright_tpu.faults.ckptio.atomic_savez",
+}
 CKPT_MODULE_SUFFIX = "faults.ckptio"
+#: Modules sanctioned to do raw checkpoint-shaped I/O: the atomic CRC
+#: writer itself, and the lease store (its CRC'd lease records follow the
+#: same tmp/fsync/rename discipline but are not npz).
+CKPT_MODULE_SUFFIXES = ("faults.ckptio", "service.lease")
 
 #: module prefixes whose failure surfaces must be on the chaos plane.
 FAULT_SCOPE = (
@@ -232,7 +248,7 @@ class Linter:
     # -- SR002: checkpoint writes outside ckptio -------------------------------
 
     def _check_ckpt_writes(self, mi: ModuleIndex) -> None:
-        if mi.module.endswith(CKPT_MODULE_SUFFIX):
+        if mi.module.endswith(CKPT_MODULE_SUFFIXES):
             return
         for node in ast.walk(mi.tree):
             if not isinstance(node, ast.Call):
@@ -250,6 +266,17 @@ class Linter:
                     f"bare {dn} — checkpoint writes must go through "
                     "faults/ckptio.py (atomic tmp+fsync+rename with CRC "
                     "footer)",
+                )
+            elif dn in CKPT_RAW_ATOMIC:
+                self._emit(
+                    mi,
+                    node,
+                    "SR002",
+                    f"bare {dn} outside faults/ckptio.py / service/"
+                    "lease.py — use ckptio.fenced_savez (the seam that "
+                    "carries the lease stamp + write-side revocation "
+                    "check; lease=None degrades to the plain atomic "
+                    "writer)",
                 )
             elif (
                 dn in ("open", "io.open")

@@ -26,6 +26,22 @@ integrity check and one generation of history:
 The ``ckpt.write`` injection point (kind ``torn``) corrupts the file right
 after a successful write — that is how tests/chaos runs prove the fallback
 actually engages.
+
+**Epoch fencing** (the cross-process fleet, service/lease.py): a
+checkpoint generation written by a fleet replica is STAMPED with the
+writer's lease (member name + monotonically increasing epoch) through
+`fenced_savez`, which also re-validates the lease immediately before the
+write — a replica the router has declared dead (lease revoked) refuses
+its own write instead of publishing a stale generation. `fenced_load_latest`
+is the read-side guard: a generation whose stamp a validator rejects
+(revoked epoch — the zombie write that raced the revocation through an
+already-open fd) is skipped exactly like a torn one, so the newest
+generation a loader can be handed is always one written under a lease
+that was valid at write time. `fenced_savez(lease=None)` degrades to
+`atomic_savez` — standalone engines keep their unfenced (but still
+crash-atomic) checkpoints through the same single seam, which is what
+lets srlint's SR002 pin every checkpoint write in the repo to this module
+or the lease module.
 """
 
 from __future__ import annotations
@@ -48,6 +64,14 @@ _FOOTER = struct.Struct("<8sQI")
 
 class CheckpointCorrupt(RuntimeError):
     """A checkpoint file failed CRC / container verification."""
+
+
+class LeaseRevoked(RuntimeError):
+    """The writer's lease epoch has been revoked (the router declared the
+    member dead and requeued its jobs) — the fenced write MUST NOT happen.
+    Raised by a lease's `check()` through `fenced_savez`; defined HERE
+    (below both the lease store and every fenced caller) so store- and
+    service-layer code can catch it by type without importing each other."""
 
 
 #: Paths this process wrote and fsynced intact (invalidated when the chaos
@@ -89,7 +113,14 @@ def atomic_savez(path: str, arrays: dict, keep_prev: bool = True) -> str:
     np.savez_compressed(buf, **arrays)
     payload = buf.getvalue()
     crc = zlib.crc32(payload) & 0xFFFFFFFF
-    tmp = path + ".tmp"
+    # Process-unique tmp name: two PROCESSES may write the same path
+    # concurrently (a fleet router re-sealing a generation while the
+    # zombie writer it just fenced is still mid-write through an open
+    # fd) — a shared ".tmp" would let one writer consume or corrupt the
+    # other's staging file; with unique names each write stages
+    # privately and the last os.replace wins atomically, which is
+    # exactly what the read-side CRC + lease fence are built to judge.
+    tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "wb") as f:
         f.write(payload)
         f.write(_FOOTER.pack(MAGIC, len(payload), crc))
@@ -201,6 +232,92 @@ def load_latest(path: str):
             tried.append(str(e))
     raise CheckpointCorrupt(
         "no intact checkpoint generation: " + "; ".join(tried)
+    )
+
+
+#: npz keys `fenced_savez` stamps into a generation (and every loader must
+#: ignore as payload): the writer's lease identity.
+LEASE_STAMP_KEYS = ("lease_member", "lease_epoch")
+
+
+def lease_stamp(data) -> Optional[tuple]:
+    """The `(member, epoch)` lease stamp of a loaded generation, or None
+    for an unfenced (standalone-engine / pre-fencing) one."""
+    try:
+        files = set(getattr(data, "files", ()))
+        if not all(k in files for k in LEASE_STAMP_KEYS):
+            return None
+        member = str(np.asarray(data["lease_member"]).reshape(-1)[0])
+        epoch = int(np.asarray(data["lease_epoch"]).reshape(-1)[0])
+        return member, epoch
+    except (KeyError, ValueError, IndexError):
+        return None
+
+
+def fenced_savez(
+    path: str, arrays: dict, lease=None, keep_prev: bool = True
+) -> str:
+    """`atomic_savez` behind the epoch-fence: with a `lease` (any object
+    exposing `.member`, `.epoch`, and a `.check()` that raises once the
+    lease is revoked — service/lease.py `Lease`), the write re-validates
+    the lease first and stamps the generation with the writer's identity,
+    so a fenced loader can reject it if the epoch was revoked meanwhile.
+    With `lease=None` this IS `atomic_savez` — the one sanctioned
+    checkpoint-write seam for every caller outside this module.
+
+    The ``fleet.zombie_write`` chaos point is consumed here: an injected
+    bypass SKIPS the pre-write lease check, simulating a hung-but-alive
+    writer that passed the check before revocation and completed the
+    write after (the open-fd race) — exactly the stale generation the
+    read-side fence must catch."""
+    if lease is not None:
+        plan = active_plan()
+        bypassed = plan is not None and plan.consume_bypass(
+            "fleet.zombie_write"
+        )
+        if not bypassed:
+            lease.check()  # raises service.lease.LeaseRevoked when fenced out
+        arrays = dict(arrays)
+        arrays["lease_member"] = np.asarray(
+            [str(lease.member)], dtype=np.str_
+        )
+        arrays["lease_epoch"] = np.asarray([int(lease.epoch)], np.int64)
+    return atomic_savez(path, arrays, keep_prev=keep_prev)
+
+
+def fenced_load_latest(path: str, validator=None, on_reject=None):
+    """`load_latest` behind the epoch-fence: serve the newest intact
+    generation whose lease stamp `validator(member, epoch)` accepts.
+    Unstamped generations (standalone engines, pre-fencing checkpoints)
+    always pass — fencing rejects only writes that PROVE they came from a
+    revoked lease. Each rejected generation is reported through
+    `on_reject(path, member, epoch)` (the `lease.rejected` accounting) and
+    skipped exactly like a torn one, falling back to `.prev`; raises
+    `CheckpointCorrupt` naming every candidate when nothing serves."""
+    path = normalize_ckpt_path(path)
+    if validator is None:
+        return load_latest(path)
+    tried: list[str] = []
+    for p in (path, path + ".prev"):
+        if not os.path.exists(p):
+            tried.append(f"{p} (missing)")
+            continue
+        try:
+            data = read_verified(p)
+        except CheckpointCorrupt as e:
+            tried.append(str(e))
+            continue
+        stamp = lease_stamp(data)
+        if stamp is not None and not validator(*stamp):
+            if on_reject is not None:
+                on_reject(p, *stamp)
+            tried.append(
+                f"{p} (lease fence: {stamp[0]} epoch {stamp[1]} revoked)"
+            )
+            continue
+        return data, p
+    raise CheckpointCorrupt(
+        "no intact fenced checkpoint generation: " + "; ".join(tried)
     )
 
 

@@ -54,6 +54,26 @@ Injection points (the name is the contract; grep for `maybe_fault(`):
                         states=n``), BEFORE the atomic write — a fault
                         leaves no partial entry and the publishing job's
                         own result is unaffected
+- ``fleet.partition`` — router↔replica connectivity (ctx ``replica=i``):
+                        fires in the router's probe path (in-proc
+                        Replica.probe) and in EVERY RemoteReplica HTTP
+                        request, so an injected partition makes one
+                        replica unreachable from the router while the
+                        replica itself keeps running — the false-positive
+                        death the lease fence exists for
+- ``fleet.zombie_write`` — the ``bypass`` kind is CONSUMED by
+                        `ckptio.fenced_savez` (via `consume_bypass`): the
+                        write skips its pre-write lease check, simulating
+                        a hung-but-alive replica whose write passed the
+                        check before revocation and landed after (the
+                        open-fd race) — the stale generation the
+                        read-side fence must reject
+- ``lease.revoke_race`` — lease revocation entry (service/lease.py
+                        LeaseStore.revoke, ctx ``member=<name>``), BEFORE
+                        the revocation is persisted — a fault here leaves
+                        the lease granted and the router's death handling
+                        must re-run the revocation on its next tick
+                        (revoke-before-requeue stays atomic per member)
 
 Determinism: every decision is a pure function of (plan seed, per-point hit
 counter, rule spec) — no RNG state, no wall clock — so a failing chaos run
@@ -135,7 +155,7 @@ KINDS = {
     "crash": ReplicaCrash,
 }
 
-_SPECIAL_KINDS = ("hang", "torn")
+_SPECIAL_KINDS = ("hang", "torn", "bypass")
 
 
 def _u01(seed: int, point: str, hit: int) -> float:
@@ -318,7 +338,7 @@ service.step:poison:job=3:times=-1"
                     r
                     for r in self.rules
                     if r.point == point
-                    and r.kind != "torn"
+                    and r.kind not in ("torn", "bypass")
                     and r.wants(self.seed, hit, ctx)
                 ),
                 None,
@@ -351,6 +371,23 @@ service.step:poison:job=3:times=-1"
                 ):
                     r.fired += 1
                     self._record(point, "torn")
+                    return True
+        return False
+
+    def consume_bypass(self, point: str) -> bool:
+        """True iff a ``bypass`` rule fires for this hit — the caller then
+        SKIPS a guard instead of raising (the `fleet.zombie_write` shape:
+        `ckptio.fenced_savez` omits its pre-write lease check, simulating a
+        write already past the check when the revocation landed)."""
+        with self._lock:
+            hit = self.hits.get(point, 0) + 1
+            self.hits[point] = hit
+            for r in self.rules:
+                if r.point == point and r.kind == "bypass" and r.wants(
+                    self.seed, hit, {}
+                ):
+                    r.fired += 1
+                    self._record(point, "bypass")
                     return True
         return False
 

@@ -122,6 +122,7 @@ REGISTRY_SOURCES = {
     "corpus": "cross-job warm-start corpus store (store/corpus.py)",
     "semantics": "consistency-tester verdict caches "
                  "(semantics/linearizability.py)",
+    "lease": "epoch-fenced checkpoint leases (service/lease.py)",
 }
 
 
@@ -144,6 +145,16 @@ FLEET_COUNTER_KEYS = {
     "restored_jobs": "requeued jobs resumed from an intact checkpoint "
                      "generation (the rest restarted fresh)",
     "steals": "queued jobs pulled to an idle replica (work stealing)",
+    "probe_skipped": "health probes deferred by the per-replica "
+                     "exponential probe backoff (failing members)",
+    "lease_revokes": "ring-member leases revoked before requeueing "
+                     "(0 on a lease-less fleet)",
+    "lease_reseals": "orphan checkpoint generations re-sealed under the "
+                     "router's lease at requeue time",
+    "lease_rejected": "fenced writes/reads/events refused or discarded "
+                      "because the writer's lease epoch was revoked "
+                      "(router-side view; per-replica counts live in the "
+                      "'lease' registry source of each process)",
     "per_replica": "one status row per replica sub-dict",
     "events_recent": "last-N flight-recorder events (obs/events.py ring; "
                      "[] when the fleet journals nothing)",
@@ -182,12 +193,32 @@ EVENT_TYPES = {
     "engine.chunk": ("jobs",),       # one fused service step (jobs: id list)
     "ckpt.write": ("job",),          # atomic checkpoint generation written
     "fault.injected": ("point", "kind"),  # chaos plane (faults/plan.py)
+    # epoch-fenced checkpoint leases (service/lease.py): the router is the
+    # single lease authority, so grant/revoke are router-journal events;
+    # reject is written by WHOEVER refused the fenced write/read (a zombie
+    # replica's own journal records its fencing — rejection is evidence,
+    # so it is deliberately NOT itself lease-gated).
+    "lease.grant": ("member", "epoch"),
+    "lease.revoke": ("member", "epoch"),
+    "lease.reject": ("member",),     # surface=write|read|event, epoch=n
 }
 
 #: Event types that end a job's timeline — obs/timeline.py flags a trace
 #: with none of these as the `no_terminal` anomaly.
 TERMINAL_EVENTS = ("job.done", "job.cancelled", "job.error",
                    "job.quarantined")
+
+#: Event types a revoked lease FENCES (service/lease.py FencedEvents drops
+#: them at emit time; obs/timeline.py drops any that still reached a
+#: journal — the bounded-flush race — at merge time). Exactly the
+#: terminal/requeue-relevant vocabulary: a zombie replica limping through
+#: orphaned job copies may journal hot-path engine.chunk rows (harmless,
+#: ungated — gating them would put file I/O on the step path), but it can
+#: never record an admission, resumption, checkpoint, or verdict the
+#: timeline would mistake for the surviving copy's.
+LEASE_GATED_EVENTS = TERMINAL_EVENTS + (
+    "replica.admit", "job.resumed", "ckpt.write", "job.warm_start",
+)
 
 #: Finish-status string -> terminal event name. Both job vocabularies
 #: (service JobStatus and fleet FleetJobStatus) spell their terminal

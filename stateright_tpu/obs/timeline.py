@@ -39,7 +39,7 @@ import sys
 from typing import Optional
 
 from .events import merge_events, read_journal
-from .schema import TERMINAL_EVENTS
+from .schema import LEASE_GATED_EVENTS, TERMINAL_EVENTS
 
 #: Events that grant a job lanes on a replica (an "admission").
 ADMIT_EVENTS = ("replica.admit", "job.resumed")
@@ -72,6 +72,42 @@ def load_events(paths) -> list:
     """Merged global event order from journal files/directories (torn
     tails skipped by the reader; a missing file is an empty journal)."""
     return merge_events(read_journal(p) for p in expand_paths(paths))
+
+
+def fence_events(events) -> tuple:
+    """The merge-time half of the epoch fence (service/lease.py): given
+    the merged global order, drop any terminal/requeue-relevant event
+    (obs/schema.py LEASE_GATED_EVENTS) written by a member AFTER the
+    router's `lease.revoke` of that member's epoch. The write-side gate
+    (FencedEvents) already refuses these at emit time; what this catches
+    is the bounded-flush race — a zombie's gated event that was buffered
+    before the revocation landed but flushed after — plus any journal
+    produced by a writer that bypassed the gate entirely. Returns
+    `(kept_events, rejected)` where `rejected` lists the dropped records;
+    a zombie's stale verdicts never reach lifecycle reconstruction."""
+    revoked: dict = {}  # member -> highest revoked epoch seen so far
+    kept: list = []
+    rejected: list = []
+    for e in events:
+        name = e.get("event")
+        if name == "lease.revoke":
+            m, ep = e.get("member"), e.get("epoch")
+            if isinstance(m, str) and isinstance(ep, int):
+                revoked[m] = max(revoked.get(m, 0), ep)
+            kept.append(e)
+            continue
+        if name in LEASE_GATED_EVENTS:
+            w = str(e.get("writer"))
+            ep = e.get("epoch")
+            if (
+                w in revoked
+                and isinstance(ep, int)
+                and ep <= revoked[w]
+            ):
+                rejected.append(e)
+                continue
+        kept.append(e)
+    return kept, rejected
 
 
 # -- per-trace timelines -------------------------------------------------------
@@ -327,6 +363,7 @@ def main(argv=None) -> int:
     if not events and not args.traces:
         print("no journal events found", file=sys.stderr)
         return 1
+    events, lease_rejected = fence_events(events)
     traces, untraced = group_traces(events)
     anomalies = find_anomalies(traces, gap_s=args.gap_s)
     counts = event_counts(events)
@@ -350,6 +387,7 @@ def main(argv=None) -> int:
                 "traces": {t: lifecycle(evs) for t, evs in traces.items()},
                 "untraced": len(untraced),
                 "anomalies": anomalies,
+                "lease_rejected_events": len(lease_rejected),
                 "chrome_out": chrome_path,
             },
             sys.stdout,
@@ -378,6 +416,12 @@ def main(argv=None) -> int:
                 print(_fmt_ev(e))
     top = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
     print("event counts: " + ", ".join(f"{k}={v}" for k, v in top))
+    if lease_rejected:
+        print(
+            f"{len(lease_rejected)} post-revocation event(s) from fenced "
+            "writers discarded by the epoch fence (not anomalies: the "
+            "fence is why they are harmless)"
+        )
     if chrome_path:
         print(f"chrome trace written to {chrome_path}")
     if anomalies:

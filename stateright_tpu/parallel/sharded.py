@@ -65,7 +65,7 @@ else:  # pragma: no cover - exercised on jax 0.4.x images
 
     _SHARD_MAP_KW = {"check_rep": False}
 
-from ..faults.ckptio import atomic_savez, load_latest
+from ..faults.ckptio import fenced_savez, load_latest
 from ..faults.plan import maybe_fault
 from ..knobs import INSERT_VARIANTS, STORE_KINDS
 from ..obs import N_COLS, REGISTRY, StepRing, as_tracer
@@ -1535,7 +1535,7 @@ class ShardedSearch:
         )
         # Crash-atomic write (tmp+fsync+rename, CRC32 footer, previous
         # generation kept at `path + ".prev"` — faults/ckptio.py).
-        atomic_savez(_ckpt_path(path), arrays)
+        fenced_savez(_ckpt_path(path), arrays)
 
     @classmethod
     def load_checkpoint(

@@ -31,15 +31,27 @@ Pieces:
 - `metrics`   — per-job queue wait / device steps / lanes held /
                 preemptions / spill share.
 - `router`    — the fleet front door: consistent-hash routing across N
-                replicas, health probes, bounded retry, replica failure →
-                checkpoint requeue-resume, cross-replica work stealing,
-                and the fleet HTTP server (`serve_fleet`).
+                replicas, health probes (jittered exponential backoff for
+                failing members), bounded retry, replica failure →
+                lease revocation + checkpoint requeue-resume,
+                cross-replica work stealing, and the fleet HTTP server
+                (`serve_fleet`).
 - `fleet`     — `Replica` crash-only drivers + the `ServiceFleet`
-                assembly (one router + N CheckService replicas).
+                assembly (one router + N CheckService replicas, in-proc
+                or — `remote=True` — one subprocess per replica over a
+                shared store root).
+- `lease`     — epoch-fenced checkpoint leases: the router revokes a dead
+                member's lease before requeueing, every replica write
+                path stamps + re-validates its epoch, and a zombie's
+                stale writes are refused or rejected, never read back.
+- `remote`    — the HTTP replica stub (`RemoteReplica`), the per-host
+                server (`serve_replica`), and the subprocess spawner
+                behind `ServiceFleet(remote=True)`.
 """
 
 from .api import CheckService, JobHandle, ServiceChecker
 from .fleet import Replica, ServiceFleet
+from .lease import FencedEvents, Lease, LeaseRevoked, LeaseStore
 from .metrics import JobMetrics
 from .queue import Job, JobResume, JobStatus
 from .router import (
@@ -49,6 +61,8 @@ from .router import (
     HashRing,
     NoHealthyReplica,
     ReplicaDead,
+    ResumeToken,
+    lease_member,
     serve_fleet,
 )
 from .scheduler import ServiceEngine, ServiceError
@@ -76,5 +90,11 @@ __all__ = [
     "HashRing",
     "NoHealthyReplica",
     "ReplicaDead",
+    "ResumeToken",
+    "lease_member",
     "serve_fleet",
+    "Lease",
+    "LeaseRevoked",
+    "LeaseStore",
+    "FencedEvents",
 ]

@@ -178,6 +178,10 @@ class CheckService:
         self.retry_limit = retry_limit
         self._adm = AdmissionQueue()
         self._jobs: dict[int, Job] = {}
+        # Jobs finished but not yet completed: (job, status, publish
+        # payload) triples whose off-lock half (_drain_finalizers) still
+        # has to run — corpus npz write, result build, event, wakeup.
+        self._finalizing: list = []
         self._next_id = 1
         self._lock = threading.RLock()
         self._work = threading.Condition(self._lock)
@@ -222,6 +226,23 @@ class CheckService:
                 "CheckService.submit requires a stateright_tpu.tensor."
                 f"TensorModel; got {type(model).__name__}"
             )
+        # Corpus prefetch OFF the service lock (ROADMAP item 4 leftover):
+        # the content-key jaxpr trace and the entry npz read+decode happen
+        # on the CLIENT thread before the lock is ever taken — a slow
+        # corpus read can no longer stall an unrelated job's poll. The
+        # probe Job is thrown away if admission control rejects below.
+        prefetch: Optional[Job] = None
+        if self._engine.has_corpus:
+            prefetch = Job(
+                0, model,
+                finish_when=finish_when,
+                target_state_count=target_state_count,
+                target_max_depth=target_max_depth,
+            )
+            try:
+                self._engine.prefetch_warm(prefetch)
+            except Exception:  # noqa: BLE001 — warm-start is an optimization
+                prefetch = None
         with self._work:
             if self._closed:
                 # srlint: fault-ok caller-contract guard, not an I/O/device surface
@@ -243,6 +264,10 @@ class CheckService:
                 resume=resume,
                 trace=trace or mint_trace_id(),
             )
+            if prefetch is not None:
+                job.content_key = prefetch.content_key
+                job.warm_entry = prefetch.warm_entry
+                job.warm_checked = prefetch.warm_checked
             self._next_id += 1
             self._jobs[job.id] = job
             self._adm.push(job)
@@ -429,6 +454,13 @@ class CheckService:
         )
 
     def _finalize(self, job: Job, status: str = JobStatus.DONE) -> None:
+        """Mark a job finished (under the lock) and queue its completion
+        work. The EXPENSIVE half of finishing — the corpus publish's npz
+        write + Bloom rehash — runs OFF the service lock in
+        `_drain_finalizers` (the caller's loop drains right after the lock
+        is released), so a slow publish can no longer stall an unrelated
+        job's poll. The job's result/event land there too, AFTER the
+        publish, so `detail["corpus"]["published"]` stays truthful."""
         self._tracer.instant(
             "service.finalize", cat="service", job=job.id, status=status,
             trace=job.trace,
@@ -436,26 +468,46 @@ class CheckService:
         job.status = status
         job.metrics.finished_at = time.monotonic()
         self._engine.retire(job)
-        # Corpus publish before the result is built so detail["corpus"]
-        # reflects it; gated inside (complete exhaustive cold runs only)
-        # and never raising — a publish failure is a counter, not a job
-        # failure.
-        self._engine.maybe_publish(job)
-        job.result = self._engine.build_result(job)
-        # The journal (the job's full visited set, ~16 B/state) has no
-        # consumer past this point — finished jobs are never checkpointed
-        # or resumed — and finished Job objects stay in self._jobs for the
-        # service lifetime, so release it or a long-lived corpus-enabled
-        # service (journal forced on) grows with every job ever served.
-        job.journal = None
-        self._events.emit(
-            TERMINAL_EVENT_BY_STATUS[status],
-            job=job.id, trace=job.trace,
-            states=job.state_count, unique=job.unique_count,
-            timed_out=job.timed_out or None,
-        )
-        job.event.set()
-        self._idle.notify_all()
+        # Under-lock half of the publish: gate + journal snapshot (memory
+        # concatenation only).
+        payload = self._engine.prepare_publish(job)
+        self._finalizing.append((job, status, payload))
+
+    def _drain_finalizers(self) -> None:
+        """Complete every deferred finalize: publish off-lock, then (back
+        under the lock) build the result, release the journal, emit the
+        terminal event, and wake waiters. Called with the service lock
+        NOT held (pump()/_loop() drain after releasing it; close() after
+        joining the scheduler thread)."""
+        while True:
+            with self._lock:
+                if not self._finalizing:
+                    return
+                job, status, payload = self._finalizing.pop(0)
+            published = False
+            if payload is not None:
+                # The slow half (Bloom rehash + crash-atomic npz write) —
+                # no lock held; never raises.
+                published = self._engine.publish_payload(payload)
+            with self._lock:
+                if payload is not None:
+                    job.published = published
+                job.result = self._engine.build_result(job)
+                # The journal (the job's full visited set, ~16 B/state)
+                # has no consumer past this point — finished jobs are
+                # never checkpointed or resumed — and finished Job objects
+                # stay in self._jobs for the service lifetime, so release
+                # it or a long-lived corpus-enabled service (journal
+                # forced on) grows with every job ever served.
+                job.journal = None
+                self._events.emit(
+                    TERMINAL_EVENT_BY_STATUS[status],
+                    job=job.id, trace=job.trace,
+                    states=job.state_count, unique=job.unique_count,
+                    timed_out=job.timed_out or None,
+                )
+                job.event.set()
+                self._idle.notify_all()
 
     def _expire_timeouts(self) -> None:
         now = time.monotonic()
@@ -651,45 +703,62 @@ class CheckService:
         return True
 
     def _loop(self) -> None:
-        while True:
-            with self._work:
-                while not self._closed and not self._has_work():
-                    # The wait doubles as the timeout poll for deadlines.
-                    self._work.wait(timeout=0.05)
-                    self._expire_timeouts()
-                if self._closed:
-                    return
-                try:
-                    self._round()
-                except ServiceError as e:
-                    self._failed = str(e)
-                    self._idle.notify_all()
-                    return
-                except Exception as e:  # noqa: BLE001 — never die silently
-                    # A scheduler bug outside the StepFault envelope used
-                    # to kill this thread silently, hanging every client
-                    # in result(); fail loudly instead.
-                    self._failed = f"scheduler error: {type(e).__name__}: {e}"
-                    self._engine._fail_all(self._failed)
-                    self._idle.notify_all()
-                    return
+        try:
+            while True:
+                with self._work:
+                    while (
+                        not self._closed
+                        and not self._has_work()
+                        and not self._finalizing
+                    ):
+                        # The wait doubles as the timeout poll for deadlines.
+                        self._work.wait(timeout=0.05)
+                        self._expire_timeouts()
+                    if self._closed:
+                        return
+                    try:
+                        self._round()
+                    except ServiceError as e:
+                        self._failed = str(e)
+                        self._idle.notify_all()
+                        return
+                    except Exception as e:  # noqa: BLE001 — never die silently
+                        # A scheduler bug outside the StepFault envelope
+                        # used to kill this thread silently, hanging every
+                        # client in result(); fail loudly instead.
+                        self._failed = (
+                            f"scheduler error: {type(e).__name__}: {e}"
+                        )
+                        self._engine._fail_all(self._failed)
+                        self._idle.notify_all()
+                        return
+                # Off-lock: the expensive completion half (corpus publish)
+                # of any jobs this round finished — polls proceed meanwhile.
+                self._drain_finalizers()
+        finally:
+            self._drain_finalizers()  # error exits still complete waiters
 
     # -- foreground driving (background=False) ---------------------------------
 
     def pump(self, rounds: int = 1) -> int:
         """Run up to `rounds` scheduling rounds in the calling thread;
-        returns how many actually dispatched a step."""
+        returns how many actually dispatched a step. Deferred completion
+        work (the off-lock corpus publish half) drains after the lock is
+        released — a pump always leaves finished jobs fully completed."""
         ran = 0
-        with self._lock:
-            for _ in range(rounds):
-                try:
-                    if self._round():
-                        ran += 1
-                    elif not self._has_work():
-                        break
-                except ServiceError as e:
-                    self._failed = str(e)
-                    raise
+        try:
+            with self._lock:
+                for _ in range(rounds):
+                    try:
+                        if self._round():
+                            ran += 1
+                        elif not self._has_work():
+                            break
+                    except ServiceError as e:
+                        self._failed = str(e)
+                        raise
+        finally:
+            self._drain_finalizers()
         return ran
 
     def drain(self, timeout: Optional[float] = None) -> None:
@@ -697,21 +766,29 @@ class CheckService:
         deadline = None if timeout is None else time.monotonic() + timeout
 
         def all_done():
-            return all(
-                j.status in JobStatus.FINISHED for j in self._jobs.values()
-            )
+            with self._lock:
+                return all(
+                    j.status in JobStatus.FINISHED and j.event.is_set()
+                    for j in self._jobs.values()
+                )
 
         if self._thread is None:
-            with self._lock:
-                while not all_done():
-                    if self._failed:
-                        raise ServiceError(self._failed)
-                    if not self.pump(64):
+            # Foreground: pump WITHOUT holding the lock across rounds
+            # (pump takes it per burst and drains the off-lock completion
+            # work between bursts — the no-stall contract applies to
+            # foreground services too).
+            while not all_done():
+                if self._failed:
+                    raise ServiceError(self._failed)
+                if not self.pump(64):
+                    with self._lock:
                         self._expire_timeouts()
-                        if not all_done() and not self._has_work():
-                            time.sleep(0.01)
-                    if deadline is not None and time.monotonic() > deadline:
-                        raise TimeoutError("drain timed out")
+                        idle_now = not self._has_work()
+                    self._drain_finalizers()
+                    if not all_done() and idle_now:
+                        time.sleep(0.01)
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError("drain timed out")
             return
         with self._idle:
             while not all_done():
@@ -743,6 +820,10 @@ class CheckService:
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
+        # Any finalize still deferred (scheduler died mid-drain, or a
+        # foreground service closed between pumps) must complete its
+        # waiters — result() clients hang on job events otherwise.
+        self._drain_finalizers()
         REGISTRY.unregister(self._metrics_name)
         if self._trace_out:
             try:

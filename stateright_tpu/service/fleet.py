@@ -34,12 +34,24 @@ import threading
 import time
 from typing import Callable, Optional
 
-from ..faults.ckptio import atomic_savez
+from ..faults.ckptio import fenced_savez
 from ..faults.plan import maybe_fault
 from ..obs import EventJournal, as_events, as_tracer
 from .api import CheckService
-from .queue import JobStatus
-from .router import FleetRouter, ReplicaDead, serve_fleet  # noqa: F401
+from .lease import (
+    FencedEvents,
+    LeaseRevoked,
+    LeaseStore,
+    load_fenced_resume,
+)
+from .queue import JobResume, JobStatus
+from .router import (  # noqa: F401
+    FleetRouter,
+    ReplicaDead,
+    ResumeToken,
+    lease_member,
+    serve_fleet,
+)
 
 __all__ = ["Replica", "ServiceFleet", "serve_fleet"]
 
@@ -59,11 +71,25 @@ class Replica:
         pump_rounds: int = 4,
         tracer=None,
         events=None,
+        lease=None,
     ):
         self.idx = idx
         self.service = service_factory()
         self.ckpt_every_spins = ckpt_every_spins
         self.pump_rounds = pump_rounds
+        # Epoch fence (service/lease.py): every checkpoint generation this
+        # driver writes is stamped + re-validated against this lease; a
+        # revoked replica (the router declared it dead — possibly wrongly,
+        # the zombie case) refuses its own writes and dies instead of
+        # publishing stale generations for requeued jobs.
+        self.lease = lease
+        if lease is not None:
+            corpus = getattr(self.service._engine, "_corpus", None)
+            if corpus is not None:
+                # The corpus write path is fenced with the same token:
+                # zombie publishes refuse themselves and stale entries are
+                # stamp-rejected at lookup.
+                corpus.set_lease(lease)
         self.error: Optional[str] = None
         self._dead = False
         self._spins = 0
@@ -87,17 +113,35 @@ class Replica:
     def submit(self, spec: dict, ckpt_path: Optional[str] = None):
         """Submit one job spec (CheckService.submit kwargs + journal/
         resume) to this replica; registers its checkpoint path with the
-        driver. Raises ReplicaDead instead of touching a dead service."""
+        driver. Raises ReplicaDead instead of touching a dead service.
+
+        A `ResumeToken` resume is resolved HERE (the replica seam's side
+        of the contract): the newest fenced checkpoint generation is
+        loaded in this process — stale (revoked-epoch) generations from a
+        zombie writer are rejected by the stamp check and the fallback
+        generation serves instead."""
         if self._dead:
             raise ReplicaDead(
                 f"replica {self.idx} is dead ({self.error})"
             )
+        spec = dict(spec)
+        spec.pop("model_ref", None)  # in-proc: the model object itself rides
+        resume = spec.get("resume")
+        if isinstance(resume, ResumeToken):
+            spec["resume"] = self._resolve_resume(resume)
         handle = self.service.submit(**spec)
         if ckpt_path is not None:
             self._ckpt_paths[handle.id] = ckpt_path
         with self._wake:
             self._wake.notify_all()
         return handle
+
+    def _resolve_resume(self, token: ResumeToken) -> Optional[JobResume]:
+        """ResumeToken -> JobResume through the fenced loader; None (fresh
+        restart, still exact) when no generation passes CRC + fence."""
+        return load_fenced_resume(
+            token.path, self.lease.store if self.lease is not None else None
+        )
 
     def withdraw(self, inner_job_id: int) -> bool:
         """Work-stealing primitive: atomically remove a still-QUEUED job
@@ -111,7 +155,12 @@ class Replica:
         dead replica, answers cheap live counters otherwise. Deliberately
         lock-free — a replica mid-compile must read as healthy, and a
         truly wedged one is caught by the router's probe deadline (the
-        `fleet.replica_hang` chaos point parks right here)."""
+        `fleet.replica_hang` chaos point parks right here). The
+        `fleet.partition` point fires here too (and in every RemoteReplica
+        HTTP request): an injected partition makes this replica
+        unreachable from the router while the replica itself keeps
+        running — the false-positive death the lease fence covers."""
+        maybe_fault("fleet.partition", replica=self.idx)
         maybe_fault("fleet.replica_hang", replica=self.idx)
         if self._dead:
             raise ReplicaDead(
@@ -207,11 +256,25 @@ class Replica:
                 continue
             with self.service._lock:
                 arrays = job.fleet_snapshot()
-            with self._tracer.span(
-                "ckpt.write", cat="fleet", job=jid, replica=self.idx,
-                trace=job.trace,
-            ):
-                atomic_savez(path, arrays)
+            try:
+                with self._tracer.span(
+                    "ckpt.write", cat="fleet", job=jid, replica=self.idx,
+                    trace=job.trace,
+                ):
+                    fenced_savez(path, arrays, lease=self.lease)
+            except LeaseRevoked as e:
+                # The router fenced this replica out (it declared us dead
+                # and requeued our jobs — we are the zombie). The refusal
+                # was counted by the lease store; record the evidence and
+                # die: a fenced-out replica must never write again, and
+                # crash-only semantics say it must not limp either.
+                self._events.emit(
+                    "lease.reject", member=lease_member(self.idx),
+                    epoch=self.lease.epoch if self.lease else 0,
+                    surface="write", job=jid, trace=job.trace,
+                )
+                self._die(e)
+                return
             self._events.emit(
                 "ckpt.write", job=jid, trace=job.trace, replica=self.idx
             )
@@ -262,6 +325,10 @@ class ServiceFleet:
         tracer=None,
         journal_dir: Optional[str] = None,
         corpus_dir: Optional[str] = None,
+        lease_dir: Optional[str] = None,
+        remote: bool = False,
+        store_root: Optional[str] = None,
+        spawn_timeout_s: float = 180.0,
     ):
         """`service_kwargs` configure every replica's CheckService
         (batch_size, table_log2, store, ...). `max_resident` bounds each
@@ -283,11 +350,46 @@ class ServiceFleet:
         same-key submission — fresh, requeued after a crash, or stolen —
         preloads that shared generation instead of re-deriving it.
         Implies `store="tiered"` on the replica services (set here as a
-        default when service_kwargs doesn't choose a store)."""
+        default when service_kwargs doesn't choose a store).
+
+        `lease_dir` turns on the epoch-fenced lease plane (service/
+        lease.py) for IN-PROC replicas: the router grants one lease per
+        replica, revokes it before requeueing a dead replica's jobs, and
+        every replica write path (checkpoint generations, terminal journal
+        events) re-validates its lease — a false-positive death (hung but
+        alive) can waste cycles but can never corrupt a resumed job.
+
+        `remote=True` runs every replica as a separate PROCESS: N
+        `replica_main` subprocesses (each its own `serve_service`-shaped
+        HTTP server over a `Replica` driver) sharing `store_root`
+        (checkpoints, journals, leases, corpus), driven through
+        `RemoteReplica` HTTP stubs behind the same router. The lease plane
+        and the flight recorder are always on in remote mode — they are
+        what makes cross-process death declarations sound. Requires
+        `background=True` (subprocesses cannot be foreground-pumped)."""
         if n_replicas < 1:
             raise ValueError("a fleet needs at least one replica")
         self._tracer = as_tracer(tracer)
         self._tmpdir = None
+        self.remote = bool(remote)
+        self.store_root = store_root
+        if remote:
+            if not background:
+                raise ValueError(
+                    "remote fleets are background-only (subprocess replicas "
+                    "cannot be foreground-pumped)"
+                )
+            if store_root is None:
+                self._tmpdir = tempfile.TemporaryDirectory(
+                    prefix="srtpu-fleet-root-"
+                )
+                self.store_root = store_root = self._tmpdir.name
+            os.makedirs(store_root, exist_ok=True)
+            ckpt_dir = ckpt_dir or os.path.join(store_root, "ckpt")
+            journal_dir = journal_dir or os.path.join(store_root, "journal")
+            lease_dir = lease_dir or os.path.join(store_root, "leases")
+            if corpus_dir is None and "corpus_dir" in (service_kwargs or {}):
+                corpus_dir = (service_kwargs or {}).get("corpus_dir")
         if ckpt_dir is None:
             self._tmpdir = tempfile.TemporaryDirectory(prefix="srtpu-fleet-")
             ckpt_dir = self._tmpdir.name
@@ -301,6 +403,14 @@ class ServiceFleet:
                 os.path.join(journal_dir, "router.jsonl"), writer="router"
             )
             self._journals.append(router_journal)
+        # Lease plane: grants happen HERE, before any replica starts (a
+        # remote member ACQUIRES the granted lease at boot; an in-proc one
+        # is handed its Lease directly).
+        self.lease_store = None
+        router_lease = None
+        if lease_dir is not None:
+            self.lease_store = LeaseStore(lease_dir)
+            router_lease = self.lease_store.grant("router")
         kw = dict(service_kwargs or {})
         kw.setdefault("max_resident", max_resident)
         if corpus_dir is not None:
@@ -311,13 +421,22 @@ class ServiceFleet:
         kw["background"] = False  # the Replica driver owns the pumping
 
         def make_replica(i: int) -> Replica:
+            lease = (
+                self.lease_store.grant(lease_member(i))
+                if self.lease_store is not None else None
+            )
             journal = None
             if journal_dir is not None:
                 journal = EventJournal(
                     os.path.join(journal_dir, f"replica{i}.jsonl"),
-                    writer=f"replica{i}",
+                    writer=lease_member(i),
                 )
                 self._journals.append(journal)
+                if lease is not None:
+                    # Gate terminal/requeue-relevant events behind the
+                    # lease: a fenced-out replica's journal can no longer
+                    # record admissions/verdicts the timeline would trust.
+                    journal = FencedEvents(journal, lease)
             return Replica(
                 i,
                 lambda: CheckService(events=journal, **kw),
@@ -325,15 +444,46 @@ class ServiceFleet:
                 pump_rounds=pump_rounds,
                 tracer=tracer,
                 events=journal,
+                lease=lease,
             )
 
-        self.replicas = [make_replica(i) for i in range(n_replicas)]
+        self._procs: list = []
+        if remote:
+            from .remote import RemoteReplica, spawn_replica_proc
+
+            self.replicas = []
+            try:
+                for i in range(n_replicas):
+                    self.lease_store.grant(lease_member(i))
+                    proc, url = spawn_replica_proc(
+                        i, store_root, kw, timeout_s=spawn_timeout_s
+                    )
+                    self._procs.append(proc)
+                    self.replicas.append(
+                        RemoteReplica(i, url, proc=proc, tracer=tracer)
+                    )
+            except BaseException:
+                # A mid-boot spawn failure must not leak the replicas that
+                # DID come up (full jax processes) — nobody will ever call
+                # close() on a constructor that raised.
+                self._kill_procs()
+                for j in self._journals:
+                    j.close()
+                if self.lease_store is not None:
+                    self.lease_store.close()
+                if self._tmpdir is not None:
+                    self._tmpdir.cleanup()
+                raise
+        else:
+            self.replicas = [make_replica(i) for i in range(n_replicas)]
         self.router = FleetRouter(
             self.replicas,
             background=background,
             ckpt_dir=ckpt_dir,
             tracer=tracer,
             events=router_journal,
+            lease_store=self.lease_store,
+            router_lease=router_lease,
             **(router_kwargs or {}),
         )
         self.background = background
@@ -358,7 +508,9 @@ class ServiceFleet:
 
     def store_stats(self) -> Optional[dict]:
         rows = [
-            r.service.store_stats() for r in self.replicas if r.alive
+            r.service.store_stats()
+            for r in self.replicas
+            if r.alive and getattr(r, "service", None) is not None
         ]
         rows = [s for s in rows if s]
         return rows[0] if len(rows) == 1 else (rows or None)
@@ -387,6 +539,24 @@ class ServiceFleet:
             else:
                 self.pump(4)
 
+    def _kill_procs(self) -> None:
+        """Stop every replica subprocess: SIGTERM first (the child drains
+        + flushes its journal), then the hard kill — teardown must never
+        hang on a wedged child."""
+        for p in self._procs:
+            try:
+                if p.poll() is None:
+                    p.terminate()
+                    p.wait(timeout=10.0)
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+            try:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=5.0)
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+
     def _supervise(self) -> None:
         while not self._stop.is_set():
             self.router.tick()
@@ -403,8 +573,11 @@ class ServiceFleet:
         for r in self.replicas:
             r.close()
         self.router.close()
+        self._kill_procs()
         for j in self._journals:
             j.close()
+        if self.lease_store is not None:
+            self.lease_store.close()
         if self._tmpdir is not None:
             self._tmpdir.cleanup()
             self._tmpdir = None

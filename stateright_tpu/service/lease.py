@@ -1,0 +1,358 @@
+"""Epoch-fenced checkpoint leases: the cross-process fleet's zombie fence.
+
+The r13 fleet's death story is sound only in-process: a hung-but-alive
+replica declared dead by the router keeps stepping orphaned job copies,
+and across a process boundary nothing stops it from still WRITING —
+checkpoint generations, terminal journal events, corpus publishes — for
+jobs the router already handed to a survivor. This module makes every such
+false-positive death provably harmless, the way every lease-based
+distributed store does (GFS/Chubby/Bigtable fencing tokens):
+
+- The ROUTER owns one monotonically increasing epoch per ring member,
+  persisted in a CRC-checked lease file under a shared directory
+  (`LeaseStore`). `grant` bumps the member's epoch and marks it held;
+  `revoke` — called BEFORE a dead member's jobs are requeued — marks it
+  fenced. Both writes are crash-atomic (tmp+fsync+rename with a `.prev`
+  generation, the faults/ckptio.py discipline).
+- Every replica WRITE path re-validates its `Lease` at the write and
+  stamps the write with (member, epoch): checkpoint generations through
+  `ckptio.fenced_savez`, terminal/requeue-relevant journal events through
+  `FencedEvents`, corpus publishes through `CorpusStore(lease=...)`.
+  A revoked writer refuses its own write (`LeaseRevoked`) — and the one
+  write that can slip past the check (in flight through an already-open
+  fd when the revocation lands; the `fleet.zombie_write` chaos point
+  simulates exactly this) is caught read-side: `ckptio.fenced_load_latest`
+  and the corpus lookup reject any generation stamped with a revoked
+  epoch, falling back to the newest validly-stamped one.
+- Every refusal/rejection is COUNTED (`rejected_writes` / `rejected_reads`
+  / `rejected_events`, exported through the obs REGISTRY "lease" source
+  and summed into the fleet's `lease_rejected`): the acceptance currency
+  for "the zombie wasted cycles but corrupted nothing".
+
+Chaos points: ``lease.revoke_race`` fires at the top of `revoke` (an
+injected fault leaves the lease granted; the router's death handling must
+re-run it next tick), ``fleet.zombie_write`` is consumed by the fenced
+writer (see ckptio).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import zlib
+from typing import Optional
+
+from ..faults.ckptio import LeaseRevoked, fenced_load_latest
+from ..faults.plan import maybe_fault
+from ..obs import REGISTRY
+from ..obs.schema import LEASE_GATED_EVENTS
+
+#: Lease-file footer: 8-byte magic, u64 payload length, u32 CRC32 — the
+#: ckptio discipline with a lease-specific magic (payload is JSON, not npz).
+LEASE_MAGIC = b"SRTPLSE1"
+_FOOTER = struct.Struct("<8sQI")
+
+GRANTED = "granted"
+REVOKED = "revoked"
+
+
+# Re-exported for API compatibility; the class itself lives in
+# faults/ckptio.py so the store layer can catch it without importing the
+# service layer.
+__all__ = [
+    "FencedEvents",
+    "Lease",
+    "LeaseRevoked",
+    "LeaseStore",
+    "load_fenced_resume",
+]
+
+
+class Lease:
+    """One writer's fencing token: (member, epoch) plus the store to
+    re-validate against. Handed to `ckptio.fenced_savez` (duck-typed:
+    `.member` / `.epoch` / `.check()`), `FencedEvents`, and the corpus."""
+
+    __slots__ = ("member", "epoch", "store")
+
+    def __init__(self, member: str, epoch: int, store: "LeaseStore"):
+        self.member = member
+        self.epoch = epoch
+        self.store = store
+
+    def valid(self) -> bool:
+        """Re-read the lease file: True iff this exact (member, epoch) is
+        still granted. A torn/unreadable lease file reads as NOT valid —
+        fencing fails safe (a fenced writer refuses; the router, the only
+        lease writer, re-persists on its next transition)."""
+        return self.store.validate(self.member, self.epoch)
+
+    def check(self) -> None:
+        """The write-side fence: raise `LeaseRevoked` (and count the
+        refusal) instead of letting a revoked writer touch shared state."""
+        if not self.valid():
+            self.store.count_rejected("write")
+            raise LeaseRevoked(
+                f"lease for {self.member} (epoch {self.epoch}) is revoked; "
+                "refusing the fenced write"
+            )
+
+    def __repr__(self) -> str:
+        return f"Lease({self.member!r}, epoch={self.epoch})"
+
+
+class LeaseStore:
+    """The shared lease directory: one CRC-checked record per ring member,
+    written only by the router (the single lease authority), read by every
+    fenced writer/loader in every process. Thread-safe; counters exported
+    through the obs REGISTRY "lease" source."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._lock = threading.Lock()
+        self.counters = {
+            "grants": 0,
+            "revokes": 0,
+            "rejected_writes": 0,
+            "rejected_reads": 0,
+            "rejected_events": 0,
+        }
+        self._metrics_name = REGISTRY.register("lease", self.metrics)
+
+    def path_for(self, member: str) -> str:
+        # Member names are fleet-internal ("router", "replica0", ...);
+        # sanitize anyway so a name can never escape the lease root.
+        safe = "".join(c if c.isalnum() or c in "-_" else "_" for c in member)
+        return os.path.join(self.root, f"lease-{safe}.json")
+
+    # -- the router's write side (single authority) ----------------------------
+
+    def _write(self, member: str, epoch: int, state: str) -> None:
+        """Crash-atomic lease record write (ckptio discipline: in-memory
+        payload + CRC footer + tmp/fsync/rename, previous record kept at
+        `.prev` so a torn current record falls back instead of bricking
+        every fenced writer)."""
+        path = self.path_for(member)
+        payload = json.dumps(
+            {"member": member, "epoch": int(epoch), "state": state}
+        ).encode()
+        crc = zlib.crc32(payload) & 0xFFFFFFFF
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:  # srlint: ckpt-ok the lease module IS the sanctioned atomic lease writer (CRC footer + tmp/fsync/rename below)
+            f.write(payload)
+            f.write(_FOOTER.pack(LEASE_MAGIC, len(payload), crc))
+            f.flush()
+            os.fsync(f.fileno())
+        if os.path.exists(path):
+            os.replace(path, path + ".prev")
+        os.replace(tmp, path)
+        try:
+            dfd = os.open(self.root, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass
+
+    def grant(self, member: str) -> Lease:
+        """Grant `member` a fresh epoch (old epochs are implicitly revoked:
+        validation requires an exact epoch match). Returns the Lease the
+        holder stamps its writes with."""
+        with self._lock:
+            epoch, _state = self._read(member)
+            epoch += 1
+            self._write(member, epoch, GRANTED)
+            self.counters["grants"] += 1
+        return Lease(member, epoch, self)
+
+    def revoke(self, member: str) -> Optional[int]:
+        """Fence `member` out: persist its current epoch as revoked. MUST
+        complete before the member's jobs are requeued (revoke-then-requeue
+        is what makes the zombie's later writes provably stale). Idempotent;
+        returns the revoked epoch (None when the member never held one).
+        The ``lease.revoke_race`` chaos point fires BEFORE anything is
+        persisted, so an injected fault leaves the lease granted and the
+        caller simply retries on its next tick."""
+        maybe_fault("lease.revoke_race", member=member)
+        with self._lock:
+            epoch, state = self._read(member)
+            if epoch == 0:
+                return None
+            if state != REVOKED:
+                self._write(member, epoch, REVOKED)
+                self.counters["revokes"] += 1
+            return epoch
+
+    # -- everyone's read side --------------------------------------------------
+
+    def _read(self, member: str) -> tuple:
+        """(epoch, state) for `member`: the newest intact lease record,
+        `.prev` fallback included; (0, "none") when the member never held
+        a lease; (0, "unreadable") when every record is torn (fail-safe:
+        validates False)."""
+        path = self.path_for(member)
+        any_file = False
+        for p in (path, path + ".prev"):
+            if not os.path.exists(p):
+                continue
+            any_file = True
+            try:
+                with open(p, "rb") as f:
+                    data = f.read()
+                if len(data) < _FOOTER.size:
+                    continue
+                magic, length, crc = _FOOTER.unpack(data[-_FOOTER.size:])
+                payload = data[: -_FOOTER.size]
+                if (
+                    magic != LEASE_MAGIC
+                    or length != len(payload)
+                    or (zlib.crc32(payload) & 0xFFFFFFFF) != crc
+                ):
+                    continue
+                rec = json.loads(payload)
+                return int(rec["epoch"]), str(rec["state"])
+            except (OSError, ValueError, KeyError):
+                continue
+        return (0, "unreadable") if any_file else (0, "none")
+
+    def state(self, member: str) -> tuple:
+        return self._read(member)
+
+    def validate(self, member: str, epoch: int) -> bool:
+        """The fence predicate: (member, epoch) is valid iff the member's
+        newest intact lease record says exactly this epoch is granted."""
+        cur, state = self._read(member)
+        return state == GRANTED and cur == int(epoch)
+
+    def acquire(self, member: str) -> Lease:
+        """A replica process picking up the lease the router granted it
+        (the router grants BEFORE spawning; the holder only reads). Raises
+        `LeaseRevoked` when no granted lease exists for `member`."""
+        epoch, state = self._read(member)
+        if state != GRANTED or epoch == 0:
+            raise LeaseRevoked(
+                f"no granted lease for {member!r} (state={state}, "
+                f"epoch={epoch}); the router grants before spawn"
+            )
+        return Lease(member, epoch, self)
+
+    # -- accounting ------------------------------------------------------------
+
+    def count_rejected(self, surface: str, n: int = 1) -> None:
+        """Account one fenced refusal/rejection: `surface` is "write"
+        (pre-write check refused), "read" (a loader skipped a
+        stale-stamped generation), or "event" (FencedEvents dropped a
+        gated journal event)."""
+        key = f"rejected_{surface}s"
+        with self._lock:
+            self.counters[key] = self.counters.get(key, 0) + n
+
+    def rejected_total(self) -> int:
+        with self._lock:
+            return sum(
+                v for k, v in self.counters.items()
+                if k.startswith("rejected_")
+            )
+
+    def metrics(self) -> dict:
+        """Flat counters for the obs REGISTRY "lease" source."""
+        with self._lock:
+            out = dict(self.counters)
+        out["rejected_total"] = sum(
+            v for k, v in out.items() if k.startswith("rejected_")
+        )
+        return out
+
+    def close(self) -> None:
+        REGISTRY.unregister(self._metrics_name)
+
+
+def load_fenced_resume(path: str, lease_store: Optional[LeaseStore]):
+    """ResumeToken path -> queue.JobResume through the fence, or None
+    (restart fresh — still exact) when nothing loadable survives CRC +
+    stamp validation. THE one spelling of replica-side resume resolution
+    (in-proc Replica, the remote serve_replica, tools): rejected
+    generations are counted as lease "read" rejections; every other
+    failure mode degrades to a fresh restart."""
+    from .queue import JobResume
+
+    try:
+        data, _src = fenced_load_latest(
+            path,
+            validator=(
+                lease_store.validate if lease_store is not None else None
+            ),
+            on_reject=(
+                (lambda _p, _m, _e: lease_store.count_rejected("read"))
+                if lease_store is not None else None
+            ),
+        )
+    except Exception:  # noqa: BLE001 — any unreadable generation: fresh
+        return None
+    return JobResume.from_npz(data)
+
+
+class FencedEvents:
+    """Flight-recorder wrapper that gates terminal/requeue-relevant journal
+    events behind the writer's lease (obs/schema.py LEASE_GATED_EVENTS) and
+    stamps every event with the writer's epoch. A revoked writer's gated
+    emit is dropped, counted, and recorded as a `lease.reject` event
+    (rejection is evidence — it is itself ungated). Hot-path events
+    (engine.chunk) pass through unchecked: gating them would put lease-file
+    I/O on the fused-step path, and the timeline treats them as harmless.
+    """
+
+    def __init__(self, events, lease: Lease):
+        self._inner = events
+        self._lease = lease
+
+    # The journal surface call sites rely on (obs/events.py):
+
+    @property
+    def enabled(self) -> bool:
+        return self._inner.enabled
+
+    @property
+    def closed(self) -> bool:
+        return self._inner.closed
+
+    @property
+    def writer(self):
+        return self._inner.writer
+
+    @property
+    def path(self):
+        return self._inner.path
+
+    def emit(self, etype: str, **fields):
+        if etype in LEASE_GATED_EVENTS and not self._lease.valid():
+            self._lease.store.count_rejected("event")
+            try:
+                self._inner.emit(
+                    "lease.reject", member=self._lease.member,
+                    epoch=self._lease.epoch, surface="event", dropped=etype,
+                )
+            except Exception:  # noqa: BLE001 — recording never raises upward
+                pass
+            return None
+        fields.setdefault("epoch", self._lease.epoch)
+        return self._inner.emit(etype, **fields)
+
+    def flush(self) -> None:
+        self._inner.flush()
+
+    def close(self) -> None:
+        self._inner.close()
+
+    def tail(self, since: int = 0, job=None, wait_s: float = 0.0) -> tuple:
+        return self._inner.tail(since=since, job=job, wait_s=wait_s)
+
+    def recent(self, n: int = 16) -> list:
+        return self._inner.recent(n)
+
+    def cursor(self) -> int:
+        return self._inner.cursor()

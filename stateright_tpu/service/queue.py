@@ -37,7 +37,7 @@ from typing import Optional
 import numpy as np
 
 from ..core.discovery import HasDiscoveries
-from ..faults.ckptio import atomic_savez, load_latest
+from ..faults.ckptio import fenced_savez, load_latest
 from ..tensor.fingerprint import job_salt
 from .metrics import JobMetrics
 
@@ -186,6 +186,13 @@ class Job:
         # published a new entry on completion.
         self.content_key: Optional[str] = None
         self.warm: Optional[dict] = None
+        # A corpus entry prefetched OFF the service lock at submit time
+        # (scheduler.prefetch_warm); consumed under lock at admission.
+        # `warm_checked` records that a prefetch RAN (hit, miss, or
+        # injected fault) — admission must not retry a lookup the chaos
+        # plane already degraded, or faults stop degrading to cold runs.
+        self.warm_entry = None
+        self.warm_checked = False
         self.warm_states = 0
         self.published = False
 
@@ -311,7 +318,7 @@ class Job:
         engines' checkpoint queue section) and free the host memory. The
         write is crash-atomic with a CRC32 footer (faults/ckptio.py) — a
         torn spill must not poison the job's resumption."""
-        self._spill_path = atomic_savez(
+        self._spill_path = fenced_savez(
             path, self._frontier_arrays(), keep_prev=False
         )
         self.drop_frontier()

@@ -1,0 +1,590 @@
+"""HTTP-backed fleet replicas: the cross-process half of ROADMAP item 1.
+
+The r13 `Replica` seam (submit / probe / withdraw) was deliberately shaped
+like an RPC surface; this module backs it with a real one. A
+`RemoteReplica` is the router-side stub: it speaks HTTP to a per-host
+`replica_main` subprocess (one `Replica` driver over one CheckService,
+served by `serve_replica`) and mirrors each submitted job's completion
+state locally so the router's harvest/steal logic works unchanged. All
+replicas share one on-disk store root:
+
+    <root>/ckpt/     per-job checkpoint generations (faults/ckptio.py)
+    <root>/leases/   the epoch-fence lease records (service/lease.py)
+    <root>/journal/  per-writer flight-recorder journals (obs/events.py)
+    <root>/logs/     child stdout/stderr
+    <root>/corpus/   (optional) the shared warm-start corpus
+
+What crosses the HTTP boundary is deliberately small: model REFERENCES
+(registry name + args — both sides resolve them through the same
+ModelRegistry), job options, and checkpoint PATHS (`ResumeToken`) — never
+array payloads. The serving process loads resume checkpoints itself
+through `ckptio.fenced_load_latest`, so a zombie's stale generation is
+rejected in whichever process the resume happens.
+
+The ``fleet.partition`` chaos point fires at the top of every RemoteReplica
+request: an injected partition makes one replica unreachable from the
+router (probes fail, submissions fail over) while the replica process
+keeps running — the false-positive death whose writes the lease fence
+makes provably harmless.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+
+from ..core.discovery import HasDiscoveries
+from ..faults.plan import maybe_fault
+from ..obs import as_tracer
+from ..tensor.frontier import SearchResult
+from .queue import JobStatus
+from .router import ReplicaDead, ResumeToken, lease_member
+
+__all__ = [
+    "RemoteReplica",
+    "RemoteJobHandle",
+    "serve_replica",
+    "spawn_replica_proc",
+]
+
+
+def encode_finish_when(fw) -> Optional[dict]:
+    if fw is None:
+        return None
+    return {"kind": fw.kind, "names": sorted(fw.names)}
+
+
+def decode_finish_when(data) -> HasDiscoveries:
+    if data is None:
+        return HasDiscoveries.ALL
+    return HasDiscoveries(str(data["kind"]), frozenset(data.get("names", ())))
+
+
+def result_to_json(r: SearchResult) -> dict:
+    """SearchResult -> wire form (discovery fingerprints as ints; detail
+    passes through — it is already JSON-shaped by the schema contract)."""
+    return {
+        "state_count": int(r.state_count),
+        "unique_state_count": int(r.unique_state_count),
+        "max_depth": int(r.max_depth),
+        "discoveries": {k: int(v) for k, v in r.discoveries.items()},
+        "complete": bool(r.complete),
+        "duration": float(r.duration),
+        "steps": int(r.steps),
+        "detail": r.detail,
+    }
+
+
+def result_from_json(data: dict) -> SearchResult:
+    return SearchResult(
+        state_count=int(data["state_count"]),
+        unique_state_count=int(data["unique_state_count"]),
+        max_depth=int(data["max_depth"]),
+        discoveries={k: int(v) for k, v in data["discoveries"].items()},
+        complete=bool(data["complete"]),
+        duration=float(data["duration"]),
+        steps=int(data.get("steps", 0)),
+        detail=data.get("detail"),
+    )
+
+
+class _RemoteJobMirror:
+    """Router-side completion mirror of one remote inner job — duck-types
+    the `Job` fields the router's harvest/steal logic reads (`status`,
+    `event`, `result`, `error`)."""
+
+    __slots__ = ("status", "result", "error", "event")
+
+    def __init__(self):
+        self.status = JobStatus.QUEUED
+        self.result = None
+        self.error: Optional[str] = None
+        self.event = threading.Event()
+
+
+class RemoteJobHandle:
+    """The remote twin of api.JobHandle, HTTP-backed. `_job` is the local
+    mirror the owning RemoteReplica's poller keeps current."""
+
+    def __init__(self, replica: "RemoteReplica", job_id: int):
+        self._replica = replica
+        self.id = job_id
+        self._job = _RemoteJobMirror()
+
+    def poll(self) -> dict:
+        return self._replica._get_json(f"/jobs/{self.id}")
+
+    def cancel(self) -> bool:
+        out = self._replica._post_json(f"/jobs/{self.id}/cancel", {})
+        return bool(out.get("cancelled"))
+
+    def discoveries(self) -> dict:
+        """{property name: discovery record} as served by the replica's
+        `/jobs/<id>/discoveries` (action-label lists — the cross-process
+        form of a reconstructed Path)."""
+        return self._replica._get_json(f"/jobs/{self.id}/discoveries")
+
+
+class RemoteReplica:
+    """The Replica seam over HTTP. The router drives it exactly like an
+    in-proc `Replica`; a background poller keeps each submitted job's
+    completion mirror current (the event/result the router harvests)."""
+
+    #: The router keys replica-kind behavior on this (resume tokens cross
+    #: the wire as paths; model objects never do).
+    remote = True
+
+    def __init__(
+        self,
+        idx: int,
+        base_url: str,
+        proc: Optional[subprocess.Popen] = None,
+        tracer=None,
+        request_timeout_s: float = 10.0,
+        probe_timeout_s: float = 2.0,
+        control_timeout_s: float = 2.0,
+        poll_interval_s: float = 0.02,
+    ):
+        self.idx = idx
+        self.base_url = base_url.rstrip("/")
+        self.proc = proc
+        self.error: Optional[str] = None
+        self.request_timeout_s = request_timeout_s
+        self.probe_timeout_s = probe_timeout_s
+        # Router-tick control ops (withdraw) get a SHORT deadline: a
+        # hung/stopped replica must cost the tick loop seconds, not a full
+        # request timeout per attempt — the probe cadence is what detects
+        # its death, and it can only run between ticks.
+        self.control_timeout_s = control_timeout_s
+        self.poll_interval_s = poll_interval_s
+        self._tracer = as_tracer(tracer)
+        self._handles: dict[int, RemoteJobHandle] = {}
+        self._lock = threading.Lock()
+        self._last_probe: dict = {}
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -- HTTP plumbing ---------------------------------------------------------
+
+    def _request(self, path: str, body=None, timeout: Optional[float] = None):
+        # Chaos-plane boundary: an injected `fleet.partition` makes this
+        # replica unreachable from the router — the request never leaves.
+        maybe_fault("fleet.partition", replica=self.idx)
+        url = self.base_url + path
+        if body is None:
+            req = urllib.request.Request(url)
+        else:
+            req = urllib.request.Request(
+                url, data=json.dumps(body).encode(), method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+        with urllib.request.urlopen(
+            req, timeout=timeout or self.request_timeout_s
+        ) as resp:
+            return json.loads(resp.read() or b"{}")
+
+    def _get_json(self, path: str, timeout: Optional[float] = None):
+        return self._request(path, timeout=timeout)
+
+    def _post_json(self, path: str, body: dict):
+        return self._request(path, body=body)
+
+    # -- router-facing surface -------------------------------------------------
+
+    @property
+    def alive(self) -> bool:
+        """Process liveness only (a kill -9 shows up here immediately);
+        hangs and partitions are the router's probe deadline's business."""
+        return self.proc is None or self.proc.poll() is None
+
+    def submit(self, spec: dict, ckpt_path: Optional[str] = None):
+        model_ref = spec.get("model_ref")
+        if model_ref is None:
+            raise ReplicaDead(
+                f"replica {self.idx} is remote: submissions need "
+                "model_ref=(registry name, args) — model objects cannot "
+                "cross the process boundary"
+            )
+        name, args = model_ref
+        resume = spec.get("resume")
+        payload = {
+            "model": name,
+            "args": dict(args or {}),
+            "opts": {
+                "finish_when": encode_finish_when(spec.get("finish_when")),
+                "target_state_count": spec.get("target_state_count"),
+                "target_max_depth": spec.get("target_max_depth"),
+                "timeout": spec.get("timeout"),
+                "priority": spec.get("priority", 0),
+            },
+            "journal": bool(spec.get("journal")),
+            "trace": spec.get("trace"),
+            "resume_from": (
+                resume.path if isinstance(resume, ResumeToken) else None
+            ),
+            "ckpt": ckpt_path,
+        }
+        try:
+            out = self._post_json("/jobs", payload)
+        except Exception as e:  # noqa: BLE001 — any transport/5xx failure
+            raise ReplicaDead(
+                f"replica {self.idx} submit failed: {type(e).__name__}: {e}"
+            ) from e
+        if "job" not in out:
+            raise ReplicaDead(
+                f"replica {self.idx} rejected the submission: {out}"
+            )
+        handle = RemoteJobHandle(self, int(out["job"]))
+        with self._lock:
+            self._handles[handle.id] = handle
+        return handle
+
+    def withdraw(self, inner_job_id: int) -> bool:
+        try:
+            out = self._request(
+                f"/jobs/{inner_job_id}/withdraw", body={},
+                timeout=self.control_timeout_s,
+            )
+        except Exception:  # noqa: BLE001 — unreachable replica: not stolen
+            return False
+        return bool(out.get("withdrawn"))
+
+    def probe(self) -> dict:
+        """GET /.probe under a short socket timeout: a SIGSTOPped or
+        partitioned child times out here, which the router's deadline
+        probe converts into suspicion and eventually a (possibly
+        false-positive — that is what the lease fence is for) death."""
+        try:
+            out = self._get_json("/.probe", timeout=self.probe_timeout_s)
+        except Exception as e:  # noqa: BLE001 — any transport failure
+            raise ReplicaDead(
+                f"replica {self.idx} probe failed: {type(e).__name__}: {e}"
+            ) from e
+        with self._lock:
+            self._last_probe = out
+        return out
+
+    def idle(self) -> bool:
+        with self._lock:
+            p = dict(self._last_probe)
+        return bool(self.alive and p.get("idle") and not p.get("queued"))
+
+    def snapshot_row(self) -> dict:
+        if not self.alive:
+            return {"alive": 0, "error": self.error or "process exited"}
+        with self._lock:
+            p = dict(self._last_probe)
+        return {
+            "alive": 1,
+            # Pre-first-probe (or partitioned-from-boot) the cache is
+            # empty: report zeros, not None — stats() SUMS these rows.
+            "queued": p.get("queued") or 0,
+            "device_steps": p.get("device_steps") or 0,
+            "remote": self.base_url,
+        }
+
+    # -- completion mirroring --------------------------------------------------
+
+    def spin(self) -> int:
+        """One mirror refresh over every unfinished handle; returns how
+        many reached a terminal state. Driven by the poller thread (the
+        remote analogue of the in-proc driver's pump loop)."""
+        with self._lock:
+            open_handles = [
+                h for h in self._handles.values()
+                if not h._job.event.is_set()
+            ]
+        done = 0
+        for h in open_handles:
+            try:
+                p = h.poll()
+            except Exception:  # noqa: BLE001 — probes own liveness verdicts
+                continue
+            status = p.get("status")
+            if status not in JobStatus.FINISHED:
+                h._job.status = status or h._job.status
+                continue
+            if status == JobStatus.DONE:
+                try:
+                    h._job.result = result_from_json(
+                        self._get_json(f"/jobs/{h.id}/result")
+                    )
+                except Exception:  # noqa: BLE001 — retry on the next spin
+                    continue
+            h._job.error = p.get("error")
+            h._job.status = status
+            h._job.event.set()
+            done += 1
+        return done
+
+    def _drive(self) -> None:
+        while not self._stop:
+            self.spin()
+            time.sleep(self.poll_interval_s)
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._drive, daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop = True
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def close(self) -> None:
+        self.stop()
+
+
+# -- the serving side (runs inside replica_main) --------------------------------
+
+
+def serve_replica(
+    replica,
+    address: str = "localhost:0",
+    registry=None,
+    lease_store=None,
+):
+    """HTTP server over one `Replica` driver — the per-host twin of
+    `serve_service`, extended with the fleet-internal endpoints the router
+    stub drives: `GET /.probe`, `POST /jobs` (model refs + resume paths +
+    checkpoint registration), `POST /jobs/<id>/withdraw`, and
+    `GET /jobs/<id>/result`. Returns the ExplorerServer-shaped handle."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from ..explorer.server import ExplorerServer
+    from ..obs import REGISTRY, render_prometheus
+    from .lease import load_fenced_resume
+    from .server import ModelRegistry, discoveries_view, events_view, status_view
+
+    service = replica.service
+    reg = registry if registry is not None else ModelRegistry()
+    host, _, port = address.partition(":")
+
+    def load_resume(path: Optional[str]):
+        """Resolve a resume path against the shared store root through the
+        fence: stale (revoked-epoch) generations are rejected and counted;
+        nothing loadable means a fresh (still exact) restart."""
+        if not path:
+            return None
+        return load_fenced_resume(path, lease_store)
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # quiet by default
+            pass
+
+        def _json(self, obj, code=200):
+            body = json.dumps(obj, default=str).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _job_id(self, suffix: str = "") -> Optional[int]:
+            raw = self.path.partition("?")[0][len("/jobs/"):]
+            if suffix:
+                if not raw.endswith(suffix):
+                    return None
+                raw = raw[: -len(suffix)]
+            try:
+                return int(raw.strip("/"))
+            except ValueError:
+                return None
+
+        def do_GET(self):
+            path, _, query = self.path.partition("?")
+            try:
+                if path == "/.probe":
+                    try:
+                        out = replica.probe()
+                    except Exception as e:  # noqa: BLE001 — dead reads as 503
+                        self._json(
+                            {"error": f"{type(e).__name__}: {e}"}, 503
+                        )
+                        return
+                    out["idle"] = replica.idle()
+                    if lease_store is not None:
+                        out["lease"] = lease_store.metrics()
+                    self._json(out)
+                    return
+                if path == "/.status":
+                    out = status_view(service)
+                    out["replica"] = replica.snapshot_row()
+                    if lease_store is not None:
+                        out["lease"] = lease_store.metrics()
+                    self._json(out)
+                    return
+                if path == "/metrics":
+                    data = render_prometheus(REGISTRY.collect()).encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                if path.startswith("/jobs/"):
+                    if path.endswith("/result"):
+                        jid = self._job_id("/result")
+                        if jid is not None:
+                            job = service._get(jid)
+                            if job.result is None:
+                                self._json({"error": "not finished"}, 409)
+                                return
+                            self._json(result_to_json(job.result))
+                            return
+                    if path.endswith("/discoveries"):
+                        jid = self._job_id("/discoveries")
+                        if jid is not None:
+                            self._json(discoveries_view(service, jid))
+                            return
+                    if path.endswith("/events"):
+                        jid = self._job_id("/events")
+                        if jid is not None:
+                            service._get(jid)
+                            self._json(events_view(service, jid, query))
+                            return
+                    jid = self._job_id()
+                    if jid is not None:
+                        self._json(service.poll(jid))
+                        return
+                self._json({"error": "not found"}, 404)
+            except KeyError as e:
+                self._json({"error": str(e)}, 404)
+
+        def do_POST(self):
+            try:
+                if self.path == "/jobs":
+                    n = int(self.headers.get("Content-Length") or 0)
+                    try:
+                        payload = json.loads(self.rfile.read(n) or b"{}")
+                    except ValueError:
+                        self._json({"error": "bad JSON body"}, 400)
+                        return
+                    model = reg.get(
+                        payload["model"], payload.get("args") or {}
+                    )
+                    opts = dict(payload.get("opts") or {})
+                    spec = dict(
+                        model=model,
+                        finish_when=decode_finish_when(
+                            opts.get("finish_when")
+                        ),
+                        target_state_count=opts.get("target_state_count"),
+                        target_max_depth=opts.get("target_max_depth"),
+                        timeout=opts.get("timeout"),
+                        priority=int(opts.get("priority") or 0),
+                        journal=bool(payload.get("journal")),
+                        resume=load_resume(payload.get("resume_from")),
+                        trace=payload.get("trace"),
+                    )
+                    try:
+                        handle = replica.submit(spec, payload.get("ckpt"))
+                    except ReplicaDead as e:
+                        self._json({"error": str(e)}, 503)
+                        return
+                    self._json({"job": handle.id})
+                    return
+                if self.path.startswith("/jobs/"):
+                    if self.path.endswith("/withdraw"):
+                        jid = self._job_id("/withdraw")
+                        if jid is not None:
+                            self._json(
+                                {"withdrawn": replica.withdraw(jid)}
+                            )
+                            return
+                    if self.path.endswith("/cancel"):
+                        jid = self._job_id("/cancel")
+                        if jid is not None:
+                            self._json({"cancelled": service.cancel(jid)})
+                            return
+                self._json({"error": "not found"}, 404)
+            except KeyError as e:
+                self._json({"error": str(e)}, 404)
+            except Exception as e:  # noqa: BLE001 — bad submits must not kill
+                self._json({"error": f"{type(e).__name__}: {e}"}, 400)
+
+    httpd = ThreadingHTTPServer((host or "localhost", int(port or 0)), Handler)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    return ExplorerServer(httpd, replica, thread)
+
+
+# -- process spawning ----------------------------------------------------------
+
+
+def spawn_replica_proc(
+    idx: int,
+    root: str,
+    service_kwargs: dict,
+    timeout_s: float = 180.0,
+    env_extra: Optional[dict] = None,
+) -> tuple:
+    """Launch one `replica_main` subprocess over the shared store root and
+    wait for its readiness file (`<root>/replica<idx>.port`, written
+    atomically once the HTTP server is bound). Returns `(Popen, base_url)`.
+    Child stdout/stderr land in `<root>/logs/replica<idx>.log`."""
+    os.makedirs(os.path.join(root, "logs"), exist_ok=True)
+    port_file = os.path.join(root, f"{lease_member(idx)}.port")
+    for p in (port_file, port_file + ".tmp"):
+        if os.path.exists(p):
+            os.unlink(p)
+    log_path = os.path.join(root, "logs", f"{lease_member(idx)}.log")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.update(env_extra or {})
+    log_f = open(log_path, "ab")  # srlint: ckpt-ok child log sink, not persistent checkpoint state
+    try:
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "stateright_tpu.service.replica_main",
+                "--idx", str(idx),
+                "--root", root,
+                "--service-kwargs", json.dumps(service_kwargs),
+            ],
+            stdout=log_f,
+            stderr=subprocess.STDOUT,
+            env=env,
+        )
+    finally:
+        log_f.close()  # the child holds its own fd now
+    deadline = time.monotonic() + timeout_s
+    while True:
+        if os.path.exists(port_file):
+            try:
+                with open(port_file) as f:
+                    port = int(f.read().strip())
+                break
+            except (OSError, ValueError):
+                pass  # racing the atomic rename: retry
+        if proc.poll() is not None:
+            tail = ""
+            try:
+                with open(log_path, "r", errors="replace") as f:
+                    tail = f.read()[-2000:]
+            except OSError:
+                pass
+            # srlint: fault-ok boot-time spawn failure, before any replica exists for the chaos plane to target
+            raise RuntimeError(
+                f"replica {idx} subprocess exited during startup "
+                f"(rc={proc.returncode}); log tail:\n{tail}"
+            )
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise TimeoutError(
+                f"replica {idx} subprocess did not come up within "
+                f"{timeout_s:.0f}s (see {log_path})"
+            )
+        time.sleep(0.05)
+    return proc, f"http://localhost:{port}"

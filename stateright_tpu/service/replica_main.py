@@ -1,0 +1,144 @@
+"""Per-host fleet replica process: `python -m stateright_tpu.service.replica_main`.
+
+One `Replica` driver (crash-only, checkpointing cadence) over one
+foreground CheckService, served over HTTP by `remote.serve_replica` and
+driven by the in-process driver thread — the subprocess the router's
+`RemoteReplica` stub talks to (`ServiceFleet(remote=True)` spawns N of
+these over one shared store root).
+
+Boot contract (remote.spawn_replica_proc is the other half):
+
+1. acquire the lease the router granted BEFORE spawning us
+   (`<root>/leases/lease-replica<idx>.json` — no granted lease is a boot
+   failure, not a silent unfenced replica);
+2. open the flight-recorder journal `<root>/journal/replica<idx>.jsonl`
+   behind the lease gate (FencedEvents), so once the router revokes us,
+   terminal/requeue-relevant events can no longer be recorded;
+3. bind the HTTP server on an ephemeral port and publish it atomically to
+   `<root>/replica<idx>.port`;
+4. drive until SIGTERM (drain + flush) or death by the crash-only rules.
+
+`SR_TPU_FAULTS` in the environment installs a chaos plan in this process,
+so cross-process chaos runs replay exactly like in-proc ones.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--idx", type=int, required=True)
+    ap.add_argument("--root", required=True,
+                    help="shared fleet store root (ckpt/leases/journal/...)")
+    ap.add_argument("--service-kwargs", default="{}",
+                    help="JSON CheckService kwargs (batch_size, ...)")
+    ap.add_argument("--address", default="localhost:0")
+    ap.add_argument("--ckpt-every-spins", type=int, default=1)
+    ap.add_argument("--pump-rounds", type=int, default=4)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    p = os.environ.get("JAX_PLATFORMS")
+    if p:
+        # The image's site config re-registers the axon TPU platform over a
+        # plain env var; pin at the jax.config level (same move as bench.py).
+        jax.config.update("jax_platforms", p)
+
+    from ..faults.plan import FaultPlan, install_plan
+    from ..obs import EventJournal
+    from .api import CheckService
+    from .fleet import Replica
+    from .lease import FencedEvents, LeaseStore
+    from .remote import serve_replica
+    from .router import lease_member
+
+    plan = FaultPlan.from_env()
+    if plan is not None:
+        install_plan(plan)
+
+    member = lease_member(args.idx)
+    root = os.path.abspath(args.root)
+    lease_store = LeaseStore(os.path.join(root, "leases"))
+    lease = lease_store.acquire(member)  # granted pre-spawn, or boot fails
+
+    journal_dir = os.path.join(root, "journal")
+    os.makedirs(journal_dir, exist_ok=True)
+    journal = EventJournal(
+        os.path.join(journal_dir, f"{member}.jsonl"), writer=member
+    )
+    events = FencedEvents(journal, lease)
+
+    kw = json.loads(args.service_kwargs)
+    kw["background"] = False  # the Replica driver owns the pumping
+
+    replica = Replica(
+        args.idx,
+        lambda: CheckService(events=events, **kw),
+        ckpt_every_spins=args.ckpt_every_spins,
+        pump_rounds=args.pump_rounds,
+        events=events,
+        lease=lease,
+    )
+
+    srv = serve_replica(
+        replica, address=args.address, lease_store=lease_store
+    )
+    port = srv.httpd.server_address[1]
+    port_file = os.path.join(root, f"{member}.port")
+    tmp = port_file + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(port))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, port_file)
+    print(f"REPLICA_READY member={member} port={port}", flush=True)
+
+    done = threading.Event()
+
+    def on_term(_sig, _frame):
+        done.set()
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+
+    # Parent-death watchdog: a replica must never outlive its fleet. If
+    # the spawning process dies without a clean close() (crashed harness,
+    # SIGKILLed test runner), we are re-parented — exit instead of
+    # burning CPU as an unkillable-by-nobody orphan. (The lease fence
+    # makes an orphan HARMLESS; this makes it CHEAP.)
+    parent0 = os.getppid()
+
+    def watch_parent() -> None:
+        while not done.is_set():
+            if os.getppid() != parent0:
+                done.set()
+                return
+            done.wait(1.0)
+
+    threading.Thread(target=watch_parent, daemon=True).start()
+
+    replica.start()
+    try:
+        done.wait()
+    finally:
+        # Graceful drain: stop the driver, flush the recorder tail, close
+        # the service — a SIGTERM'd replica leaves a clean journal.
+        replica.close()
+        journal.close()
+        try:
+            srv.httpd.shutdown()
+        except Exception:  # noqa: BLE001 — best-effort teardown
+            pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

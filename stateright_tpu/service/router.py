@@ -55,7 +55,13 @@ import zlib
 from typing import Optional
 
 from ..core.discovery import HasDiscoveries
-from ..faults.ckptio import CheckpointCorrupt, load_latest
+from ..faults.ckptio import (
+    CheckpointCorrupt,
+    LEASE_STAMP_KEYS,
+    fenced_load_latest,
+    fenced_savez,
+    latest_generation,
+)
 from ..faults.plan import FaultError, _u01, active_plan, maybe_fault
 from ..obs import (
     REGISTRY,
@@ -64,12 +70,37 @@ from ..obs import (
     as_tracer,
     mint_trace_id,
 )
-from .queue import JobResume, JobStatus
+from .queue import JobStatus
 
 
 class ReplicaDead(RuntimeError):
     """The targeted replica's driver has stopped (crash, hang past the
     probe policy, or shutdown); the router must place the work elsewhere."""
+
+
+def lease_member(idx: int) -> str:
+    """The ONE spelling of a replica's lease-member / journal-writer name
+    (fleet wiring, replica_main, and the timeline fence all key on it)."""
+    return f"replica{idx}"
+
+
+class ResumeToken:
+    """A requeued/stolen job's resume pointer: the checkpoint path whose
+    newest FENCED generation the next replica must resume from. The token
+    (not a loaded payload) crosses the replica seam so each replica kind
+    resolves it where the bytes are cheap: an in-proc `Replica` loads it
+    in this process, a `RemoteReplica` sends the path over HTTP and the
+    serving process loads it against the shared store root — both through
+    `ckptio.fenced_load_latest`, so a zombie's stale generation is
+    rejected wherever the resume happens."""
+
+    __slots__ = ("path",)
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def __repr__(self) -> str:
+        return f"ResumeToken({self.path!r})"
 
 
 class NoHealthyReplica(RuntimeError):
@@ -160,9 +191,13 @@ class FleetJob:
     resubmit it anywhere), its current binding, and its completion state."""
 
     def __init__(self, fleet_id: int, model, key: str, opts: dict,
-                 ckpt_path: Optional[str]):
+                 ckpt_path: Optional[str], model_ref: Optional[tuple] = None):
         self.id = fleet_id
         self.model = model
+        # (registry name, args dict) when known — what a REMOTE replica
+        # submits across the process boundary (model objects cannot cross
+        # it; both sides resolve the ref through the same ModelRegistry).
+        self.model_ref = model_ref
         self.key = key
         # Flight-recorder trace id: minted HERE (the outermost front door)
         # and carried through every replica the job ever touches, so the
@@ -228,6 +263,10 @@ class FleetRouter:
         ckpt_dir: Optional[str] = None,
         tracer=None,
         events=None,
+        lease_store=None,
+        router_lease=None,
+        probe_backoff_base: int = 1,
+        probe_backoff_cap: int = 8,
     ):
         """`replicas` are service/fleet.py `Replica` drivers (one
         CheckService each). `background=True` makes probes run under a
@@ -239,7 +278,19 @@ class FleetRouter:
         usually `ServiceFleet(journal_dir=...)`'s `router.jsonl`): every
         routing decision, failover, requeue, and steal is journaled keyed
         by the job's trace id, the fleet `/.status` carries the last-N
-        event ring, and `GET /jobs/<id>/events` tails it live."""
+        event ring, and `GET /jobs/<id>/events` tails it live.
+
+        `lease_store` + `router_lease` (service/lease.py, wired by
+        `ServiceFleet(lease_dir=...)` / remote mode) turn on epoch
+        fencing: this router is the single lease authority — it REVOKES a
+        member's lease before requeueing its jobs (so the member's later
+        writes are provably stale) and re-seals each orphan's newest
+        intact checkpoint generation under its own never-revoked lease.
+
+        `probe_backoff_base` / `probe_backoff_cap` (ticks) are the
+        exponential probe backoff for repeatedly-failing members: a
+        partitioned replica's probes are deferred (with seeded jitter)
+        instead of eating the tick budget every round."""
         self.replicas = {r.idx: r for r in replicas}
         self.ckpt_dir = ckpt_dir
         self.ring = HashRing(list(self.replicas))
@@ -253,22 +304,41 @@ class FleetRouter:
         self.background = background
         self._tracer = as_tracer(tracer)
         self._events = as_events(events)
+        self.lease_store = lease_store
+        self.router_lease = router_lease
+        self.probe_backoff_base = max(int(probe_backoff_base), 1)
+        self.probe_backoff_cap = max(int(probe_backoff_cap), 1)
         self._jobs: dict[int, FleetJob] = {}
         self._next_id = 1
         self._lock = threading.RLock()
         self._suspect: dict[int, int] = {r: 0 for r in self.replicas}
         self._dead: set = set()
+        self._tick_n = 0
+        self._next_probe: dict[int, int] = {}  # idx -> earliest probe tick
         self.counters = {
             "jobs_routed": 0,
             "router_retries": 0,
             "router_backoff_ms": 0,
             "probe_failures": 0,
+            "probe_skipped": 0,
             "replica_crashes": 0,
             "requeued_jobs": 0,
             "restored_jobs": 0,
             "steals": 0,
+            "lease_revokes": 0,
+            "lease_reseals": 0,
         }
         self._metrics_name = REGISTRY.register("fleet", self.metrics)
+        if self.lease_store is not None:
+            # The grants happened before the replicas started (a remote
+            # member ACQUIRES its lease at boot); journal them here so the
+            # lease lifecycle reads start-to-finish in the router journal.
+            for idx in self.replicas:
+                epoch, _state = self.lease_store.state(lease_member(idx))
+                if epoch:
+                    self._events.emit(
+                        "lease.grant", member=lease_member(idx), epoch=epoch
+                    )
 
     # -- client surface --------------------------------------------------------
 
@@ -281,10 +351,15 @@ class FleetRouter:
         target_max_depth: Optional[int] = None,
         timeout: Optional[float] = None,
         priority: int = 0,
+        model_ref: Optional[tuple] = None,
     ) -> FleetJobHandle:
         """Route one job onto the fleet; returns immediately. `route_key`
         defaults to the model's type name — same-key jobs share a replica
-        (and so a compiled step); distinct keys spread over the ring."""
+        (and so a compiled step); distinct keys spread over the ring.
+        `model_ref=(registry name, args)` is REQUIRED when any replica is
+        remote: model objects cannot cross the process boundary, so the
+        ref is what a RemoteReplica submits (both sides resolve it through
+        the same ModelRegistry; serve_fleet fills it in automatically)."""
         if not self._healthy():
             # One of the satellite 503/Retry-After surfaces: journaled so
             # a forensic pass can see WHY clients were bounced.
@@ -294,6 +369,18 @@ class FleetRouter:
             self._tracer.instant("router.unavailable", cat="fleet")
             raise NoHealthyReplica(
                 "every fleet replica is dead; resubmit after recovery"
+            )
+        if model_ref is None and any(
+            getattr(r, "remote", False) for r in self.replicas.values()
+        ):
+            # Caller-contract violation, not a fleet failure: without the
+            # early check, every placement attempt would misread the
+            # refusal as ReplicaDead and burn the failover walk on
+            # perfectly healthy replicas.
+            raise TypeError(
+                "this fleet has remote replicas: submit() needs "
+                "model_ref=(registry name, args) — model objects cannot "
+                "cross the process boundary"
             )
         key = route_key if route_key is not None else type(model).__name__
         opts = dict(
@@ -306,7 +393,7 @@ class FleetRouter:
         with self._lock:
             fj = FleetJob(
                 self._next_id, model, key, opts,
-                self._ckpt_path_for(self._next_id),
+                self._ckpt_path_for(self._next_id), model_ref=model_ref,
             )
             self._next_id += 1
             self._jobs[fj.id] = fj
@@ -408,8 +495,9 @@ class FleetRouter:
         return dict(
             fj.opts,
             model=fj.model,
+            model_ref=fj.model_ref,
             journal=fj.ckpt_path is not None,
-            resume=resume,
+            resume=resume,  # None | ResumeToken (each replica resolves it)
             trace=fj.trace,  # one timeline across every replica hop
         )
 
@@ -534,18 +622,40 @@ class FleetRouter:
             self._steal()
 
     def _probe_all(self) -> None:
+        self._tick_n += 1
         for r in list(self.replicas.values()):
             if r.idx in self._dead:
                 continue
             if not r.alive:
                 self._on_replica_death(r)
                 continue
+            if self._tick_n < self._next_probe.get(r.idx, 0):
+                # Exponential probe backoff: a repeatedly-failing member
+                # (partitioned, hung) is probed on a widening jittered
+                # cadence instead of eating a probe deadline out of EVERY
+                # router tick.
+                self.counters["probe_skipped"] += 1
+                continue
             ok = self._probe(r)
             if ok:
                 self._suspect[r.idx] = 0
+                self._next_probe.pop(r.idx, None)
                 continue
             self.counters["probe_failures"] += 1
             self._suspect[r.idx] += 1
+            backoff = min(
+                self.probe_backoff_base * 2 ** (self._suspect[r.idx] - 1),
+                self.probe_backoff_cap,
+            )
+            # Seeded jitter (±25%): N members suspected on the same tick
+            # must not re-probe in lockstep forever.
+            jitter = 0.75 + 0.5 * _u01(
+                self.seed, "router.probe_jitter",
+                self._tick_n * 131 + r.idx,
+            )
+            self._next_probe[r.idx] = self._tick_n + max(
+                1, int(round(backoff * jitter))
+            )
             # Journal/span only probe FAILURES: healthy probes fire every
             # tick per replica and would drown both planes in no-ops —
             # the suspect counter is the forensic story a failure tells.
@@ -588,7 +698,38 @@ class FleetRouter:
         """Remove the replica from the ring and requeue every unfinished
         job it held — resumed from its newest intact checkpoint generation
         when one exists, restarted fresh otherwise. Zero lost jobs either
-        way."""
+        way.
+
+        With the lease plane on, the member's lease is REVOKED (persisted)
+        before anything is requeued, and each orphan's newest intact
+        generation is re-sealed under the router's own lease — so if the
+        "dead" replica is actually a zombie (hung, partitioned), every
+        write it attempts from here on is provably stale: its fenced
+        writes refuse themselves, and the one raced write that can slip
+        through an already-open fd is rejected at load time by the stamp
+        check. An injected `lease.revoke_race` fault aborts the whole
+        death handling BEFORE any state changes; the next tick re-detects
+        the death and re-runs it — revoke-then-requeue stays atomic."""
+        with self._lock:
+            if r.idx in self._dead:
+                return
+        member = lease_member(r.idx)
+        if self.lease_store is not None:
+            try:
+                epoch = self.lease_store.revoke(member)
+            except FaultError:
+                # Injected lease.revoke_race: nothing was persisted and
+                # nothing is requeued — the member stays (suspected)
+                # alive until the next tick retries the revocation.
+                self._tracer.instant(
+                    "lease.revoke_race", cat="fleet", member=member
+                )
+                return
+            if epoch is not None:
+                self.counters["lease_revokes"] += 1
+                self._events.emit(
+                    "lease.revoke", member=member, epoch=epoch
+                )
         with self._lock:
             if r.idx in self._dead:
                 return
@@ -621,7 +762,7 @@ class FleetRouter:
                     fj.replica = None
                     fj.handle = None
                     self.counters["requeued_jobs"] += 1
-                resume = self._load_resume(fj)
+                resume = self._resume_token(fj, reseal=True)
                 if resume is not None:
                     self.counters["restored_jobs"] += 1
                 self._events.emit(
@@ -630,17 +771,45 @@ class FleetRouter:
                 )
                 self._place(fj, resume=resume)
 
-    def _load_resume(self, fj: FleetJob) -> Optional[JobResume]:
+    def _resume_token(self, fj: FleetJob, reseal: bool = False):
+        """Probe the job's newest intact checkpoint generation; return a
+        `ResumeToken` for the next replica to resolve, or None (restart
+        fresh — still exact). With `reseal=True` (the death path — the
+        writer's lease was JUST revoked) the generation is re-written
+        under the router's own lease first: the revoked stamp it carries
+        is legitimate (written before the revocation, which is exactly why
+        this load accepts it with CRC-only validation), but every LATER
+        read must be able to tell it from a zombie write — after the
+        re-seal, anything still stamped with the revoked epoch is by
+        definition post-revocation and gets rejected. The non-reseal paths
+        (steal, lost-withdraw requeue — the checkpoint's writer is a LIVE
+        member, its stamps valid by construction) use a cheap
+        CRC-existence probe instead of parsing the whole npz on the
+        supervisor tick thread; the receiving replica is the one that
+        loads the bytes (through the fence) anyway."""
         if fj.ckpt_path is None:
             return None
-        try:
-            data, src = load_latest(fj.ckpt_path)
-        except (CheckpointCorrupt, FileNotFoundError, OSError):
-            return None  # no intact generation: restart fresh (still exact)
+        if reseal and self.router_lease is not None:
+            try:
+                # CRC-only load: the pre-revocation generation carries the
+                # now-revoked stamp by construction.
+                data, src = fenced_load_latest(fj.ckpt_path)
+                arrays = {
+                    k: data[k] for k in data.files
+                    if k not in LEASE_STAMP_KEYS
+                }
+                fenced_savez(fj.ckpt_path, arrays, lease=self.router_lease)
+                self.counters["lease_reseals"] += 1
+            except (CheckpointCorrupt, FileNotFoundError, OSError):
+                return None  # no intact generation: restart fresh
+        else:
+            src = latest_generation(fj.ckpt_path)
+            if src is None:
+                return None
         self._tracer.instant(
             "fleet.restore", cat="fleet", job=fj.id, src=src, trace=fj.trace
         )
-        return JobResume.from_npz(data)
+        return ResumeToken(fj.ckpt_path)
 
     def _harvest(self) -> None:
         """Fold finished inner jobs into their fleet jobs. ERROR on a DEAD
@@ -652,6 +821,7 @@ class FleetRouter:
                 if fj.status not in FleetJobStatus.FINISHED
                 and fj.handle is not None
             ]
+        lost_steals: list = []
         for fj in open_jobs:
             inner = fj.handle._job
             if not inner.event.is_set():
@@ -670,8 +840,33 @@ class FleetRouter:
                         continue  # death handler will requeue
                     fj.error = inner.error
                     self._finish(fj, FleetJobStatus.ERROR)
-                # inner CANCELLED: either our own cancel (already finished)
-                # or a steal withdrawal that rebound the handle first.
+                elif inner.status == JobStatus.CANCELLED:
+                    # A still-ROUTED fleet job whose inner copy is
+                    # CANCELLED: a withdraw whose RESPONSE was lost (a
+                    # remote steal hit its control deadline after the
+                    # victim had already withdrawn — at-most-once RPC, the
+                    # cross-process failure the in-proc fleet could never
+                    # produce). The steal itself rebinds the handle in the
+                    # same tick before harvest ever sees it, and the
+                    # router's own cancel finishes the fleet job first, so
+                    # what remains IS the lost-response case: recover like
+                    # any orphan — requeue on the ring, zero lost jobs.
+                    src = fj.replica
+                    fj.requeues += 1
+                    fj.replica = None
+                    fj.handle = None
+                    self.counters["requeued_jobs"] += 1
+                    lost_steals.append((fj, src))
+        for fj, src in lost_steals:
+            resume = self._resume_token(fj)
+            if resume is not None:
+                with self._lock:
+                    self.counters["restored_jobs"] += 1
+            self._events.emit(
+                "job.requeued", job=fj.id, trace=fj.trace, src=src,
+                reason="withdraw response lost", restored=resume is not None,
+            )
+            self._place(fj, resume=resume)
 
     def _steal(self) -> None:
         """Idle replicas pull still-QUEUED jobs from the most-loaded
@@ -700,7 +895,11 @@ class FleetRouter:
         for thief in idle:
             victims = [
                 (len(v), idx) for idx, v in queued_by_replica.items()
-                if v and idx != thief.idx
+                # Never steal from a SUSPECTED victim: its withdraw call
+                # would stall the tick loop against a hung/partitioned
+                # process, and if it is truly dead the death handler is
+                # about to requeue its whole queue anyway.
+                if v and idx != thief.idx and not self._suspect.get(idx)
             ]
             if not victims:
                 return
@@ -731,7 +930,7 @@ class FleetRouter:
                     # journal's job.resumed events stay equal to the
                     # restored_jobs counter (the flight-recorder
                     # consistency pin).
-                    resume = self._load_resume(fj)
+                    resume = self._resume_token(fj)
                     if resume is not None:
                         with self._lock:
                             self.counters["restored_jobs"] += 1
@@ -807,6 +1006,13 @@ class FleetRouter:
                     row.get("queued", 0) for row in per_replica.values()
                 ),
                 **self.counters,
+                # Router-process fencing refusals/rejections (each REMOTE
+                # replica's own counts live in its process's "lease"
+                # registry source, scraped from its /metrics).
+                "lease_rejected": (
+                    self.lease_store.rejected_total()
+                    if self.lease_store is not None else 0
+                ),
                 "per_replica": per_replica,
                 # Last-N flight-recorder events — the `/.status` at-a-
                 # glance ring ([] when the fleet journals nothing; the
@@ -982,7 +1188,13 @@ def serve_fleet(
                         f":{k}={v}" for k, v in sorted(args.items())
                     )
                     try:
-                        h = router.submit(model, route_key=key, **opts)
+                        # model_ref rides along so REMOTE replicas can
+                        # resolve the same (name, args) through their own
+                        # registry — in-proc replicas just ignore it.
+                        h = router.submit(
+                            model, route_key=key,
+                            model_ref=(name, args), **opts,
+                        )
                     except NoHealthyReplica as e:
                         self._503(str(e))
                         return

@@ -356,21 +356,44 @@ class ServiceEngine:
             pass  # weakref-less exotic model: re-derive next time
         return key
 
+    def prefetch_warm(self, job: Job) -> None:
+        """The OFF-LOCK half of warm-start (ROADMAP item 4 leftover):
+        compute the job's content key and read+decode the corpus entry
+        npz WITHOUT the service lock held — CheckService.submit calls this
+        from the client thread before it ever takes the lock, so a slow
+        corpus read can never stall an unrelated job's poll. Only
+        immutable engine config and the (internally locked) CorpusStore
+        are touched; the decoded entry parks on the job and the
+        under-lock `_maybe_warm` consumes it at admission without I/O."""
+        if self._corpus is None or job.warm is not None:
+            return
+        if job.content_key is None:
+            job.content_key = self._content_key_for(job)
+        job.warm_checked = True
+        job.warm_entry = self._corpus.lookup(job.content_key)
+
     def _maybe_warm(self, job: Job) -> None:
-        """Corpus lookup + tiered preload at admission. On a hit, the
-        published visited set lands in the spill tier + Bloom summary
-        RE-SALTED with this job's salt (so co-resident jobs never see each
-        other's preload) and the publisher's result metadata is kept on
-        the job for the completion-time replay. Every failure mode —
-        miss, corrupt entry, injected `corpus.load` fault — degrades to a
-        cold run."""
+        """Corpus preload at admission. On a hit, the published visited
+        set lands in the spill tier + Bloom summary RE-SALTED with this
+        job's salt (so co-resident jobs never see each other's preload)
+        and the publisher's result metadata is kept on the job for the
+        completion-time replay. The entry itself was prefetched OFF the
+        service lock (`prefetch_warm`); only the device/host preload —
+        engine state — happens here. Every failure mode — miss, corrupt
+        entry, injected `corpus.load` fault — degrades to a cold run."""
         if self._corpus is None:
             return
         if job.content_key is None:
             job.content_key = self._content_key_for(job)
         if job.warm is not None:
             return  # already preloaded (re-admission path)
-        entry = self._corpus.lookup(job.content_key)
+        entry, job.warm_entry = job.warm_entry, None
+        if entry is None and not job.warm_checked:
+            # No prefetch reached this admission (direct engine use): one
+            # inline lookup. A prefetch that MISSED (or was degraded by an
+            # injected corpus.load fault) is never retried here — the
+            # chaos plane's "fault => cold run" contract stands.
+            entry = self._corpus.lookup(job.content_key)
         if entry is None:
             return
         with self._tracer.span(
@@ -391,14 +414,14 @@ class ServiceEngine:
             key=job.content_key[:16],
         )
 
-    def maybe_publish(self, job: Job) -> bool:
-        """Publish a finished job's visited set into the corpus. Gated on
-        a COMPLETE exhaustive run (never early-exited, timed out, or
-        cancelled): only then is the journal the full reachable set, valid
-        for any later submission of the same content key. Warm jobs never
-        publish (their journal covers only the re-expanded frontier; the
-        content-address skip would reject the write anyway). Never raises
-        — a publish failure is a counter, not a job failure."""
+    def prepare_publish(self, job: Job) -> Optional[tuple]:
+        """The UNDER-LOCK half of a corpus publish: apply the gate (a
+        COMPLETE exhaustive cold run only — never early-exited, timed out,
+        or cancelled; only then is the journal the full reachable set) and
+        snapshot the journal into packed arrays + metadata. Returns the
+        payload for `publish_payload`, or None when the job must not
+        publish. Cheap (memory concatenation) by design: the npz write
+        and the Bloom rehash — the slow parts — happen off-lock."""
         if (
             self._corpus is None
             or job.content_key is None
@@ -410,12 +433,12 @@ class ServiceEngine:
             or job.timed_out
             or job.pending_lanes != 0
         ):
-            return False
+            return None
         j_lo = np.concatenate([c[0] for c in job.journal])
         j_hi = np.concatenate([c[1] for c in job.journal])
         jp_lo = np.concatenate([c[2] for c in job.journal])
         jp_hi = np.concatenate([c[3] for c in job.journal])
-        job.published = self._corpus.publish(
+        return (
             job.content_key,
             pack_fp(j_lo, j_hi),
             pack_fp(jp_lo, jp_hi),
@@ -426,7 +449,14 @@ class ServiceEngine:
                 "discoveries": dict(job.discoveries),
             },
         )
-        return job.published
+
+    def publish_payload(self, payload: tuple) -> bool:
+        """The OFF-LOCK half: Bloom rehash + crash-atomic npz write
+        (ROADMAP item 4 leftover — a slow publish must not stall an
+        unrelated job's poll against the service lock). The CorpusStore
+        is internally thread-safe; never raises."""
+        key, fps, parents, meta = payload
+        return self._corpus.publish(key, fps, parents, meta)
 
     def admit(self, job: Job) -> Optional[Job]:
         """Seed a job's init states into the shared table (salted) and hand

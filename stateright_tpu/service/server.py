@@ -17,6 +17,9 @@ Endpoints:
 - ``GET /jobs/<id>`` — poll one job (status, counts, discovery names,
   metrics).
 - ``POST /jobs/<id>/cancel`` / ``DELETE /jobs/<id>`` — cancel.
+- ``POST /jobs/<id>/withdraw`` — atomically remove a still-QUEUED job
+  (the fleet work-stealing primitive, exposed over HTTP so a remote
+  router can steal exactly like an in-proc one); ``{"withdrawn": bool}``.
 - ``GET /jobs/<id>/discoveries`` — the reconstructed discovery paths of a
   finished job (action-label lists, the `assert_discovery` currency).
 - ``GET /jobs/<id>/events?since=N&wait=S`` — live flight-recorder tail
@@ -294,6 +297,14 @@ def serve_service(
                     jid = self._job_id("/cancel")
                     if jid is not None:
                         self._json({"cancelled": service.cancel(jid)})
+                        return
+                if self.path.startswith("/jobs/") and self.path.endswith(
+                    "/withdraw"
+                ):
+                    jid = self._job_id("/withdraw")
+                    if jid is not None:
+                        service._get(jid)  # 404 on unknown jobs
+                        self._json({"withdrawn": service.withdraw(jid)})
                         return
                 self._json({"error": "not found"}, 404)
             except KeyError as e:

@@ -52,10 +52,11 @@ import numpy as np
 
 from ..faults.ckptio import (
     CheckpointCorrupt,
-    atomic_savez,
+    LeaseRevoked,
     content_path,
+    fenced_load_latest,
+    fenced_savez,
     latest_generation,
-    load_latest,
 )
 from ..faults.plan import FaultError, maybe_fault
 from ..obs import REGISTRY
@@ -197,6 +198,12 @@ class CorpusStore:
         self.summary_hashes = summary_hashes
         os.makedirs(root, exist_ok=True)
         self._lock = threading.Lock()
+        # Epoch fence (service/lease.py, fleet replicas only): set by the
+        # owning Replica driver via `set_lease`. A fenced corpus refuses
+        # its own publishes once the lease is revoked, stamps every entry
+        # it writes, and rejects stale-stamped entries at lookup — the
+        # "zombie double-publish" hazard closed at both ends.
+        self._lease = None
         self.counters = {
             "hits": 0,
             "misses": 0,
@@ -205,9 +212,15 @@ class CorpusStore:
             "publish_faults": 0,
             "load_faults": 0,
             "corrupt_entries": 0,
+            "lease_rejected": 0,
             "preload_states": 0,
         }
         self._metrics_name = REGISTRY.register("corpus", self.metrics)
+
+    def set_lease(self, lease) -> None:
+        """Attach the owning replica's fencing token (service/lease.py
+        Lease); publishes re-validate it and entries carry its stamp."""
+        self._lease = lease
 
     def path_for(self, key: str) -> str:
         return content_path(self.root, key)
@@ -225,6 +238,7 @@ class CorpusStore:
         ``corpus.load`` fault degrades to a miss — warm-start is an
         optimization, so every failure mode here means "run cold"."""
         path = self.path_for(key)
+        fenced_out = []
         try:
             # Chaos-plane boundary: fires before any file is touched, so a
             # faulted load leaves the corpus (and the job) untouched.
@@ -234,7 +248,18 @@ class CorpusStore:
             ):
                 self._count("misses")
                 return None
-            data, _src = load_latest(path)
+            def reject(*stamp):
+                fenced_out.append(stamp)
+                self._count("lease_rejected")
+
+            data, _src = fenced_load_latest(
+                path,
+                validator=(
+                    self._lease.store.validate
+                    if self._lease is not None else None
+                ),
+                on_reject=reject,
+            )
             entry = self._decode(key, data)
         except (FaultError, OSError) as e:
             self._count("load_faults")
@@ -242,11 +267,17 @@ class CorpusStore:
             del e
             return None
         except CheckpointCorrupt:
-            # Torn tail / flipped byte / truncated entry: the ckptio CRC
-            # footer caught it. Ignore the entry — cold, never wrong.
-            self._count("corrupt_entries")
+            # Torn tail / flipped byte / truncated entry — or every
+            # candidate stamped with a REVOKED lease epoch (a zombie's
+            # publish that raced the revocation: stale, never read back).
+            # Either way: ignore the entry — cold, never wrong.
+            if not fenced_out:
+                self._count("corrupt_entries")
             self._count("misses")
             return None
+        finally:
+            if fenced_out and self._lease is not None:
+                self._lease.store.count_rejected("read", len(fenced_out))
         if entry is None:
             self._count("corrupt_entries")
             self._count("misses")
@@ -307,6 +338,13 @@ class CorpusStore:
         (injected ``corpus.publish`` fault or real I/O error) is counted
         and the job's own result is unaffected."""
         path = self.path_for(key)
+        if self._lease is not None and not self._lease.valid():
+            # Write-side fence: a revoked replica (the zombie) must never
+            # publish — not even content-identical bytes; the fence is the
+            # invariant, not the content.
+            self._count("lease_rejected")
+            self._lease.store.count_rejected("write")
+            return False
         try:
             if latest_generation(path) is not None:
                 self._count("publish_skipped")
@@ -327,7 +365,7 @@ class CorpusStore:
                 self.summary_hashes,
             )
             names = sorted(meta.get("discoveries", {}))
-            atomic_savez(
+            fenced_savez(
                 path,
                 {
                     "key": np.asarray([key], dtype=np.str_),
@@ -353,7 +391,14 @@ class CorpusStore:
                         dtype=np.uint64,
                     ),
                 },
+                lease=self._lease,
             )
+        except LeaseRevoked:
+            # The write-side fence refused a publish whose lease was
+            # revoked between the pre-check above and the write — stale,
+            # counted, harmless.
+            self._count("lease_rejected")
+            return False
         except (FaultError, OSError):
             self._count("publish_faults")
             return False

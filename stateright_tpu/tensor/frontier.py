@@ -34,7 +34,7 @@ from ..core.discovery import HasDiscoveries
 from ..core.model import Expectation
 from ..core.path import Path
 from ..knobs import APPEND_KINDS, STORE_KINDS
-from ..faults.ckptio import atomic_savez, load_latest
+from ..faults.ckptio import fenced_savez, load_latest
 from ..faults.plan import maybe_fault
 from ..obs import REGISTRY, StepRing, as_tracer, build_detail
 from .fingerprint import device_fingerprint, pack_fp
@@ -1055,7 +1055,7 @@ class FrontierSearch:
                 dtype=np.uint8,
             ),
         )
-        atomic_savez(path, arrays)
+        fenced_savez(path, arrays)
 
     @classmethod
     def load_checkpoint(

@@ -37,7 +37,7 @@ import jax.numpy as jnp
 from ..core.discovery import HasDiscoveries
 from ..core.model import Expectation
 from ..knobs import INSERT_VARIANTS, PHASED_VARIANTS, STORE_KINDS, TABLE_LAYOUTS
-from ..faults.ckptio import atomic_savez, load_latest, normalize_ckpt_path
+from ..faults.ckptio import fenced_savez, load_latest, normalize_ckpt_path
 from ..faults.plan import maybe_fault
 from ..obs import N_COLS, REGISTRY, StepRing, as_tracer, build_detail
 from .fingerprint import pack_fp
@@ -1460,7 +1460,7 @@ class ResidentSearch:
         )
         # Crash-atomic write (tmp+fsync+rename, CRC32 footer, previous
         # generation kept at `path + ".prev"` — faults/ckptio.py).
-        atomic_savez(path, arrays)
+        fenced_savez(path, arrays)
 
     @classmethod
     def load_checkpoint(

@@ -370,3 +370,66 @@ def test_fleet_stats_conform_to_obs_schema(fleet2):
 
     s = fleet2.stats()
     assert set(s) == set(FLEET_COUNTER_KEYS)
+
+
+# -- epoch-fenced leases, in-proc (the fast half of ISSUE 12) ------------------
+
+
+def test_inproc_partition_zombie_is_fenced_and_results_bit_identical(tmp_path):
+    """A router<->replica partition (`fleet.partition`) makes the router
+    declare a perfectly-alive replica dead — the false-positive death.
+    With the lease plane on (`lease_dir=`), the whole fencing story runs
+    in-proc: an injected `lease.revoke_race` aborts the FIRST death
+    handling before anything is persisted (the next tick retries), then
+    the revocation fences the zombie — the foreground fleet keeps
+    SPINNING it (it is alive!), its next checkpoint write refuses itself
+    (counted), it dies crash-only, and every requeued job completes on
+    the survivor with the single-replica golden counts."""
+    fleet = ServiceFleet(
+        n_replicas=2, background=False, max_resident=1,
+        service_kwargs=SVC_KW, lease_dir=str(tmp_path / "leases"),
+        router_kwargs=dict(steal=False, unhealthy_after=2),
+    )
+    try:
+        handles = [fleet.submit(M3) for _ in range(4)]
+        owners = {h._job.replica for h in handles}
+        assert len(owners) == 1
+        victim = owners.pop()
+        # Let the victim make progress + write checkpoint generations.
+        while fleet.replicas[victim].service._engine.total_steps < 2:
+            fleet.pump(1)
+        plan = (
+            FaultPlan()
+            .rule("fleet.partition", "io", times=-1,
+                  match={"replica": victim})
+            .rule("lease.revoke_race", "io", times=1)
+        )
+        with active(plan):
+            # Drive until the router declares the partitioned replica
+            # dead (the first attempt is aborted by the injected
+            # revoke-race and retried); the zombie is STILL spun by pump
+            # (alive), hits the fence on its next checkpoint write, and
+            # dies crash-only.
+            deadline = time.monotonic() + 60
+            while fleet.stats()["replica_crashes"] < 1:
+                assert time.monotonic() < deadline, fleet.stats()
+                fleet.pump(1)
+            fleet.drain(timeout=600)
+        assert plan.injected["lease.revoke_race:io"] == 1
+        for h in handles:
+            r = h.result()
+            assert r.complete
+            assert (r.state_count, r.unique_state_count) == GOLD_2PC3
+        s = fleet.stats()
+        assert s["replica_crashes"] == 1
+        assert s["lease_revokes"] == 1
+        assert s["requeued_jobs"] >= 1
+        # The fence engaged: the zombie's post-revocation writes were
+        # refused (write-side) — counted in the shared lease store.
+        assert s["lease_rejected"] >= 1, s
+        assert fleet.lease_store.counters["rejected_writes"] >= 1
+        # The zombie died crash-only AFTER being fenced out.
+        assert not fleet.replicas[victim].alive
+        assert "LeaseRevoked" in (fleet.replicas[victim].error or "")
+    finally:
+        fleet.close()

@@ -1,0 +1,134 @@
+"""Cross-process fleet: HTTP-backed replicas + epoch-fenced leases
+(service/{remote,replica_main,lease}.py behind the same Replica seam).
+
+The richest single scenario — the ZOMBIE: a 3-subprocess fleet loses one
+replica to SIGSTOP (a hung-but-alive process), the router declares it
+dead (lease revoked BEFORE requeue), the process is SIGCONTed and keeps
+stepping its orphaned job copies — and every write it attempts is fenced.
+All jobs finish bit-identical to the single-replica goldens and the
+merged flight-recorder timeline shows zero anomalies. kill -9 and the
+injected router↔replica partition ride the same machinery and are
+exercised by the full matrix in scripts/fleet_procs_smoke.py (also
+wrapped here).
+
+Both tests are `slow`-marked: subprocess fleets pay real jax boots, and
+tier-1 is timeout-bound (ROADMAP re-anchor note) — the fast half of the
+fencing story (including an in-proc zombie golden) lives in
+tests/test_lease.py and tests/test_fleet.py.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from stateright_tpu.service import ServiceFleet
+from stateright_tpu.service.server import ModelRegistry
+
+GOLD_2PC3 = (1_146, 288)
+REF = ("2pc", {"n": 3})
+
+
+def _wait_steps(replica, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            p = replica._get_json("/.probe", timeout=1.0)
+            if p.get("device_steps", 0) >= 1:
+                return
+        except Exception:
+            pass
+        time.sleep(0.02)
+    raise TimeoutError("victim never stepped")
+
+
+@pytest.mark.slow
+def test_remote_fleet_zombie_replica_fenced_and_bit_identical(tmp_path):
+    fleet = ServiceFleet(
+        n_replicas=3, remote=True, store_root=str(tmp_path),
+        max_resident=1,
+        service_kwargs=dict(batch_size=128, table_log2=14),
+        router_kwargs=dict(
+            probe_timeout_s=0.5, unhealthy_after=2, steal=False,
+        ),
+    )
+    reg = ModelRegistry()
+    try:
+        # One route key -> one owner; steal off + max_resident=1 pins a
+        # backlog on the victim so the zombie still holds work.
+        handles = [
+            fleet.submit(reg.get(*REF), model_ref=REF) for _ in range(5)
+        ]
+        victim = fleet.replicas[handles[0]._job.replica]
+        _wait_steps(victim)
+        os.kill(victim.proc.pid, signal.SIGSTOP)
+        deadline = time.monotonic() + 90
+        while fleet.stats()["replica_crashes"] < 1:
+            assert time.monotonic() < deadline, fleet.stats()
+            time.sleep(0.05)
+        os.kill(victim.proc.pid, signal.SIGCONT)  # the zombie rises
+        fleet.drain(timeout=300)
+        # Zero lost jobs, counts/discoveries bit-identical to the
+        # single-replica goldens (test_service.py pins the same numbers).
+        results = [h.result() for h in handles]
+        for r in results:
+            assert (r.state_count, r.unique_state_count) == GOLD_2PC3
+            assert r.complete
+        for r in results[1:]:
+            assert r.discoveries == results[0].discoveries
+            assert r.max_depth == results[0].max_depth
+        s = fleet.stats()
+        assert s["replica_crashes"] == 1
+        assert s["lease_revokes"] == 1
+        assert s["requeued_jobs"] >= 1
+        # The zombie's post-revocation writes were refused/rejected and
+        # counted — its own HTTP plane still reports them (that a fenced
+        # process stays harmlessly alive is the point).
+        rejected = 0
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and rejected == 0:
+            try:
+                st = json.loads(urllib.request.urlopen(
+                    victim.base_url + "/.status", timeout=2).read())
+                rejected = st.get("lease", {}).get("rejected_total", 0)
+            except Exception:
+                pass
+            time.sleep(0.1)
+        assert rejected > 0, "zombie wrote nothing / was not fenced"
+    finally:
+        fleet.close()
+    # Forensic pass: merged journals (router + 3 replica processes)
+    # reconstruct every lifecycle with zero anomalies, through the CLI as
+    # a real subprocess.
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "stateright_tpu.obs.timeline",
+            str(tmp_path / "journal"), "--json",
+        ],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, (proc.returncode, proc.stderr[-800:])
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["anomalies"] == []
+    assert len(report["traces"]) == 5
+
+
+@pytest.mark.slow
+def test_fleet_procs_smoke_full_matrix():
+    """The whole acceptance matrix — kill -9, zombie, partition — as the
+    smoke script runs it (real subprocesses, shared store root, timeline
+    verdicts). Slow-marked: three fleets' worth of subprocess boots."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join("scripts", "fleet_procs_smoke.py")],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, text=True, timeout=900,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert "FLEET PROCS SMOKE PASSED" in proc.stdout
