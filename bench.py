@@ -920,6 +920,92 @@ def device_search_fleet(n_replicas: int = 3):
     return out, err
 
 
+def device_search_semantics(model_name: str = "single_copy", n: int = 6):
+    """BENCH_SEMANTICS=1 row: cold-vs-optimized A/B of the dedup-first
+    verdict plane (semantics/canonical.py + batch.py) on a register-model
+    anchor's PROPERTY-EVALUATION phase. The anchor is the single-copy
+    register with n clients / 2 servers (the not-linearizable config, so
+    most verdicts are the expensive exhaustive refutations), its first 6000
+    DFS states' history testers — the post-dedup batch a checker block
+    hands the plane. Side A evaluates every tester through the pre-PR
+    cache-only path (canonical plane disabled, per-identity lru memo only);
+    side B clears all caches and runs ONE batched plane call (canonical
+    collapse + witness guidance + native-parallel search). Acceptance:
+    >= 2x wall-clock with bit-identical verdict booleans."""
+    _pin_platform()
+    from stateright_tpu.actor import Network
+    from stateright_tpu.examples.single_copy_register import (
+        SingleCopyModelCfg,
+    )
+    from stateright_tpu.semantics import (
+        canonical,
+        clear_serialization_caches,
+    )
+    from stateright_tpu.semantics.batch import evaluate_batch
+
+    model = SingleCopyModelCfg(
+        client_count=n,
+        server_count=2,
+        network=Network.new_unordered_nonduplicating(),
+    ).into_model()
+
+    # The anchor's post-dedup testers, depth-first (shared enumerator —
+    # the smoke script measures the same batch shape).
+    from stateright_tpu.semantics.batch import collect_history_testers
+
+    testers, n_unique = collect_history_testers(model, 6000)
+
+    # Side A: the pre-PR cache-only path (per-identity lru memo, fresh).
+    clear_serialization_caches()
+    prev = canonical.set_enabled(False)
+    t0 = time.monotonic()
+    legacy = [t.serialized_history() is not None for t in testers]
+    sec_legacy = time.monotonic() - t0
+    canonical.set_enabled(prev)
+
+    # Side B: the dedup-first plane, cold (both caches cleared).
+    clear_serialization_caches()
+    counters0 = dict(canonical.CACHE.counters)
+    t0 = time.monotonic()
+    optimized = evaluate_batch(testers)
+    sec = time.monotonic() - t0
+    stats = canonical.CACHE.stats()
+    delta = {
+        k: stats[k] - counters0.get(k, 0)
+        for k in (
+            "canonical_collapsed", "witness_guided_hits", "full_searches",
+            "batch_parallel_evals",
+        )
+    }
+
+    err = None
+    if optimized != legacy:
+        err = "semantics parity failure: plane verdicts != cache-only verdicts"
+    speedup = round(sec_legacy / max(sec, 1e-9), 2)
+    if err is None and speedup < 2.0:
+        # The acceptance bar is part of the row contract, not just prose.
+        err = (
+            f"dedup-first plane only {speedup}x faster than the cache-only "
+            "path (acceptance >= 2x)"
+        )
+
+    out = {
+        "states": len(testers),
+        "unique": n_unique,
+        "sec": round(sec, 4),
+        "states_per_sec": len(testers) / max(sec, 1e-9),
+        "compile_sec": 0.0,  # host-only phase: nothing compiles
+        "sec_legacy": round(sec_legacy, 4),
+        "semantics_speedup": speedup,
+        "verdict_negatives": int(legacy.count(False)),
+        "canonical_collapsed": int(delta["canonical_collapsed"]),
+        "witness_guided_hits": int(delta["witness_guided_hits"]),
+        "full_searches": int(delta["full_searches"]),
+        "batch_parallel_evals": int(delta["batch_parallel_evals"]),
+    }
+    return out, err
+
+
 def device_search_corpus(model_name: str = "2pc", n: int = 4):
     """BENCH_CORPUS=1 row: cold-vs-warm A/B of the cross-job warm-start
     corpus (store/corpus.py, ROADMAP item 4). Two tiered services with a
@@ -1195,6 +1281,14 @@ DEVICE_DETAIL_FIELDS = (
     # 5x), the preloaded-state count, and the corrupted-entry CRC verdict
     # (True = a flipped byte was detected and the run fell back cold).
     "sec_cold", "warm_speedup", "corpus_preloaded", "corrupt_detected",
+    # Dedup-first semantics (BENCH_SEMANTICS=1 row): the cache-only wall
+    # time next to the plane's (`sec`), the measured ratio (acceptance >=
+    # 2x with bit-identical verdicts), and the plane's own evidence —
+    # classes collapsed by canonicalization, witness-guided resolutions,
+    # full searches actually run, and native-pool evaluations.
+    "sec_legacy", "semantics_speedup", "verdict_negatives",
+    "canonical_collapsed", "witness_guided_hits", "full_searches",
+    "batch_parallel_evals",
 )
 
 
@@ -1432,6 +1526,15 @@ def main(argv: list | None = None) -> int:
         # CRC verdict).
         if os.environ.get("BENCH_CORPUS") == "1" and not smoke:
             workloads += (("2pc", 4, 2400.0, "--worker-corpus", None),)
+        # BENCH_SEMANTICS=1: add the dedup-first verdict-plane A/B on the
+        # single-copy-register 6c2s anchor (property-evaluation phase only,
+        # host-side; the measured ratio lands in
+        # detail.device["single_copy-6-semantics"].semantics_speedup —
+        # acceptance >= 2x with bit-identical verdicts).
+        if os.environ.get("BENCH_SEMANTICS") == "1" and not smoke:
+            workloads += (
+                ("single_copy", 6, 2400.0, "--worker-semantics", None),
+            )
         for model, n, wl_timeout, mode, env_extra in workloads:
             key = f"{model}-{n}" + (
                 {
@@ -1441,6 +1544,7 @@ def main(argv: list | None = None) -> int:
                     "--worker-faults": "-faults",
                     "--worker-pallas": "-pallas",
                     "--worker-corpus": "-corpus",
+                    "--worker-semantics": "-semantics",
                     "--worker-fleet": "",
                 }.get(mode, "")
             )
@@ -1531,6 +1635,8 @@ def worker_main(model_name: str, n: int, mode: str = "--worker") -> int:
             r, perr = device_search_pallas(model_name, n)
         elif mode == "--worker-corpus":
             r, perr = device_search_corpus(model_name, n)
+        elif mode == "--worker-semantics":
+            r, perr = device_search_semantics(model_name, n)
         else:
             r, perr = device_search(model_name, n)
         print(json.dumps({"result": r, "error": perr}), flush=True)
@@ -1546,7 +1652,7 @@ if __name__ == "__main__":
     if len(sys.argv) == 4 and sys.argv[1] in (
         "--worker", "--worker-sharded", "--worker-service", "--worker-obs",
         "--worker-journal", "--worker-faults", "--worker-pallas",
-        "--worker-fleet", "--worker-corpus",
+        "--worker-fleet", "--worker-corpus", "--worker-semantics",
     ):
         sys.exit(worker_main(sys.argv[2], int(sys.argv[3]), mode=sys.argv[1]))
     if len(sys.argv) == 2 and sys.argv[1] == "--worker-analysis":

@@ -26,7 +26,7 @@ def _unused_local_boundary(i, s):
     return i >= 3 or s.state.ballot[0] <= C
 
 def properties(view):
-    lin = view.history_pred(lambda h: h.serialized_history() is not None)
+    lin = view.history_pred(lambda h: h.is_consistent())
     chosen = view.any_env(
         lambda e: isinstance(e.msg, GetOk) and e.msg.value != NULL_VALUE
     )

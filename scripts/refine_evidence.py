@@ -24,7 +24,7 @@ cfg = PaxosModelCfg(
 )
 
 def properties(view):
-    lin = view.history_pred(lambda h: h.serialized_history() is not None)
+    lin = view.history_pred(lambda h: h.is_consistent())
     chosen = view.any_env(
         lambda e: isinstance(e.msg, GetOk) and e.msg.value != NULL_VALUE
     )

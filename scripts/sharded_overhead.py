@@ -57,7 +57,7 @@ elif wl == "paxos2-lowered":
 
     def properties(view):
         lin = view.history_pred(
-            lambda h: h.serialized_history() is not None
+            lambda h: h.is_consistent()
         )
         chosen = view.any_env(
             lambda e: isinstance(e.msg, GetOk) and e.msg.value != NULL_VALUE

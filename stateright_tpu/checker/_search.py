@@ -12,6 +12,54 @@ from __future__ import annotations
 from ..core.model import Expectation
 
 
+def plane_activity() -> int:
+    """Monotonic count of THIS thread's dedup-first-plane consultations —
+    the feedback signal for the prefetch gate below: if a whole prefetched
+    block's serial property loop moves this by nothing, the properties no
+    longer consult the plane (the consistency property already has a
+    discovery, or never existed) and prefetching would be pure speculative
+    search work the pre-plane checker never did. Thread-local on purpose:
+    sibling worker threads' consultations must not mask this worker's block
+    going unconsumed."""
+    from ..semantics.canonical import local_consultations
+
+    return local_consultations()
+
+
+def state_carries_tester(state) -> bool:
+    """Whether a state's `.history` is a consistency tester — the one-time
+    peek that decides if block prefetching can ever pay off for this model
+    (checked on the next-popped state BEFORE materializing a block copy)."""
+    from ..semantics import ConsistencyTester
+
+    return isinstance(getattr(state, "history", None), ConsistencyTester)
+
+
+def prefetch_block_verdicts(block) -> int:
+    """Dedup-first semantics plane (semantics/batch.py): before a worker
+    walks a block of states one-by-one, gather the block's consistency
+    testers (actor-model states carry one as `.history`) and resolve their
+    verdicts in ONE batched call — canonical-class collapse + witness
+    guidance + (native) parallel search — so the per-state property lambdas
+    hit a warm cache instead of probing (and too often searching) serially
+    mid-loop. Pure optimization: property evaluation still decides on its
+    own; a model without testers costs one getattr on the first state."""
+    if not block:
+        return 0
+    probe = getattr(block[0][0], "history", None)
+    from ..semantics import ConsistencyTester
+
+    if not isinstance(probe, ConsistencyTester):
+        return 0
+    from ..semantics.batch import prefetch_verdicts
+
+    return prefetch_verdicts(
+        h
+        for h in (getattr(item[0], "history", None) for item in block)
+        if isinstance(h, ConsistencyTester)
+    )
+
+
 class WorkerLoopMixin:
     """The per-thread job loop (ref: src/checker/bfs.rs:103-160 and the
     identical src/checker/dfs.rs:106-164).

@@ -20,7 +20,16 @@ from typing import Optional
 from ..core.fingerprint import Fingerprint, fingerprint
 from ..core.model import Expectation
 from ..core.path import Path
-from ._search import WorkerLoopMixin, evaluate_properties, record_terminal_ebits
+from itertools import islice
+
+from ._search import (
+    WorkerLoopMixin,
+    evaluate_properties,
+    plane_activity,
+    prefetch_block_verdicts,
+    state_carries_tester,
+    record_terminal_ebits,
+)
 from .base import Checker
 from .job_market import JobBroker
 
@@ -70,6 +79,25 @@ class BfsChecker(WorkerLoopMixin, Checker):
         depth bookkeeping, visitor, property evaluation, expansion with dedup."""
         model = self._model
         properties = self._properties
+        # Chunk-boundary verdict prefetch (dedup-first semantics): resolve
+        # the block's consistency-tester verdicts in one batched call before
+        # the serial per-state loop below probes them. Feedback-gated: once
+        # a prefetched block's property loop consults the plane zero times
+        # (the consistency property has its discovery, or no property reads
+        # the testers), prefetching stops — speculative searches the
+        # pre-plane checker never ran must not outlive their consumer.
+        probe_mark = None
+        if getattr(self, "_plane_prefetch", True) and pending:
+            if not state_carries_tester(pending[-1][0]):
+                # Tester-less model: prefetching can never pay off — disable
+                # before ever materializing a block copy.
+                self._plane_prefetch = False
+            else:
+                prefetched = prefetch_block_verdicts(
+                    list(islice(reversed(pending), max_count))
+                )
+                if prefetched:
+                    probe_mark = plane_activity()
         while max_count > 0 and pending:
             max_count -= 1
             state, state_fp, ebits, depth = pending.pop()
@@ -116,6 +144,8 @@ class BfsChecker(WorkerLoopMixin, Checker):
                 record_terminal_ebits(
                     properties, ebits, self._discoveries, self._lock, state_fp
                 )
+        if probe_mark is not None and plane_activity() == probe_mark:
+            self._plane_prefetch = False  # block went unconsumed: stop
 
     # -- Checker interface -----------------------------------------------------
 

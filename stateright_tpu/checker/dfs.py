@@ -17,7 +17,16 @@ from collections import deque
 from ..core.fingerprint import Fingerprint, fingerprint
 from ..core.model import Expectation
 from ..core.path import Path
-from ._search import WorkerLoopMixin, evaluate_properties, record_terminal_ebits
+from itertools import islice
+
+from ._search import (
+    WorkerLoopMixin,
+    evaluate_properties,
+    plane_activity,
+    prefetch_block_verdicts,
+    state_carries_tester,
+    record_terminal_ebits,
+)
 from .base import Checker
 from .job_market import JobBroker
 
@@ -70,6 +79,21 @@ class DfsChecker(WorkerLoopMixin, Checker):
         model = self._model
         properties = self._properties
         symmetry = self._symmetry
+        # Chunk-boundary verdict prefetch (dedup-first semantics),
+        # feedback-gated exactly like bfs.py: a block whose property loop
+        # never consults the plane disables further prefetching.
+        probe_mark = None
+        if getattr(self, "_plane_prefetch", True) and pending:
+            if not state_carries_tester(pending[-1][0]):
+                # Tester-less model: prefetching can never pay off — disable
+                # before ever materializing a block copy.
+                self._plane_prefetch = False
+            else:
+                prefetched = prefetch_block_verdicts(
+                    list(islice(reversed(pending), max_count))
+                )
+                if prefetched:
+                    probe_mark = plane_activity()
         while max_count > 0 and pending:
             max_count -= 1
             state, fingerprints, ebits, depth = pending.pop()
@@ -133,6 +157,8 @@ class DfsChecker(WorkerLoopMixin, Checker):
                 record_terminal_ebits(
                     properties, ebits, self._discoveries, self._lock, list(fingerprints)
                 )
+        if probe_mark is not None and plane_activity() == probe_mark:
+            self._plane_prefetch = False  # block went unconsumed: stop
 
     # -- Checker interface -----------------------------------------------------
 

@@ -219,7 +219,10 @@ class AbdModelCfg:
             .property(
                 Expectation.ALWAYS,
                 "linearizable",
-                lambda m, s: s.history.serialized_history() is not None,
+                # is_consistent routes through the dedup-first verdict plane
+                # (canonical fingerprints + witness-guided serialization) —
+                # boolean-identical to `serialized_history() is not None`.
+                lambda m, s: s.history.is_consistent(),
             )
             .property(Expectation.SOMETIMES, "value chosen", value_chosen)
             .record_msg_in(record_returns)

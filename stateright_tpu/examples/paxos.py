@@ -278,7 +278,9 @@ class PaxosModelCfg:
             .property(
                 Expectation.ALWAYS,
                 "linearizable",
-                lambda m, s: s.history.serialized_history() is not None,
+                # Dedup-first verdict plane; boolean-identical to
+                # `serialized_history() is not None`.
+                lambda m, s: s.history.is_consistent(),
             )
             .property(Expectation.SOMETIMES, "value chosen", value_chosen)
             .record_msg_in(record_returns)

@@ -54,6 +54,10 @@ Injection points (the name is the contract; grep for `maybe_fault(`):
                         states=n``), BEFORE the atomic write — a fault
                         leaves no partial entry and the publishing job's
                         own result is unaffected
+- ``corpus.gc``       — corpus eviction sweep entry (store/corpus.py
+                        ``CorpusStore.gc``, ctx ``max_bytes=n``), BEFORE
+                        any file is removed — a fault aborts the sweep
+                        with the directory intact (bigger, never wrong)
 - ``fleet.partition`` — router↔replica connectivity (ctx ``replica=i``):
                         fires in the router's probe path (in-proc
                         Replica.probe) and in EVERY RemoteReplica HTTP

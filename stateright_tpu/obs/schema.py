@@ -50,6 +50,8 @@ DETAIL_KEYS = {
 CORPUS_DETAIL_KEYS = {
     "warm_start": "True when the job preloaded a published visited set",
     "preloaded_states": "states preloaded into the spill tier + summary",
+    "verdict_preloads": "semantics verdict bits the warm preload seeded "
+                        "into the canonical cache (dedup-first semantics)",
     "published": "True when this job published a NEW corpus entry",
     "key": "content-key prefix (model definition + lowering + finish hash)",
 }
@@ -120,8 +122,10 @@ REGISTRY_SOURCES = {
     "supervisor": "self-healing supervisor (faults/supervisor.py)",
     "fleet": "multi-replica fleet router (service/router.py)",
     "corpus": "cross-job warm-start corpus store (store/corpus.py)",
-    "semantics": "consistency-tester verdict caches "
-                 "(semantics/linearizability.py)",
+    "semantics": "consistency-tester verdict planes: the legacy "
+                 "per-identity memos plus the dedup-first canonical cache "
+                 "(semantics/canonical.py — class collapse, witness "
+                 "guidance, batch evals, corpus preloads, trims)",
     "lease": "epoch-fenced checkpoint leases (service/lease.py)",
 }
 

@@ -79,15 +79,49 @@ from .sequential_consistency import SequentialConsistencyTester  # noqa: E402
 def clear_serialization_caches() -> None:
     """Drop the memoized serialization verdicts (they pin tester histories in
     memory for the process lifetime otherwise). Call between unrelated long
-    checker runs if memory matters."""
-    from . import linearizability, sequential_consistency
+    checker runs if memory matters. Clears BOTH planes: the per-identity
+    lru memos and the canonical verdict cache (witnesses included)."""
+    from . import canonical, linearizability, sequential_consistency
 
     linearizability._serialized_cached.cache_clear()
     sequential_consistency._serialized_cached.cache_clear()
+    canonical.CACHE.clear()
+
+
+#: `maintain_caches` trims the canonical plane back under this fraction of
+#: its bound and clears a legacy lru memo that crossed the same bar. The
+#: legacy memos pin FULL histories (tester objects are the keys), so a
+#: long-lived service replica serving thousands of register jobs would
+#: otherwise grow until the lru maxsize (2^15 testers) of RETAINED history
+#: tuples per memo.
+MAINTAIN_MAX_ENTRIES = 1 << 14
+
+
+def maintain_caches(max_entries: int = MAINTAIN_MAX_ENTRIES) -> dict:
+    """Bound the verdict caches for long-lived services: called by the check
+    service at every job finalize (service/scheduler.py). The canonical
+    cache LRU-trims (cheap, keeps the hot classes); an oversized legacy lru
+    memo is cleared outright (functools.lru_cache cannot partially shrink).
+    Returns {trimmed, legacy_cleared} and counts both through the
+    "semantics" REGISTRY source."""
+    from . import canonical, linearizability, sequential_consistency
+
+    trimmed = 0
+    if len(canonical.CACHE) > max_entries:
+        trimmed = canonical.CACHE.trim(max_entries)
+    legacy_cleared = 0
+    for mod in (linearizability, sequential_consistency):
+        if mod._serialized_cached.cache_info().currsize > max_entries:
+            mod._serialized_cached.cache_clear()
+            legacy_cleared += 1
+    if legacy_cleared:
+        canonical.CACHE._count("legacy_clears", legacy_cleared)
+    return {"trimmed": trimmed, "legacy_cleared": legacy_cleared}
 
 
 __all__ = [
     "clear_serialization_caches",
+    "maintain_caches",
     "SequentialSpec",
     "ConsistencyTester",
     "Register",

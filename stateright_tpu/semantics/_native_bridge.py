@@ -109,17 +109,25 @@ def _encode_ret(ret, intern, is_vec: bool):
     return None
 
 
-def native_serialized_history(
+def native_serialize_steps(
     init_ref_obj,
     history_by_thread: dict,
     in_flight_by_thread: dict,
     linearizable: bool,
+    min_ops: int = NATIVE_MIN_OPS,
 ):
-    """A serialized history list, None (not serializable), or NOT_SUPPORTED."""
+    """The raw witness as (thread_id, from_in_flight) steps, None (not
+    serializable), or NOT_SUPPORTED. Thread ids are the caller's own dict
+    keys — the canonical verdict plane (semantics/canonical.py) passes
+    canonically-relabeled dicts and gets canonical steps back, skipping
+    the (op, ret) decode replay entirely. `min_ops` gates the marshalling
+    overhead: the default protects repeated per-call sites, while the
+    canonical plane lowers it (each of its searches runs once per
+    equivalence class, so the ~100us ctypes cost always amortizes)."""
     n_ops = len(in_flight_by_thread) + sum(
         len(h) for h in history_by_thread.values()
     )
-    if n_ops < NATIVE_MIN_OPS:
+    if n_ops < min_ops:
         return NOT_SUPPORTED
     lib = _load()
     if lib is None:
@@ -245,15 +253,32 @@ def native_serialized_history(
         return None
     if rc != 1:
         return NOT_SUPPORTED
+    return [
+        (tids[out_thread[i]], bool(out_ifl[i]))
+        for i in range(out_len.value)
+    ]
+
+
+def native_serialized_history(
+    init_ref_obj,
+    history_by_thread: dict,
+    in_flight_by_thread: dict,
+    linearizable: bool,
+):
+    """A serialized history list, None (not serializable), or NOT_SUPPORTED."""
+    steps = native_serialize_steps(
+        init_ref_obj, history_by_thread, in_flight_by_thread, linearizable
+    )
+    if steps is None or steps is NOT_SUPPORTED:
+        return steps
 
     # Decode: replay the chosen interleaving through the Python spec so the
     # returned (op, ret) pairs are the exact Python objects.
-    pos = {tid: 0 for tid in tids}
+    pos = {tid: 0 for tid in history_by_thread}
     spec = init_ref_obj
     out = []
-    for i in range(out_len.value):
-        tid = tids[out_thread[i]]
-        if out_ifl[i]:
+    for tid, from_ifl in steps:
+        if from_ifl:
             entry = in_flight_by_thread[tid]
             op = entry[1] if linearizable else entry
             ret, spec = spec.invoke(op)

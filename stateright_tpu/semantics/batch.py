@@ -1,0 +1,262 @@
+"""Batched parallel verdict plane (dedup-first semantics, ROADMAP item 5).
+
+At every checker chunk boundary the host engines hand this module the
+post-dedup batch's consistency testers in one call. The plane:
+
+1. canonicalizes each tester and COLLAPSES the batch to unique equivalence
+   classes (`canonical_collapsed` counts the savings),
+2. resolves classes cheaply in deterministic order — cache probe, then
+   witness guidance off parents (shorter histories are evaluated first, so a
+   child's parent is usually already resolved a few iterations earlier),
+3. runs the full canonical search only for the surviving classes —
+   concurrently through a thread pool when the native serializer is
+   available (the ctypes call releases the GIL), serially in the same
+   deterministic order otherwise. Verdicts are order-independent pure
+   functions of the canonical class, so pool scheduling cannot change any
+   result: serial and parallel runs are bit-identical by construction.
+
+The packed (canonical fingerprint, verdict bit) table round-trips through
+the warm-start corpus (store/corpus.py): `export_verdicts` rides in every
+published entry, `preload_verdicts` seeds the cache at admission — verdict
+bits are content-addressed by canonical class, so a table computed by any
+job is valid for every other.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterable, Optional
+
+from . import ConsistencyTester
+from .canonical import (
+    CACHE,
+    enabled,
+    probe_verdict,
+    search_steps,
+    try_canonical_form,
+)
+
+#: Default thread-pool width for the native-backed parallel phase. The pool
+#: only materializes when there are >= 2 unresolved classes and the native
+#: serializer loaded; pure-Python fallbacks run serially (the GIL would
+#: serialize them anyway).
+PARALLEL_WORKERS = 4
+
+#: Below this many unresolved classes the pool's spawn overhead exceeds the
+#: search time saved.
+_PARALLEL_MIN = 2
+
+
+def _native_available() -> bool:
+    from . import _native_bridge
+
+    return _native_bridge._load() is not None
+
+
+def evaluate_batch(
+    testers: Iterable, parallel: Optional[bool] = None
+) -> list:
+    """Verdicts (booleans) for `testers`, positionally. The workhorse of the
+    chunk-boundary prefetch: one call per post-dedup batch instead of one
+    cache probe (and too often one search) per state mid-loop."""
+    testers = list(testers)
+    out = [False] * len(testers)
+    if not testers:
+        return out
+    if not enabled():
+        for i, t in enumerate(testers):
+            out[i] = t.serialized_history() is not None
+        return out
+
+    t0 = time.perf_counter()
+    # 1a. Identity pre-dedup: equal testers recur across many states of a
+    # batch, and tester hash/eq are memoized — collapse those FIRST so
+    # canonicalization runs once per distinct history, not once per state.
+    ident: dict = {}  # distinct tester -> [output indices]
+    for i, t in enumerate(testers):
+        if not isinstance(t, ConsistencyTester):
+            raise TypeError(f"not a ConsistencyTester: {t!r}")
+        if not t.is_valid_history:
+            continue  # verdict False, no class needed
+        ident.setdefault(t, []).append(i)
+
+    # 1b. Canonicalize + collapse identities to equivalence classes
+    # (thread-relabeled histories). Testers whose history cannot
+    # canonicalize (exotic user specs) take the legacy memo path.
+    by_fp: dict = {}
+    slots: dict = {}  # fp -> [output indices]
+    n_canon = 0  # identities that actually canonicalized (collapse basis)
+    for t, idxs in ident.items():
+        form = try_canonical_form(t)
+        if form is None:
+            v = t.serialized_history() is not None
+            for i in idxs:
+                out[i] = v
+            continue
+        n_canon += 1
+        if form.fp not in by_fp:
+            by_fp[form.fp] = t
+        slots.setdefault(form.fp, []).extend(idxs)
+    CACHE._count("canonical_collapsed", n_canon - len(by_fp))
+
+    # 2. Deterministic cheap pass, shallowest recordings first: cache probes
+    # + witness guidance off classes already resolved (possibly by an
+    # earlier batch or a corpus preload). The key is the RECORDING rank, not
+    # op count — an `on_return` child has the same op count as its parent
+    # (in-flight became completed), but rank is strictly +1 per recording,
+    # so a parent class always orders before its children.
+    order = sorted(
+        by_fp, key=lambda fp: (try_canonical_form(by_fp[fp]).rank, fp)
+    )
+    verdicts: dict = {}
+    pending: list = []
+    for fp in order:
+        got = probe_verdict(by_fp[fp])
+        if got is not None:
+            verdicts[fp] = got
+        else:
+            pending.append(fp)
+
+    # 3. Split the survivors: a class whose PARENT class is also unresolved
+    # in this batch chains — its search can be witness-guided once the
+    # parent lands, so those resolve serially parent-first. Everything else
+    # is an independent root: full search now, concurrently through the
+    # native serializer when available (the ctypes call releases the GIL).
+    if pending:
+        pending_set = set(pending)
+
+        def parent_class(t):
+            p = getattr(t, "_parent", None)
+            if p is None or not p.is_valid_history:
+                return None
+            pf = try_canonical_form(p)
+            return None if pf is None else pf.fp
+
+        chained = [
+            fp for fp in pending
+            if parent_class(by_fp[fp]) in pending_set
+        ]
+        chained_set = set(chained)
+        roots = [fp for fp in pending if fp not in chained_set]
+
+        use_pool = (
+            (parallel if parallel is not None else len(roots) >= _PARALLEL_MIN)
+            and len(roots) >= _PARALLEL_MIN
+            and _native_available()
+        )
+
+        def run(fp):
+            steps = search_steps(try_canonical_form(by_fp[fp]))
+            return fp, steps
+
+        if use_pool:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                max_workers=min(PARALLEL_WORKERS, len(roots)),
+                thread_name_prefix="semantics-verdict",
+            ) as pool:
+                results = list(pool.map(run, roots))
+            CACHE._count("batch_parallel_evals", len(roots))
+        else:
+            results = [run(fp) for fp in roots]
+        from .canonical import _seal
+
+        for fp, steps in results:
+            CACHE._count("canonical_misses")
+            CACHE._count("full_searches")
+            CACHE.put(fp, steps is not None, steps)
+            _seal(by_fp[fp])
+            verdicts[fp] = steps is not None
+
+        # Chained classes, parent-first (the sort above put every parent
+        # before its children — one recording adds exactly one rank).
+        for fp in chained:
+            got = probe_verdict(by_fp[fp])
+            if got is None:
+                CACHE._count("canonical_misses")
+                steps = search_steps(try_canonical_form(by_fp[fp]))
+                CACHE._count("full_searches")
+                CACHE.put(fp, steps is not None, steps)
+                _seal(by_fp[fp])
+                got = steps is not None
+            verdicts[fp] = got
+
+    # 4. Scatter back to states.
+    for fp, idxs in slots.items():
+        v = verdicts[fp]
+        for i in idxs:
+            out[i] = v
+    dt_ms = (time.perf_counter() - t0) * 1000.0
+    with CACHE._lock:
+        CACHE.counters["batch_evals"] += 1
+        CACHE.counters["batch_states"] += len(testers)
+        CACHE.counters["batch_eval_ms_total"] += dt_ms
+        CACHE.counters["batch_eval_ms_last"] = dt_ms
+    return out
+
+
+def prefetch_verdicts(testers: Iterable) -> int:
+    """Warm the canonical cache for a batch (checker chunk boundaries,
+    lowering history closures). Returns the number of testers considered.
+    Never raises — the plane is an optimization, property evaluation still
+    decides on its own."""
+    batch = [
+        t for t in testers
+        if isinstance(t, ConsistencyTester) and t.is_valid_history
+    ]
+    if len(batch) < 2 or not enabled():
+        return 0
+    evaluate_batch(batch)
+    return len(batch)
+
+
+def collect_history_testers(model, cap: int):
+    """A register-model anchor's post-dedup batch: unique states' history
+    testers, enumerated depth-first (deep states carry the long, contended
+    histories where backtracking blows up). Returns (testers, unique_count).
+    Shared by bench.py's BENCH_SEMANTICS worker and
+    scripts/semantics_smoke.py so the A/B and the smoke measure the same
+    batch shape."""
+    from ..core.fingerprint import fingerprint
+
+    seen, testers, stack = set(), [], []
+    for s in model.init_states():
+        seen.add(fingerprint(s))
+        stack.append(s)
+        testers.append(s.history)
+    while stack and len(testers) < cap:
+        s = stack.pop()
+        actions: list = []
+        model.actions(s, actions)
+        for a in actions:
+            ns = model.next_state(s, a)
+            if ns is None:
+                continue
+            fp = fingerprint(ns)
+            if fp in seen:
+                continue
+            seen.add(fp)
+            stack.append(ns)
+            testers.append(ns.history)
+    return testers, len(seen)
+
+
+# -- corpus round-trip ---------------------------------------------------------
+
+
+def export_verdicts():
+    """(uint64 fingerprints, uint8 verdict bits) — the packed table the
+    corpus publishes with every entry (store/corpus.py)."""
+    return CACHE.export()
+
+
+def preload_verdicts(fps, verdicts) -> int:
+    """Seed the cache from a corpus table; returns NEW entries inserted."""
+    import numpy as np
+
+    fps = np.asarray(fps, dtype=np.uint64)
+    verdicts = np.asarray(verdicts, dtype=np.uint8)
+    if fps.size == 0 or fps.shape != verdicts.shape:
+        return 0
+    return CACHE.preload(fps, verdicts)

@@ -21,6 +21,7 @@ from functools import lru_cache
 from typing import Optional
 
 from . import ConsistencyTester, SequentialSpec
+from .canonical import enabled as _plane_enabled
 
 
 class LinearizabilityTester(ConsistencyTester):
@@ -31,6 +32,11 @@ class LinearizabilityTester(ConsistencyTester):
         "is_valid_history",
         "_key_cache",  # lazy identity-tuple cache (testers are immutable)
         "_hash",
+        # Dedup-first verdict plane (semantics/canonical.py). None of these
+        # participate in identity/encoding — they are evaluation hints:
+        "_canon",  # lazy canonical form (thread-relabeled fingerprint)
+        "_parent",  # the tester this one was recorded from
+        "_delta",  # ("inv"|"ret", thread_id): the recording that made it
     )
 
     def __init__(
@@ -70,7 +76,17 @@ class LinearizabilityTester(ConsistencyTester):
         in_flight[thread_id] = (last_completed, op)
         history = dict(self.history_by_thread)
         history.setdefault(thread_id, ())
-        return LinearizabilityTester(self.init_ref_obj, history, in_flight, True)
+        child = LinearizabilityTester(self.init_ref_obj, history, in_flight, True)
+        # Witness-guidance hint (semantics/canonical.py): the child extends
+        # this tester by one recording; the verdict plane seeds its search
+        # from this tester's cached witness instead of from scratch. Only
+        # stamped while the plane is live — chains are severed by plane code
+        # (_seal), so a disabled plane (SR_TPU_SEMANTICS=legacy) must not
+        # pin O(depth) ancestry per live tester.
+        if _plane_enabled():
+            child._parent = self
+            child._delta = ("inv", thread_id)
+        return child
 
     def on_return(self, thread_id, ret) -> "LinearizabilityTester":
         if not self.is_valid_history or thread_id not in self.in_flight_by_thread:
@@ -79,7 +95,11 @@ class LinearizabilityTester(ConsistencyTester):
         last_completed, op = in_flight.pop(thread_id)
         history = dict(self.history_by_thread)
         history[thread_id] = history.get(thread_id, ()) + ((last_completed, op, ret),)
-        return LinearizabilityTester(self.init_ref_obj, history, in_flight, True)
+        child = LinearizabilityTester(self.init_ref_obj, history, in_flight, True)
+        if _plane_enabled():
+            child._parent = self
+            child._delta = ("ret", thread_id)
+        return child
 
     def _invalidated(self) -> "LinearizabilityTester":
         return LinearizabilityTester(
@@ -90,14 +110,28 @@ class LinearizabilityTester(ConsistencyTester):
         )
 
     def is_consistent(self) -> bool:
-        return self.serialized_history() is not None
+        """The dedup-first verdict path (semantics/canonical.py): canonical
+        fingerprint cache -> witness-guided incremental serialization ->
+        full search, boolean-identical to `serialized_history() is not
+        None` but ~one search per equivalence class per process instead of
+        one per distinct history. Properties should call THIS."""
+        from .canonical import verdict
+
+        return verdict(self)
 
     # -- serialization search (ref: src/semantics/linearizability.rs:175-280) --
 
     def serialized_history(self) -> Optional[list]:
         """A valid total order of (op, ret) pairs, or None. In-flight ops may
-        appear (they might have taken effect) or not (they might not have)."""
+        appear (they might have taken effect) or not (they might not have).
+        Exact legacy search order — pinned witness lists never change; the
+        canonical plane only short-circuits the verdict-equivalent negative
+        (a cached False IS None)."""
         if not self.is_valid_history:
+            return None
+        from .canonical import probe_cached_negative
+
+        if probe_cached_negative(self):
             return None
         cached = _serialized_cached(self)
         return None if cached is None else list(cached)
@@ -166,21 +200,37 @@ def _serialized_cached(tester: "LinearizabilityTester"):
     component of the state), so the search result is memoized on the immutable
     tester (SURVEY.md §7: "cache verdicts by history-fingerprint")."""
     result = tester._serialized_uncached()
-    return None if result is None else tuple(result)
+    if result is None:
+        # Feed the canonical plane the refutation for free: a negative is a
+        # class-wide fact `serialized_history` can short-circuit on later
+        # (positives are not recorded here — the legacy list is
+        # label-specific and a positive cannot skip the legacy search, so
+        # canonicalizing every positive would be pure overhead).
+        from .canonical import note_verdict
+
+        note_verdict(tester, False)
+        return None
+    return tuple(result)
 
 
 def verdict_cache_stats() -> dict:
-    """The verdict cache's hit/miss counters (ROADMAP item 5 fold-in): the
-    register models evaluate linearizability on every post-dedup state, but
-    distinct states share histories wholesale — every hit here is one
-    exponential backtracking search NOT re-run. Exported through the obs
-    REGISTRY ("semantics" source) and pinned by tests/test_semantics.py."""
+    """The verdict planes' counters (ROADMAP item 5): the legacy
+    per-identity lru memo plus the dedup-first canonical plane
+    (semantics/canonical.py: class collapse, witness guidance, batch
+    evaluation, corpus preloads). Exported through the obs REGISTRY
+    ("semantics" source) and pinned by tests/test_semantics.py."""
+    from . import sequential_consistency as _sc
+    from .canonical import CACHE
+
     info = _serialized_cached.cache_info()
-    return {
-        "verdict_cache_hits": info.hits,
-        "verdict_cache_misses": info.misses,
-        "verdict_cache_entries": info.currsize,
+    sc_info = _sc._serialized_cached.cache_info()
+    out = {
+        "verdict_cache_hits": info.hits + sc_info.hits,
+        "verdict_cache_misses": info.misses + sc_info.misses,
+        "verdict_cache_entries": info.currsize + sc_info.currsize,
     }
+    out.update(CACHE.stats())
+    return out
 
 
 # Module-level registration: the cache is process-global (the lru_cache

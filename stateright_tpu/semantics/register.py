@@ -52,6 +52,11 @@ class Register(SequentialSpec):
 
     value: Any = None
 
+    #: `is_valid_step` below mirrors `invoke` exactly (speed-only override),
+    #: so the canonical plane's zero-search refutation rule applies
+    #: (semantics/canonical.py `_deterministic_invoke`).
+    invoke_deterministic = True
+
     def invoke(self, op) -> Tuple[Any, "Register"]:
         if isinstance(op, Write):
             return WriteOk(), Register(op.value)
@@ -75,6 +80,10 @@ class WORegister(SequentialSpec):
 
     value: Any = None
     written: bool = False
+
+    #: Speed-only `is_valid_step` override mirroring `invoke` exactly — see
+    #: Register.invoke_deterministic.
+    invoke_deterministic = True
 
     def invoke(self, op) -> Tuple[Any, "WORegister"]:
         if isinstance(op, Write):

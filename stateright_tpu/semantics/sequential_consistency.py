@@ -12,6 +12,7 @@ from functools import lru_cache
 from typing import Optional
 
 from . import ConsistencyTester, SequentialSpec
+from .canonical import enabled as _plane_enabled
 
 
 class SequentialConsistencyTester(ConsistencyTester):
@@ -20,6 +21,12 @@ class SequentialConsistencyTester(ConsistencyTester):
         "history_by_thread",
         "in_flight_by_thread",
         "is_valid_history",
+        "_key_cache",  # lazy identity-tuple cache (testers are immutable)
+        "_hash",
+        # Dedup-first verdict plane hints (see LinearizabilityTester):
+        "_canon",
+        "_parent",
+        "_delta",
     )
 
     def __init__(
@@ -48,7 +55,14 @@ class SequentialConsistencyTester(ConsistencyTester):
         in_flight[thread_id] = op
         history = dict(self.history_by_thread)
         history.setdefault(thread_id, ())
-        return SequentialConsistencyTester(self.init_ref_obj, history, in_flight, True)
+        child = SequentialConsistencyTester(
+            self.init_ref_obj, history, in_flight, True
+        )
+        # Plane-gated witness-guidance hints — see LinearizabilityTester.
+        if _plane_enabled():
+            child._parent = self
+            child._delta = ("inv", thread_id)
+        return child
 
     def on_return(self, thread_id, ret) -> "SequentialConsistencyTester":
         if not self.is_valid_history or thread_id not in self.in_flight_by_thread:
@@ -57,7 +71,13 @@ class SequentialConsistencyTester(ConsistencyTester):
         op = in_flight.pop(thread_id)
         history = dict(self.history_by_thread)
         history[thread_id] = history.get(thread_id, ()) + ((op, ret),)
-        return SequentialConsistencyTester(self.init_ref_obj, history, in_flight, True)
+        child = SequentialConsistencyTester(
+            self.init_ref_obj, history, in_flight, True
+        )
+        if _plane_enabled():
+            child._parent = self
+            child._delta = ("ret", thread_id)
+        return child
 
     def _invalidated(self) -> "SequentialConsistencyTester":
         return SequentialConsistencyTester(
@@ -68,12 +88,19 @@ class SequentialConsistencyTester(ConsistencyTester):
         )
 
     def is_consistent(self) -> bool:
-        return self.serialized_history() is not None
+        """Dedup-first verdict path — see LinearizabilityTester.is_consistent."""
+        from .canonical import verdict
+
+        return verdict(self)
 
     # -- serialization search (ref: sequential_consistency.rs:152-238) ---------
 
     def serialized_history(self) -> Optional[list]:
         if not self.is_valid_history:
+            return None
+        from .canonical import probe_cached_negative
+
+        if probe_cached_negative(self):
             return None
         cached = _serialized_cached(self)
         return None if cached is None else list(cached)
@@ -99,12 +126,19 @@ class SequentialConsistencyTester(ConsistencyTester):
     # -- identity --------------------------------------------------------------
 
     def _key(self):
-        return (
-            self.init_ref_obj,
-            frozenset(self.history_by_thread.items()),
-            frozenset(self.in_flight_by_thread.items()),
-            self.is_valid_history,
-        )
+        # Lazy identity-tuple memo, ported from LinearizabilityTester._key
+        # (round-4 exact-closure profile): testers are immutable, so the two
+        # frozensets are built ONCE instead of on every hash/eq — `hid_of`
+        # dict probes during lowering closures dominate otherwise.
+        k = getattr(self, "_key_cache", None)
+        if k is None:
+            k = self._key_cache = (
+                self.init_ref_obj,
+                frozenset(self.history_by_thread.items()),
+                frozenset(self.in_flight_by_thread.items()),
+                self.is_valid_history,
+            )
+        return k
 
     def __stable_encode__(self):
         return (
@@ -119,7 +153,10 @@ class SequentialConsistencyTester(ConsistencyTester):
         return isinstance(other, type(self)) and self._key() == other._key()
 
     def __hash__(self) -> int:
-        return hash(self._key())
+        h = getattr(self, "_hash", None)
+        if h is None:
+            h = self._hash = hash(self._key())
+        return h
 
     def __repr__(self) -> str:
         return (
@@ -133,7 +170,13 @@ def _serialized_cached(tester: "SequentialConsistencyTester"):
     """Memoized search result on the immutable tester (equal histories recur
     across many checker states)."""
     result = tester._serialized_uncached()
-    return None if result is None else tuple(result)
+    if result is None:
+        # Negatives only — see linearizability._serialized_cached.
+        from .canonical import note_verdict
+
+        note_verdict(tester, False)
+        return None
+    return tuple(result)
 
 
 def _serialize(valid_history, ref_obj, remaining, in_flight) -> Optional[list]:

@@ -268,6 +268,10 @@ class CheckService:
                 job.content_key = prefetch.content_key
                 job.warm_entry = prefetch.warm_entry
                 job.warm_checked = prefetch.warm_checked
+                # The off-lock prefetch already seeded the canonical verdict
+                # cache (scheduler.prefetch_warm); carry the count so the
+                # real job's detail["corpus"] reports it.
+                job.verdict_preloads = prefetch.verdict_preloads
             self._next_id += 1
             self._jobs[job.id] = job
             self._adm.push(job)

@@ -195,6 +195,11 @@ class Job:
         self.warm_checked = False
         self.warm_states = 0
         self.published = False
+        # Dedup-first semantics (semantics/canonical.py): verdict bits the
+        # warm preload seeded into the canonical cache, and whether this
+        # job holds a corpus GC pin on its entry (released at retire).
+        self.verdict_preloads = 0
+        self.corpus_pinned = False
 
         self._chunks: deque[_Chunk] = deque()
         self._pending = 0

@@ -371,6 +371,16 @@ class ServiceEngine:
             job.content_key = self._content_key_for(job)
         job.warm_checked = True
         job.warm_entry = self._corpus.lookup(job.content_key)
+        if job.warm_entry is not None:
+            # Dedup-first semantics: seed the canonical verdict cache HERE,
+            # still on the client thread — inserting a 2^16-entry packed
+            # table under the service lock would stall unrelated polls, the
+            # same invariant the publish side honors (publish_payload).
+            # Verdict bits are class-addressed, so preloading before the
+            # job is admitted (or even if it never is) cannot be wrong.
+            job.verdict_preloads = self._corpus.preload_verdicts(
+                job.warm_entry
+            )
 
     def _maybe_warm(self, job: Job) -> None:
         """Corpus preload at admission. On a hit, the published visited
@@ -409,6 +419,18 @@ class ServiceEngine:
         self._corpus.note_preload(n)
         job.warm = entry.meta
         job.warm_states = n
+        # Dedup-first semantics: the verdict table was preloaded OFF-LOCK
+        # by prefetch_warm; only the rare no-prefetch admissions (direct
+        # engine use, crash-resume on a survivor) seed it here — single-job
+        # paths where holding the lock over the insert loop stalls nobody.
+        # Gate on warm_checked, not the preload COUNT: a prefetch that found
+        # every fingerprint already cached legitimately returns 0.
+        if not job.warm_checked:
+            job.verdict_preloads = self._corpus.preload_verdicts(entry)
+        # Pin the entry against corpus GC while this job depends on it
+        # (released at retire).
+        self._corpus.pin(job.content_key)
+        job.corpus_pinned = True
         self._events.emit(
             "job.warm_start", job=job.id, trace=job.trace, states=n,
             key=job.content_key[:16],
@@ -454,9 +476,21 @@ class ServiceEngine:
         """The OFF-LOCK half: Bloom rehash + crash-atomic npz write
         (ROADMAP item 4 leftover — a slow publish must not stall an
         unrelated job's poll against the service lock). The CorpusStore
-        is internally thread-safe; never raises."""
+        is internally thread-safe; never raises. Dedup-first semantics:
+        the packed canonical verdict table rides along, snapshotted HERE
+        (off the service lock — walking a 2^16-entry cache under it would
+        stall unrelated polls); verdict bits are class-addressed, so
+        over-inclusion is harmless and a repeat register-model submission
+        in a fresh process warm-starts its consistency properties, not
+        just its visited set."""
         key, fps, parents, meta = payload
-        return self._corpus.publish(key, fps, parents, meta)
+        from ..semantics.batch import export_verdicts
+
+        sem_fps, sem_verdicts = export_verdicts()
+        return self._corpus.publish(
+            key, fps, parents, meta,
+            sem_fps=sem_fps, sem_verdicts=sem_verdicts,
+        )
 
     def admit(self, job: Job) -> Optional[Job]:
         """Seed a job's init states into the shared table (salted) and hand
@@ -615,8 +649,23 @@ class ServiceEngine:
         if g is not None and job in g.jobs:
             g.jobs.remove(job)
         job.drop_frontier()
+        self._job_semantics_finalize(job)
         # Empty groups are kept: their compiled step is the expensive part,
         # and a later job on the same model instance reuses it.
+
+    def _job_semantics_finalize(self, job: Job) -> None:
+        """Per-job-retire semantics housekeeping: release the job's corpus
+        GC pin and bound the process-global verdict caches (the legacy lru
+        memos pin FULL tester histories; semantics.maintain_caches trims
+        the canonical plane and clears an oversized memo, counted through
+        the "semantics" REGISTRY source) — a fleet replica serving
+        thousands of register jobs stops growing without bound."""
+        if job.corpus_pinned and self._corpus is not None:
+            self._corpus.unpin(job.content_key)
+            job.corpus_pinned = False
+        from ..semantics import maintain_caches
+
+        maintain_caches()
 
     def runnable_groups(self) -> list:
         return [
@@ -984,6 +1033,7 @@ class ServiceEngine:
             detail["corpus"] = {
                 "warm_start": job.warm is not None,
                 "preloaded_states": job.warm_states,
+                "verdict_preloads": job.verdict_preloads,
                 "published": job.published,
                 "key": job.content_key[:16],
             }
@@ -1036,6 +1086,7 @@ class ServiceEngine:
             job.error = msg
             job.metrics.finished_at = time.monotonic()
             job.drop_frontier()
+            self._job_semantics_finalize(job)
             self._events.emit(
                 "job.error", job=job.id, trace=job.trace, error=msg
             )

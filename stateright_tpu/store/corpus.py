@@ -161,10 +161,18 @@ def finish_signature(finish_when, target_state_count, target_max_depth):
     )
 
 
+_EMPTY_U64 = np.zeros(0, dtype=np.uint64)
+_EMPTY_U8 = np.zeros(0, dtype=np.uint8)
+
+
 @dataclass
 class CorpusEntry:
     """One published visited set: packed host-tier arrays + the serialized
-    Bloom summary + the result metadata a warm run replays."""
+    Bloom summary + the result metadata a warm run replays + the semantics
+    plane's packed (canonical history fingerprint -> verdict bit) table
+    (dedup-first semantics, ROADMAP item 5: verdicts are content-addressed
+    by canonical equivalence class, so any job's table warm-starts every
+    other's consistency-property evaluation)."""
 
     key: str
     fps: np.ndarray  # uint64[n] packed unsalted fingerprints
@@ -173,10 +181,22 @@ class CorpusEntry:
     summary_log2: int
     summary_hashes: int
     meta: dict  # state_count / unique_count / max_depth / discoveries
+    sem_fps: np.ndarray = None  # uint64[m] canonical history fingerprints
+    sem_verdicts: np.ndarray = None  # uint8[m] serialization verdict bits
+
+    def __post_init__(self):
+        if self.sem_fps is None:
+            self.sem_fps = _EMPTY_U64
+        if self.sem_verdicts is None:
+            self.sem_verdicts = _EMPTY_U8
 
     @property
     def states(self) -> int:
         return int(self.fps.size)
+
+    @property
+    def verdicts(self) -> int:
+        return int(self.sem_fps.size)
 
 
 class CorpusStore:
@@ -204,6 +224,10 @@ class CorpusStore:
         # it writes, and rejects stale-stamped entries at lookup — the
         # "zombie double-publish" hazard closed at both ends.
         self._lease = None
+        # Entries a live job preloaded: `gc` refuses to evict them.
+        # {content key: pin count} managed by the service scheduler
+        # (pin at warm admission, unpin at job finalize).
+        self._pinned: dict = {}
         self.counters = {
             "hits": 0,
             "misses": 0,
@@ -214,6 +238,13 @@ class CorpusStore:
             "corrupt_entries": 0,
             "lease_rejected": 0,
             "preload_states": 0,
+            "verdict_preloads": 0,
+            "verdicts_published": 0,
+            "gc_sweeps": 0,
+            "gc_evicted": 0,
+            "gc_bytes_freed": 0,
+            "gc_pinned_skips": 0,
+            "gc_faults": 0,
         }
         self._metrics_name = REGISTRY.register("corpus", self.metrics)
 
@@ -299,6 +330,11 @@ class CorpusStore:
                 str(n): int(f)
                 for n, f in zip(data["d_names"], data["d_fps"])
             }
+            # Semantics verdict table: optional (entries published before
+            # the dedup-first plane, or by verdict-less jobs, simply lack
+            # the keys — warm-start degrades to visited-set-only).
+            names = getattr(data, "files", data)
+            has_sem = "sem_fps" in names and "sem_verdicts" in names
             return CorpusEntry(
                 key=key,
                 fps=np.asarray(data["fps"], dtype=np.uint64),
@@ -312,6 +348,14 @@ class CorpusStore:
                     "max_depth": int(counts[2]),
                     "discoveries": discoveries,
                 },
+                sem_fps=(
+                    np.asarray(data["sem_fps"], dtype=np.uint64)
+                    if has_sem else None
+                ),
+                sem_verdicts=(
+                    np.asarray(data["sem_verdicts"], dtype=np.uint8)
+                    if has_sem else None
+                ),
             )
         except (KeyError, ValueError, IndexError):
             return None
@@ -319,6 +363,103 @@ class CorpusStore:
     def note_preload(self, n: int) -> None:
         """Account states actually preloaded into a tiered store."""
         self._count("preload_states", n)
+
+    def preload_verdicts(self, entry: CorpusEntry) -> int:
+        """Seed the semantics plane's canonical verdict cache from the
+        entry's packed table (semantics/batch.py). Returns NEW verdicts
+        inserted; counted as `verdict_preloads`. Verdict bits are
+        content-addressed by canonical history class, so a preload can
+        never be wrong for any job — only unused."""
+        if entry.sem_fps.size == 0:
+            return 0
+        from ..semantics.batch import preload_verdicts
+
+        n = preload_verdicts(entry.sem_fps, entry.sem_verdicts)
+        if n:
+            self._count("verdict_preloads", n)
+        return n
+
+    # -- GC pinning (the service pins what live jobs preloaded) ----------------
+
+    def pin(self, key: str) -> None:
+        """Protect `key` from `gc` eviction while a live job depends on it."""
+        with self._lock:
+            self._pinned[key] = self._pinned.get(key, 0) + 1
+
+    def unpin(self, key: str) -> None:
+        with self._lock:
+            n = self._pinned.get(key, 0) - 1
+            if n <= 0:
+                self._pinned.pop(key, None)
+            else:
+                self._pinned[key] = n
+
+    def gc(self, max_bytes: int) -> dict:
+        """mtime-LRU sweep over entry generations (ROADMAP item 4 residue):
+        evict least-recently-written entries (newest generation's mtime)
+        until the directory fits `max_bytes`, REFUSING to evict any entry a
+        live job preloaded (`pin`). Chaos-pointed (``corpus.gc`` fires
+        before any file is removed — a fault leaves the directory intact)
+        and never raises: a GC failure means a bigger directory, not a
+        wrong result. Returns {evicted, bytes_freed, pinned_skips,
+        bytes_total}."""
+        import glob as _glob
+
+        out = {"evicted": 0, "bytes_freed": 0, "pinned_skips": 0,
+               "bytes_total": 0}
+        try:
+            maybe_fault("corpus.gc", max_bytes=int(max_bytes))
+        except FaultError:
+            self._count("gc_faults")
+            return out
+        self._count("gc_sweeps")
+        # Group generations (entry + .prev) by content key. ONLY the two
+        # committed generation names — a `corpus-*.npz*` wildcard would also
+        # match another process's in-flight `.npz.tmp.<pid>` staging file
+        # (fleet replicas share the directory), and unlinking that makes the
+        # concurrent publish's atomic rename fail.
+        entries: dict = {}
+        paths = _glob.glob(os.path.join(self.root, "corpus-*.npz"))
+        paths += _glob.glob(os.path.join(self.root, "corpus-*.npz.prev"))
+        for path in paths:
+            base = os.path.basename(path)
+            key = base[len("corpus-"):].split(".npz")[0]
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            ent = entries.setdefault(key, {"paths": [], "bytes": 0, "mtime": 0.0})
+            ent["paths"].append(path)
+            ent["bytes"] += st.st_size
+            ent["mtime"] = max(ent["mtime"], st.st_mtime)
+        total = sum(e["bytes"] for e in entries.values())
+        out["bytes_total"] = total
+        if total <= max_bytes:
+            return out
+        with self._lock:
+            pinned = set(self._pinned)
+        for key, ent in sorted(entries.items(), key=lambda kv: kv[1]["mtime"]):
+            if total <= max_bytes:
+                break
+            if key in pinned:
+                out["pinned_skips"] += 1
+                self._count("gc_pinned_skips")
+                continue
+            freed = 0
+            for path in ent["paths"]:
+                try:
+                    sz = os.path.getsize(path)
+                    os.unlink(path)
+                    freed += sz
+                except OSError:
+                    pass  # raced with a concurrent publish/reader: skip
+            total -= freed
+            out["bytes_freed"] += freed
+            out["evicted"] += 1
+            self._count("gc_evicted")
+            self._count("gc_bytes_freed", freed)
+        out["bytes_total"] = total
+        return out
 
     # -- write side ------------------------------------------------------------
 
@@ -328,6 +469,8 @@ class CorpusStore:
         fps: np.ndarray,
         parents: np.ndarray,
         meta: dict,
+        sem_fps: Optional[np.ndarray] = None,
+        sem_verdicts: Optional[np.ndarray] = None,
     ) -> bool:
         """Publish one completed visited set under `key`. Idempotent by
         content address: when an intact generation already exists the
@@ -365,6 +508,17 @@ class CorpusStore:
                 self.summary_hashes,
             )
             names = sorted(meta.get("discoveries", {}))
+            payload_extra = {}
+            if sem_fps is not None and len(sem_fps):
+                # The semantics plane's packed verdict table (dedup-first
+                # semantics): canonical fingerprints are class-addressed,
+                # so the table is valid for ANY consumer of the directory.
+                payload_extra["sem_fps"] = np.asarray(
+                    sem_fps, dtype=np.uint64
+                )
+                payload_extra["sem_verdicts"] = np.asarray(
+                    sem_verdicts, dtype=np.uint8
+                )
             fenced_savez(
                 path,
                 {
@@ -373,6 +527,7 @@ class CorpusStore:
                     "fps": fps,
                     "parents": parents,
                     "summary": summary,
+                    **payload_extra,
                     "cfg": np.asarray(
                         [self.summary_log2, self.summary_hashes],
                         dtype=np.int64,
@@ -403,6 +558,8 @@ class CorpusStore:
             self._count("publish_faults")
             return False
         self._count("publishes")
+        if "sem_fps" in payload_extra:
+            self._count("verdicts_published", int(len(payload_extra["sem_fps"])))
         return True
 
     # -- reporting -------------------------------------------------------------

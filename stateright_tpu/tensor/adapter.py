@@ -41,6 +41,16 @@ class TensorModelAdapter:
                 return repr(tm.decode(np.asarray(row, dtype=np.uint32)))
 
         self._row = Row
+        # Per-row expansion memo: the host checker protocol calls
+        # actions(s) and then next_state(s, a) for EACH action — without
+        # the memo that is (1 + n_actions) eager single-row device expands
+        # per state, and the eager jax dispatch overhead dominates host
+        # cross-validation runs (~8x on the 2pc-3 adapter BFS). Bounded for
+        # long Explorer sessions; cleared wholesale when full (re-expanding
+        # is always correct).
+        self._expand_memo: dict = {}
+
+    _EXPAND_MEMO_MAX = 1 << 16
 
     # -- expansion -------------------------------------------------------------
 
@@ -53,6 +63,16 @@ class TensorModelAdapter:
             in_bounds
         )
 
+    def _expand_state(self, state):
+        key = tuple(state)
+        got = self._expand_memo.get(key)
+        if got is None:
+            if len(self._expand_memo) >= self._EXPAND_MEMO_MAX:
+                self._expand_memo.clear()
+            got = self._expand_row(np.asarray(state, dtype=np.uint32))
+            self._expand_memo[key] = got
+        return got
+
     def init_states(self) -> list:
         rows = np.asarray(self.tensor_model.init_states(), dtype=np.uint32)
         return [self._row(int(x) for x in r) for r in rows]
@@ -60,7 +80,7 @@ class TensorModelAdapter:
     def actions(self, state, actions: list) -> None:
         tm = self.tensor_model
         row = np.asarray(state, dtype=np.uint32)
-        _succs, valid = self._expand_row(row)
+        _succs, valid = self._expand_state(state)
         for a in range(tm.max_actions):
             if valid[a]:
                 actions.append(tm.action_label(row, a))
@@ -68,7 +88,7 @@ class TensorModelAdapter:
     def next_state(self, state, action):
         tm = self.tensor_model
         row = np.asarray(state, dtype=np.uint32)
-        succs, valid = self._expand_row(row)
+        succs, valid = self._expand_state(state)
         for a in range(tm.max_actions):
             if valid[a] and tm.action_label(row, a) == action:
                 return self._row(int(x) for x in succs[a])
@@ -76,10 +96,11 @@ class TensorModelAdapter:
 
     def next_steps(self, state) -> list:
         """One device expand per state (the Model-protocol default would do
-        one per action)."""
+        one per action; the memo reduces the checker's actions+next_state
+        protocol to one as well)."""
         tm = self.tensor_model
         row = np.asarray(state, dtype=np.uint32)
-        succs, valid = self._expand_row(row)
+        succs, valid = self._expand_state(state)
         return [
             (tm.action_label(row, a), self._row(int(x) for x in succs[a]))
             for a in range(tm.max_actions)

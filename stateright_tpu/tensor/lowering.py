@@ -2273,9 +2273,25 @@ class LoweredView:
         m = self.m
         if not m.track_history:
             raise LoweringError("model has no history")
+        # Dedup-first semantics (semantics/batch.py): the closure's history
+        # vocabulary IS a post-dedup batch — resolve consistency-tester
+        # verdicts in one batched call (canonical-class collapse + witness
+        # guidance + parallel search) so predicates like `h.is_consistent()`
+        # hit a warm cache. Feedback-gated: the batch fires only after the
+        # first fn() that actually consults the plane — a structural
+        # predicate that never reads verdicts costs zero speculative
+        # searches (and non-tester histories skip at type-check cost).
+        from ..semantics.batch import prefetch_verdicts
+        from ..semantics.canonical import local_consultations
+
         tab = np.zeros(m._hd.shape[0], bool)  # padded to the hid capacity
+        prefetched = False
+        mark = local_consultations()
         for hid, h in enumerate(m.histories):
             tab[hid] = bool(fn(h))
+            if not prefetched and local_consultations() != mark:
+                prefetched = True
+                prefetch_verdicts(m.histories[hid + 1:])
         name = m._reg(f"view{m._view_ct}", tab)
         m._view_ct += 1
 

@@ -397,3 +397,55 @@ def test_device_detail_pins_service_row_keys():
     assert row["vs_serial"] == 1.74
     assert row["jobs_per_sec"] == 0.63
     assert row["service_steps"] == 54
+
+
+def test_device_detail_pins_semantics_row_keys():
+    # The BENCH_SEMANTICS=1 dedup-first verdict-plane A/B row (ISSUE 13):
+    # the cache-only wall time, the measured ratio (acceptance >= 2x with
+    # bit-identical verdicts), and the plane's evidence counters must all
+    # survive into detail.device so the speedup claim is auditable in
+    # every BENCH_r*.json.
+    for key in (
+        "sec_legacy", "semantics_speedup", "verdict_negatives",
+        "canonical_collapsed", "witness_guided_hits", "full_searches",
+        "batch_parallel_evals",
+    ):
+        assert key in bench.DEVICE_DETAIL_FIELDS
+    row = bench.device_detail(
+        {
+            "states_per_sec": 14000.0,
+            "sec": 0.43,
+            "sec_legacy": 2.4,
+            "semantics_speedup": 5.58,
+            "verdict_negatives": 5977,
+            "canonical_collapsed": 0,
+            "witness_guided_hits": 1501,
+            "full_searches": 332,
+            "batch_parallel_evals": 331,
+        }
+    )
+    assert row["semantics_speedup"] == 5.58
+    assert row["sec_legacy"] == 2.4
+    assert row["witness_guided_hits"] == 1501
+    assert row["full_searches"] == 332
+
+
+def test_semantics_counters_exported_through_registry_schema():
+    # The plane's counters flow through the obs REGISTRY "semantics"
+    # source (pinned in obs/schema.py REGISTRY_SOURCES) and the corpus
+    # detail schema names the verdict-preload key.
+    from stateright_tpu.obs.schema import (
+        CORPUS_DETAIL_KEYS,
+        REGISTRY_SOURCES,
+    )
+    from stateright_tpu.semantics.linearizability import verdict_cache_stats
+
+    assert "semantics" in REGISTRY_SOURCES
+    assert "verdict_preloads" in CORPUS_DETAIL_KEYS
+    stats = verdict_cache_stats()
+    for key in (
+        "canonical_hits", "canonical_collapsed", "witness_guided_hits",
+        "batch_evals", "batch_eval_ms_total", "preloaded_verdicts",
+        "trims", "canonical_entries",
+    ):
+        assert key in stats

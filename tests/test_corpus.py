@@ -532,3 +532,155 @@ def test_corpus_schema_registered():
     assert validate_detail(detail) == []
     detail["corpus"]["renamed"] = 1
     assert validate_detail(detail) == ["corpus.renamed"]
+
+
+# -- dedup-first semantics: GC + verdict warm-start ----------------------------
+
+
+def test_corpus_gc_mtime_lru_respects_pins(tmp_path):
+    """ROADMAP item 4 residue, minimal version: `CorpusStore.gc(max_bytes=)`
+    evicts least-recently-written entries first, refuses to evict a pinned
+    (live-job-preloaded) entry, is chaos-pointed, and never breaks a
+    surviving entry."""
+    store = CorpusStore(str(tmp_path / "c"), summary_log2=16)
+    n = 64
+    metas = {"state_count": 1, "unique_count": 1, "max_depth": 1,
+             "discoveries": {}}
+    keys = []
+    for i in range(3):
+        key = f"{i:032x}"
+        fps = np.arange(1, n + 1, dtype=np.uint64) + i
+        assert store.publish(key, fps, np.zeros(n, np.uint64), metas)
+        # Strictly increasing mtimes (filesystem clocks can be coarse).
+        path = store.path_for(key)
+        os.utime(path, (1_000_000 + i * 100, 1_000_000 + i * 100))
+        keys.append(key)
+
+    # Injected corpus.gc fault: sweep aborts, directory intact, counted.
+    plan = FaultPlan().rule("corpus.gc", "io", times=1)
+    with active(plan):
+        out = store.gc(max_bytes=0)
+    assert plan.injected_total() == 1
+    assert out["evicted"] == 0
+    assert store.metrics()["gc_faults"] == 1
+    assert len(glob.glob(os.path.join(store.root, "corpus-*.npz"))) == 3
+
+    # Pin the OLDEST entry (a live job preloaded it): GC must skip it and
+    # evict the next-oldest instead.
+    store.pin(keys[0])
+    total = sum(
+        os.path.getsize(p)
+        for p in glob.glob(os.path.join(store.root, "corpus-*.npz*"))
+    )
+    out = store.gc(max_bytes=total - 1)  # must free >= 1 byte
+    assert out["pinned_skips"] >= 1
+    assert out["evicted"] == 1
+    assert os.path.exists(store.path_for(keys[0]))  # pinned survivor
+    assert not os.path.exists(store.path_for(keys[1]))  # mtime-LRU victim
+    assert store.lookup(keys[0]) is not None  # survivor still serves
+    m = store.metrics()
+    assert m["gc_evicted"] == 1 and m["gc_pinned_skips"] >= 1
+    assert m["gc_bytes_freed"] > 0
+
+    # Unpinned, a tighter budget takes the rest oldest-first.
+    store.unpin(keys[0])
+    out = store.gc(max_bytes=0)
+    assert out["evicted"] == 2
+    assert glob.glob(os.path.join(store.root, "corpus-*.npz")) == []
+
+
+def _lowered_register_model():
+    """A fresh lowering of the single-copy register (2 clients / 1 server,
+    93 states) — the register-model service anchor. Each call re-runs the
+    closure with FRESH tester objects, exactly like a new process would."""
+    from stateright_tpu.actor.register import GetOk
+    from stateright_tpu.examples.single_copy_register import (
+        NULL_VALUE,
+        SingleCopyModelCfg,
+    )
+    from stateright_tpu.tensor.lowering import lower_actor_model
+    from stateright_tpu.tensor.model import TensorProperty
+
+    cfg = SingleCopyModelCfg(client_count=2, server_count=1)
+
+    def properties(view):
+        lin = view.history_pred(lambda h: h.is_consistent())
+        chosen = view.any_env(
+            lambda env: isinstance(env.msg, GetOk)
+            and env.msg.value != NULL_VALUE
+        )
+        return [
+            TensorProperty.always("linearizable", lambda m, s: lin(s)),
+            TensorProperty.sometimes("value chosen", lambda m, s: chosen(s)),
+        ]
+
+    return lower_actor_model(cfg.into_model(), properties=properties)
+
+
+def test_service_verdict_warm_start_register_model(tmp_path):
+    """THE acceptance criterion: a repeat register-model submission with
+    `corpus_dir=` set reports witness_guided_hits + corpus verdict
+    preloads > 0 and replays the cold run's result bit-identically —
+    warm-start extended from visited sets to the semantics plane."""
+    from stateright_tpu.semantics import clear_serialization_caches
+    from stateright_tpu.semantics.canonical import CACHE
+    from stateright_tpu.semantics.linearizability import verdict_cache_stats
+
+    corpus_dir = str(tmp_path / "corpus")
+    clear_serialization_caches()
+    svc = CheckService(corpus_dir=corpus_dir, **SVC_KW)
+    try:
+        model1 = _lowered_register_model()
+        cold = _run(svc, model1).result()
+        assert cold.detail["corpus"]["published"] is True
+        assert cold.unique_state_count == 93
+
+        # The published entry carries the packed verdict table the lowering
+        # populated (canonical fingerprints -> verdict bits).
+        import numpy as _np
+
+        paths = glob.glob(os.path.join(corpus_dir, "corpus-*.npz"))
+        assert len(paths) == 1
+        with _np.load(paths[0]) as data:
+            assert "sem_fps" in data.files and len(data["sem_fps"]) > 0
+            assert len(data["sem_fps"]) == len(data["sem_verdicts"])
+
+        # "Fresh process": drop every in-memory verdict, then re-lower the
+        # model from scratch — the re-lowering's history closure resolves
+        # through witness guidance (every history extends its parent).
+        clear_serialization_caches()
+        guided0 = CACHE.counters["witness_guided_hits"]
+        _lowered_register_model()
+        guided_lowering = CACHE.counters["witness_guided_hits"] - guided0
+
+        # Drop the verdicts the re-lowering just computed so the admission
+        # preload demonstrably seeds the cache from the CORPUS entry (in a
+        # real fresh process the cache starts empty anyway; in-process the
+        # preload would be shadowed by the lowering's own inserts).
+        # The repeat submission reuses model1's compiled group — the
+        # compile budget stays flat and the corpus path is identical (the
+        # content key depends on the model DEFINITION, not the instance).
+        clear_serialization_caches()
+        warm = _run(svc, model1).result()
+        corpus_detail = warm.detail["corpus"]
+        assert corpus_detail["warm_start"] is True
+        # The acceptance sum: witness-guided resolutions + corpus verdict
+        # preloads must both be live on the repeat submission.
+        assert guided_lowering > 0
+        assert corpus_detail["verdict_preloads"] > 0
+        assert (
+            guided_lowering + corpus_detail["verdict_preloads"] > 0
+        )
+        stats = verdict_cache_stats()
+        assert stats["witness_guided_hits"] >= guided_lowering
+        assert svc.stats()["corpus"]["verdict_preloads"] > 0
+
+        # ...and the warm result replays the cold run bit-identically.
+        assert (
+            warm.state_count, warm.unique_state_count, warm.max_depth,
+        ) == (cold.state_count, cold.unique_state_count, cold.max_depth)
+        assert sorted(warm.discoveries.items()) == sorted(
+            cold.discoveries.items()
+        )
+    finally:
+        svc.close()

@@ -149,7 +149,7 @@ def test_single_copy_register_with_linearizability_history():
     host = _host(cfg.into_model())
 
     def properties(view):
-        lin = view.history_pred(lambda h: h.serialized_history() is not None)
+        lin = view.history_pred(lambda h: h.is_consistent())
         chosen = view.any_env(
             lambda env: isinstance(env.msg, GetOk)
             and env.msg.value != NULL_VALUE
@@ -184,7 +184,7 @@ def test_paxos_lowers_generically():
         return i >= 3 or s.state.ballot[0] <= 1
 
     def properties(view):
-        lin = view.history_pred(lambda h: h.serialized_history() is not None)
+        lin = view.history_pred(lambda h: h.is_consistent())
         chosen = view.any_env(
             lambda e: isinstance(e.msg, GetOk) and e.msg.value != NULL_VALUE
         )
@@ -278,8 +278,12 @@ def test_timer_lowering_parity():
 
 def test_lowering_rejects_unsupported_features():
     cfg2 = PingPongCfg(max_nat=1).into_model().with_max_crashes(1)
+    # The unbounded message space trips the envelope-vocabulary cap; a small
+    # cap hits the identical rejection path without enumerating 4096
+    # envelopes first (this was the suite's slowest test at ~40 s of pure
+    # closure growth before the raise — /tmp/_t1.log --durations table).
     with pytest.raises(LoweringError):
-        lower_actor_model(cfg2)
+        lower_actor_model(cfg2, max_envelopes=256)
 
 
 def test_ping_pong_ordered_network_golden():
@@ -327,7 +331,7 @@ def test_single_copy_register_ordered_with_history():
     host = _host(cfg.into_model())
 
     def properties(view):
-        lin = view.history_pred(lambda h: h.serialized_history() is not None)
+        lin = view.history_pred(lambda h: h.is_consistent())
         chosen = view.any_env(
             lambda env: isinstance(env.msg, GetOk)
             and env.msg.value != NULL_VALUE
@@ -634,7 +638,7 @@ def test_paxos2_exact_closure_golden():
     )
 
     def properties(view):
-        lin = view.history_pred(lambda h: h.serialized_history() is not None)
+        lin = view.history_pred(lambda h: h.is_consistent())
         chosen = view.any_env(
             lambda e: isinstance(e.msg, GetOk) and e.msg.value != NULL_VALUE
         )
@@ -740,7 +744,7 @@ def test_refine_check_paxos1_golden():
     from stateright_tpu.tensor.lowering import refine_check
 
     def props(view):
-        lin = view.history_pred(lambda h: h.serialized_history() is not None)
+        lin = view.history_pred(lambda h: h.is_consistent())
         chosen = view.any_env(
             lambda e: isinstance(e.msg, GetOk) and e.msg.value != NULL_VALUE
         )

@@ -1,0 +1,532 @@
+"""Dedup-first semantics plane (stateright_tpu/semantics/{canonical,batch}.py).
+
+The contract under test is ONE SEARCH PER EQUIVALENCE CLASS, NEVER A WRONG
+VERDICT: thread-relabeled histories share one canonical fingerprint and one
+cached verdict; witness-guided incremental serialization agrees with the
+uncached search on randomized histories (verdict AND a validated witness);
+the batched parallel plane is bit-identical to the serial one on the
+abd/paxos register models; and the caches stay bounded at service-job
+granularity."""
+
+import random
+
+import pytest
+
+from stateright_tpu.semantics import (
+    LinearizabilityTester,
+    Len,
+    LenOk,
+    Pop,
+    PopOk,
+    Push,
+    PushOk,
+    Read,
+    ReadOk,
+    Register,
+    SequentialConsistencyTester,
+    VecSpec,
+    WORegister,
+    Write,
+    WriteFail,
+    WriteOk,
+    clear_serialization_caches,
+    maintain_caches,
+)
+from stateright_tpu.semantics import canonical
+from stateright_tpu.semantics.batch import (
+    evaluate_batch,
+    export_verdicts,
+    preload_verdicts,
+)
+from stateright_tpu.semantics.canonical import (
+    CACHE,
+    cached_steps,
+    canonical_form,
+    serialized_from_steps,
+    validate_steps,
+    verdict,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_caches():
+    clear_serialization_caches()
+    yield
+    clear_serialization_caches()
+
+
+# -- equivalence-class pins ----------------------------------------------------
+
+
+def test_thread_relabeled_histories_share_one_canonical_class():
+    """The tentpole's first claim: linearizability verdicts are invariant
+    under thread relabeling, so relabeled testers collapse to ONE cache
+    entry (the per-identity lru memo would search each separately)."""
+    def build(t0, t1):
+        return (
+            LinearizabilityTester(Register("\x00"))
+            .on_invret(t0, Write("B"), WriteOk())
+            .on_invoke(t1, Read())
+            .on_return(t1, ReadOk("B"))
+        )
+
+    a, b, c = build(0, 1), build(7, 3), build("x", "y")
+    fa, fb, fc = (canonical_form(t).fp for t in (a, b, c))
+    assert fa == fb == fc
+    assert a != b  # distinct identities — the lru memo would miss
+    searches0 = CACHE.counters["full_searches"]
+    hits0 = CACHE.counters["canonical_hits"]
+    assert verdict(a) is True
+    assert verdict(b) is True
+    assert verdict(c) is True
+    # One evaluation (search or guided) served the whole class.
+    assert CACHE.counters["canonical_hits"] >= hits0 + 2
+    assert CACHE.counters["full_searches"] <= searches0 + 1
+
+
+def test_relabeling_preserves_real_time_prerequisites():
+    # The non-linearizable stale-read history stays non-linearizable under
+    # relabeling (prerequisite references remap with the threads).
+    def build(t0, t1):
+        return (
+            LinearizabilityTester(Register("A"))
+            .on_invret(t0, Read(), ReadOk("B"))
+            .on_invoke(t1, Write("B"))
+        )
+
+    a, b = build(0, 1), build(5, 2)
+    assert canonical_form(a).fp == canonical_form(b).fp
+    assert verdict(a) is False
+    assert verdict(b) is False
+    assert b.serialized_history() is None
+
+
+def test_batch_collapses_relabeled_classes_and_counts():
+    def build(cls, t0, t1):
+        return (
+            cls(Register("\x00"))
+            .on_invret(t0, Write("Q"), WriteOk())
+            .on_invret(t1, Read(), ReadOk("Q"))
+        )
+
+    testers = [
+        build(LinearizabilityTester, 0, 1),
+        build(LinearizabilityTester, 4, 9),
+        build(LinearizabilityTester, "a", "b"),
+        build(SequentialConsistencyTester, 0, 1),
+        build(SequentialConsistencyTester, 2, 3),
+    ]
+    collapsed0 = CACHE.counters["canonical_collapsed"]
+    out = evaluate_batch(testers)
+    assert out == [True] * 5
+    # 5 distinct identities, 2 classes (the tester kind is folded into the
+    # canonical fingerprint, so lin and seq never share an entry).
+    assert CACHE.counters["canonical_collapsed"] == collapsed0 + 3
+
+
+# -- witness-guided parity vs the uncached search ------------------------------
+
+
+def _random_chain(rng, cls, spec, n_threads, n_events):
+    t = cls(spec)
+    chain = [t]
+    inflight = {}
+    vals = ["A", "B", "C"]
+    for _ in range(n_events):
+        tid = rng.randrange(n_threads)
+        if tid in inflight and rng.random() < 0.7:
+            op = inflight.pop(tid)
+            if isinstance(op, Write):
+                ret = WriteOk() if rng.random() < 0.9 else WriteFail()
+            elif isinstance(op, Read):
+                ret = ReadOk(rng.choice(vals + [None, "\x00"]))
+            elif isinstance(op, Push):
+                ret = PushOk()
+            elif isinstance(op, Pop):
+                ret = PopOk(rng.choice(vals + [None]))
+            else:
+                ret = LenOk(rng.randrange(3))
+            t = t.on_return(tid, ret)
+        elif tid not in inflight:
+            if isinstance(spec, VecSpec):
+                op = rng.choice([Push(rng.choice(vals)), Pop(), Len()])
+            else:
+                op = rng.choice([Write(rng.choice(vals)), Read()])
+            inflight[tid] = op
+            t = t.on_invoke(tid, op)
+        chain.append(t)
+    return chain
+
+
+def test_witness_guided_parity_on_randomized_histories():
+    """Every chain extension's plane verdict must equal the raw uncached
+    search's, and every cached positive witness must VALIDATE and
+    reconstruct to a spec-valid serialization — witness guidance may only
+    skip work, never change an answer."""
+    rng = random.Random(0xC0FFEE)
+    checked = guided0 = 0
+    guided0 = CACHE.counters["witness_guided_hits"]
+    for _ in range(120):
+        cls = rng.choice([LinearizabilityTester, SequentialConsistencyTester])
+        spec = rng.choice([Register("\x00"), WORegister(), VecSpec()])
+        for t in _random_chain(rng, cls, spec, rng.randrange(2, 5),
+                               rng.randrange(3, 11)):
+            prev = canonical.set_enabled(False)
+            raw = (
+                t._serialized_uncached() is not None
+                if t.is_valid_history else False
+            )
+            canonical.set_enabled(prev)
+            assert verdict(t) == raw
+            checked += 1
+            if raw and t.is_valid_history:
+                steps = cached_steps(t)
+                if steps is not None:
+                    form = canonical_form(t)
+                    assert validate_steps(form, steps)
+                    # ...and the reconstructed (op, ret) order replays
+                    # through the spec (serialized_from_steps re-validates).
+                    assert serialized_from_steps(t, steps) is not None
+    assert checked > 800
+    # The chains must actually have exercised guidance, not just searches.
+    assert CACHE.counters["witness_guided_hits"] > guided0
+
+
+def test_extension_chain_resolves_without_full_searches():
+    # The on_return fast path: extending a verified history is near-linear.
+    base = LinearizabilityTester(Register("\x00")).on_invret(
+        0, Write("B"), WriteOk()
+    )
+    assert verdict(base) is True
+    searches0 = CACHE.counters["full_searches"]
+    cur = base
+    for tid in range(1, 6):
+        cur = cur.on_invoke(tid, Read())
+        assert verdict(cur) is True
+        cur = cur.on_return(tid, ReadOk("B"))
+        assert verdict(cur) is True
+    assert CACHE.counters["full_searches"] == searches0
+
+
+def test_ancestor_walk_resolves_multi_recording_transitions():
+    # A checker transition can record several ops at once (deliver = return
+    # + emissions); the intermediate testers never surface as states. The
+    # plane must still resolve the final tester by climbing the chain.
+    base = LinearizabilityTester(Register("\x00")).on_invret(
+        0, Write("B"), WriteOk()
+    )
+    assert verdict(base) is True
+    searches0 = CACHE.counters["full_searches"]
+    ext = base.on_invoke(1, Read()).on_return(1, ReadOk("B")).on_invoke(
+        2, Write("C")
+    ).on_return(2, WriteOk())
+    assert verdict(ext) is True  # three uncached intermediates climbed
+    assert CACHE.counters["full_searches"] == searches0
+
+
+# -- parallel-vs-serial bit-identical goldens ----------------------------------
+
+
+def _abd_checker():
+    from stateright_tpu.actor import Network
+    from stateright_tpu.examples.abd import AbdModelCfg
+
+    return (
+        AbdModelCfg(
+            client_count=2, server_count=2,
+            network=Network.new_unordered_nonduplicating(),
+        )
+        .into_model()
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+
+
+def test_parallel_vs_serial_bit_identical_abd_golden():
+    """The abd register model through the host checker with the plane's
+    thread pool forced on vs off: verdicts are order-independent pure
+    functions of the canonical class, so counts and discoveries must be
+    bit-identical (and equal to the 544-state golden)."""
+    from stateright_tpu.semantics import batch as batch_mod
+
+    prev_min = batch_mod._PARALLEL_MIN
+    try:
+        batch_mod._PARALLEL_MIN = 1  # force the pool wherever possible
+        par = _abd_checker()
+        clear_serialization_caches()
+        batch_mod._PARALLEL_MIN = 10**9  # never pool
+        ser = _abd_checker()
+    finally:
+        batch_mod._PARALLEL_MIN = prev_min
+    assert par.unique_state_count() == ser.unique_state_count() == 544
+    assert par.state_count() == ser.state_count()
+    assert sorted(par.discoveries()) == sorted(ser.discoveries())
+    par.assert_properties()
+    ser.assert_properties()
+
+
+def test_parallel_vs_serial_bit_identical_paxos_batch():
+    # Paxos histories (1 client / 3 servers host model) through
+    # evaluate_batch with the pool on vs off: identical verdicts AND
+    # identical cache contents (witness steps included — the canonical
+    # search is deterministic per class).
+    from collections import deque
+
+    from stateright_tpu.core.fingerprint import fingerprint
+    from stateright_tpu.examples.paxos import PaxosModelCfg
+
+    model = PaxosModelCfg(client_count=1, server_count=3).into_model()
+    seen, testers, q = set(), [], deque()
+    for s in model.init_states():
+        seen.add(fingerprint(s))
+        q.append(s)
+        testers.append(s.history)
+    while q and len(testers) < 600:
+        s = q.popleft()
+        actions = []
+        model.actions(s, actions)
+        for a in actions:
+            ns = model.next_state(s, a)
+            if ns is None:
+                continue
+            fp = fingerprint(ns)
+            if fp in seen:
+                continue
+            seen.add(fp)
+            q.append(ns)
+            testers.append(ns.history)
+
+    par = evaluate_batch(testers, parallel=True)
+    snap_par = dict(CACHE._entries)
+    clear_serialization_caches()
+    ser = evaluate_batch(testers, parallel=False)
+    snap_ser = dict(CACHE._entries)
+    assert par == ser
+    assert snap_par == snap_ser
+
+
+# -- legacy-path agreements ----------------------------------------------------
+
+
+def test_cached_negative_short_circuits_serialized_history():
+    # Once the plane knows a class is False, serialized_history returns None
+    # WITHOUT running the legacy exhaustive search. (The history must be at
+    # least PROBE_MIN_OPS ops — below that the probe deliberately stays out
+    # of the way because the legacy search is cheaper than canonicalizing.)
+    from stateright_tpu.semantics import linearizability as lin_mod
+    from stateright_tpu.semantics.canonical import PROBE_MIN_OPS
+
+    def build():
+        t = LinearizabilityTester(Register("A")).on_invret(
+            0, Write("B"), WriteOk()
+        )
+        t = t.on_invret(1, Read(), ReadOk("A"))  # stale read: refuted
+        for tid in range(2, PROBE_MIN_OPS):
+            t = t.on_invret(tid, Read(), ReadOk("B"))
+        return t
+
+    t1 = build()
+    assert len(t1) >= PROBE_MIN_OPS
+    assert verdict(t1) is False
+    # An equal-but-distinct twin: the legacy memo would miss and search.
+    t2 = build()
+    misses0 = lin_mod._serialized_cached.cache_info().misses
+    assert t2.serialized_history() is None
+    assert lin_mod._serialized_cached.cache_info().misses == misses0
+
+
+def test_disabled_plane_is_pure_legacy():
+    prev = canonical.set_enabled(False)
+    try:
+        t = LinearizabilityTester(Register("A")).on_invret(
+            0, Read(), ReadOk("A")
+        )
+        entries0 = len(CACHE)
+        assert t.is_consistent() is True
+        assert len(CACHE) == entries0  # the plane never engaged
+    finally:
+        canonical.set_enabled(prev)
+
+
+# -- corpus round-trip + bounded caches ----------------------------------------
+
+
+def test_verdict_table_export_preload_roundtrip():
+    t_pos = LinearizabilityTester(Register("\x00")).on_invret(
+        0, Write("B"), WriteOk()
+    )
+    t_neg = LinearizabilityTester(Register("A")).on_invret(
+        0, Read(), ReadOk("B")
+    )
+    assert verdict(t_pos) is True and verdict(t_neg) is False
+    fps, bits = export_verdicts()
+    assert len(fps) == len(bits) >= 2
+    clear_serialization_caches()
+    assert preload_verdicts(fps, bits) == len(fps)
+    # Preloaded bits serve as canonical hits — no search, no witness needed.
+    searches0 = CACHE.counters["full_searches"]
+    twin_neg = LinearizabilityTester(Register("A")).on_invret(
+        0, Read(), ReadOk("B")
+    )
+    assert verdict(twin_neg) is False
+    assert twin_neg.serialized_history() is None
+    assert CACHE.counters["full_searches"] == searches0
+    assert CACHE.counters["preloaded_verdicts"] >= len(fps)
+
+
+def test_maintain_caches_bounds_long_lived_services():
+    # The service-finalize hook: the canonical cache LRU-trims under the
+    # bound and the trim is counted through the "semantics" source.
+    for i in range(40):
+        t = LinearizabilityTester(Register("\x00")).on_invret(
+            i, Write(f"v{i}"), WriteOk()
+        )
+        assert verdict(t) is True
+    assert len(CACHE) >= 40
+    out = maintain_caches(max_entries=10)
+    assert out["trimmed"] >= 30
+    assert len(CACHE) <= 10
+    from stateright_tpu.semantics.linearizability import verdict_cache_stats
+
+    stats = verdict_cache_stats()
+    assert stats["trims"] >= 1
+    assert stats["trimmed_entries"] >= 30
+    assert "canonical_entries" in stats
+    # ...and the source is scrapeable through the obs registry.
+    from stateright_tpu.obs import REGISTRY
+
+    assert any(s.startswith("semantics") for s in REGISTRY.sources())
+
+
+# -- satellite: sequential-consistency key memo --------------------------------
+
+
+def test_sequential_consistency_key_built_once():
+    """Round-4 `_key_cache`/`_hash` lazy-identity memo ported from the
+    linearizability tester: the identity tuple (two frozensets over the
+    full history) is built exactly once per immutable tester."""
+    t = (
+        SequentialConsistencyTester(Register("A"))
+        .on_invret(0, Write("B"), WriteOk())
+        .on_invoke(1, Read())
+    )
+    k1 = t._key()
+    assert t._key() is k1  # same tuple object — no rebuild on re-probe
+    h1 = hash(t)
+    assert t._hash == h1 and hash(t) == h1
+    # eq/hash still behave (the memo is invisible to identity semantics).
+    twin = (
+        SequentialConsistencyTester(Register("A"))
+        .on_invret(0, Write("B"), WriteOk())
+        .on_invoke(1, Read())
+    )
+    assert t == twin and hash(t) == hash(twin)
+
+
+def test_on_return_child_orders_after_parent_in_batch():
+    # Regression pin (review finding): an `on_return` child has the SAME op
+    # count as its parent (the in-flight op became completed), so the batch
+    # order must sort by recording RANK — parent first — or the child runs
+    # a needless full search instead of witness guidance.
+    parent = (
+        LinearizabilityTester(Register("\x00"))
+        .on_invret(0, Write("B"), WriteOk())
+        .on_invoke(1, Read())
+    )
+    child = parent.on_return(1, ReadOk("B"))
+    assert len(parent) == len(child)  # op counts tie...
+    assert canonical_form(parent).rank + 1 == canonical_form(child).rank
+    searches0 = CACHE.counters["full_searches"]
+    out = evaluate_batch([child, parent])  # child listed FIRST on purpose
+    assert out == [True, True]
+    # ...yet only the parent needed a search; the child was guided.
+    assert CACHE.counters["full_searches"] == searches0 + 1
+
+
+def test_prefetch_gate_disables_after_property_discovered():
+    # Regression pin (review finding): once the consistency property has a
+    # discovery, no property consults the verdict plane anymore — block
+    # prefetching must stop instead of running speculative searches for
+    # every new history class until the space is exhausted.
+    from stateright_tpu import Property
+    from stateright_tpu.actor import Network
+    from stateright_tpu.examples.single_copy_register import (
+        SingleCopyModelCfg,
+    )
+
+    model = SingleCopyModelCfg(
+        client_count=3, server_count=2,
+        network=Network.new_unordered_nonduplicating(),
+    ).into_model()
+    # An undiscoverable property keeps the search running after both real
+    # properties (linearizable counterexample + value chosen) are found.
+    model.property(
+        Property.sometimes("unreachable", lambda m, s: False).expectation,
+        "unreachable",
+        lambda m, s: False,
+    )
+    checker = model.checker().threads(1).spawn_bfs().join()
+    assert checker.discovery("linearizable") is not None
+    # The space is > 1 block, so post-discovery blocks ran with prefetch
+    # candidates but zero plane consumption — the gate must have flipped.
+    assert checker.unique_state_count() > 1500
+    assert checker._plane_prefetch is False
+
+
+def test_nondeterministic_spec_skips_refuted_parent_rule():
+    """Soundness gate (`canonical._deterministic_invoke`): the zero-search
+    "refuted parent refutes its `on_return` child" rule is proved only for
+    specs whose `is_valid_step` accepts exactly what `invoke` produces. A
+    spec with a more permissive override (here: a register whose reads
+    validly return either the current value or a wildcard) can have a
+    refuted parent whose child completes the in-flight op with a return
+    `invoke` would never pick — and IS serializable. The plane must fall
+    back to the full search and agree with the legacy verdict."""
+    from stateright_tpu.semantics import SequentialSpec
+
+    class FuzzyRegister(SequentialSpec):
+        # Nondeterministic: invoke picks the stored value, but a read of
+        # "*" is also valid. No invoke_deterministic declaration, custom
+        # is_valid_step => the gate must treat it as nondeterministic.
+        def __init__(self, value):
+            self.value = value
+
+        def invoke(self, op):
+            if isinstance(op, Write):
+                return WriteOk(), FuzzyRegister(op.value)
+            return ReadOk(self.value), self
+
+        def is_valid_step(self, op, ret):
+            if isinstance(op, Write):
+                return FuzzyRegister(op.value) if ret == WriteOk() else None
+            if isinstance(op, Read) and isinstance(ret, ReadOk):
+                return self if ret.value in (self.value, "*") else None
+            return None
+
+        def __stable_encode__(self):
+            return ("FuzzyRegister", self.value)
+
+        def __eq__(self, other):
+            return (
+                isinstance(other, FuzzyRegister) and other.value == self.value
+            )
+
+        def __hash__(self):
+            return hash(("FuzzyRegister", self.value))
+
+    assert not canonical._deterministic_invoke(FuzzyRegister("A"))
+    assert canonical._deterministic_invoke(Register("A"))
+
+    # A parent with one in-flight Read, its class verdict pinned False in
+    # the cache (synthetic refutation): with a nondeterministic spec the
+    # `on_return` child must NOT inherit the refutation without a search —
+    # completing the read with the wildcard "*" is valid via is_valid_step
+    # even though invoke would never produce it.
+    parent = LinearizabilityTester(FuzzyRegister("A")).on_invoke(0, Read())
+    CACHE.put(canonical_form(parent).fp, False, None)
+    child = parent.on_return(0, ReadOk("*"))
+    # Gated off the rule, the child runs its own search and comes out True,
+    # boolean-identical to the legacy path.
+    assert verdict(child) is True
+    assert child.serialized_history() is not None

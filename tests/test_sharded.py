@@ -298,7 +298,7 @@ def test_sharded_lowered_paxos2_golden():
     )
 
     def properties(view):
-        lin = view.history_pred(lambda h: h.serialized_history() is not None)
+        lin = view.history_pred(lambda h: h.is_consistent())
         chosen = view.any_env(
             lambda e: isinstance(e.msg, GetOk) and e.msg.value != NULL_VALUE
         )
