@@ -1006,6 +1006,117 @@ def device_search_semantics(model_name: str = "single_copy", n: int = 6):
     return out, err
 
 
+def device_search_simulation(model_name: str = "2pc", n: int = 3):
+    """BENCH_SIM=1 row: cold A/B of the fourth checker mode on the 2pc-3
+    anchor (CPU rehearsal) — the host thread-pool `SimulationChecker` vs
+    the device walk engine (tensor/simulation.py), both running random
+    walks until the same generated-state budget. Walks are counted on the
+    host side by a counting chooser (`new_state` fires once per trace) and
+    on the device side by the engine's own telemetry; both sides exclude
+    compile (the device side times rounds 2+ of one engine — continuous
+    batching makes those steady-state). Acceptance: device >= 2x host
+    walks/s, identical property verdicts on the anchor (abort agreement
+    found, safety never violated), and same-seed device runs bit-identical
+    (counts + discoveries)."""
+    _pin_platform()
+    from stateright_tpu.checker.simulation import UniformChooser
+    from stateright_tpu.examples.two_phase_commit import TwoPhaseSys
+    from stateright_tpu.tensor.models import TensorTwoPhaseSys
+    from stateright_tpu.tensor.simulation import DeviceSimulation
+
+    target = 60_000
+
+    class CountingChooser(UniformChooser):
+        def __init__(self):
+            self.walks = 0
+
+        def new_state(self, seed):
+            self.walks += 1
+            return super().new_state(seed)
+
+    chooser = CountingChooser()
+    t0 = time.monotonic()
+    host = (
+        TwoPhaseSys(n)
+        .checker()
+        .target_state_count(target)
+        .spawn_simulation(seed=0, chooser=chooser)
+        .join()
+    )
+    sec_host = time.monotonic() - t0
+    host_walks = chooser.walks
+    host_states = host.state_count()
+    host_found = set(host.discoveries())
+
+    def fresh():
+        return DeviceSimulation(
+            TensorTwoPhaseSys(n), seed=0, traces=1024, max_depth=64,
+            dedup="shared", table_log2=18,
+        )
+
+    def measure(sim):
+        """Round 1 absorbs the compile; time rounds 2+ to the same state
+        budget (continuous batching makes every round steady-state)."""
+        r = sim.run()
+        base_states, base_walks = r.state_count, sim._totals["walks"]
+        t0 = time.monotonic()
+        while r.state_count - base_states < target:
+            r = sim.run()
+        sec = time.monotonic() - t0
+        return (
+            r,
+            sec,
+            r.state_count - base_states,
+            sim._totals["walks"] - base_walks,
+        )
+
+    sim = fresh()
+    r, sec, dev_states, dev_walks = measure(sim)
+    sim_b = fresh()
+    r_b, _sec_b, dev_states_b, dev_walks_b = measure(sim_b)
+
+    tel = r.detail["telemetry"]
+    host_wps = host_walks / max(sec_host, 1e-9)
+    dev_wps = dev_walks / max(sec, 1e-9)
+    speedup = round(dev_wps / max(host_wps, 1e-9), 2)
+
+    err = None
+    if (dev_states, dev_walks, r.unique_state_count, sorted(r.discoveries)) \
+            != (dev_states_b, dev_walks_b, r_b.unique_state_count,
+                sorted(r_b.discoveries)):
+        err = "simulation determinism failure: same-seed runs differ"
+    dev_found = set(r.discoveries)
+    for found, side in ((host_found, "host"), (dev_found, "device")):
+        if err is None and "abort agreement" not in found:
+            err = f"simulation verdict failure: {side} missed abort agreement"
+        if err is None and "consistent" in found:
+            err = f"simulation verdict failure: {side} violated safety"
+    if err is None and speedup < 2.0:
+        # The acceptance bar is part of the row contract, not just prose.
+        err = (
+            f"device simulation only {speedup}x host walks/s "
+            "(acceptance >= 2x)"
+        )
+
+    out = {
+        "states": dev_states,
+        "unique": r.unique_state_count,
+        "sec": round(sec, 4),
+        "states_per_sec": dev_states / max(sec, 1e-9),
+        "compile_sec": 0.0,  # both sides measured post-compile (A/B fair)
+        "sec_host_sim": round(sec_host, 4),
+        "host_states_per_sec": round(host_states / max(sec_host, 1e-9), 1),
+        "sim_walks_per_sec": round(dev_wps, 1),
+        "host_walks_per_sec": round(host_wps, 1),
+        "sim_speedup": speedup,
+        "sim_lane_util": tel["lane_util"],
+        "sim_restarts": tel["restarts"],
+        "sim_dedup_hit_rate": tel["dedup_hit_rate"],
+        "sim_bit_identical": err is None or "determinism" not in err,
+    }
+    return out, err
+
+
 def device_search_corpus(model_name: str = "2pc", n: int = 4):
     """BENCH_CORPUS=1 row: cold-vs-warm A/B of the cross-job warm-start
     corpus (store/corpus.py, ROADMAP item 4). Two tiered services with a
@@ -1289,6 +1400,14 @@ DEVICE_DETAIL_FIELDS = (
     "sec_legacy", "semantics_speedup", "verdict_negatives",
     "canonical_collapsed", "witness_guided_hits", "full_searches",
     "batch_parallel_evals",
+    # Device random simulation (BENCH_SIM=1 row): the host walker's wall
+    # time and rates next to the device engine's (`sec`/`states_per_sec`),
+    # the walks/s ratio (acceptance >= 2x), the lane-utilization and
+    # restart evidence of continuous walk batching, the shared-table dedup
+    # hit rate, and the same-seed determinism verdict.
+    "sec_host_sim", "host_states_per_sec", "sim_walks_per_sec",
+    "host_walks_per_sec", "sim_speedup", "sim_lane_util", "sim_restarts",
+    "sim_dedup_hit_rate", "sim_bit_identical",
 )
 
 
@@ -1535,6 +1654,14 @@ def main(argv: list | None = None) -> int:
             workloads += (
                 ("single_copy", 6, 2400.0, "--worker-semantics", None),
             )
+        # BENCH_SIM=1: add the fourth checker mode's host-vs-device A/B on
+        # the 2pc-3 anchor (host thread-pool SimulationChecker vs the
+        # continuous-batched device walk engine to the same state budget;
+        # the measured walks/s ratio lands in
+        # detail.device["2pc-3-sim"].sim_speedup — acceptance >= 2x with
+        # identical verdicts and bit-identical same-seed device runs).
+        if os.environ.get("BENCH_SIM") == "1" and not smoke:
+            workloads += (("2pc", 3, 2400.0, "--worker-sim", None),)
         for model, n, wl_timeout, mode, env_extra in workloads:
             key = f"{model}-{n}" + (
                 {
@@ -1545,6 +1672,7 @@ def main(argv: list | None = None) -> int:
                     "--worker-pallas": "-pallas",
                     "--worker-corpus": "-corpus",
                     "--worker-semantics": "-semantics",
+                    "--worker-sim": "-sim",
                     "--worker-fleet": "",
                 }.get(mode, "")
             )
@@ -1637,6 +1765,8 @@ def worker_main(model_name: str, n: int, mode: str = "--worker") -> int:
             r, perr = device_search_corpus(model_name, n)
         elif mode == "--worker-semantics":
             r, perr = device_search_semantics(model_name, n)
+        elif mode == "--worker-sim":
+            r, perr = device_search_simulation(model_name, n)
         else:
             r, perr = device_search(model_name, n)
         print(json.dumps({"result": r, "error": perr}), flush=True)
@@ -1653,6 +1783,7 @@ if __name__ == "__main__":
         "--worker", "--worker-sharded", "--worker-service", "--worker-obs",
         "--worker-journal", "--worker-faults", "--worker-pallas",
         "--worker-fleet", "--worker-corpus", "--worker-semantics",
+        "--worker-sim",
     ):
         sys.exit(worker_main(sys.argv[2], int(sys.argv[3]), mode=sys.argv[1]))
     if len(sys.argv) == 2 and sys.argv[1] == "--worker-analysis":

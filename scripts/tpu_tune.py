@@ -18,6 +18,18 @@ Usage:
       [--batches 2048,4096,8192] [--variants split,kv,phased,capped,pallas] \
       [--stores device,tiered] [--high-waters 0.85] [--summary-bits 20] \
       [--repeats R] [--timeout SEC] [--out tune_ranking.json]
+  python scripts/tpu_tune.py sim MODEL N TRACES DEDUP [WALKS] [MAX_DEPTH] \
+      [REPEATS] [TABLE_LOG2]
+  python scripts/tpu_tune.py --sweep MODEL N TABLE_LOG2 --sim \
+      [--traces 1024,2048,4096] [--dedup trace,shared] [--walks W] \
+      [--max-depth D] [--repeats R] [--timeout SEC] [--out ...]
+
+The `sim` forms race the fourth engine (tensor/simulation.py, the device
+random-walk checker): `--sim` switches the sweep axes to traces x dedup
+(DEDUP values: trace | shared — knobs.SIM_DEDUP_KINDS; shared runs the
+global visited table so walks/s AND real unique coverage are measured),
+ranking configs by walks/s next to the costmodel's committed
+sim_step_cost/sim_walks_per_sec predictions.
 
 LAYOUT / --variants values: split (default) | kv | phased | capped |
 capped-kv | capped-phased | pallas — the visited-table designs to race
@@ -182,6 +194,77 @@ def run_single(model_name, n, batch, table_log2, repeats, layout,
     return 0
 
 
+def run_sim_single(model_name, n, traces, dedup, walks, max_depth,
+                   repeats, table_log2) -> int:
+    """One simulation-engine config: repeated rounds on a fresh engine per
+    repeat (the rounds loop is cumulative by design), reporting walks/s and
+    the walk-plane telemetry digest as the RESULT_JSON line."""
+    from stateright_tpu.knobs import SIM_DEDUP_KINDS
+    from stateright_tpu.tensor.simulation import DeviceSimulation
+
+    if dedup not in SIM_DEDUP_KINDS:
+        print(f"unknown DEDUP {dedup!r} ({' | '.join(SIM_DEDUP_KINDS)})")
+        return 2
+    model = _build_model(model_name, n)
+    print(
+        f"devices={jax.devices()} workload={model_name}-{n} sim "
+        f"traces={traces} dedup={dedup} walks={walks} depth={max_depth}",
+        flush=True,
+    )
+
+    def fresh():
+        return DeviceSimulation(
+            model, seed=7, traces=traces, max_depth=max_depth,
+            dedup=dedup, table_log2=table_log2, walks=walks,
+        )
+
+    t0 = time.monotonic()
+    fresh().run()
+    compile_s = time.monotonic() - t0
+    print(f"compile+first: {compile_s:.1f}s", flush=True)
+    best = None
+    for i in range(repeats):
+        sim = fresh()  # same seed per repeat: bit-identical rounds
+        t0 = time.monotonic()
+        r = sim.run()
+        sec = time.monotonic() - t0
+        tel = r.detail["telemetry"]
+        print(
+            f"  run {i}: {sec:.4f}s ({tel['walks'] / max(sec, 1e-9):,.0f} "
+            f"walks/s, {r.state_count / max(sec, 1e-9):,.0f} states/s, "
+            f"lane_util={tel['lane_util']})",
+            flush=True,
+        )
+        if best is None or sec < best[0]:
+            best = (sec, r, tel)
+    sec, r, tel = best
+    rec = {
+        "workload": f"{model_name}-{n}",
+        "sim": True,
+        "traces": traces,
+        "dedup": dedup,
+        "walks": tel["walks"],
+        "max_depth": max_depth,
+        "table_log2": table_log2,
+        "sec": round(sec, 4),
+        "walks_per_sec": round(tel["walks"] / max(sec, 1e-9), 1),
+        "states_per_sec": round(r.state_count / max(sec, 1e-9), 1),
+        "unique": r.unique_state_count,
+        "lane_util": tel["lane_util"],
+        "restarts": tel["restarts"],
+        "compile_sec": round(compile_s, 1),
+        "parity_ok": True,  # simulation has no exhaustive golden to pin
+    }
+    if dedup == "shared":
+        rec["dedup_hit_rate"] = tel["dedup_hit_rate"]
+    print("RESULT_JSON " + json.dumps(rec), flush=True)
+    print(
+        f"BEST {model_name}-{n} sim traces={traces} dedup={dedup}: "
+        f"{rec['walks_per_sec']:,.0f} walks/s"
+    )
+    return 0
+
+
 def run_sweep(argv: list) -> int:
     def opt(name, default):
         if name in argv:
@@ -193,6 +276,13 @@ def run_sweep(argv: list) -> int:
             return v
         return default
 
+    sim = "--sim" in argv
+    if sim:
+        argv.remove("--sim")
+    traces_axis = [int(t) for t in opt("--traces", "1024,2048,4096").split(",")]
+    dedup_axis = opt("--dedup", "trace,shared").split(",")
+    sim_walks = opt("--walks", None)
+    sim_depth = int(opt("--max-depth", "256"))
     batches = [int(b) for b in opt("--batches", "2048,4096,8192").split(",")]
     variants = opt("--variants", "split,kv,phased,capped,pallas").split(",")
     stores = opt("--stores", "device").split(",")
@@ -205,6 +295,12 @@ def run_sweep(argv: list) -> int:
         print(__doc__)
         return 2
     model_name, n, table_log2 = argv[0], int(argv[1]), int(argv[2])
+
+    if sim:
+        return run_sim_sweep(
+            model_name, n, table_log2, traces_axis, dedup_axis,
+            sim_walks, sim_depth, repeats, timeout, out_path,
+        )
 
     bad = [v for v in variants if v not in LAYOUTS]
     if bad:
@@ -369,8 +465,139 @@ def run_sweep(argv: list) -> int:
     return 0
 
 
+def run_sim_sweep(model_name, n, table_log2, traces_axis, dedup_axis,
+                  sim_walks, sim_depth, repeats, timeout, out_path) -> int:
+    """The fourth engine's tunnel-day command: race traces x dedup in
+    subprocess-isolated single-config runs, join with the costmodel's
+    committed walk-step predictions, rank by walks/s."""
+    from stateright_tpu.knobs import SIM_DEDUP_KINDS
+
+    bad = [d for d in dedup_axis if d not in SIM_DEDUP_KINDS]
+    if bad:
+        print(f"unknown dedup values {bad} ({' | '.join(SIM_DEDUP_KINDS)})")
+        return 2
+    model = _build_model(model_name, n)
+    from stateright_tpu.tensor import costmodel as cm
+
+    configs = []
+
+    def flush() -> list:
+        measured = [c for c in configs if "walks_per_sec" in c]
+        ranking = sorted(
+            measured, key=lambda c: c["walks_per_sec"], reverse=True
+        )
+        with open(out_path, "w") as f:
+            json.dump(
+                {
+                    "workload": f"{model_name}-{n}",
+                    "sim": True,
+                    "table_log2": table_log2,
+                    "backend": jax.default_backend(),
+                    "model": {
+                        "lanes": model.lanes,
+                        "max_actions": model.max_actions,
+                    },
+                    "configs": configs,
+                    "ranking": [
+                        {
+                            "traces": c["traces"],
+                            "dedup": c["dedup"],
+                            "walks_per_sec": c["walks_per_sec"],
+                            "states_per_sec": c["states_per_sec"],
+                            "lane_util": c["lane_util"],
+                            "predicted_ms": round(
+                                c.get("predicted_ms", 0.0), 3
+                            ),
+                        }
+                        for c in ranking
+                    ],
+                },
+                f,
+                indent=1,
+            )
+        return ranking
+
+    for traces in traces_axis:
+        for dedup in dedup_axis:
+            print(
+                f"== {model_name}-{n} sim traces={traces} dedup={dedup}",
+                flush=True,
+            )
+            rec = {
+                "workload": f"{model_name}-{n}",
+                "traces": traces,
+                "dedup": dedup,
+            }
+            walks = sim_walks or str(4 * traces)
+            cmd = [
+                sys.executable, os.path.abspath(__file__),
+                "sim", model_name, str(n), str(traces), dedup,
+                str(walks), str(sim_depth), str(repeats), str(table_log2),
+            ]
+            try:
+                proc = subprocess.run(
+                    cmd, capture_output=True, text=True, timeout=timeout
+                )
+            except subprocess.TimeoutExpired:
+                rec["error"] = f"timed out after {timeout:.0f}s"
+                configs.append(rec)
+                flush()
+                print("   TIMEOUT", flush=True)
+                continue
+            sys.stderr.write(proc.stderr)
+            line = next(
+                (
+                    ln[len("RESULT_JSON "):]
+                    for ln in proc.stdout.splitlines()
+                    if ln.startswith("RESULT_JSON ")
+                ),
+                None,
+            )
+            if line is None:
+                tail = proc.stdout.strip().splitlines()
+                rec["error"] = tail[-1] if tail else f"rc={proc.returncode}"
+                configs.append(rec)
+                flush()
+                print(f"   FAILED: {rec['error']}", flush=True)
+                continue
+            rec.update(json.loads(line))
+            rec["predicted_ms"] = cm.sim_step_cost(
+                model.lanes, model.max_actions, traces,
+                dedup=dedup, table_log2=table_log2,
+            ).total_ms
+            configs.append(rec)
+            flush()
+            print(
+                f"   {rec['walks_per_sec']:,.0f} walks/s "
+                f"(predicted {rec['predicted_ms']:.2f} ms/step, "
+                f"lane_util={rec['lane_util']})",
+                flush=True,
+            )
+
+    ranking = flush()
+    print(f"ranking written to {out_path}")
+    if ranking:
+        best = ranking[0]
+        print(
+            f"WINNER sim traces={best['traces']} dedup={best['dedup']}: "
+            f"{best['walks_per_sec']:,.0f} walks/s"
+        )
+    return 0 if ranking else 1
+
+
 def main() -> int:
     argv = sys.argv[1:]
+    if argv and argv[0] == "sim":
+        if len(argv) < 5:
+            print(__doc__)
+            return 2
+        return run_sim_single(
+            argv[1], int(argv[2]), int(argv[3]), argv[4],
+            int(argv[5]) if len(argv) > 5 else None,
+            int(argv[6]) if len(argv) > 6 else 256,
+            max(1, int(argv[7])) if len(argv) > 7 else 3,
+            int(argv[8]) if len(argv) > 8 else 20,
+        )
     if argv and argv[0] == "--sweep":
         if len(argv) < 4:
             print(__doc__)

@@ -111,6 +111,11 @@ KNOB_UNIVERSES = {
     "table_layout": "TABLE_LAYOUTS",
     "append": "APPEND_KINDS",
     "engine": "ENGINES",
+    # knobs.CHECKER_MODES (spawn_tpu's `mode=`) is deliberately NOT mapped:
+    # "mode" is a ubiquitous stdlib/jnp keyword (open(mode="w"),
+    # put_along_axis(mode="drop")), so literal-linting it drowns in false
+    # positives; the builder validates against the registry tuple instead.
+    "dedup": "SIM_DEDUP_KINDS",
 }
 
 

@@ -93,9 +93,33 @@ class CheckerBuilder:
 
         return DfsChecker(self)
 
-    def spawn_simulation(self, seed: int = 0, chooser=None):
+    def spawn_simulation(
+        self, seed: int = 0, chooser=None, device: bool = False, **kwargs
+    ):
+        """Spawn the random-simulation checker (the fourth checker mode,
+        ref: src/checker/simulation.rs). `device=False` (default) runs the
+        host thread-pool walker over a host `Model`; `device=True` runs the
+        vmapped device engine (tensor/simulation.py) over a `TensorModel` —
+        thousands of continuously-rebatched walks per dispatch, with
+        `kwargs` passing through to `DeviceSimulation` (traces, max_depth,
+        dedup="trace"/"shared", table_log2, insert_variant, walks,
+        stale_limit, salt, continuous, telemetry)."""
+        if device:
+            if chooser is not None:
+                raise ValueError(
+                    "chooser is a host-walker hook; the device engine "
+                    "draws from counter-based jax.random streams"
+                )
+            from .simulation import DeviceSimulationChecker
+
+            return DeviceSimulationChecker(self, seed=seed, **kwargs)
         from .simulation import SimulationChecker, UniformChooser
 
+        if kwargs:
+            raise TypeError(
+                f"options {sorted(kwargs)} require the device engine "
+                "(spawn_simulation(device=True, ...))"
+            )
         return SimulationChecker(self, seed, chooser or UniformChooser())
 
     def spawn_on_demand(self):
@@ -117,9 +141,21 @@ class CheckerBuilder:
             ) from e
         return serve(self, address, block=block)
 
-    def spawn_tpu(self, **kwargs):
-        """Spawn the batched device (TPU) frontier checker. The model must be a
-        `stateright_tpu.tensor.TensorModel` or provide one via `tensor_model()`."""
+    def spawn_tpu(self, mode: str = "search", **kwargs):
+        """Spawn a batched device (TPU) checker. The model must be a
+        `stateright_tpu.tensor.TensorModel` or provide one via
+        `tensor_model()`. `mode` picks the engine (knobs.CHECKER_MODES):
+        "search" (default) is the exhaustive frontier checker;
+        "simulation" is the device random-walk engine — equivalent to
+        `spawn_simulation(device=True, **kwargs)`."""
+        from ..knobs import CHECKER_MODES
+
+        if mode not in CHECKER_MODES:  # knob universe: knobs.py
+            raise ValueError(
+                f"mode must be one of {CHECKER_MODES}, got {mode!r}"
+            )
+        if mode == "simulation":
+            return self.spawn_simulation(device=True, **kwargs)
         try:
             from .tpu import TpuChecker
         except ImportError as e:

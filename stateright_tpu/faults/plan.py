@@ -12,7 +12,9 @@ to bit-identical results.
 Injection points (the name is the contract; grep for `maybe_fault(`):
 
 - ``engine.step``     — engine step dispatch (frontier per-batch, resident/
-                        sharded per-chunk), BEFORE the device call
+                        sharded per-chunk, simulation per-round — ctx
+                        ``engine="simulation", round=r``), BEFORE the
+                        device call
 - ``engine.chunk``    — between resident/sharded chunk dispatches
                         (preemption mid-run; the carry is sound here)
 - ``store.spill``     — tiered-store high-water eviction entry

@@ -45,8 +45,27 @@ STORE_KINDS = ("device", "tiered")
 #: compact-then-dynamic_update_slice (frontier.resolve_append).
 APPEND_KINDS = ("scatter", "dus")
 
-#: Engine spines (supervisor/adapter `engine=` selectors).
-ENGINES = ("frontier", "resident", "sharded")
+#: Engine spines (supervisor/adapter `engine=` selectors, chaos-plane
+#: `engine=` context). "simulation" is the fourth checker mode
+#: (tensor/simulation.py) — a first-class spine for faults/obs/bench
+#: purposes, though the supervisor's degrade ladder drives the three
+#: exhaustive spines only.
+ENGINES = ("frontier", "resident", "sharded", "simulation")
+
+#: Checker modes accepted by `CheckerBuilder.spawn_tpu(mode=)`: the batched
+#: frontier search (the default) vs the device random-simulation engine
+#: (tensor/simulation.py — the reference's fourth checker mode, SURVEY L2).
+CHECKER_MODES = ("search", "simulation")
+
+#: Device-simulation dedup designs (`dedup=` on DeviceSimulation /
+#: spawn_simulation(device=True)): "trace" keeps an exact per-walk visited
+#: table per lane (host SimulationChecker parity — no global dedup, so
+#: unique_state_count == state_count), "shared" keeps a small per-walk depth
+#: ring for cycle detection plus ONE global visited table shared by every
+#: walk (the tensor/inserts.py dispatch table — capped/pallas variants,
+#: job-salted fingerprints) so unique_state_count is real coverage and
+#: stale walks can be restarted.
+SIM_DEDUP_KINDS = ("trace", "shared")
 
 #: Cost-model variant alphabet (tensor/costmodel.py) — the (table_layout,
 #: insert_variant) product collapsed to the designs the roofline model
@@ -102,6 +121,7 @@ def check_registry() -> list:
         from .service.scheduler import ServiceEngine
         from .tensor import inserts
         from .tensor.frontier import FrontierSearch
+        from .tensor.simulation import DeviceSimulation
     except ModuleNotFoundError as e:
         # jax-free images run the lint half only (`--skip-audit`); the
         # engine cross-check needs the jax-importing spine and is the one
@@ -135,5 +155,13 @@ def check_registry() -> list:
         problems.append(
             "ServiceEngine.INSERT_VARIANTS is a restated copy, not the "
             "inserts.INSERT_TABLE alias"
+        )
+    # The fourth engine's dedup universe must be THE registry tuple (alias
+    # identity, same probe as the costmodel alias above), and its shared
+    # visited table must resolve through the one insert dispatch table.
+    if DeviceSimulation.DEDUP_KINDS is not SIM_DEDUP_KINDS:
+        problems.append(
+            "DeviceSimulation.DEDUP_KINDS is a restated copy, not the "
+            "knobs.SIM_DEDUP_KINDS alias"
         )
     return problems

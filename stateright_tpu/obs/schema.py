@@ -86,6 +86,19 @@ TELEMETRY_KEYS = {
     "step_us": "per-step wall-time digest {mean,p50,p95,max} where timed",
     "suspects_max": "peak suspect-buffer occupancy (tiered only)",
     "shard_imbalance": "max/mean of per-shard claimed totals (sharded only)",
+    # Device random-simulation engine (tensor/simulation.py): the walk-plane
+    # digest. `lane_util` above is reused (mean active lanes / traces) —
+    # with continuous walk batching it stays ~1 instead of collapsing to
+    # the tail walk.
+    "walks": "random walks completed (simulation engine)",
+    "walks_per_sec": "completed walks per second of device time (simulation)",
+    "restarts": "lane re-seeds: walks started beyond the initial batch "
+                "(continuous walk batching; simulation)",
+    "stale_restarts": "walks cut short by the staleness knob after "
+                      "stale_limit consecutive already-visited states "
+                      "(shared dedup only)",
+    "dedup_hit_rate": "fraction of walk states already present in the "
+                      "shared visited table (dedup='shared' only)",
 }
 
 
@@ -127,6 +140,8 @@ REGISTRY_SOURCES = {
                  "(semantics/canonical.py — class collapse, witness "
                  "guidance, batch evals, corpus preloads, trims)",
     "lease": "epoch-fenced checkpoint leases (service/lease.py)",
+    "simulation": "device random-simulation engine (tensor/simulation.py — "
+                  "walks, restarts, shared-table dedup hits)",
 }
 
 

@@ -484,3 +484,162 @@ class TensorIncrementLock(TensorModel):
             {0: "lock", 1: "read", 2: "write", 3: "release"}.get(pc, "?"),
             action_index,
         )
+
+
+# -- Raft leader election ------------------------------------------------------
+
+# Server roles (one lane each).
+_FOLLOWER, _CANDIDATE, _LEADER = 0, 1, 2
+
+
+@dataclass
+class TensorRaft(TensorModel):
+    """Raft leader election (Ongaro & Ousterhout §5.2), tensor-encoded — the
+    model-zoo workload built FOR the device simulation engine: terms are
+    bounded by `max_term`, so the space is finite but grows so fast with
+    `server_count`/`max_term` that the exhaustive engines only finish the
+    small configs (the goldens), while random walks cover the large ones.
+
+    Lanes (grouped): [term[0..n], role[0..n], voted[0..n]] — per server its
+    current term, role (follower/candidate/leader), and vote in its current
+    term (0 = none, k+1 = server k). Message passing is collapsed into
+    direct peer-state actions (the classic shared-memory reduction of the
+    election protocol — votes are granted only for a strictly newer term,
+    so each server votes at most once per term and two leaders can never
+    share a term).
+
+    Actions (static slots):
+      [0, n)            timeout(i):  non-leader i starts an election —
+                        term+1, candidate, votes for itself
+      [n, 2n)           win(i):      candidate i with a strict majority of
+                        same-term votes becomes leader
+      [2n, 2n + n(n-1)) vote(i<-j):  j grants its vote to candidate i
+                        (only when term_j < term_i; j adopts the term)
+      [.., + n(n-1))    beat(i->j):  leader i brings j to its term (j
+                        follows, vote cleared — it never voted in that
+                        term)
+
+    Properties: "election safety" (ALWAYS — no two leaders share a term),
+    "leader elected" (EVENTUALLY — split-vote walks that exhaust max_term
+    without a leader are genuine counterexamples: Raft's liveness needs
+    randomized timeouts the adversarial scheduler doesn't grant), and
+    "can elect" (SOMETIMES — the positive witness)."""
+
+    server_count: int = 3
+    max_term: int = 3
+
+    def __post_init__(self):
+        n = self.server_count
+        self.lanes = 3 * n
+        self.max_actions = 2 * n + 2 * n * (n - 1)
+
+    def init_states(self):
+        return jnp.zeros((1, self.lanes), dtype=jnp.uint32)
+
+    def _split(self, states):
+        n = self.server_count
+        return states[:, :n], states[:, n : 2 * n], states[:, 2 * n :]
+
+    def expand(self, states):
+        n = self.server_count
+        terms, roles, voted = self._split(states)
+        succs, valids = [], []
+
+        def build(t, r, v, valid):
+            succs.append(jnp.concatenate([t, r, v], axis=1))
+            valids.append(valid)
+
+        for i in range(n):
+            valid = (roles[:, i] != _LEADER) & (
+                terms[:, i] < jnp.uint32(self.max_term)
+            )
+            build(
+                terms.at[:, i].set(terms[:, i] + 1),
+                roles.at[:, i].set(_CANDIDATE),
+                voted.at[:, i].set(i + 1),
+                valid,
+            )
+        for i in range(n):
+            votes = (
+                (terms == terms[:, i : i + 1]) & (voted == jnp.uint32(i + 1))
+            ).sum(axis=1)
+            valid = (roles[:, i] == _CANDIDATE) & (votes * 2 > n)
+            build(terms, roles.at[:, i].set(_LEADER), voted, valid)
+        for i in range(n):
+            for j in range(n):
+                if i == j:
+                    continue
+                valid = (roles[:, i] == _CANDIDATE) & (
+                    terms[:, j] < terms[:, i]
+                )
+                build(
+                    terms.at[:, j].set(terms[:, i]),
+                    roles.at[:, j].set(_FOLLOWER),
+                    voted.at[:, j].set(i + 1),
+                    valid,
+                )
+        for i in range(n):
+            for j in range(n):
+                if i == j:
+                    continue
+                valid = (roles[:, i] == _LEADER) & (
+                    terms[:, j] < terms[:, i]
+                )
+                build(
+                    terms.at[:, j].set(terms[:, i]),
+                    roles.at[:, j].set(_FOLLOWER),
+                    voted.at[:, j].set(0),
+                    valid,
+                )
+        return (
+            jnp.stack(succs, axis=1).astype(jnp.uint32),
+            jnp.stack(valids, axis=1),
+        )
+
+    def properties(self):
+        n = self.server_count
+
+        def safety(model, states):
+            terms, roles, _v = model._split(states)
+            bad = jnp.zeros(states.shape[0], dtype=bool)
+            for i in range(n):
+                for j in range(i + 1, n):
+                    bad = bad | (
+                        (roles[:, i] == _LEADER)
+                        & (roles[:, j] == _LEADER)
+                        & (terms[:, i] == terms[:, j])
+                    )
+            return ~bad
+
+        def has_leader(model, states):
+            _t, roles, _v = model._split(states)
+            return (roles == _LEADER).any(axis=1)
+
+        return [
+            TensorProperty.always("election safety", safety),
+            TensorProperty.eventually("leader elected", has_leader),
+            TensorProperty.sometimes("can elect", has_leader),
+        ]
+
+    def decode(self, row):
+        n = self.server_count
+        role = {_FOLLOWER: "F", _CANDIDATE: "C", _LEADER: "L"}
+        return tuple(
+            (int(row[i]), role[int(row[n + i])], int(row[2 * n + i]) - 1)
+            for i in range(n)
+        )
+
+    def action_label(self, row, action_index):
+        n = self.server_count
+        a = action_index
+        if a < n:
+            return f"timeout({a})"
+        if a < 2 * n:
+            return f"win({a - n})"
+        a -= 2 * n
+        pairs = [(i, j) for i in range(n) for j in range(n) if i != j]
+        if a < n * (n - 1):
+            i, j = pairs[a]
+            return f"vote({i}<-{j})"
+        i, j = pairs[a - n * (n - 1)]
+        return f"beat({i}->{j})"

@@ -430,6 +430,64 @@ def test_device_detail_pins_semantics_row_keys():
     assert row["full_searches"] == 332
 
 
+def test_device_detail_pins_simulation_row_keys():
+    # The BENCH_SIM=1 fourth-checker-mode A/B row (ISSUE 14): the host
+    # walker's wall time and rates, the device walks/s and the measured
+    # ratio (acceptance >= 2x with identical verdicts), the continuous-
+    # batching evidence (lane_util ~1, restarts > 0), the shared-table
+    # dedup hit rate, and the same-seed determinism verdict must all
+    # survive into detail.device so the "device simulation beats the host
+    # walker" claim is auditable in every BENCH_r*.json.
+    for key in (
+        "sec_host_sim", "host_states_per_sec", "sim_walks_per_sec",
+        "host_walks_per_sec", "sim_speedup", "sim_lane_util",
+        "sim_restarts", "sim_dedup_hit_rate", "sim_bit_identical",
+    ):
+        assert key in bench.DEVICE_DETAIL_FIELDS
+    row = bench.device_detail(
+        {
+            "states_per_sec": 321657.0,
+            "sec": 0.19,
+            "sec_host_sim": 1.69,
+            "host_states_per_sec": 35419.8,
+            "sim_walks_per_sec": 39106.2,
+            "host_walks_per_sec": 6557.6,
+            "sim_speedup": 5.96,
+            "sim_lane_util": 1.0,
+            "sim_restarts": 8528,
+            "sim_dedup_hit_rate": 0.996,
+            "sim_bit_identical": True,
+        }
+    )
+    assert row["sim_speedup"] == 5.96
+    assert row["sim_lane_util"] == 1.0
+    assert row["sim_bit_identical"] is True
+    # And the walk-plane vocabulary itself is the documented obs schema's:
+    # telemetry keys, the REGISTRY source, and the dedup knob universe all
+    # resolve through one registry each.
+    from stateright_tpu.knobs import CHECKER_MODES, SIM_DEDUP_KINDS
+    from stateright_tpu.obs.schema import (
+        REGISTRY_SOURCES,
+        TELEMETRY_KEYS,
+        validate_detail,
+    )
+
+    assert "simulation" in REGISTRY_SOURCES
+    for key in ("walks", "walks_per_sec", "restarts", "stale_restarts",
+                "dedup_hit_rate"):
+        assert key in TELEMETRY_KEYS
+    assert SIM_DEDUP_KINDS == ("trace", "shared")
+    assert CHECKER_MODES == ("search", "simulation")
+    detail = {
+        "telemetry": {
+            "steps": 77, "walks": 8528, "walks_per_sec": 39106.2,
+            "lane_util": 1.0, "restarts": 8528, "dedup_hit_rate": 0.996,
+            "stale_restarts": 0, "generated_total": 61171,
+        }
+    }
+    assert validate_detail(detail) == []
+
+
 def test_semantics_counters_exported_through_registry_schema():
     # The plane's counters flow through the obs REGISTRY "semantics"
     # source (pinned in obs/schema.py REGISTRY_SOURCES) and the corpus

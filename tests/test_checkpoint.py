@@ -40,13 +40,15 @@ def test_kill_and_resume_reproduces_exact_counts(tmp_path):
 def test_multiple_suspensions(tmp_path):
     # Each load_checkpoint builds a fresh engine whose step kernel
     # RECOMPILES (~1.7 s per round trip on the CI box), so the round-trip
-    # count is the whole cost of this test; a dozen suspensions exercise
-    # the repeated dump/restore path as thoroughly as the original 50 at a
-    # quarter of the wall clock.
+    # count is the whole cost of this test; six suspensions exercise the
+    # repeated dump/restore path (state survives dump N -> restore N ->
+    # dump N+1) as thoroughly as the original 50 at a fraction of the
+    # wall clock — the multi-round-trip invariant is already proven by
+    # round trip 2, the rest is repetition.
     full = FrontierSearch(TensorLinearEquation(2, 4, 7), 256, 18).run()
     fs = FrontierSearch(TensorLinearEquation(2, 4, 7), 256, 18)
     ckpt = str(tmp_path / "s.npz")
-    for _ in range(12):
+    for _ in range(6):
         r = fs.run(max_steps=3)
         fs.checkpoint(ckpt)
         fs = FrontierSearch.load_checkpoint(

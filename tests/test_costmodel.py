@@ -201,3 +201,30 @@ def test_pallas_term_scales_with_table_and_ranks_the_crossover():
     assert winner(16, 3072) == "pallas"  # table fits: no claim phase wins
     assert winner(22, 3072) == "capped"  # r4 anchor: capped stays default
     assert winner(22, 131072) == "pallas"  # batch amortizes the stream
+
+
+def test_sim_step_cost_structure_and_walks_prediction():
+    # The fourth engine's walk-step term (ISSUE 14): trace-dedup pays the
+    # per-lane cycle probe, shared-dedup swaps it for the ring scan plus
+    # the SAME insert design the exhaustive engines race (at batch =
+    # traces) — so the shared premium is exactly the priced insert ops.
+    import pytest
+
+    trace = cm.sim_step_cost(21, 14, 4096, dedup="trace")
+    shared = cm.sim_step_cost(21, 14, 4096, dedup="shared", table_log2=22)
+    assert trace.total_ms > 0 and shared.total_ms > trace.total_ms
+    names_t = [o.name for o in trace.ops]
+    names_s = [o.name for o in shared.ops]
+    assert "cycle_probe" in names_t and "cycle_ring" not in names_t
+    assert "cycle_ring" in names_s
+    assert any(n.startswith("insert_") for n in names_s)
+    assert not any(n.startswith("insert_") for n in names_t)
+    # More lanes, more step cost; walks/s still grows with lanes because
+    # every lane completes a walk every mean_walk_len steps (continuous
+    # batching: no tail-idle correction needed).
+    assert cm.sim_step_cost(21, 14, 8192).total_ms > trace.total_ms
+    assert cm.sim_walks_per_sec(21, 14, 8192, 40.0) > cm.sim_walks_per_sec(
+        21, 14, 4096, 40.0
+    )
+    with pytest.raises(ValueError):
+        cm.sim_step_cost(21, 14, 4096, dedup="global")
