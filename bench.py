@@ -920,6 +920,87 @@ def device_search_fleet(n_replicas: int = 3):
     return out, err
 
 
+def device_search_blob(n_replicas: int = 2):
+    """BENCH_BLOB=1 row: local-vs-blob checkpoint-backend overhead A/B
+    (ISSUE 15). The SAME mixed job set runs through an N-replica in-proc
+    fleet twice — requeue-resume checkpoint plane + lease fence on a
+    local directory, then on the in-proc blob emulator
+    (faults/blobstore.py: HTTP conditional puts, bounded retry, CRC'd
+    generations) — and the measured overhead lands next to the blob
+    client's own op/retry counters. Parity = every blob-side job's counts
+    and discoveries equal its local twin's (the backend must be
+    bit-identical, only slower by the wire)."""
+    _pin_platform()
+    from stateright_tpu.faults.blobstore import serve_blobd, uri_client
+    from stateright_tpu.service import ServiceFleet
+    from stateright_tpu.tensor.models import (
+        TensorIncrementLock,
+        TensorTwoPhaseSys,
+    )
+
+    m3, mi = TensorTwoPhaseSys(3), TensorIncrementLock(4)
+    jobs = [m3] * 4 + [mi] * 2
+
+    def run(fleet_kw):
+        fleet = ServiceFleet(
+            n_replicas=n_replicas,
+            background=True,
+            max_resident=2,
+            service_kwargs=dict(batch_size=1024, table_log2=17),
+            **fleet_kw,
+        )
+        t0 = time.monotonic()
+        handles = [fleet.submit(m) for m in jobs]
+        fleet.drain(timeout=1800)
+        sec = time.monotonic() - t0
+        results = [h.result() for h in handles]
+        fleet.close()
+        return sec, results
+
+    run({})  # untimed warm-up: compiles land here, not in either side
+    sec_local, local_results = run({})
+    srv = serve_blobd()
+    root = srv.root_uri + "/bench"
+    try:
+        sec_blob, blob_results = run(
+            {"ckpt_dir": root + "/ckpt", "lease_dir": root + "/leases"}
+        )
+        client, _name = uri_client(root)
+        blob_counters = dict(client.counters)
+    finally:
+        srv.shutdown()
+
+    err = None
+    for i, (r, s) in enumerate(zip(blob_results, local_results)):
+        got = (r.state_count, r.unique_state_count, r.max_depth)
+        want = (s.state_count, s.unique_state_count, s.max_depth)
+        if got != want or sorted(r.discoveries.items()) != sorted(
+            s.discoveries.items()
+        ):
+            err = (
+                f"blob-backend parity failure on job {i}: {got} != {want}"
+            )
+            break
+
+    states = sum(r.state_count for r in blob_results)
+    out = {
+        "states": states,
+        "unique": sum(r.unique_state_count for r in blob_results),
+        "sec": round(sec_blob, 4),
+        "states_per_sec": states / max(sec_blob, 1e-9),
+        "compile_sec": 0.0,  # compiles paid by the untimed warm-up run
+        "n_replicas": n_replicas,
+        "n_jobs": len(jobs),
+        "sec_local_fs": round(sec_local, 4),
+        "blob_overhead_pct": round(
+            (sec_blob - sec_local) / max(sec_local, 1e-9) * 100.0, 2
+        ),
+        "blob_ops": int(blob_counters.get("ops", 0)),
+        "blob_retries": int(blob_counters.get("retries", 0)),
+    }
+    return out, err
+
+
 def device_search_semantics(model_name: str = "single_copy", n: int = 6):
     """BENCH_SEMANTICS=1 row: cold-vs-optimized A/B of the dedup-first
     verdict plane (semantics/canonical.py + batch.py) on a register-model
@@ -1387,6 +1468,11 @@ DEVICE_DETAIL_FIELDS = (
     "n_replicas", "fleet_jobs_per_sec", "sec_one_replica",
     "vs_one_replica", "fleet_p50_ms", "fleet_p99_ms",
     "fleet_steals", "fleet_requeued",
+    # Blob checkpoint backend (BENCH_BLOB=1 row): the local-filesystem
+    # wall time next to the blob-emulator run's (`sec`), the measured
+    # overhead percentage, and the blob client's op/retry counters —
+    # the "object store costs only the wire, never the answers" claim.
+    "sec_local_fs", "blob_overhead_pct", "blob_ops", "blob_retries",
     # Warm-start corpus (BENCH_CORPUS=1 row): the cold wall time next to
     # the warm submission's (`sec`), the cold/warm ratio (acceptance >=
     # 5x), the preloaded-state count, and the corrupted-entry CRC verdict
@@ -1637,6 +1723,13 @@ def main(argv: list | None = None) -> int:
         # in detail.device["fleet-mixed-3"]).
         if os.environ.get("BENCH_FLEET") == "1" and not smoke:
             workloads += (("fleet-mixed", 3, 2400.0, "--worker-fleet", None),)
+        # BENCH_BLOB=1: add the local-vs-blob checkpoint-backend overhead
+        # A/B (the mixed job set through a 2-replica fleet with the
+        # requeue-resume plane + lease fence on a local dir vs the blob
+        # emulator; overhead + blob op/retry counters land in
+        # detail.device["fleet-blob-2"]).
+        if os.environ.get("BENCH_BLOB") == "1" and not smoke:
+            workloads += (("fleet-blob", 2, 2400.0, "--worker-blob", None),)
         # BENCH_CORPUS=1: add the cross-job warm-start cold-vs-warm A/B on
         # the 2pc-4 anchor (second submission of the same content key
         # through a corpus-enabled tiered service; the measured ratio
@@ -1674,6 +1767,7 @@ def main(argv: list | None = None) -> int:
                     "--worker-semantics": "-semantics",
                     "--worker-sim": "-sim",
                     "--worker-fleet": "",
+                    "--worker-blob": "",
                 }.get(mode, "")
             )
             r, perr = device_search_subprocess(
@@ -1751,6 +1845,8 @@ def worker_main(model_name: str, n: int, mode: str = "--worker") -> int:
             r, perr = device_search_service(n)
         elif mode == "--worker-fleet":
             r, perr = device_search_fleet(n)
+        elif mode == "--worker-blob":
+            r, perr = device_search_blob(n)
         elif mode == "--worker-sharded":
             r, perr = device_search_sharded(model_name, n)
         elif mode == "--worker-obs":
@@ -1782,8 +1878,8 @@ if __name__ == "__main__":
     if len(sys.argv) == 4 and sys.argv[1] in (
         "--worker", "--worker-sharded", "--worker-service", "--worker-obs",
         "--worker-journal", "--worker-faults", "--worker-pallas",
-        "--worker-fleet", "--worker-corpus", "--worker-semantics",
-        "--worker-sim",
+        "--worker-fleet", "--worker-blob", "--worker-corpus",
+        "--worker-semantics", "--worker-sim",
     ):
         sys.exit(worker_main(sys.argv[2], int(sys.argv[3]), mode=sys.argv[1]))
     if len(sys.argv) == 2 and sys.argv[1] == "--worker-analysis":

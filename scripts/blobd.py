@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""blobd: the standalone HTTP object-store emulator.
+
+    python scripts/blobd.py [--address 0.0.0.0:3700]
+
+Serves the conditional-put/generation-token blob protocol from
+`stateright_tpu/faults/blobstore.py` (PUT /b/<name> with If-None-Match /
+If-Match and server-side `.prev` rotation, GET /b/<name>, DELETE,
+GET /list?prefix=, GET /healthz). Point a fleet at it with
+
+    ServiceFleet(remote=True, store_root="blob://host:3700/myfleet")
+
+or any `*_dir` knob spelled as a ``blob://`` URI — checkpoint
+generations, lease records, corpus entries, member-discovery records,
+and flush-synced journals then all live here, and the URI is the only
+configuration the fleet's processes share. Storage is in-memory: an
+emulator for development, CI, and chaos runs — the S3/GCS shape without
+the credentials (the managed-store backend is the ROADMAP residue).
+
+Stdlib-only (no jax import): runs anywhere.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--address", default="localhost:3700",
+                    help="host:port to bind (default localhost:3700)")
+    args = ap.parse_args(argv)
+
+    from stateright_tpu.faults.blobstore import serve_blobd
+
+    print(f"blobd serving blob://{args.address}", flush=True)
+    serve_blobd(args.address, block=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,7 +11,7 @@ the same load twice — N replicas, then 1 — and prints the jobs/s ratio
 
     JAX_PLATFORMS=cpu python scripts/fleet_load.py \
         [--replicas 3] [--clients 100] [--jobs 200] [--compare] [--crash] \
-        [--warm] [--procs]
+        [--warm] [--procs] [--blob]
 
 `--crash` additionally kills one replica mid-load and asserts zero lost
 jobs: in-proc through the chaos plane (`fleet.replica_crash`), with
@@ -23,6 +23,11 @@ both modes also get their 1-replica baseline). `--procs` runs the fleet
 CROSS-PROCESS (`ServiceFleet(remote=True)`): one `replica_main` subprocess
 per replica over a shared store root, with the epoch-fence lease plane on
 — the load (and the crash) then exercises real process boundaries.
+`--blob` puts the shared store root behind the in-proc object-store
+emulator (faults/blobstore.py): checkpoint generations, lease records,
+member-discovery records (and the corpus with `--warm`) ride HTTP
+conditional puts with bounded-retry/backoff — the true multi-host
+storage path under load.
 """
 
 import argparse
@@ -69,7 +74,7 @@ def prepublish_corpus(corpus_dir):
 
 
 def run_load(n_replicas, clients, jobs, crash=False, corpus_dir=None,
-             tiered=False, procs=False):
+             tiered=False, procs=False, blob_root=None):
     from stateright_tpu.faults import FaultPlan, active
     from stateright_tpu.service import ServiceFleet, serve_fleet
 
@@ -79,6 +84,15 @@ def run_load(n_replicas, clients, jobs, crash=False, corpus_dir=None,
         # store config as the corpus side, so the ratio measures the
         # corpus, not the store kind.
         svc_kw["store"] = "tiered"
+    fleet_kw = {}
+    if blob_root is not None:
+        if procs:
+            fleet_kw["store_root"] = blob_root
+        else:
+            # In-proc over the blob backend: the requeue-resume checkpoint
+            # plane and the lease fence ride HTTP conditional puts.
+            fleet_kw["ckpt_dir"] = blob_root + "/ckpt"
+            fleet_kw["lease_dir"] = blob_root + "/leases"
     fleet = ServiceFleet(
         n_replicas=n_replicas,
         background=True,
@@ -86,6 +100,7 @@ def run_load(n_replicas, clients, jobs, crash=False, corpus_dir=None,
         service_kwargs=svc_kw,
         corpus_dir=corpus_dir,
         remote=procs,
+        **fleet_kw,
     )
     srv = serve_fleet(fleet, address="localhost:0")
     base = "http://" + srv.address
@@ -223,6 +238,10 @@ def main(argv=None) -> int:
                     help="cross-process fleet: one replica_main subprocess "
                          "per replica over a shared store root (lease "
                          "plane on; --crash becomes a real kill -9)")
+    ap.add_argument("--blob", action="store_true",
+                    help="shared store root behind the in-proc object-store "
+                         "emulator (blob:// backend: conditional puts, "
+                         "bounded retry, member discovery)")
     args = ap.parse_args(argv)
 
     import jax
@@ -233,6 +252,21 @@ def main(argv=None) -> int:
         # plain env var; pin at the jax.config level (same move as bench.py).
         jax.config.update("jax_platforms", p)
 
+    blobd = None
+    roots = [0]
+
+    def fresh_blob_root():
+        if blobd is None:
+            return None
+        roots[0] += 1
+        return f"{blobd.root_uri}/load{roots[0]}"
+
+    if args.blob:
+        from stateright_tpu.faults.blobstore import serve_blobd
+
+        blobd = serve_blobd()
+        print(f"blob emulator at {blobd.root_uri}")
+
     if args.warm:
         # Warm-vs-cold A/B: pre-publish the mixed set into one shared
         # corpus, run the load against it, then run the identical load
@@ -242,21 +276,26 @@ def main(argv=None) -> int:
         # conflating warm-start speedup into it.
         import tempfile
 
-        with tempfile.TemporaryDirectory(prefix="srtpu-corpus-") as d:
+        with tempfile.TemporaryDirectory(prefix="srtpu-corpus-") as td:
+            # With --blob the shared corpus ALSO lives in the object store
+            # (content-addressed conditional puts de-duplicate publishes
+            # server-side).
+            d = td if blobd is None else fresh_blob_root() + "/corpus"
             prepublish_corpus(d)
             row, failures = run_load(
                 args.replicas, args.clients, args.jobs, crash=args.crash,
                 corpus_dir=d, procs=args.procs,
+                blob_root=fresh_blob_root(),
             )
             row1, fail1 = (
                 run_load(1, args.clients, args.jobs, corpus_dir=d,
-                         procs=args.procs)
+                         procs=args.procs, blob_root=fresh_blob_root())
                 if args.compare
                 else (None, [])
             )
         cold_row, cold_fail = run_load(
             args.replicas, args.clients, args.jobs, tiered=True,
-            procs=args.procs,
+            procs=args.procs, blob_root=fresh_blob_root(),
         )
         print("warm:", json.dumps(row))
         print("cold:", json.dumps(cold_row))
@@ -270,12 +309,13 @@ def main(argv=None) -> int:
     else:
         row, failures = run_load(
             args.replicas, args.clients, args.jobs, crash=args.crash,
-            procs=args.procs,
+            procs=args.procs, blob_root=fresh_blob_root(),
         )
         print("fleet:", json.dumps(row))
         bad = list(failures)
         row1, fail1 = (
-            run_load(1, args.clients, args.jobs, procs=args.procs)
+            run_load(1, args.clients, args.jobs, procs=args.procs,
+                     blob_root=fresh_blob_root())
             if args.compare
             else (None, [])
         )
@@ -290,6 +330,8 @@ def main(argv=None) -> int:
         )
     if args.crash and row["replica_crashes"] < 1:
         bad.append("crash requested but no replica crash was recorded")
+    if blobd is not None:
+        blobd.shutdown()
     if bad:
         print("FAILURES:", "; ".join(bad[:10]), file=sys.stderr)
         return 1

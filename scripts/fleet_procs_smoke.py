@@ -1,12 +1,14 @@
 """Cross-process fleet smoke: real subprocesses, kill -9, a zombie, and a
-partition — then the forensic timeline must read clean.
+partition — on BOTH store backends — then the forensic timeline must read
+clean.
 
-The ISSUE 12 acceptance run, end to end. Each phase starts a 3-process
-fleet (`ServiceFleet(remote=True)`: one `replica_main` subprocess per
-replica over a shared store root, epoch-fence lease plane + flight
-recorder on), pins a same-route-key job backlog on one victim replica
-(steal disabled, max_resident=1 — so the victim still holds running AND
-queued jobs when it is interrupted), then:
+The ISSUE 12 acceptance run end to end, re-run per backend (ISSUE 15's
+blob phase). Each phase starts a 3-process fleet
+(`ServiceFleet(remote=True)`: one `replica_main` subprocess per replica
+over a shared store root, epoch-fence lease plane + flight recorder on),
+pins a same-route-key job backlog on one victim replica (steal disabled,
+max_resident=1 — so the victim still holds running AND queued jobs when
+it is interrupted), then:
 
 1. **kill -9** — SIGKILL the victim mid-job: lease revoked, orphans
    requeued onto survivors from re-sealed checkpoint generations;
@@ -18,15 +20,25 @@ queued jobs when it is interrupted), then:
    router sees it dead while the PROCESS keeps running — the
    false-positive death, fenced exactly like the zombie.
 
+Backends:
+
+- **file** — a shared local directory (the r16 machine-boundary story);
+- **blob** — an in-proc `blobd` object-store emulator
+  (`faults/blobstore.py`): checkpoint generations, lease records, and
+  member-discovery records live behind HTTP conditional puts; journals
+  are local-write and blob-synced at flush boundaries, and the timeline
+  CLI reads them back FROM THE BLOB ROOT (`blob://...` argument).
+
 In every phase all jobs complete with counts bit-identical to the
 single-replica goldens and the merged journals reconstruct to ZERO
 anomalies through the timeline CLI (run as a real subprocess).
 
-    JAX_PLATFORMS=cpu python scripts/fleet_procs_smoke.py
+    JAX_PLATFORMS=cpu python scripts/fleet_procs_smoke.py [--backend file|blob|both]
 
 Exit 0 = fenced, recovered, reconstructed. Anything else is a regression.
 """
 
+import argparse
 import json
 import os
 import signal
@@ -105,11 +117,14 @@ def zombie_rejections(victim, timeout=30.0):
     return 0
 
 
-def run_timeline(journal_dir):
+def run_timeline(journal_root):
+    """The forensic CLI as a real subprocess; `journal_root` is a local
+    directory or a blob:// journal root (the blob phase reads the
+    flush-synced journals straight from the object store)."""
     proc = subprocess.run(
         [
             sys.executable, "-m", "stateright_tpu.obs.timeline",
-            journal_dir, "--json",
+            journal_root, "--json",
         ],
         capture_output=True, text=True, timeout=300,
         env=dict(os.environ, JAX_PLATFORMS="cpu"),
@@ -118,67 +133,141 @@ def run_timeline(journal_dir):
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
-def main() -> int:
+class _Roots:
+    """Per-backend store-root factory: fresh local tempdirs, or fresh
+    prefixes on one in-proc blobd emulator."""
+
+    def __init__(self, backend):
+        self.backend = backend
+        self._srv = None
+        self._n = 0
+        if backend == "blob":
+            from stateright_tpu.faults.blobstore import serve_blobd
+
+            self._srv = serve_blobd()
+
+    def fresh(self, tag):
+        self._n += 1
+        if self.backend == "blob":
+            return f"{self._srv.root_uri}/{tag}{self._n}"
+        return tempfile.mkdtemp(prefix=f"srtpu-procs-{tag}-")
+
+    def journal_root(self, root):
+        return root + "/journal" if self.backend == "blob" else os.path.join(
+            root, "journal"
+        )
+
+    def close(self):
+        if self._srv is not None:
+            self._srv.shutdown()
+
+
+def run_matrix(backend) -> None:
     from stateright_tpu.faults import FaultPlan, active
 
-    print("== phase 1: 3-proc fleet, kill -9 the victim mid-backlog ==")
-    root = tempfile.mkdtemp(prefix="srtpu-procs-kill9-")
-    fleet, handles, victim = start_fleet(root)
-    os.kill(victim.proc.pid, signal.SIGKILL)
-    wait_crashes(fleet, 1)
-    fleet.drain(timeout=300)
-    check_golden(handles)
-    s = fleet.stats()
-    assert s["lease_revokes"] == 1 and s["requeued_jobs"] >= 1, s
-    fleet.close()
-    report = run_timeline(os.path.join(root, "journal"))
-    assert report["anomalies"] == [], report["anomalies"]
-    print(f"   kill -9 survived: requeued={s['requeued_jobs']} "
-          f"restored={s['restored_jobs']} reseals={s['lease_reseals']}; "
-          "timeline clean")
-
-    print("== phase 2: SIGSTOP -> declared dead -> SIGCONT zombie ==")
-    root = tempfile.mkdtemp(prefix="srtpu-procs-zombie-")
-    fleet, handles, victim = start_fleet(root)
-    os.kill(victim.proc.pid, signal.SIGSTOP)
-    wait_crashes(fleet, 1)
-    os.kill(victim.proc.pid, signal.SIGCONT)  # the zombie rises
-    fleet.drain(timeout=300)
-    check_golden(handles)
-    rejected = zombie_rejections(victim)
-    assert rejected > 0, "zombie wrote nothing / was not fenced"
-    s = fleet.stats()
-    fleet.close()
-    report = run_timeline(os.path.join(root, "journal"))
-    assert report["anomalies"] == [], report["anomalies"]
-    print(f"   zombie fenced: lease.rejected={rejected}, "
-          f"requeued={s['requeued_jobs']} restored={s['restored_jobs']}; "
-          "timeline clean")
-
-    print("== phase 3: injected router<->replica partition ==")
-    root = tempfile.mkdtemp(prefix="srtpu-procs-part-")
-    fleet, handles, victim = start_fleet(root)
-    plan = FaultPlan().rule(
-        "fleet.partition", "io", times=-1, match={"replica": victim.idx}
-    )
-    with active(plan):
+    roots = _Roots(backend)
+    try:
+        print(f"== [{backend}] phase 1: 3-proc fleet, kill -9 the victim "
+              "mid-backlog ==")
+        root = roots.fresh("kill9")
+        fleet, handles, victim = start_fleet(root)
+        os.kill(victim.proc.pid, signal.SIGKILL)
         wait_crashes(fleet, 1)
         fleet.drain(timeout=300)
-    check_golden(handles)
-    assert plan.injected_total() >= 1
-    # The partitioned process never died: it is a zombie by another name,
-    # and the shared-filesystem lease fences it the same way.
-    rejected = zombie_rejections(victim)
-    assert rejected > 0, "partitioned replica was not fenced"
-    s = fleet.stats()
-    assert s["lease_revokes"] == 1, s
-    fleet.close()
-    report = run_timeline(os.path.join(root, "journal"))
-    assert report["anomalies"] == [], report["anomalies"]
-    print(f"   partition survived + fenced: lease.rejected={rejected}, "
-          f"probe_failures={s['probe_failures']} "
-          f"probe_skipped={s['probe_skipped']}; timeline clean")
+        check_golden(handles)
+        s = fleet.stats()
+        assert s["lease_revokes"] == 1 and s["requeued_jobs"] >= 1, s
+        fleet.close()
+        report = run_timeline(roots.journal_root(root))
+        assert report["anomalies"] == [], report["anomalies"]
+        print(f"   kill -9 survived: requeued={s['requeued_jobs']} "
+              f"restored={s['restored_jobs']} reseals={s['lease_reseals']}; "
+              "timeline clean")
 
+        print(f"== [{backend}] phase 2: SIGSTOP -> declared dead -> "
+              "SIGCONT zombie ==")
+        root = roots.fresh("zombie")
+        fleet, handles, victim = start_fleet(root)
+        os.kill(victim.proc.pid, signal.SIGSTOP)
+        wait_crashes(fleet, 1)
+        os.kill(victim.proc.pid, signal.SIGCONT)  # the zombie rises
+        fleet.drain(timeout=300)
+        check_golden(handles)
+        rejected = zombie_rejections(victim)
+        assert rejected > 0, "zombie wrote nothing / was not fenced"
+        s = fleet.stats()
+        fleet.close()
+        report = run_timeline(roots.journal_root(root))
+        assert report["anomalies"] == [], report["anomalies"]
+        print(f"   zombie fenced: lease.rejected={rejected}, "
+              f"requeued={s['requeued_jobs']} restored={s['restored_jobs']}; "
+              "timeline clean")
+
+        print(f"== [{backend}] phase 3: injected router<->replica "
+              "partition ==")
+        root = roots.fresh("part")
+        fleet, handles, victim = start_fleet(root)
+        plan = FaultPlan().rule(
+            "fleet.partition", "io", times=-1, match={"replica": victim.idx}
+        )
+        if backend == "blob":
+            # Blob-backend chaos rides along: throttle some puts (429 ->
+            # bounded retry) and tear one (CRC-rejected, .prev serves) —
+            # outcomes must stay bit-identical and counted.
+            plan.rule("blob.put", "http", times=2)
+            plan.rule("blob.put", "torn", times=1, after=4)
+        with active(plan):
+            wait_crashes(fleet, 1)
+            fleet.drain(timeout=300)
+        check_golden(handles)
+        assert plan.injected_total() >= 1
+        # The partitioned process never died: it is a zombie by another
+        # name, and the shared store root's lease fences it the same way.
+        rejected = zombie_rejections(victim)
+        assert rejected > 0, "partitioned replica was not fenced"
+        s = fleet.stats()
+        assert s["lease_revokes"] == 1, s
+        fleet.close()
+        report = run_timeline(roots.journal_root(root))
+        assert report["anomalies"] == [], report["anomalies"]
+        print(f"   partition survived + fenced: lease.rejected={rejected}, "
+              f"probe_failures={s['probe_failures']} "
+              f"probe_skipped={s['probe_skipped']}; timeline clean")
+
+        print(f"== [{backend}] phase 4: kill -9 -> REJOIN mid-backlog ==")
+        root = roots.fresh("rejoin")
+        fleet, handles, victim = start_fleet(root, n_jobs=6)
+        os.kill(victim.proc.pid, signal.SIGKILL)
+        wait_crashes(fleet, 1)
+        assert fleet.rejoin_replica(victim.idx), "rejoin refused"
+        deadline = time.monotonic() + 90
+        while fleet.stats()["rejoin_promotions"] < 1:
+            assert time.monotonic() < deadline, fleet.stats()
+            time.sleep(0.05)
+        fleet.drain(timeout=300)
+        check_golden(handles)
+        s = fleet.stats()
+        assert s["rejoins"] == 1 and s["rejoin_promotions"] == 1, s
+        fleet.close()
+        report = run_timeline(roots.journal_root(root))
+        assert report["anomalies"] == [], report["anomalies"]
+        print(f"   rejoin survived: rejoins={s['rejoins']} "
+              f"promotions={s['rejoin_promotions']} "
+              f"requeued={s['requeued_jobs']}; timeline clean")
+    finally:
+        roots.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--backend", choices=("file", "blob", "both"),
+                    default="both")
+    args = ap.parse_args(argv)
+    backends = (
+        ("file", "blob") if args.backend == "both" else (args.backend,)
+    )
+    for backend in backends:
+        run_matrix(backend)
     print("FLEET PROCS SMOKE PASSED")
     return 0
 

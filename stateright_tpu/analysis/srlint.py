@@ -12,13 +12,17 @@ error):
   a while_loop body turns a fused device step into a per-step PCIe round
   trip.
 - **SR002 bare-checkpoint-write** (`ckpt-ok`): checkpoint-shaped writes —
-  ``np.savez``/``np.savez_compressed``, ``open(..., "wb")``, or a bare
-  ``atomic_savez`` — anywhere outside ``faults/ckptio.py`` or the lease
-  module (``service/lease.py``). r10 found every checkpoint writer torn;
-  the atomic CRC writer is the only sanctioned path — and since the
-  epoch-fence PR, `ckptio.fenced_savez` is the only sanctioned CALLER of
-  it: a write that skips the wrapper also skips the lease stamp + the
-  write-side revocation check, which is exactly the zombie-writer hole.
+  ``np.savez``/``np.savez_compressed``, ``open(..., "wb")``, a bare
+  ``atomic_savez``, or a bare BLOB write (``put_blob`` or a ``.put``/
+  ``.put_if_absent`` on a blob-shaped receiver) — anywhere outside
+  ``faults/ckptio.py``, the blob backend (``faults/blobstore.py``), or
+  the lease module (``service/lease.py``). r10 found every checkpoint
+  writer torn; the atomic CRC writer is the only sanctioned path — and
+  since the epoch-fence PR, `ckptio.fenced_savez` is the only sanctioned
+  CALLER of it: a write that skips the wrapper also skips the lease
+  stamp + the write-side revocation check, which is exactly the
+  zombie-writer hole. A bare blob ``put`` skips the CRC footer AND the
+  fence, so it gets the same verdict.
 - **SR003 undeclared-detail-key** (`key-ok`): every string-literal
   ``detail[...]`` subscript, every ``REGISTRY.register("<source>")``, and
   every flight-recorder ``events.emit("<type>", ...)`` (any receiver named
@@ -90,9 +94,23 @@ CKPT_RAW_ATOMIC = {
 }
 CKPT_MODULE_SUFFIX = "faults.ckptio"
 #: Modules sanctioned to do raw checkpoint-shaped I/O: the atomic CRC
-#: writer itself, and the lease store (its CRC'd lease records follow the
-#: same tmp/fsync/rename discipline but are not npz).
-CKPT_MODULE_SUFFIXES = ("faults.ckptio", "service.lease")
+#: writer itself, the blob backend it routes through, and the lease store
+#: (its CRC'd lease records follow the same tmp/fsync/rename discipline
+#: but are not npz).
+CKPT_MODULE_SUFFIXES = ("faults.ckptio", "faults.blobstore", "service.lease")
+
+#: The blob-store write surface: the URI-level helper by (resolved)
+#: dotted name, plus `.put`/`.put_if_absent` method calls on blob-shaped
+#: receivers (a name or attribute mentioning "blob" — `blob.put`,
+#: `self._blobstore.put`; CACHE.put/queue.put stay out of scope). Only
+#: `ckptio.fenced_savez`/`write_record` may write blobs: a bare put skips
+#: the CRC footer and the epoch fence.
+BLOB_WRITE_CALLS = {
+    "put_blob",
+    "blobstore.put_blob",
+    "stateright_tpu.faults.blobstore.put_blob",
+}
+BLOB_PUT_METHODS = {"put", "put_if_absent", "put_fenced"}
 
 #: module prefixes whose failure surfaces must be on the chaos plane.
 FAULT_SCOPE = (
@@ -101,8 +119,11 @@ FAULT_SCOPE = (
     "stateright_tpu.parallel.sharded",
     "stateright_tpu.store",
     "stateright_tpu.service",
+    # The blob-store backend's failure surfaces (retry exhaustion, HTTP
+    # translation) must sit on the chaos plane like every other store's.
+    "stateright_tpu.faults.blobstore",
 )
-FAULT_EXC_NAMES = {"RuntimeError", "OSError", "IOError"}
+FAULT_EXC_NAMES = {"RuntimeError", "OSError", "IOError", "BlobUnavailable"}
 
 #: knob parameter/variable names -> registry attribute (knobs.py).
 KNOB_UNIVERSES = {
@@ -283,6 +304,16 @@ class Linter:
                     "check; lease=None degrades to the plain atomic "
                     "writer)",
                 )
+            elif dn in BLOB_WRITE_CALLS or self._blob_put(node):
+                self._emit(
+                    mi,
+                    node,
+                    "SR002",
+                    "bare blob-store write outside faults/ckptio.py / "
+                    "faults/blobstore.py — route it through "
+                    "ckptio.fenced_savez / write_record (the seam that "
+                    "carries the CRC footer and the epoch fence)",
+                )
             elif (
                 dn in ("open", "io.open")
                 or (isinstance(node.func, ast.Name) and node.func.id == "open")
@@ -320,6 +351,31 @@ class Linter:
                         "faults/ckptio.py — persistent state must use the "
                         "atomic checkpoint writer",
                     )
+
+    @staticmethod
+    def _blob_put(node: ast.Call) -> bool:
+        """True for `.put`/`.put_if_absent` method calls whose receiver is
+        blob-shaped (a name/attribute mentioning "blob") — the BlobStore
+        write surface, without dragging CACHE.put/queue.put into scope."""
+        f = node.func
+        if not (
+            isinstance(f, ast.Attribute) and f.attr in BLOB_PUT_METHODS
+        ):
+            return False
+        recv = f.value
+        if isinstance(recv, ast.Name):
+            return "blob" in recv.id.lower()
+        if isinstance(recv, ast.Attribute):
+            return "blob" in recv.attr.lower()
+        if isinstance(recv, ast.Call):
+            # blob_backend(root).put(...) — the factory names the surface.
+            cf = recv.func
+            name = (
+                cf.id if isinstance(cf, ast.Name)
+                else cf.attr if isinstance(cf, ast.Attribute) else ""
+            )
+            return "blob" in name.lower()
+        return False
 
     # -- SR003: undeclared detail / registry keys ------------------------------
 

@@ -19,6 +19,13 @@ Recovery events register into the obs counter registry and appear in
 `SearchResult.detail["faults"]` (schema: obs/schema.py FAULTS_DETAIL_KEYS).
 """
 
+from .blobstore import (
+    BlobUnavailable,
+    blob_backend,
+    is_blob_uri,
+    normalize_root,
+    serve_blobd,
+)
 from .ckptio import (
     CheckpointCorrupt,
     atomic_savez,
@@ -78,6 +85,11 @@ __all__ = [
     "latest_generation",
     "normalize_ckpt_path",
     "CheckpointCorrupt",
+    "BlobUnavailable",
+    "blob_backend",
+    "is_blob_uri",
+    "normalize_root",
+    "serve_blobd",
     "Supervisor",
     "SupervisorConfig",
     "SupervisorGaveUp",

@@ -1,0 +1,724 @@
+"""Pluggable blob-store backend for the checkpoint plane (ROADMAP item 1:
+the true multi-host residue).
+
+Everything durable the fleet shares — checkpoint generations, lease
+records, corpus entries, member-discovery records, synced journals — is
+bytes-at-a-name with one-generation history. On one machine that name is a
+filesystem path and the discipline is tmp+fsync+rename (faults/ckptio.py);
+across machines it is an OBJECT STORE, where the failure modes are
+throttling (429/5xx), latency, partial writes, and stale listings rather
+than torn renames. This module gives the repo ONE backend seam for both:
+
+- `LocalFSBlobStore` — today's on-disk layout, bit-identical: files under
+  a root directory, `put` staged through a pid-unique tmp + fsync +
+  `os.replace`, the previous generation rotated to ``<name>.prev``.
+- `HTTPBlobStore` / `_BlobClient` — an HTTP object-store client with
+  conditional-put (``If-None-Match: *``) and server-side generation
+  tokens, speaking to the emulator in this module (`serve_blobd`, also
+  runnable standalone as ``scripts/blobd.py``). The server rotates the
+  previous payload to ``<name>.prev`` atomically on PUT — the same
+  two-generation contract as the filesystem, so `ckptio.load_latest`'s
+  current-then-`.prev` walk is backend-agnostic.
+
+Backends are chosen by ROOT URI: a plain path or ``file://...`` is the
+filesystem; ``blob://host:port[/prefix]`` is the HTTP store. `faults/
+ckptio.py` (`fenced_savez`/`fenced_load_latest`), `service/lease.py`, and
+`store/corpus.py` all route through here when handed a blob URI, so one
+shared root URI is the fleet's whole storage configuration.
+
+**Chaos + retry discipline**: every HTTP op is a chaos boundary
+(``blob.put`` / ``blob.get`` / ``blob.list`` / ``blob.delete`` in
+faults/plan.py) and runs
+under bounded retry with the supervisor's seeded deterministic backoff and
+a per-op deadline. Injected 429/5xx/transport faults are retried and
+counted; a ``torn`` PUT truncates the uploaded payload (CRC-rejected at
+read, ``.prev`` serves — the r13 torn-generation story over the network);
+a ``stale`` LIST serves the previous listing (consumers degrade to a
+bigger directory, never a wrong result); ``slow`` injects latency. Retry
+exhaustion raises `BlobUnavailable` (an OSError), which every caller
+already degrades on: resume-fresh, cold corpus run, counted publish fault.
+Counters are exported through the obs REGISTRY "blob" source.
+
+The ONE sanctioned write path into a blob store is `faults/ckptio.py`
+(`fenced_savez` / `write_record`) — srlint SR002 flags a bare ``put``
+anywhere else, exactly as it flags a bare `atomic_savez`: a write that
+skips the seam also skips the CRC footer and the lease stamp.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from collections import namedtuple
+from typing import Optional
+
+from .plan import (
+    FaultError,
+    active_plan,
+    deterministic_backoff,
+    maybe_fault,
+)
+
+__all__ = [
+    "BlobStat",
+    "BlobUnavailable",
+    "HTTPBlobStore",
+    "LocalFSBlobStore",
+    "blob_backend",
+    "is_blob_uri",
+    "normalize_root",
+    "serve_blobd",
+]
+
+#: One listing row, backend-agnostic: `name` is relative to the store's
+#: root, `mtime` is the backend's last-write stamp (file mtime / server
+#: PUT time) — the metadata `CorpusStore.gc`'s LRU order runs on.
+BlobStat = namedtuple("BlobStat", "name size mtime")
+
+
+class BlobUnavailable(OSError):
+    """A blob op exhausted its bounded retry / per-op deadline. An OSError
+    so every existing degrade path (resume-fresh, cold corpus, counted
+    publish fault) absorbs it without new handling."""
+
+
+class _Conflict(RuntimeError):
+    """Server refused a conditional put (If-None-Match/If-Match miss) —
+    internal; `put(if_absent=True)` surfaces it as a None return."""
+
+
+#: HTTP statuses worth retrying (throttling + transient server failures).
+RETRYABLE_HTTP = (429, 500, 502, 503, 504)
+
+#: Injected-latency sleep for a consumed ``slow`` fault, seconds.
+SLOW_S = 0.05
+
+
+def is_blob_uri(path) -> bool:
+    return isinstance(path, str) and path.startswith("blob://")
+
+
+def normalize_root(root: Optional[str]) -> Optional[str]:
+    """Strip a ``file://`` scheme down to the plain path it names (so
+    everything downstream sees either a filesystem path or a ``blob://``
+    URI — the only two spellings the backend seam dispatches on)."""
+    if isinstance(root, str) and root.startswith("file://"):
+        return root[len("file://"):] or "/"
+    return root
+
+
+def split_blob_uri(uri: str) -> tuple:
+    """``blob://host:port/some/name`` -> ("http://host:port", "/some/name")."""
+    rest = uri[len("blob://"):]
+    host, slash, name = rest.partition("/")
+    if not host:
+        raise ValueError(f"blob URI {uri!r} has no host")
+    return f"http://{host}", ("/" + name if slash else "/")
+
+
+# -- the HTTP client (absolute names, shared per server) -----------------------
+
+
+class _BlobClient:
+    """One server's client: retry/backoff/chaos wrapper over the four
+    verbs, counters exported through the obs REGISTRY "blob" source.
+    Cached per base URL (`_client`) so every URI op against one server
+    shares one counter set and one stale-list cache."""
+
+    retry_limit = 4
+    op_deadline_s = 30.0
+    backoff_base_s = 0.02
+    backoff_cap_s = 0.5
+
+    def __init__(self, base_url: str):
+        self.base_url = base_url.rstrip("/")
+        self._lock = threading.Lock()
+        self._stale_cache: dict = {}  # prefix -> previous listing
+        self.counters = {
+            "ops": 0,
+            "retries": 0,
+            "backoff_ms": 0,
+            "faults": 0,
+            "torn_puts": 0,
+            "stale_lists": 0,
+            "slow_ops": 0,
+            "unavailable": 0,
+        }
+        from ..obs import REGISTRY
+
+        self._metrics_name = REGISTRY.register("blob", self.metrics)
+
+    def metrics(self) -> dict:
+        with self._lock:
+            return dict(self.counters)
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[key] += n
+
+    # -- retry/chaos wrapper ---------------------------------------------------
+
+    def _op(
+        self,
+        point: str,
+        fn,
+        chaos: bool = True,
+        deadline_s: Optional[float] = None,
+        **ctx,
+    ):
+        """Run one server round trip under the chaos point + bounded
+        deterministic-backoff retry + per-op deadline. 404s and
+        conditional-put conflicts pass straight through (they are answers,
+        not failures); everything transport-shaped is retried until the
+        budget runs out, then surfaced as `BlobUnavailable`.
+
+        `chaos=False` skips the injection point (real transport failures
+        are still retried): reserved for ops the chaos plane itself can
+        re-enter — the flight-recorder journal's blob mirror, where an
+        injected fault would be recorded as a `fault.injected` event into
+        the very journal whose sync is mid-flight (journal `_io_lock` and
+        plan lock re-entered: a self-deadlock, found by the smoke's blob
+        partition phase)."""
+        self._count("ops")
+        plan = active_plan() if chaos else None
+        if plan is not None and plan.consume_special(point, "slow"):
+            self._count("slow_ops")
+            time.sleep(SLOW_S)
+        seed = plan.seed if plan is not None else 0
+        deadline = time.monotonic() + (
+            deadline_s if deadline_s is not None else self.op_deadline_s
+        )
+        attempt = 0
+        last: Optional[BaseException] = None
+        while True:
+            try:
+                if chaos:
+                    maybe_fault(point, store=self.base_url, **ctx)
+                return fn()
+            except (FileNotFoundError, _Conflict):
+                raise
+            except urllib.error.HTTPError as e:
+                if e.code == 404:
+                    raise FileNotFoundError(
+                        f"{self.base_url}: no such blob ({ctx})"
+                    ) from e
+                if e.code == 412:
+                    raise _Conflict(str(e)) from e
+                if e.code not in RETRYABLE_HTTP:
+                    raise BlobUnavailable(
+                        f"blob op {point} failed with HTTP {e.code}"
+                    ) from e
+                last = e
+            except (
+                FaultError,
+                urllib.error.URLError,
+                ConnectionError,
+                TimeoutError,
+                http.client.HTTPException,
+                OSError,
+            ) as e:
+                last = e
+            self._count("faults")
+            attempt += 1
+            if attempt > self.retry_limit or time.monotonic() >= deadline:
+                self._count("unavailable")
+                raise BlobUnavailable(
+                    f"blob op {point} against {self.base_url} exhausted "
+                    f"{attempt} attempts (last: {type(last).__name__}: "
+                    f"{last})"
+                ) from last
+            delay = deterministic_backoff(
+                seed, f"{point}.backoff", attempt - 1,
+                self.backoff_base_s, self.backoff_cap_s,
+            )
+            delay = min(delay, max(deadline - time.monotonic(), 0.0))
+            self._count("retries")
+            self._count("backoff_ms", int(delay * 1000))
+            time.sleep(delay)
+
+    # -- raw verbs -------------------------------------------------------------
+
+    def _url(self, name: str) -> str:
+        return self.base_url + "/b" + urllib.parse.quote(name)
+
+    def _request(self, req, timeout: float = 10.0):
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.read()
+
+    def put(
+        self,
+        name: str,
+        data: bytes,
+        rotate: bool = True,
+        if_absent: bool = False,
+        chaos: bool = True,
+        deadline_s: Optional[float] = None,
+    ) -> Optional[int]:
+        """Upload one blob; the server rotates the previous payload to
+        ``<name>.prev`` when `rotate` (the two-generation contract).
+        `if_absent=True` is the conditional put (``If-None-Match: *``):
+        None means another writer got there first — the content-addressed
+        idempotence the corpus publish rides. A consumed ``torn`` fault
+        truncates the payload BEFORE upload: the partial PUT the read-side
+        CRC must reject. `chaos=False` (journal mirror only) skips the
+        injection point — see `_op`; `deadline_s` overrides the per-op
+        deadline (best-effort callers cap their stall).
+
+        Returns the server's generation token — NEGATED when the upload
+        was torn, so the caller KNOWS this write is not trustworthy
+        (ckptio must not mark the path written-intact, or a later write
+        would rotate the torn generation over the good `.prev`, and a
+        conditional republish would 412-skip the repair forever)."""
+        plan = active_plan() if chaos else None
+        torn = False
+        if plan is not None and plan.consume_special("blob.put", "torn"):
+            self._count("torn_puts")
+            data = data[: max(len(data) // 2, 1)]
+            torn = True
+
+        def do():
+            headers = {"Content-Type": "application/octet-stream"}
+            if if_absent:
+                headers["If-None-Match"] = "*"
+            req = urllib.request.Request(
+                self._url(name) + f"?rotate={int(bool(rotate))}",
+                data=data,
+                method="PUT",
+                headers=headers,
+            )
+            out = json.loads(self._request(req) or b"{}")
+            return int(out.get("generation", 0))
+
+        try:
+            gen = self._op(
+                "blob.put", do, chaos=chaos, deadline_s=deadline_s,
+                name=name[-64:],
+            )
+        except _Conflict:
+            return None
+        return -gen if torn and gen else gen
+
+    def get(self, name: str) -> bytes:
+        """One blob's bytes; FileNotFoundError when absent (an answer, not
+        a failure — never retried)."""
+
+        def do():
+            return self._request(urllib.request.Request(self._url(name)))
+
+        return self._op("blob.get", do, name=name[-64:])
+
+    def delete(self, name: str) -> bool:
+        # Its own chaos point: deletes riding ``blob.put`` would shift
+        # the put hit counter (replayed torn-put plans landing on the
+        # wrong upload) and let put-targeted rules fire on GC traffic.
+        def do():
+            req = urllib.request.Request(self._url(name), method="DELETE")
+            out = json.loads(self._request(req) or b"{}")
+            return bool(out.get("deleted"))
+
+        return self._op("blob.delete", do, name=name[-64:])
+
+    def list(self, prefix: str = "/") -> list:
+        """Every blob under `prefix` as `BlobStat` rows (absolute names).
+        A consumed ``stale`` fault serves the PREVIOUS listing for this
+        prefix — the eventually-consistent LIST every consumer must
+        tolerate (GC sweeps a smaller set, discovery sees yesterday's
+        members; both degrade, neither is wrong)."""
+        plan = active_plan()
+        if plan is not None and plan.consume_special("blob.list", "stale"):
+            self._count("stale_lists")
+            return list(self._stale_cache.get(prefix, ()))
+
+        def do():
+            req = urllib.request.Request(
+                self.base_url
+                + "/list?prefix="
+                + urllib.parse.quote(prefix)
+            )
+            out = json.loads(self._request(req) or b"{}")
+            return [
+                BlobStat(b["name"], int(b["size"]), float(b["mtime"]))
+                for b in out.get("blobs", ())
+            ]
+
+        out = self._op("blob.list", do, prefix=prefix[-64:])
+        self._stale_cache[prefix] = list(out)
+        return out
+
+    def exists(self, name: str) -> bool:
+        """Existence probe via HEAD — answers without downloading the
+        payload (checkpoint generations are multi-MB; `any_generation`
+        probes two names per corpus lookup). Runs with `chaos=False`:
+        letting HEADs consume ``blob.get`` hits would shift the point's
+        hit numbering and break replayed plans (the same reason deletes
+        got their own point), and the payload GET that always follows a
+        positive probe is the real chaos surface anyway."""
+
+        def do():
+            req = urllib.request.Request(self._url(name), method="HEAD")
+            self._request(req)
+            return True
+
+        try:
+            return bool(
+                self._op("blob.get", do, chaos=False, name=name[-64:])
+            )
+        except (FileNotFoundError, BlobUnavailable):
+            return False
+
+
+_clients: dict = {}
+_clients_lock = threading.Lock()
+
+
+def _client(base_url: str) -> _BlobClient:
+    with _clients_lock:
+        c = _clients.get(base_url)
+        if c is None:
+            c = _clients[base_url] = _BlobClient(base_url)
+        return c
+
+
+# -- URI-level helpers (what ckptio routes through) ----------------------------
+
+
+def uri_client(uri: str) -> tuple:
+    """(client, absolute name) for one ``blob://`` URI."""
+    base, name = split_blob_uri(uri)
+    return _client(base), name
+
+
+def get_blob(uri: str) -> bytes:
+    c, name = uri_client(uri)
+    return c.get(name)
+
+
+def put_blob(
+    uri: str,
+    data: bytes,
+    rotate: bool = True,
+    if_absent: bool = False,
+    chaos: bool = True,
+    deadline_s: Optional[float] = None,
+) -> Optional[int]:
+    c, name = uri_client(uri)
+    return c.put(
+        name, data, rotate=rotate, if_absent=if_absent, chaos=chaos,
+        deadline_s=deadline_s,
+    )
+
+
+def delete_blob(uri: str) -> bool:
+    c, name = uri_client(uri)
+    return c.delete(name)
+
+
+def blob_exists(uri: str) -> bool:
+    c, name = uri_client(uri)
+    return c.exists(name)
+
+
+# -- rooted store views (the corpus-GC / discovery listing seam) ---------------
+
+
+class LocalFSBlobStore:
+    """The filesystem backend behind the same four-verb surface: files
+    under `root`, put through the pid-unique tmp + fsync + `os.replace`
+    discipline with ``.prev`` rotation — byte-identical to what
+    `ckptio.atomic_savez` leaves on disk, which is why routing `gc`/
+    listing consumers through this view changes nothing on local roots."""
+
+    def __init__(self, root: str):
+        self.root = root
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.root, name)
+
+    def list(self, prefix: str = "") -> list:
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        out = []
+        for n in sorted(names):
+            if prefix and not n.startswith(prefix):
+                continue
+            try:
+                st = os.stat(self._path(n))
+            except OSError:
+                continue
+            if not os.path.isfile(self._path(n)):
+                continue
+            out.append(BlobStat(n, int(st.st_size), float(st.st_mtime)))
+        return out
+
+    def get(self, name: str) -> bytes:
+        with open(self._path(name), "rb") as f:
+            return f.read()
+
+    def put(
+        self,
+        name: str,
+        data: bytes,
+        rotate: bool = True,
+        if_absent: bool = False,
+    ) -> Optional[int]:
+        path = self._path(name)
+        if if_absent and os.path.exists(path):
+            return None
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:  # srlint: ckpt-ok the LocalFS blob backend IS the sanctioned tmp/fsync/rename writer (rotation below)
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        if rotate and os.path.exists(path):
+            os.replace(path, path + ".prev")
+        os.replace(tmp, path)
+        # Make the renames themselves durable (best-effort: not every
+        # filesystem supports directory fsync).
+        try:
+            dfd = os.open(self.root or ".", os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass
+        return 1
+
+    def delete(self, name: str) -> bool:
+        try:
+            os.unlink(self._path(name))
+            return True
+        except OSError:
+            return False
+
+    def exists(self, name: str) -> bool:
+        return os.path.exists(self._path(name))
+
+
+class HTTPBlobStore:
+    """A rooted view over one server's `_BlobClient`: names are relative
+    to the root URI's prefix, so `CorpusStore.gc` / discovery listings run
+    the same code on both backends."""
+
+    def __init__(self, root_uri: str):
+        base, prefix = split_blob_uri(root_uri)
+        if not prefix.endswith("/"):
+            prefix += "/"
+        self.root = root_uri
+        self._c = _client(base)
+        self._prefix = prefix
+
+    def list(self, prefix: str = "") -> list:
+        out = self._c.list(self._prefix + prefix)
+        cut = len(self._prefix)
+        return [BlobStat(b.name[cut:], b.size, b.mtime) for b in out]
+
+    def get(self, name: str) -> bytes:
+        return self._c.get(self._prefix + name)
+
+    def put(
+        self,
+        name: str,
+        data: bytes,
+        rotate: bool = True,
+        if_absent: bool = False,
+    ) -> Optional[int]:
+        return self._c.put(
+            self._prefix + name, data, rotate=rotate, if_absent=if_absent
+        )
+
+    def delete(self, name: str) -> bool:
+        return self._c.delete(self._prefix + name)
+
+    def exists(self, name: str) -> bool:
+        return self._c.exists(self._prefix + name)
+
+
+def blob_backend(root: str):
+    """The rooted store view for one root URI — `HTTPBlobStore` for
+    ``blob://``, `LocalFSBlobStore` for a plain/‌``file://`` path. The ONE
+    dispatch every backend-agnostic consumer (corpus GC, member
+    discovery, journal-root listing) goes through."""
+    root = normalize_root(root)
+    if is_blob_uri(root):
+        return HTTPBlobStore(root)
+    return LocalFSBlobStore(root)
+
+
+# -- the emulator server -------------------------------------------------------
+
+
+class _ServerHandle:
+    """serve_blobd's return: the bound address, the live store dict (tests
+    reach in to corrupt/inspect payloads), and shutdown."""
+
+    def __init__(self, httpd, store, thread):
+        self.httpd = httpd
+        self.store = store
+        self.thread = thread
+
+    @property
+    def address(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"{host}:{port}"
+
+    @property
+    def root_uri(self) -> str:
+        return f"blob://{self.address}"
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self.thread is not None:
+            self.thread.join(timeout=5.0)
+
+
+def serve_blobd(address: str = "localhost:0", block: bool = False):
+    """The in-proc HTTP object-store emulator (`scripts/blobd.py` runs it
+    standalone). Protocol — deliberately the S3/GCS-shaped minimum:
+
+    - ``PUT /b/<name>?rotate=0|1`` — store bytes; ``rotate=1`` moves the
+      previous payload to ``<name>.prev`` atomically first (the
+      two-generation contract). ``If-None-Match: *`` is the conditional
+      put (412 when the name exists); ``If-Match: <gen>`` compares
+      against the server's generation token. Returns ``{"generation": g}``.
+    - ``GET /b/<name>`` — the bytes (+ ``X-Blob-Generation``); 404 absent.
+    - ``DELETE /b/<name>`` — ``{"deleted": bool}``.
+    - ``GET /list?prefix=`` — ``{"blobs": [{name,size,mtime,generation}]}``.
+    - ``GET /healthz`` — liveness.
+
+    Storage is in-memory (an emulator, not a database): one dict guarded
+    by a lock, rotation + conditional checks atomic under it.
+    """
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    store: dict = {}  # name -> {"data": bytes, "gen": int, "mtime": float}
+    lock = threading.Lock()
+    gen_counter = [0]
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # quiet by default
+            pass
+
+        def _json(self, obj, code=200):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _name(self) -> Optional[str]:
+            path = urllib.parse.unquote(self.path.partition("?")[0])
+            if not path.startswith("/b/"):
+                return None
+            return path[len("/b"):]
+
+        def do_GET(self):
+            path, _, query = self.path.partition("?")
+            if path == "/healthz":
+                with lock:
+                    self._json({"ok": 1, "blobs": len(store)})
+                return
+            if path == "/list":
+                q = urllib.parse.parse_qs(query)
+                prefix = urllib.parse.unquote(q.get("prefix", [""])[0])
+                with lock:
+                    blobs = [
+                        {
+                            "name": n,
+                            "size": len(rec["data"]),
+                            "mtime": rec["mtime"],
+                            "generation": rec["gen"],
+                        }
+                        for n, rec in sorted(store.items())
+                        if n.startswith(prefix)
+                    ]
+                self._json({"blobs": blobs})
+                return
+            name = self._name()
+            with lock:
+                rec = store.get(name) if name else None
+                data = rec["data"] if rec else None
+                gen = rec["gen"] if rec else 0
+            if data is None:
+                self._json({"error": "no such blob"}, 404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("Content-Length", str(len(data)))
+            self.send_header("X-Blob-Generation", str(gen))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_HEAD(self):
+            name = self._name()
+            with lock:
+                rec = store.get(name) if name else None
+            if rec is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(rec["data"])))
+            self.send_header("X-Blob-Generation", str(rec["gen"]))
+            self.end_headers()
+
+        def do_PUT(self):
+            name = self._name()
+            if not name:
+                self._json({"error": "not found"}, 404)
+                return
+            n = int(self.headers.get("Content-Length") or 0)
+            data = self.rfile.read(n)
+            q = urllib.parse.parse_qs(self.path.partition("?")[2])
+            rotate = q.get("rotate", ["1"])[0] != "0"
+            if_absent = self.headers.get("If-None-Match") == "*"
+            if_match = self.headers.get("If-Match")
+            with lock:
+                cur = store.get(name)
+                if if_absent and cur is not None:
+                    self._json({"error": "exists", "generation": cur["gen"]},
+                               412)
+                    return
+                if if_match is not None and (
+                    cur is None or str(cur["gen"]) != if_match
+                ):
+                    self._json({"error": "generation mismatch"}, 412)
+                    return
+                if rotate and cur is not None:
+                    store[name + ".prev"] = dict(cur)
+                gen_counter[0] += 1
+                store[name] = {
+                    "data": data,
+                    "gen": gen_counter[0],
+                    "mtime": time.time(),
+                }
+                self._json({"generation": gen_counter[0]})
+
+        def do_DELETE(self):
+            name = self._name()
+            with lock:
+                deleted = store.pop(name, None) is not None if name else False
+            self._json({"deleted": deleted})
+
+    host, _, port = address.partition(":")
+    httpd = ThreadingHTTPServer((host or "localhost", int(port or 0)), Handler)
+    if block:
+        handle = _ServerHandle(httpd, store, None)
+        try:
+            httpd.serve_forever()
+        finally:
+            httpd.server_close()
+        return handle
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    return _ServerHandle(httpd, store, thread)

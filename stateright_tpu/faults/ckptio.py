@@ -42,6 +42,18 @@ that was valid at write time. `fenced_savez(lease=None)` degrades to
 crash-atomic) checkpoints through the same single seam, which is what
 lets srlint's SR002 pin every checkpoint write in the repo to this module
 or the lease module.
+
+**Blob backend** (faults/blobstore.py, the true multi-host step): every
+function here dispatches on the path spelling — a plain/``file://`` path
+keeps today's rename/CRC discipline bit-identically, a ``blob://`` URI
+routes the same payload+footer bytes through the HTTP object-store client
+(conditional puts, server-side ``.prev`` rotation, bounded retry with
+seeded deterministic backoff, the ``blob.*`` chaos points). The CRC
+footer, the lease stamp, and the current-then-``.prev`` fallback walk are
+backend-invariant: a torn blob PUT is rejected and ``.prev`` serves,
+exactly like a torn rename. `write_record`/`read_record_latest` extend
+the same seam to non-npz CRC'd records (lease files, member-discovery
+records), so the store root URI is the only configuration a fleet shares.
 """
 
 from __future__ import annotations
@@ -55,6 +67,7 @@ from typing import Optional
 
 import numpy as np
 
+from .blobstore import delete_blob, get_blob, is_blob_uri, put_blob
 from .plan import active_plan
 
 #: Footer layout: 8-byte magic, u64 payload length, u32 CRC32 of payload.
@@ -82,7 +95,11 @@ _WRITTEN_INTACT: set = set()
 
 def normalize_ckpt_path(path: str) -> str:
     """`np.savez` historically appended `.npz` when the suffix was absent;
-    keep every writer/loader on the same normalized name."""
+    keep every writer/loader on the same normalized name. A ``file://``
+    scheme is stripped here (the earliest seam every path flows through)
+    so downstream code only ever sees plain paths or ``blob://`` URIs."""
+    if path.startswith("file://"):
+        path = path[len("file://"):] or "/"
     return path if path.endswith(".npz") else path + ".npz"
 
 
@@ -104,15 +121,39 @@ def content_path(root: str, key: str, kind: str = "corpus") -> str:
     return os.path.join(root, f"{kind}-{key}.npz")
 
 
-def atomic_savez(path: str, arrays: dict, keep_prev: bool = True) -> str:
+def atomic_savez(
+    path: str,
+    arrays: dict,
+    keep_prev: bool = True,
+    if_absent: bool = False,
+) -> Optional[str]:
     """Write `arrays` as a compressed npz at `path`, crash-atomically, with
     a CRC32 footer. Rotates an existing `path` to ``path + ".prev"`` first
-    (the fallback generation). Returns the path written."""
+    (the fallback generation). Returns the path written.
+
+    `if_absent=True` is the conditional write (the corpus's content-
+    addressed idempotence): when an intact generation already exists the
+    write is skipped and None returned — on the blob backend this is a
+    server-side conditional put (``If-None-Match``), so N fleet replicas
+    racing one content key keep exactly ONE generation.
+
+    A ``blob://`` path routes the identical payload+footer bytes through
+    the object-store client (faults/blobstore.py): the server rotates
+    ``.prev`` atomically, and the only-rotate-verified-generations rule is
+    enforced client-side exactly like the local branch below — a torn
+    current generation is deleted, never promoted to the fallback."""
     path = normalize_ckpt_path(path)
     buf = io.BytesIO()
     np.savez_compressed(buf, **arrays)
     payload = buf.getvalue()
     crc = zlib.crc32(payload) & 0xFFFFFFFF
+    if is_blob_uri(path):
+        return _blob_savez(
+            path, payload + _FOOTER.pack(MAGIC, len(payload), crc),
+            keep_prev=keep_prev, if_absent=if_absent,
+        )
+    if if_absent and latest_generation(path) is not None:
+        return None
     # Process-unique tmp name: two PROCESSES may write the same path
     # concurrently (a fleet router re-sealing a generation while the
     # zombie writer it just fenced is still mid-write through an open
@@ -159,6 +200,73 @@ def atomic_savez(path: str, arrays: dict, keep_prev: bool = True) -> str:
     return path
 
 
+def _corrupt_payload(data: bytes, seed: int) -> bytes:
+    """The blob twin of `_corrupt_file`: deterministically tear an
+    in-memory payload (truncate to half on even seeds, flip a byte on odd
+    seeds) before it is uploaded — both must be caught by the CRC check
+    and absorbed by the `.prev` fallback."""
+    if seed % 2 == 0:
+        return data[: max(len(data) // 2, 1)]
+    pos = max((len(data) - _FOOTER.size) // 2, 0)
+    return data[:pos] + bytes([data[pos] ^ 0xFF]) + data[pos + 1:]
+
+
+def _blob_savez(
+    path: str, data: bytes, keep_prev: bool = True, if_absent: bool = False
+) -> Optional[str]:
+    """One checkpoint generation onto the blob backend. Mirrors the local
+    branch's invariants: only a VERIFIED current generation may rotate
+    into ``.prev`` (a torn one is deleted instead — rotating it would
+    evict the last good generation), a generation this process itself
+    wrote intact is trusted without a round trip, and a consumed
+    ``ckpt.write`` torn fault corrupts the uploaded payload (on top of
+    the transport-level ``blob.put`` torn point the client consumes)."""
+    torn = False
+    plan = active_plan()
+    if plan is not None and plan.consume_corruption("ckpt.write"):
+        data = _corrupt_payload(data, plan.seed)
+        torn = True
+    rotate = keep_prev
+    if path not in _WRITTEN_INTACT and (keep_prev or if_absent):
+        # One verified probe of the current generation (paid at most once
+        # per path per process — _WRITTEN_INTACT carries the verdict for
+        # every later write). It serves two invariants: (a) only a
+        # VERIFIED generation may rotate into `.prev` (a torn one is
+        # deleted instead — rotating it would evict the last good
+        # fallback), and (b) a conditional (`if_absent`) write must treat
+        # a TORN current generation as ABSENT: the server's If-None-Match
+        # keys on bare existence, so without the delete a single torn
+        # first publish would 412-skip every repair attempt forever —
+        # the local backend self-heals by overwriting, and the blob
+        # backend must match it (backend invariance).
+        try:
+            data_cur = read_verified(path)
+            del data_cur
+            if if_absent:
+                return None  # intact generation exists: skip, no round trip
+        except FileNotFoundError:
+            pass  # nothing to rotate; rotate flag is harmless
+        except CheckpointCorrupt:
+            try:
+                delete_blob(path)
+            except OSError:
+                pass  # unreachable store: rotation best-effort
+        except OSError:
+            pass  # unreachable store: rotation/conditional best-effort
+    gen = put_blob(path, data, rotate=rotate, if_absent=if_absent)
+    if gen is None:
+        return None  # conditional put lost the race: entry already exists
+    if torn or gen < 0:
+        # A negated generation is the client saying the UPLOAD was torn
+        # (the transport-level blob.put tear): the path must not be
+        # trusted for rotation, and the next conditional write must be
+        # allowed to probe-and-repair it.
+        _WRITTEN_INTACT.discard(path)
+    else:
+        _WRITTEN_INTACT.add(path)
+    return path
+
+
 def _flip_byte_at(path: str, pos: int) -> None:
     """XOR one byte of `path` in place (shared by the chaos plane's torn
     write and the deliberate test probe)."""
@@ -192,11 +300,15 @@ def corrupt_one_byte(path: str, frac: float = 0.33) -> None:
 
 
 def read_verified(path: str):
-    """Load one checkpoint file, verifying the CRC footer when present.
-    Returns an `NpzFile`-alike; raises `CheckpointCorrupt` on any torn /
-    flipped / truncated content, `FileNotFoundError` when absent."""
-    with open(path, "rb") as f:
-        data = f.read()
+    """Load one checkpoint file (or blob), verifying the CRC footer when
+    present. Returns an `NpzFile`-alike; raises `CheckpointCorrupt` on any
+    torn / flipped / truncated content, `FileNotFoundError` when absent
+    (both backends — a blob 404 IS a missing file)."""
+    if is_blob_uri(path):
+        data = get_blob(path)
+    else:
+        with open(path, "rb") as f:
+            data = f.read()
     payload = data
     if len(data) >= _FOOTER.size:
         magic, length, crc = _FOOTER.unpack(data[-_FOOTER.size:])
@@ -219,17 +331,21 @@ def read_verified(path: str):
 def load_latest(path: str):
     """Load the newest intact generation of `path`: the file itself, else
     ``path + ".prev"``. Returns ``(npz, served_path)``; raises
-    `CheckpointCorrupt` naming every candidate only when none verifies."""
+    `CheckpointCorrupt` naming every candidate only when none verifies.
+    Backend-agnostic: a blob 404 reads as missing, a blob-store outage
+    (retry exhaustion) reads as unavailable — both fall to the next
+    candidate, so callers keep their one degrade path."""
     path = normalize_ckpt_path(path)
     tried: list[str] = []
     for p in (path, path + ".prev"):
-        if not os.path.exists(p):
-            tried.append(f"{p} (missing)")
-            continue
         try:
             return read_verified(p), p
+        except FileNotFoundError:
+            tried.append(f"{p} (missing)")
         except CheckpointCorrupt as e:
             tried.append(str(e))
+        except OSError as e:
+            tried.append(f"{p} (unavailable: {type(e).__name__}: {e})")
     raise CheckpointCorrupt(
         "no intact checkpoint generation: " + "; ".join(tried)
     )
@@ -255,8 +371,12 @@ def lease_stamp(data) -> Optional[tuple]:
 
 
 def fenced_savez(
-    path: str, arrays: dict, lease=None, keep_prev: bool = True
-) -> str:
+    path: str,
+    arrays: dict,
+    lease=None,
+    keep_prev: bool = True,
+    if_absent: bool = False,
+) -> Optional[str]:
     """`atomic_savez` behind the epoch-fence: with a `lease` (any object
     exposing `.member`, `.epoch`, and a `.check()` that raises once the
     lease is revoked — service/lease.py `Lease`), the write re-validates
@@ -282,7 +402,9 @@ def fenced_savez(
             [str(lease.member)], dtype=np.str_
         )
         arrays["lease_epoch"] = np.asarray([int(lease.epoch)], np.int64)
-    return atomic_savez(path, arrays, keep_prev=keep_prev)
+    return atomic_savez(
+        path, arrays, keep_prev=keep_prev, if_absent=if_absent
+    )
 
 
 def fenced_load_latest(path: str, validator=None, on_reject=None):
@@ -299,13 +421,16 @@ def fenced_load_latest(path: str, validator=None, on_reject=None):
         return load_latest(path)
     tried: list[str] = []
     for p in (path, path + ".prev"):
-        if not os.path.exists(p):
-            tried.append(f"{p} (missing)")
-            continue
         try:
             data = read_verified(p)
+        except FileNotFoundError:
+            tried.append(f"{p} (missing)")
+            continue
         except CheckpointCorrupt as e:
             tried.append(str(e))
+            continue
+        except OSError as e:
+            tried.append(f"{p} (unavailable: {type(e).__name__}: {e})")
             continue
         stamp = lease_stamp(data)
         if stamp is not None and not validator(*stamp):
@@ -323,13 +448,90 @@ def fenced_load_latest(path: str, validator=None, on_reject=None):
 
 def latest_generation(path: str) -> Optional[str]:
     """The path `load_latest` would serve, or None — a cheap existence
-    probe for supervisors deciding between restore and fresh restart."""
+    probe for supervisors deciding between restore and fresh restart
+    (both backends; a blob-store outage probes as None, i.e. fresh)."""
     path = normalize_ckpt_path(path)
     for p in (path, path + ".prev"):
-        if os.path.exists(p):
-            try:
-                read_verified(p)
-                return p
-            except CheckpointCorrupt:
-                continue
+        if not is_blob_uri(p) and not os.path.exists(p):
+            continue
+        try:
+            read_verified(p)
+            return p
+        except (CheckpointCorrupt, OSError):
+            continue
     return None
+
+
+def any_generation(path: str) -> bool:
+    """True iff ANY generation candidate exists at `path` (intact or not)
+    — the miss-vs-corrupt distinction `CorpusStore.lookup` accounts on,
+    without paying a full verified read on the local backend."""
+    path = normalize_ckpt_path(path)
+    if not is_blob_uri(path):
+        return os.path.exists(path) or os.path.exists(path + ".prev")
+    from .blobstore import blob_exists
+
+    return blob_exists(path) or blob_exists(path + ".prev")
+
+
+#: Shared record-footer layout for non-npz CRC'd records (lease files,
+#: member-discovery records): payload + (magic, length, CRC32) — the same
+#: torn-write detection as checkpoint generations, magic per record kind.
+RECORD_FOOTER = _FOOTER
+
+
+def write_record(path: str, payload: bytes, magic: bytes) -> None:
+    """Crash-atomic small-record write, backend-agnostic: payload + CRC
+    footer staged through tmp/fsync/rename with unconditional ``.prev``
+    rotation on the filesystem, one rotating PUT on the blob backend.
+    THE sanctioned write seam for every CRC'd non-npz record (the lease
+    store's records, member-discovery records) — srlint SR002 pins raw
+    record writes to this module for the same reason it pins npz ones."""
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    data = payload + _FOOTER.pack(magic, len(payload), crc)
+    if is_blob_uri(path):
+        put_blob(path, data, rotate=True)
+        return
+    # The LocalFS backend IS the tmp/fsync/rename + `.prev` rotation
+    # discipline — one spelling, not three (atomic_savez keeps its own
+    # local branch only for the verified-rotation/_WRITTEN_INTACT rules
+    # records don't need).
+    from .blobstore import LocalFSBlobStore
+
+    d, name = os.path.split(os.path.abspath(path))
+    LocalFSBlobStore(d).put(name, data, rotate=True)
+
+
+def read_record_latest(path: str, magic: bytes) -> tuple:
+    """`(payload, any_candidate)` for the newest intact record at `path`
+    (``.prev`` fallback included): payload is None when no candidate
+    verifies, `any_candidate` says whether anything existed at all (the
+    fail-safe distinction the lease store's none-vs-unreadable states
+    ride on — an unreachable blob store reads as unreadable, so fencing
+    fails SAFE during an outage)."""
+    any_candidate = False
+    for p in (path, path + ".prev"):
+        try:
+            if is_blob_uri(p):
+                data = get_blob(p)
+            else:
+                with open(p, "rb") as f:
+                    data = f.read()
+        except FileNotFoundError:
+            continue
+        except OSError:
+            any_candidate = True  # present-but-unreachable: fail safe
+            continue
+        any_candidate = True
+        if len(data) < _FOOTER.size:
+            continue
+        m, length, crc = _FOOTER.unpack(data[-_FOOTER.size:])
+        payload = data[: -_FOOTER.size]
+        if (
+            m != magic
+            or length != len(payload)
+            or (zlib.crc32(payload) & 0xFFFFFFFF) != crc
+        ):
+            continue
+        return payload, True
+    return None, any_candidate

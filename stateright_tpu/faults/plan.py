@@ -80,6 +80,42 @@ Injection points (the name is the contract; grep for `maybe_fault(`):
                         the lease granted and the router's death handling
                         must re-run the revocation on its next tick
                         (revoke-before-requeue stays atomic per member)
+- ``blob.put``        — object-store write (faults/blobstore.py HTTP
+                        backend, ctx ``name=<key>``): raising kinds
+                        (``http``/``io`` — injected 429/5xx/transport
+                        failures) are absorbed by the client's bounded
+                        deterministic-backoff retry; the ``torn`` kind is
+                        CONSUMED (`consume_special`) and truncates the
+                        uploaded payload — a partial PUT the read-side CRC
+                        footer must reject (`.prev` serves, exactly like
+                        the r13 torn generation); the ``slow`` kind is
+                        consumed as injected latency
+- ``blob.get``        — object-store read: raising kinds retried under
+                        the per-op deadline; exhaustion degrades to the
+                        caller's missing/corrupt path (resume-fresh, cold
+                        corpus run — counted, never wrong)
+- ``blob.list``       — object-store listing (corpus GC, journal-root
+                        discovery): raising kinds retried; the ``stale``
+                        kind is consumed and serves the PREVIOUS listing
+                        (an eventually-consistent store's stale LIST) —
+                        consumers must degrade to a bigger directory /
+                        shorter merge, never a wrong result
+- ``blob.delete``     — object-store deletion (GC sweeps, record
+                        retirement): raising kinds retried; exhaustion
+                        degrades to a skipped eviction (bigger directory,
+                        never a wrong one) — its own point so delete
+                        traffic never shifts ``blob.put`` hit numbering
+                        in a replayed plan
+- ``fleet.rejoin``    — replica rejoin entry (service/fleet.py
+                        ServiceFleet.rejoin_replica, ctx ``replica=i``),
+                        BEFORE the fresh lease grant and the respawn — an
+                        injected fault aborts the rejoin with nothing
+                        changed (not even a burned epoch; the member
+                        stays dead and the caller simply retries), and
+                        the rejoin-vs-stale-zombie race it covers is
+                        fence-rejected: the restarted member holds a
+                        FRESH epoch, so the old incarnation's writes
+                        fail the exact-epoch check
 
 Determinism: every decision is a pure function of (plan seed, per-point hit
 counter, rule spec) — no RNG state, no wall clock — so a failing chaos run
@@ -161,13 +197,36 @@ KINDS = {
     "crash": ReplicaCrash,
 }
 
-_SPECIAL_KINDS = ("hang", "torn", "bypass")
+#: Kinds consumed by the boundary itself instead of raised: ``hang`` parks
+#: on the cancel gate, ``torn`` corrupts a just-written payload, ``bypass``
+#: skips a guard, ``stale`` serves a previous listing, ``slow`` injects
+#: latency (see `consume_special`).
+_SPECIAL_KINDS = ("hang", "torn", "bypass", "stale", "slow")
 
 
 def _u01(seed: int, point: str, hit: int) -> float:
     """Deterministic uniform in [0, 1): crc32 of (seed, point, hit)."""
     h = zlib.crc32(f"{seed}:{point}:{hit}".encode()) & 0xFFFFFFFF
     return h / 2**32
+
+
+def deterministic_backoff(
+    seed: int,
+    point: str,
+    attempt: int,
+    base_s: float,
+    cap_s: float,
+    factor: float = 2.0,
+) -> float:
+    """THE one spelling of the repo's seeded exponential backoff delay
+    (supervisor retry slices, router submit retries, blob-store op
+    retries): `min(base * factor^attempt, cap)` scaled by a deterministic
+    jitter in [0.5, 1.5) derived from `(seed, point, attempt)` — replayable
+    run to run, never synchronized across differently-seeded actors."""
+    if base_s <= 0:
+        return 0.0
+    delay = min(base_s * factor ** attempt, cap_s)
+    return delay * (0.5 + _u01(seed, point, attempt))
 
 
 @dataclass
@@ -344,7 +403,7 @@ service.step:poison:job=3:times=-1"
                     r
                     for r in self.rules
                     if r.point == point
-                    and r.kind not in ("torn", "bypass")
+                    and (r.kind in KINDS or r.kind == "hang")
                     and r.wants(self.seed, hit, ctx)
                 ),
                 None,
@@ -364,38 +423,37 @@ service.step:poison:job=3:times=-1"
             + ")"
         )
 
-    def consume_corruption(self, point: str = "ckpt.write") -> bool:
-        """True iff a ``torn`` rule fires for this write — the caller (the
-        atomic checkpoint writer) then corrupts the file it just wrote,
-        simulating a torn write that the CRC footer must catch on load."""
+    def consume_special(self, point: str, kind: str) -> bool:
+        """True iff a rule of consumed `kind` fires for this hit — the
+        caller then acts the fault out itself instead of raising: ``torn``
+        corrupts a just-written payload, ``bypass`` skips a guard,
+        ``stale`` serves a previous listing, ``slow`` injects latency.
+        Each consumption counts its own hit of `point` (one boundary, one
+        counter) and is recorded like any injection."""
         with self._lock:
             hit = self.hits.get(point, 0) + 1
             self.hits[point] = hit
             for r in self.rules:
-                if r.point == point and r.kind == "torn" and r.wants(
+                if r.point == point and r.kind == kind and r.wants(
                     self.seed, hit, {}
                 ):
                     r.fired += 1
-                    self._record(point, "torn")
+                    self._record(point, kind)
                     return True
         return False
+
+    def consume_corruption(self, point: str = "ckpt.write") -> bool:
+        """True iff a ``torn`` rule fires for this write — the caller (the
+        atomic checkpoint writer) then corrupts the file it just wrote,
+        simulating a torn write that the CRC footer must catch on load."""
+        return self.consume_special(point, "torn")
 
     def consume_bypass(self, point: str) -> bool:
         """True iff a ``bypass`` rule fires for this hit — the caller then
         SKIPS a guard instead of raising (the `fleet.zombie_write` shape:
         `ckptio.fenced_savez` omits its pre-write lease check, simulating a
         write already past the check when the revocation landed)."""
-        with self._lock:
-            hit = self.hits.get(point, 0) + 1
-            self.hits[point] = hit
-            for r in self.rules:
-                if r.point == point and r.kind == "bypass" and r.wants(
-                    self.seed, hit, {}
-                ):
-                    r.fired += 1
-                    self._record(point, "bypass")
-                    return True
-        return False
+        return self.consume_special(point, "bypass")
 
     def _hang(self, point: str) -> None:
         """The hang gate: block until the watchdog cancels us (or the

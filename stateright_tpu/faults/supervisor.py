@@ -48,7 +48,13 @@ from typing import Optional
 from ..core.discovery import HasDiscoveries
 from ..obs import REGISTRY, as_tracer
 from .ckptio import CheckpointCorrupt, latest_generation
-from .plan import FaultError, FaultPlan, WatchdogTimeout, active, _u01
+from .plan import (
+    FaultError,
+    FaultPlan,
+    WatchdogTimeout,
+    active,
+    deterministic_backoff,
+)
 
 ENGINES = ("frontier", "resident", "sharded")
 
@@ -320,13 +326,15 @@ class Supervisor:
         return None
 
     def _backoff(self, attempt: int) -> None:
-        base = self.cfg.backoff_base_s
-        if base <= 0:
-            return
-        delay = min(
-            base * self.cfg.backoff_factor ** attempt, self.cfg.backoff_cap_s
+        # The ONE seeded backoff spelling (faults/plan.py), shared with
+        # the fleet router's submit retries and the blob-store client.
+        delay = deterministic_backoff(
+            self.cfg.seed, "backoff", attempt,
+            self.cfg.backoff_base_s, self.cfg.backoff_cap_s,
+            factor=self.cfg.backoff_factor,
         )
-        delay *= 0.5 + _u01(self.cfg.seed, "backoff", attempt)
+        if delay <= 0:
+            return
         self.counters["backoff_ms"] += int(delay * 1000)
         time.sleep(delay)
 

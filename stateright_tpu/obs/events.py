@@ -74,12 +74,30 @@ class EventJournal:
         flush_interval_s: float = 0.5,
         ring: int = 4096,
         fsync: bool = False,
+        sync_uri: Optional[str] = None,
     ):
         self.path = path
         self.writer = writer if writer is not None else f"pid{os.getpid()}"
         self.flush_every = max(int(flush_every), 1)
         self.flush_interval_s = flush_interval_s
         self.fsync = fsync
+        # Blob-root journal sync (the multi-host flight recorder): the
+        # journal stays LOCAL-write (an emit must never pay a full
+        # network round trip), and the whole file is mirrored to
+        # `sync_uri` on a throttled cadence (`sync_interval_s` — the
+        # whole-file PUT would otherwise make cumulative sync bytes
+        # quadratic in journal length) and UNCONDITIONALLY at explicit
+        # flush()/close() (the crash-durability calls: Replica._die,
+        # SIGTERM drain). Mirror puts run under a short per-op deadline
+        # so a store outage stalls an emit by at most ~2 s, not the full
+        # retry budget. A crash loses at most one sync window blob-side,
+        # and a reader may observe a mid-line tail — exactly the
+        # torn-tail discipline `read_journal` already applies.
+        self.sync_uri = sync_uri
+        self.sync_interval_s = 2.0
+        self.sync_deadline_s = 2.0
+        self.sync_errors = 0
+        self._last_sync = 0.0
         self.write_errors = 0  # I/O failures absorbed (recording must not kill)
         self._f = open(path, "a") if path is not None else None
         self._lock = threading.Lock()
@@ -175,6 +193,10 @@ class EventJournal:
             self._f.flush()
             if self.fsync:
                 os.fsync(self._f.fileno())
+            if self.sync_uri is not None and (
+                time.monotonic() - self._last_sync >= self.sync_interval_s
+            ):
+                self._sync_blob()
         except (OSError, ValueError, AttributeError):
             # Recording must never kill the host component; the loss is
             # visible as a counter instead. (AttributeError: a close()
@@ -183,15 +205,47 @@ class EventJournal:
         finally:
             self._io_lock.release()
 
+    def _sync_blob(self) -> None:
+        """Mirror the whole local journal file to the blob root (called
+        under _io_lock, so batches can't interleave a sync). Sync
+        failures are counted, never raised — a store outage costs
+        blob-side freshness, not the local journal."""
+        self._last_sync = time.monotonic()
+        try:
+            from ..faults.blobstore import put_blob
+
+            with open(self.path, "rb") as f:
+                data = f.read()
+            # chaos=False: an injected blob.put fault would be RECORDED as
+            # a fault.injected event into the very journal whose sync is
+            # mid-flight (the plan adopts this journal) — re-entering the
+            # journal and plan locks. The mirror is best-effort anyway;
+            # real transport failures are still retried and counted —
+            # under the SHORT sync deadline, so an outage can't park the
+            # emitting thread for the full retry budget.
+            put_blob(self.sync_uri, data, rotate=False, chaos=False, deadline_s=self.sync_deadline_s)  # srlint: ckpt-ok append-only JSONL journal mirror; torn/stale tails are the reader's documented discipline
+        except OSError:
+            self.sync_errors += 1
+
+    def _force_sync(self) -> None:
+        """The unconditional mirror (explicit flush/close — the crash-
+        durability moments): runs under _io_lock like any batch write."""
+        if self.sync_uri is None or self.path is None:
+            return
+        with self._io_lock:
+            self._sync_blob()
+
     def flush(self) -> None:
         with self._lock:
             batch = self._take_batch_locked()
         self._write_batch(batch)
+        self._force_sync()
 
     def close(self) -> None:
         with self._lock:
             batch = self._take_batch_locked()
         self._write_batch(batch)
+        self._force_sync()
         with self._lock:
             self._closed = True
             if self._f is not None:
@@ -283,15 +337,22 @@ def as_events(events) -> "EventJournal | _NullEvents":
 
 
 def read_journal(path: str) -> list:
-    """Every intact event in one journal file, in file order. The torn-tail
-    discipline: an append-only JSONL writer can only tear the FINAL line
-    (a crash mid-append), so an unparseable or truncated line is skipped —
-    this reader NEVER raises on journal content, and a missing or empty
-    file is just an empty journal. Non-final garbage lines are skipped the
-    same way (a forensic reader takes what it can prove)."""
+    """Every intact event in one journal file (or ``blob://`` object), in
+    file order. The torn-tail discipline: an append-only JSONL writer can
+    only tear the FINAL line (a crash mid-append — or a blob mirror
+    snapshotted mid-window, the stale-tail twin), so an unparseable or
+    truncated line is skipped — this reader NEVER raises on journal
+    content, and a missing/unreachable file is just an empty journal.
+    Non-final garbage lines are skipped the same way (a forensic reader
+    takes what it can prove)."""
     try:
-        with open(path, "r") as f:
-            data = f.read()
+        if path.startswith("blob://"):
+            from ..faults.blobstore import get_blob
+
+            data = get_blob(path).decode("utf-8", errors="replace")
+        else:
+            with open(path, "r") as f:
+                data = f.read()
     except OSError:
         return []
     events = []

@@ -142,6 +142,8 @@ REGISTRY_SOURCES = {
     "lease": "epoch-fenced checkpoint leases (service/lease.py)",
     "simulation": "device random-simulation engine (tensor/simulation.py — "
                   "walks, restarts, shared-table dedup hits)",
+    "blob": "object-store backend client (faults/blobstore.py — ops, "
+            "retries, backoff, torn puts, stale lists, unavailability)",
 }
 
 
@@ -166,6 +168,11 @@ FLEET_COUNTER_KEYS = {
     "steals": "queued jobs pulled to an idle replica (work stealing)",
     "probe_skipped": "health probes deferred by the per-replica "
                      "exponential probe backoff (failing members)",
+    "rejoins": "dead/fenced members re-admitted into probation with a "
+               "fresh lease epoch (replica REJOIN)",
+    "rejoin_promotions": "rejoined members that passed their probation "
+                         "probes and re-entered the ring (only their own "
+                         "keys move back)",
     "lease_revokes": "ring-member leases revoked before requeueing "
                      "(0 on a lease-less fleet)",
     "lease_reseals": "orphan checkpoint generations re-sealed under the "
@@ -207,6 +214,7 @@ EVENT_TYPES = {
     "router.probe": ("replica", "ok"),     # health-probe FAILURE accounting
     "router.unavailable": ("reason",),     # 503 surface (no healthy replica)
     "replica.crash": ("replica",),         # declared dead, removed from ring
+    "replica.rejoin": ("replica", "phase"),  # probation entered / ring re-add
     "fleet.steal": ("job", "src", "dst"),  # queued job pulled to idle replica
     # engine / durability plane
     "engine.chunk": ("jobs",),       # one fused service step (jobs: id list)

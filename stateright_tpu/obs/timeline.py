@@ -1,6 +1,6 @@
 """Forensic timeline CLI over flight-recorder journals.
 
-    python -m stateright_tpu.obs.timeline <journal.jsonl | dir> ... \
+    python -m stateright_tpu.obs.timeline <journal.jsonl | dir | blob://...> \
         [--gap-s 30] [--traces t1.json t2.json] [--chrome-out merged.json] \
         [--trace TRACE_ID] [--json]
 
@@ -53,11 +53,33 @@ WAIT_EVENTS = ("job.submitted", "job.requeued")
 
 
 def expand_paths(paths) -> list:
-    """Journal files from a mix of file and directory arguments (a
-    directory contributes its *.jsonl members, sorted)."""
+    """Journal files from a mix of file, directory, and ``blob://``
+    arguments (a directory — local or a blob-root prefix — contributes
+    its *.jsonl members, sorted). A blob root is listed through the
+    backend seam, so the forensic pass runs against the fleet's shared
+    store root directly: ``python -m stateright_tpu.obs.timeline
+    blob://host:port/journal``. Journals are blob-synced at flush
+    boundaries, so a blob listing may trail the local truth by one flush
+    window — the reader's torn-tail discipline covers the ragged edge."""
     out: list = []
     for p in paths:
-        if os.path.isdir(p):
+        if isinstance(p, str) and p.startswith("blob://"):
+            if p.endswith(".jsonl"):
+                out.append(p)
+                continue
+            from ..faults.blobstore import blob_backend
+
+            root = p.rstrip("/")
+            try:
+                stats = blob_backend(root).list("")
+            except OSError:
+                stats = []
+            out.extend(
+                f"{root}/{st.name}"
+                for st in sorted(stats)
+                if st.name.endswith(".jsonl")
+            )
+        elif os.path.isdir(p):
             out.extend(
                 os.path.join(p, n)
                 for n in sorted(os.listdir(p))
@@ -98,11 +120,17 @@ def fence_events(events) -> tuple:
             continue
         if name in LEASE_GATED_EVENTS:
             w = str(e.get("writer"))
+            # A rejoined member's incarnation writes under
+            # "<member>@e<epoch>" (distinct journal stream so per-writer
+            # seq order survives the restart); the fence matches on the
+            # member name either way — the EPOCH comparison is what tells
+            # a fenced old incarnation from its validly-rejoined successor.
+            member = w.partition("@")[0]
             ep = e.get("epoch")
             if (
-                w in revoked
+                member in revoked
                 and isinstance(ep, int)
-                and ep <= revoked[w]
+                and ep <= revoked[member]
             ):
                 rejected.append(e)
                 continue
@@ -344,7 +372,8 @@ def main(argv=None) -> int:
                     "journals; flag anomalies; merge Chrome traces.",
     )
     ap.add_argument("journals", nargs="*",
-                    help="journal .jsonl files or directories of them")
+                    help="journal .jsonl files, directories of them, or "
+                    "blob:// roots (journals synced at flush boundaries)")
     ap.add_argument("--gap-s", type=float, default=30.0,
                     help="admission-gap anomaly budget, seconds (the "
                     "watchdog discipline; default 30)")

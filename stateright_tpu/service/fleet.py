@@ -34,8 +34,9 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..faults.blobstore import is_blob_uri, normalize_root
 from ..faults.ckptio import fenced_savez
-from ..faults.plan import maybe_fault
+from ..faults.plan import FaultError, maybe_fault
 from ..obs import EventJournal, as_events, as_tracer
 from .api import CheckService
 from .lease import (
@@ -366,13 +367,30 @@ class ServiceFleet:
         `RemoteReplica` HTTP stubs behind the same router. The lease plane
         and the flight recorder are always on in remote mode — they are
         what makes cross-process death declarations sound. Requires
-        `background=True` (subprocesses cannot be foreground-pumped)."""
+        `background=True` (subprocesses cannot be foreground-pumped).
+
+        `store_root` (and every *_dir) may be a ``blob://host:port[/pfx]``
+        URI (faults/blobstore.py): checkpoint generations, lease records,
+        corpus entries, and member-discovery records then live in the
+        object store — the TRUE multi-host root, where the root URI is
+        the only configuration replicas share. Journals stay local-write
+        (a scratch directory) and are blob-synced at flush boundaries;
+        replica addresses are discovered from ``members/`` records in the
+        root (service/discovery.py) instead of hand-wired port files."""
         if n_replicas < 1:
             raise ValueError("a fleet needs at least one replica")
         self._tracer = as_tracer(tracer)
+        self._tracer_raw = tracer
         self._tmpdir = None
+        self._scratch_tmp = None
         self.remote = bool(remote)
+        store_root = normalize_root(store_root)
+        ckpt_dir = normalize_root(ckpt_dir)
+        journal_dir = normalize_root(journal_dir)
+        lease_dir = normalize_root(lease_dir)
+        corpus_dir = normalize_root(corpus_dir)
         self.store_root = store_root
+        self.scratch_dir: Optional[str] = None
         if remote:
             if not background:
                 raise ValueError(
@@ -384,7 +402,17 @@ class ServiceFleet:
                     prefix="srtpu-fleet-root-"
                 )
                 self.store_root = store_root = self._tmpdir.name
-            os.makedirs(store_root, exist_ok=True)
+            if is_blob_uri(store_root):
+                # A blob root holds the shared durable state; local-write
+                # surfaces (journals, child logs) need a scratch directory
+                # on THIS host, synced/irrelevant-to the blob root.
+                self._scratch_tmp = tempfile.TemporaryDirectory(
+                    prefix="srtpu-fleet-scratch-"
+                )
+                self.scratch_dir = self._scratch_tmp.name
+            else:
+                os.makedirs(store_root, exist_ok=True)
+                self.scratch_dir = store_root
             ckpt_dir = ckpt_dir or os.path.join(store_root, "ckpt")
             journal_dir = journal_dir or os.path.join(store_root, "journal")
             lease_dir = lease_dir or os.path.join(store_root, "leases")
@@ -393,14 +421,15 @@ class ServiceFleet:
         if ckpt_dir is None:
             self._tmpdir = tempfile.TemporaryDirectory(prefix="srtpu-fleet-")
             ckpt_dir = self._tmpdir.name
-        os.makedirs(ckpt_dir, exist_ok=True)
+        if not is_blob_uri(ckpt_dir):
+            os.makedirs(ckpt_dir, exist_ok=True)
         self.journal_dir = journal_dir
         self._journals: list = []
         router_journal = None
         if journal_dir is not None:
-            os.makedirs(journal_dir, exist_ok=True)
+            jpath, jsync = self._journal_path("router.jsonl")
             router_journal = EventJournal(
-                os.path.join(journal_dir, "router.jsonl"), writer="router"
+                jpath, writer="router", sync_uri=jsync
             )
             self._journals.append(router_journal)
         # Lease plane: grants happen HERE, before any replica starts (a
@@ -414,38 +443,24 @@ class ServiceFleet:
         kw = dict(service_kwargs or {})
         kw.setdefault("max_resident", max_resident)
         if corpus_dir is not None:
-            os.makedirs(corpus_dir, exist_ok=True)
+            if not is_blob_uri(corpus_dir):
+                os.makedirs(corpus_dir, exist_ok=True)
             kw["corpus_dir"] = corpus_dir
             kw.setdefault("store", "tiered")
         self.corpus_dir = corpus_dir
         kw["background"] = False  # the Replica driver owns the pumping
-
-        def make_replica(i: int) -> Replica:
-            lease = (
-                self.lease_store.grant(lease_member(i))
-                if self.lease_store is not None else None
-            )
-            journal = None
-            if journal_dir is not None:
-                journal = EventJournal(
-                    os.path.join(journal_dir, f"replica{i}.jsonl"),
-                    writer=lease_member(i),
-                )
-                self._journals.append(journal)
-                if lease is not None:
-                    # Gate terminal/requeue-relevant events behind the
-                    # lease: a fenced-out replica's journal can no longer
-                    # record admissions/verdicts the timeline would trust.
-                    journal = FencedEvents(journal, lease)
-            return Replica(
-                i,
-                lambda: CheckService(events=journal, **kw),
-                ckpt_every_spins=ckpt_every_spins,
-                pump_rounds=pump_rounds,
-                tracer=tracer,
-                events=journal,
-                lease=lease,
-            )
+        self._service_kw = kw
+        self._ckpt_every_spins = ckpt_every_spins
+        self._pump_rounds = pump_rounds
+        self._spawn_timeout_s = spawn_timeout_s
+        self._retired: list = []  # dead incarnations replaced by rejoins
+        self._incarnations: dict = {}  # idx -> rejoin count (lease-less)
+        # Serializes rejoin_replica end-to-end: deadness is monotonic
+        # except through rejoin, so holding this across check+grant+spawn
+        # +rejoin means a lost race can never burn a fresh epoch that
+        # would implicitly fence the WINNING incarnation (grant bumps the
+        # member's epoch, revoking older ones).
+        self._rejoin_lock = threading.Lock()
 
         self._procs: list = []
         if remote:
@@ -456,11 +471,15 @@ class ServiceFleet:
                 for i in range(n_replicas):
                     self.lease_store.grant(lease_member(i))
                     proc, url = spawn_replica_proc(
-                        i, store_root, kw, timeout_s=spawn_timeout_s
+                        i, store_root, kw, timeout_s=spawn_timeout_s,
+                        scratch=self.scratch_dir,
                     )
                     self._procs.append(proc)
                     self.replicas.append(
-                        RemoteReplica(i, url, proc=proc, tracer=tracer)
+                        RemoteReplica(
+                            i, url, proc=proc, tracer=tracer,
+                            store_root=store_root,
+                        )
                     )
             except BaseException:
                 # A mid-boot spawn failure must not leak the replicas that
@@ -471,11 +490,15 @@ class ServiceFleet:
                     j.close()
                 if self.lease_store is not None:
                     self.lease_store.close()
+                if self._scratch_tmp is not None:
+                    self._scratch_tmp.cleanup()
                 if self._tmpdir is not None:
                     self._tmpdir.cleanup()
                 raise
         else:
-            self.replicas = [make_replica(i) for i in range(n_replicas)]
+            self.replicas = [
+                self._make_inproc_replica(i) for i in range(n_replicas)
+            ]
         self.router = FleetRouter(
             self.replicas,
             background=background,
@@ -497,6 +520,146 @@ class ServiceFleet:
                 target=self._supervise, daemon=True
             )
             self._router_thread.start()
+
+    # -- construction helpers --------------------------------------------------
+
+    def _journal_path(self, name: str) -> tuple:
+        """(local write path, blob sync URI or None) for one journal file:
+        journals are always LOCAL-write (an emit must never pay a network
+        round trip); on a blob journal root the local file lives in the
+        scratch directory and mirrors to the root at flush boundaries."""
+        jd = self.journal_dir
+        if not is_blob_uri(jd):
+            os.makedirs(jd, exist_ok=True)
+            return os.path.join(jd, name), None
+        if self.scratch_dir is None:
+            self._scratch_tmp = tempfile.TemporaryDirectory(
+                prefix="srtpu-fleet-scratch-"
+            )
+            self.scratch_dir = self._scratch_tmp.name
+        local_dir = os.path.join(self.scratch_dir, "journal")
+        os.makedirs(local_dir, exist_ok=True)
+        return os.path.join(local_dir, name), os.path.join(jd, name)
+
+    def _make_inproc_replica(self, i: int, rejoin: bool = False) -> Replica:
+        """One in-proc Replica driver (fresh service, fresh lease epoch).
+        A REJOINED incarnation journals to its own file under the writer
+        name ``replica<i>@e<epoch>``: per-writer seq order stays monotonic
+        across the restart (the merge contract), and the timeline fence
+        tells the fenced old incarnation from this one by epoch."""
+        member = lease_member(i)
+        lease = (
+            self.lease_store.grant(member)
+            if self.lease_store is not None else None
+        )
+        writer, fname = member, f"replica{i}.jsonl"
+        if rejoin:
+            n = (
+                lease.epoch if lease is not None
+                else self._incarnations.get(i, 1) + 1
+            )
+            self._incarnations[i] = n
+            writer, fname = f"{member}@e{n}", f"replica{i}.e{n}.jsonl"
+        journal = None
+        if self.journal_dir is not None:
+            path, sync = self._journal_path(fname)
+            journal = EventJournal(path, writer=writer, sync_uri=sync)
+            self._journals.append(journal)
+            if lease is not None:
+                # Gate terminal/requeue-relevant events behind the
+                # lease: a fenced-out replica's journal can no longer
+                # record admissions/verdicts the timeline would trust.
+                journal = FencedEvents(journal, lease)
+        return Replica(
+            i,
+            lambda: CheckService(events=journal, **self._service_kw),
+            ckpt_every_spins=self._ckpt_every_spins,
+            pump_rounds=self._pump_rounds,
+            tracer=self._tracer_raw,
+            events=journal,
+            lease=lease,
+        )
+
+    # -- replica rejoin --------------------------------------------------------
+
+    def rejoin_replica(self, idx: int) -> bool:
+        """Re-admit a dead/fenced member as a FRESH incarnation (ROADMAP
+        item 1's rejoin residue): grant it a fresh lease epoch, rebuild
+        the driver (in-proc) or respawn the subprocess (remote — it
+        re-publishes its member-discovery record, so the router learns
+        the new address from the store root alone), and hand it to
+        `FleetRouter.rejoin`, which quarantines it behind probation
+        probes before moving its keys back. Returns False when the member
+        is still alive, or when the ``fleet.rejoin`` chaos point aborted
+        the rejoin (the fresh incarnation is torn down; retry later).
+
+        The fresh epoch is what makes a rejoin racing its own stale
+        zombie safe: the moment the grant lands, the old incarnation's
+        epoch fails the exact-epoch check on every fenced write/read —
+        the zombie refuses itself, the rejoined member proceeds.
+
+        Serialized (`_rejoin_lock`): two concurrent rejoins of one member
+        must not both grant — the second grant would implicitly revoke
+        the first incarnation's epoch and silently fence the winner."""
+        with self._rejoin_lock:
+            return self._rejoin_replica_locked(idx)
+
+    def _rejoin_replica_locked(self, idx: int) -> bool:
+        old = self.replicas[idx]
+        if idx not in self.router._dead:
+            # The ROUTER's verdict is the one that matters: only a
+            # declared-dead member may rejoin (the old PROCESS may well
+            # still be alive — the zombie case; its stale epoch is what
+            # the fresh grant fences). A racing rejoin that already won
+            # also lands here — and critically, nothing is GRANTED for a
+            # member the router still considers a member.
+            return False
+        try:
+            # Chaos boundary: BEFORE the grant and the spawn, so an
+            # injected fault aborts the rejoin with nothing changed —
+            # not even a burned lease epoch.
+            maybe_fault("fleet.rejoin", replica=idx)
+        except FaultError:
+            return False
+        member = lease_member(idx)
+        proc = None
+        if self.remote:
+            from .remote import RemoteReplica, spawn_replica_proc
+
+            lease = self.lease_store.grant(member)
+            proc, url = spawn_replica_proc(
+                idx, self.store_root, self._service_kw,
+                timeout_s=self._spawn_timeout_s,
+                scratch=self.scratch_dir,
+                incarnation=lease.epoch,
+            )
+            new = RemoteReplica(
+                idx, url, proc=proc, tracer=self._tracer_raw,
+                store_root=self.store_root,
+            )
+        else:
+            new = self._make_inproc_replica(idx, rejoin=True)
+        if not self.router.rejoin(new):
+            # Injected fleet.rejoin fault (or a racing recovery): tear the
+            # fresh incarnation down — the member stays dead, nothing
+            # leaks, and the caller retries on its own cadence.
+            if proc is not None:
+                self._kill_one(proc)
+            else:
+                new.close()
+            return False
+        self.replicas[idx] = new
+        self._retired.append(old)
+        if proc is not None:
+            self._procs.append(proc)
+        if self.background:
+            new.start()
+        if self.lease_store is not None:
+            epoch, _state = self.lease_store.state(member)
+            self.router._events.emit(
+                "lease.grant", member=member, epoch=epoch
+            )
+        return True
 
     # -- client surface --------------------------------------------------------
 
@@ -539,23 +702,27 @@ class ServiceFleet:
             else:
                 self.pump(4)
 
+    @staticmethod
+    def _kill_one(p) -> None:
+        """SIGTERM first (the child drains + flushes its journal), then
+        the hard kill — teardown must never hang on a wedged child."""
+        try:
+            if p.poll() is None:
+                p.terminate()
+                p.wait(timeout=10.0)
+        except Exception:  # noqa: BLE001 — best-effort teardown
+            pass
+        try:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=5.0)
+        except Exception:  # noqa: BLE001 — best-effort teardown
+            pass
+
     def _kill_procs(self) -> None:
-        """Stop every replica subprocess: SIGTERM first (the child drains
-        + flushes its journal), then the hard kill — teardown must never
-        hang on a wedged child."""
+        """Stop every replica subprocess (rejoined incarnations included)."""
         for p in self._procs:
-            try:
-                if p.poll() is None:
-                    p.terminate()
-                    p.wait(timeout=10.0)
-            except Exception:  # noqa: BLE001 — best-effort teardown
-                pass
-            try:
-                if p.poll() is None:
-                    p.kill()
-                    p.wait(timeout=5.0)
-            except Exception:  # noqa: BLE001 — best-effort teardown
-                pass
+            self._kill_one(p)
 
     def _supervise(self) -> None:
         while not self._stop.is_set():
@@ -570,7 +737,7 @@ class ServiceFleet:
         if self._router_thread is not None:
             self._router_thread.join(timeout=5.0)
             self._router_thread = None
-        for r in self.replicas:
+        for r in list(self.replicas) + self._retired:
             r.close()
         self.router.close()
         self._kill_procs()
@@ -578,6 +745,9 @@ class ServiceFleet:
             j.close()
         if self.lease_store is not None:
             self.lease_store.close()
+        if self._scratch_tmp is not None:
+            self._scratch_tmp.cleanup()
+            self._scratch_tmp = None
         if self._tmpdir is not None:
             self._tmpdir.cleanup()
             self._tmpdir = None

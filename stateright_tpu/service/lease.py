@@ -39,20 +39,23 @@ from __future__ import annotations
 
 import json
 import os
-import struct
 import threading
-import zlib
 from typing import Optional
 
-from ..faults.ckptio import LeaseRevoked, fenced_load_latest
+from ..faults.blobstore import is_blob_uri, normalize_root
+from ..faults.ckptio import (
+    LeaseRevoked,
+    fenced_load_latest,
+    read_record_latest,
+    write_record,
+)
 from ..faults.plan import maybe_fault
 from ..obs import REGISTRY
 from ..obs.schema import LEASE_GATED_EVENTS
 
-#: Lease-file footer: 8-byte magic, u64 payload length, u32 CRC32 — the
-#: ckptio discipline with a lease-specific magic (payload is JSON, not npz).
+#: Lease-record magic for the shared CRC'd record footer
+#: (`ckptio.write_record` / `read_record_latest` — payload is JSON, not npz).
 LEASE_MAGIC = b"SRTPLSE1"
-_FOOTER = struct.Struct("<8sQI")
 
 GRANTED = "granted"
 REVOKED = "revoked"
@@ -110,8 +113,9 @@ class LeaseStore:
     through the obs REGISTRY "lease" source."""
 
     def __init__(self, root: str):
-        self.root = root
-        os.makedirs(root, exist_ok=True)
+        self.root = normalize_root(root)
+        if not is_blob_uri(self.root):
+            os.makedirs(self.root, exist_ok=True)
         self._lock = threading.Lock()
         self.counters = {
             "grants": 0,
@@ -131,32 +135,34 @@ class LeaseStore:
     # -- the router's write side (single authority) ----------------------------
 
     def _write(self, member: str, epoch: int, state: str) -> None:
-        """Crash-atomic lease record write (ckptio discipline: in-memory
-        payload + CRC footer + tmp/fsync/rename, previous record kept at
-        `.prev` so a torn current record falls back instead of bricking
-        every fenced writer)."""
-        path = self.path_for(member)
+        """Crash-atomic lease record write through the ONE record seam
+        (`ckptio.write_record`: in-memory payload + CRC footer +
+        tmp/fsync/rename locally, a rotating conditional-safe PUT on the
+        blob backend — previous record kept at `.prev` either way, so a
+        torn current record falls back instead of bricking every fenced
+        writer).
+
+        VERIFIED after write: a lease transition that did not durably
+        land is a broken fence, not a smaller one — a torn PUT of a
+        REVOKE record would otherwise fall back to the still-granted
+        `.prev` and quietly un-fence the zombie (found by the blob torn-
+        put chaos). A failed verification retries the write (fresh blob
+        generation); persistent failure raises, and the router's death
+        handling aborts wholesale and re-runs next tick — revoke-before-
+        requeue stays atomic."""
         payload = json.dumps(
             {"member": member, "epoch": int(epoch), "state": state}
         ).encode()
-        crc = zlib.crc32(payload) & 0xFFFFFFFF
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:  # srlint: ckpt-ok the lease module IS the sanctioned atomic lease writer (CRC footer + tmp/fsync/rename below)
-            f.write(payload)
-            f.write(_FOOTER.pack(LEASE_MAGIC, len(payload), crc))
-            f.flush()
-            os.fsync(f.fileno())
-        if os.path.exists(path):
-            os.replace(path, path + ".prev")
-        os.replace(tmp, path)
-        try:
-            dfd = os.open(self.root, os.O_RDONLY)
-            try:
-                os.fsync(dfd)
-            finally:
-                os.close(dfd)
-        except OSError:
-            pass
+        path = self.path_for(member)
+        for _attempt in range(3):
+            write_record(path, payload, LEASE_MAGIC)
+            if self._read(member) == (int(epoch), state):
+                return
+        # srlint: fault-ok the chaos boundary is the blob.put/ckpt record seam inside write_record; this raise IS the degrade path it feeds
+        raise OSError(
+            f"lease record for {member!r} failed post-write verification "
+            "(torn writes exhausted retries); the transition is NOT durable"
+        )
 
     def grant(self, member: str) -> Lease:
         """Grant `member` a fresh epoch (old epochs are implicitly revoked:
@@ -192,31 +198,18 @@ class LeaseStore:
     def _read(self, member: str) -> tuple:
         """(epoch, state) for `member`: the newest intact lease record,
         `.prev` fallback included; (0, "none") when the member never held
-        a lease; (0, "unreadable") when every record is torn (fail-safe:
-        validates False)."""
-        path = self.path_for(member)
-        any_file = False
-        for p in (path, path + ".prev"):
-            if not os.path.exists(p):
-                continue
-            any_file = True
+        a lease; (0, "unreadable") when every record is torn — or when
+        the blob store is unreachable (fail-safe: validates False, so a
+        fenced writer refuses during a store outage instead of guessing)."""
+        payload, any_file = read_record_latest(
+            self.path_for(member), LEASE_MAGIC
+        )
+        if payload is not None:
             try:
-                with open(p, "rb") as f:
-                    data = f.read()
-                if len(data) < _FOOTER.size:
-                    continue
-                magic, length, crc = _FOOTER.unpack(data[-_FOOTER.size:])
-                payload = data[: -_FOOTER.size]
-                if (
-                    magic != LEASE_MAGIC
-                    or length != len(payload)
-                    or (zlib.crc32(payload) & 0xFFFFFFFF) != crc
-                ):
-                    continue
                 rec = json.loads(payload)
                 return int(rec["epoch"]), str(rec["state"])
-            except (OSError, ValueError, KeyError):
-                continue
+            except (ValueError, KeyError):
+                any_file = True
         return (0, "unreadable") if any_file else (0, "none")
 
     def state(self, member: str) -> tuple:

@@ -6,13 +6,24 @@ like an RPC surface; this module backs it with a real one. A
 `replica_main` subprocess (one `Replica` driver over one CheckService,
 served by `serve_replica`) and mirrors each submitted job's completion
 state locally so the router's harvest/steal logic works unchanged. All
-replicas share one on-disk store root:
+replicas share one store root — a local/NFS directory OR a
+``blob://host:port`` object store (faults/blobstore.py):
 
     <root>/ckpt/     per-job checkpoint generations (faults/ckptio.py)
     <root>/leases/   the epoch-fence lease records (service/lease.py)
-    <root>/journal/  per-writer flight-recorder journals (obs/events.py)
-    <root>/logs/     child stdout/stderr
+    <root>/journal/  per-writer flight-recorder journals (obs/events.py;
+                     local-write, blob-synced at flush boundaries)
+    <root>/members/  member-discovery records (service/discovery.py):
+                     address, pid, lease epoch, heartbeat — the spawner
+                     waits on them instead of port files, the router
+                     re-discovers a rejoined incarnation's fresh address
+                     from them, and the root URI becomes the fleet's
+                     single shared configuration
     <root>/corpus/   (optional) the shared warm-start corpus
+
+Local-only surfaces (child stdout/stderr logs, the local halves of the
+journals) live in a per-host SCRATCH directory when the root is a blob
+URI (the root itself when it is a filesystem path).
 
 What crosses the HTTP boundary is deliberately small: model REFERENCES
 (registry name + args — both sides resolve them through the same
@@ -151,11 +162,20 @@ class RemoteReplica:
         probe_timeout_s: float = 2.0,
         control_timeout_s: float = 2.0,
         poll_interval_s: float = 0.02,
+        store_root: Optional[str] = None,
     ):
         self.idx = idx
         self.base_url = base_url.rstrip("/")
         self.proc = proc
         self.error: Optional[str] = None
+        # Address re-discovery (service/discovery.py): with a store root,
+        # a failed probe re-resolves the member's published record — a
+        # replica that restarted on a fresh port (rejoin without a
+        # respawn, a host-local supervisor bouncing the process) is
+        # reachable again without anyone re-wiring the router.
+        self.store_root = store_root
+        self.rediscoveries = 0
+        self._next_rediscover = 0.0  # throttle: record reads cost retries
         self.request_timeout_s = request_timeout_s
         self.probe_timeout_s = probe_timeout_s
         # Router-tick control ops (withdraw) get a SHORT deadline: a
@@ -260,16 +280,53 @@ class RemoteReplica:
         """GET /.probe under a short socket timeout: a SIGSTOPped or
         partitioned child times out here, which the router's deadline
         probe converts into suspicion and eventually a (possibly
-        false-positive — that is what the lease fence is for) death."""
+        false-positive — that is what the lease fence is for) death. A
+        transport failure additionally attempts ADDRESS RE-DISCOVERY
+        from the store root's member record before reporting, so a
+        replica serving at a fresh address answers the NEXT probe."""
         try:
             out = self._get_json("/.probe", timeout=self.probe_timeout_s)
         except Exception as e:  # noqa: BLE001 — any transport failure
+            self._maybe_rediscover()
             raise ReplicaDead(
                 f"replica {self.idx} probe failed: {type(e).__name__}: {e}"
             ) from e
         with self._lock:
             self._last_probe = out
         return out
+
+    def _maybe_rediscover(self) -> None:
+        """Re-resolve this member's address from its discovery record;
+        best-effort (a missing/unreachable record changes nothing) and
+        THROTTLED — it runs inside the probe-failure path, and paying the
+        record read's bounded retry on every failed probe would multiply
+        probe latency exactly when the store is also struggling."""
+        if self.store_root is None:
+            return
+        now = time.monotonic()
+        if now < self._next_rediscover:
+            return
+        self._next_rediscover = now + 5.0
+        try:
+            from .discovery import MemberDirectory
+            from .router import lease_member
+
+            rec = MemberDirectory(self.store_root).lookup(
+                lease_member(self.idx)
+            )
+        except OSError:
+            return
+        if rec is None:
+            return
+        addr = str(rec.get("address", "")).rstrip("/")
+        if addr and addr != self.base_url:
+            with self._lock:
+                self.base_url = addr
+                self.rediscoveries += 1
+            self._tracer.instant(
+                "fleet.rediscover", cat="fleet", replica=self.idx,
+                address=addr,
+            )
 
     def idle(self) -> bool:
         with self._lock:
@@ -530,44 +587,64 @@ def spawn_replica_proc(
     service_kwargs: dict,
     timeout_s: float = 180.0,
     env_extra: Optional[dict] = None,
+    scratch: Optional[str] = None,
+    incarnation: Optional[int] = None,
 ) -> tuple:
     """Launch one `replica_main` subprocess over the shared store root and
-    wait for its readiness file (`<root>/replica<idx>.port`, written
-    atomically once the HTTP server is bound). Returns `(Popen, base_url)`.
-    Child stdout/stderr land in `<root>/logs/replica<idx>.log`."""
-    os.makedirs(os.path.join(root, "logs"), exist_ok=True)
-    port_file = os.path.join(root, f"{lease_member(idx)}.port")
-    for p in (port_file, port_file + ".tmp"):
-        if os.path.exists(p):
-            os.unlink(p)
-    log_path = os.path.join(root, "logs", f"{lease_member(idx)}.log")
+    wait for it to DISCOVER itself: the child publishes a
+    ``members/member-replica<idx>.json`` record (service/discovery.py)
+    into the root once its HTTP server is bound, and the spawner waits
+    for a record whose ``pid`` matches the child it just forked — a stale
+    record from a previous incarnation can never satisfy a fresh spawn.
+    Works identically on filesystem and ``blob://`` roots (the point:
+    the root URI is the only configuration the spawner and the child
+    share). Returns `(Popen, base_url)`.
+
+    `scratch` is the local directory for child logs and local-write
+    journals (required when `root` is a blob URI; defaults to `root`).
+    `incarnation` marks a REJOIN respawn: the child journals under the
+    ``replica<idx>@e<epoch>`` writer so the restarted stream merges
+    cleanly next to the fenced old incarnation's."""
+    from .discovery import MemberDirectory
+
+    member = lease_member(idx)
+    scratch = scratch or root
+    if scratch.startswith("blob://"):
+        raise ValueError(
+            "spawn_replica_proc needs a LOCAL scratch dir for child "
+            "logs/journals when the store root is a blob URI"
+        )
+    os.makedirs(os.path.join(scratch, "logs"), exist_ok=True)
+    suffix = f".e{incarnation}" if incarnation else ""
+    log_path = os.path.join(scratch, "logs", f"{member}{suffix}.log")
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
     env.update(env_extra or {})
+    cmd = [
+        sys.executable, "-m", "stateright_tpu.service.replica_main",
+        "--idx", str(idx),
+        "--root", root,
+        "--scratch", scratch,
+        "--service-kwargs", json.dumps(service_kwargs),
+    ]
+    if incarnation:
+        cmd += ["--incarnation", str(incarnation)]
     log_f = open(log_path, "ab")  # srlint: ckpt-ok child log sink, not persistent checkpoint state
     try:
         proc = subprocess.Popen(
-            [
-                sys.executable, "-m", "stateright_tpu.service.replica_main",
-                "--idx", str(idx),
-                "--root", root,
-                "--service-kwargs", json.dumps(service_kwargs),
-            ],
-            stdout=log_f,
-            stderr=subprocess.STDOUT,
-            env=env,
+            cmd, stdout=log_f, stderr=subprocess.STDOUT, env=env
         )
     finally:
         log_f.close()  # the child holds its own fd now
+    directory = MemberDirectory(root)
     deadline = time.monotonic() + timeout_s
     while True:
-        if os.path.exists(port_file):
-            try:
-                with open(port_file) as f:
-                    port = int(f.read().strip())
-                break
-            except (OSError, ValueError):
-                pass  # racing the atomic rename: retry
+        try:
+            rec = directory.lookup(member)
+        except OSError:
+            rec = None  # store outage: keep waiting inside the deadline
+        if rec is not None and rec.get("pid") == proc.pid:
+            return proc, str(rec["address"])
         if proc.poll() is not None:
             tail = ""
             try:
@@ -583,8 +660,7 @@ def spawn_replica_proc(
         if time.monotonic() > deadline:
             proc.kill()
             raise TimeoutError(
-                f"replica {idx} subprocess did not come up within "
-                f"{timeout_s:.0f}s (see {log_path})"
+                f"replica {idx} subprocess published no member record "
+                f"within {timeout_s:.0f}s (see {log_path})"
             )
         time.sleep(0.05)
-    return proc, f"http://localhost:{port}"

@@ -62,7 +62,13 @@ from ..faults.ckptio import (
     fenced_savez,
     latest_generation,
 )
-from ..faults.plan import FaultError, _u01, active_plan, maybe_fault
+from ..faults.plan import (
+    FaultError,
+    _u01,
+    active_plan,
+    deterministic_backoff,
+    maybe_fault,
+)
 from ..obs import (
     REGISTRY,
     TERMINAL_EVENT_BY_STATUS,
@@ -267,6 +273,7 @@ class FleetRouter:
         router_lease=None,
         probe_backoff_base: int = 1,
         probe_backoff_cap: int = 8,
+        probation_probes: int = 2,
     ):
         """`replicas` are service/fleet.py `Replica` drivers (one
         CheckService each). `background=True` makes probes run under a
@@ -290,7 +297,13 @@ class FleetRouter:
         `probe_backoff_base` / `probe_backoff_cap` (ticks) are the
         exponential probe backoff for repeatedly-failing members: a
         partitioned replica's probes are deferred (with seeded jitter)
-        instead of eating the tick budget every round."""
+        instead of eating the tick budget every round.
+
+        `probation_probes` is the rejoin quarantine: a dead/fenced member
+        re-registered through `rejoin` must answer this many CONSECUTIVE
+        health probes before its keys move back (`HashRing.add` — only
+        ITS keys, mirroring dead-member removal); until promotion it
+        receives no placements and neither steals nor is stolen from."""
         self.replicas = {r.idx: r for r in replicas}
         self.ckpt_dir = ckpt_dir
         self.ring = HashRing(list(self.replicas))
@@ -311,10 +324,12 @@ class FleetRouter:
         self._jobs: dict[int, FleetJob] = {}
         self._next_id = 1
         self._lock = threading.RLock()
+        self.probation_probes = max(int(probation_probes), 1)
         self._suspect: dict[int, int] = {r: 0 for r in self.replicas}
         self._dead: set = set()
         self._tick_n = 0
         self._next_probe: dict[int, int] = {}  # idx -> earliest probe tick
+        self._probation: dict[int, int] = {}  # idx -> healthy probes still owed
         self.counters = {
             "jobs_routed": 0,
             "router_retries": 0,
@@ -327,6 +342,8 @@ class FleetRouter:
             "steals": 0,
             "lease_revokes": 0,
             "lease_reseals": 0,
+            "rejoins": 0,
+            "rejoin_promotions": 0,
         }
         self._metrics_name = REGISTRY.register("fleet", self.metrics)
         if self.lease_store is not None:
@@ -502,11 +519,14 @@ class FleetRouter:
         )
 
     def _backoff(self, attempt: int) -> None:
-        base = self.backoff_base_s
-        if base <= 0:
+        # The ONE seeded backoff spelling (faults/plan.py), shared with
+        # the supervisor's retry slices and the blob-store client.
+        delay = deterministic_backoff(
+            self.seed, "router.backoff", attempt,
+            self.backoff_base_s, self.backoff_cap_s,
+        )
+        if delay <= 0:
             return
-        delay = min(base * 2.0 ** attempt, self.backoff_cap_s)
-        delay *= 0.5 + _u01(self.seed, "router.backoff", attempt)
         with self._lock:
             self.counters["router_backoff_ms"] += int(delay * 1000)
         time.sleep(delay)
@@ -527,6 +547,20 @@ class FleetRouter:
                     i for i in self.ring.preference(fj.key)
                     if i not in self._dead and self.replicas[i].alive
                 ]
+                if not order:
+                    # Ring empty but probation members alive (every live
+                    # member is mid-rejoin — e.g. the 1-replica fleet's
+                    # only member rejoining): place on them rather than
+                    # hard-failing the job. "No placements during
+                    # probation" is a load-shedding policy for when ring
+                    # members exist, not a reason to turn a 2-tick
+                    # quarantine window into a permanent job ERROR.
+                    with self._lock:
+                        order = sorted(
+                            i for i in self._probation
+                            if i not in self._dead
+                            and self.replicas[i].alive
+                        )
                 if not order:
                     break
                 r = self.replicas[order[attempt % len(order)]]
@@ -599,6 +633,57 @@ class FleetRouter:
         )
         fj.event.set()
 
+    # -- replica rejoin --------------------------------------------------------
+
+    def rejoin(self, replica) -> bool:
+        """Re-admit a restarted incarnation of a dead/fenced member behind
+        the quarantine policy: the new driver replaces the dead one in the
+        replica map and is probed like any member, but its keys do NOT
+        move back until it answers `probation_probes` consecutive health
+        probes — only then does `HashRing.add` re-route ITS keys (and only
+        its keys: consistent hashing makes re-add the exact mirror of
+        dead-member removal, pinned by the ring unit tests). The caller
+        (ServiceFleet.rejoin_replica) granted the member a FRESH lease
+        epoch first, so a stale zombie of the old incarnation racing this
+        rejoin is fence-rejected on every write — the exact-epoch check
+        fails for the old epoch the moment the grant lands.
+
+        The ``fleet.rejoin`` chaos point fires at the TOP of the caller
+        (`ServiceFleet.rejoin_replica`) — before the fresh grant, before
+        the spawn — so an injected fault aborts the whole rejoin with
+        literally nothing changed (not even a burned epoch)."""
+        with self._lock:
+            if replica.idx not in self._dead:
+                return False  # alive (or never known): nothing to rejoin
+            self._dead.discard(replica.idx)
+            self.replicas[replica.idx] = replica
+            self._suspect[replica.idx] = 0
+            self._next_probe.pop(replica.idx, None)
+            self._probation[replica.idx] = self.probation_probes
+            self.counters["rejoins"] += 1
+        self._tracer.instant(
+            "fleet.rejoin", cat="fleet", replica=replica.idx
+        )
+        self._events.emit(
+            "replica.rejoin", replica=replica.idx, phase="probation",
+            probes=self.probation_probes,
+        )
+        return True
+
+    def _promote(self, idx: int) -> None:
+        """Probation served: move the member's keys back (ring re-add)."""
+        with self._lock:
+            if self._probation.pop(idx, None) is None:
+                return
+            self.counters["rejoin_promotions"] += 1
+        self.ring.add(idx)
+        self._events.emit(
+            "replica.rejoin", replica=idx, phase="ring"
+        )
+        self._tracer.instant(
+            "fleet.rejoin_promoted", cat="fleet", replica=idx
+        )
+
     # -- supervision tick ------------------------------------------------------
 
     def tick(self) -> None:
@@ -637,10 +722,28 @@ class FleetRouter:
                 self.counters["probe_skipped"] += 1
                 continue
             ok = self._probe(r)
+            if ok is None:
+                # The probe worker never got scheduled inside the budget
+                # (host starvation — e.g. compile threads hogging a small
+                # box): that measures the HOST, not the replica. No
+                # evidence either way — neither reset nor grow suspicion.
+                continue
             if ok:
                 self._suspect[r.idx] = 0
                 self._next_probe.pop(r.idx, None)
+                owed = self._probation.get(r.idx)
+                if owed is not None:
+                    # One healthy probation probe served; promotion (ring
+                    # re-add) happens only when the full run is CONSECUTIVE.
+                    if owed <= 1:
+                        self._promote(r.idx)
+                    else:
+                        self._probation[r.idx] = owed - 1
                 continue
+            if r.idx in self._probation:
+                # A failed probe resets the probation clock: the quarantine
+                # demands consecutive health, not eventual health.
+                self._probation[r.idx] = self.probation_probes
             self.counters["probe_failures"] += 1
             self._suspect[r.idx] += 1
             backoff = min(
@@ -670,11 +773,16 @@ class FleetRouter:
             if self._suspect[r.idx] >= self.unhealthy_after or not r.alive:
                 self._on_replica_death(r)
 
-    def _probe(self, r) -> bool:
-        """True iff the replica answered its status probe in time. In
+    def _probe(self, r) -> Optional[bool]:
+        """True iff the replica answered its status probe in time; False
+        on a failure/timeout; None when the probe WORKER never started
+        inside the budget (a starved host scheduler — no evidence about
+        the replica at all, so the caller must not move the suspect
+        counter either way; counting it as a failure is how a loaded box
+        false-positively killed perfectly healthy replicas). In
         background mode the probe runs under a deadline thread — a hung
-        replica (injected `fleet.replica_hang` or a real wedge) shows up as
-        a timeout, not a hung router."""
+        replica (injected `fleet.replica_hang` or a real wedge) shows up
+        as a timeout, not a hung router."""
         if not self.background:
             try:
                 r.probe()
@@ -682,8 +790,10 @@ class FleetRouter:
             except Exception:  # noqa: BLE001 — any probe failure counts
                 return False
         box: list = []
+        started = threading.Event()
 
         def work():
+            started.set()
             try:
                 box.append(("ok", r.probe()))
             except BaseException as e:  # noqa: BLE001 — reported as unhealthy
@@ -691,7 +801,25 @@ class FleetRouter:
 
         t = threading.Thread(target=work, daemon=True)
         t.start()
-        t.join(self.probe_timeout_s)
+        deadline = time.monotonic() + self.probe_timeout_s
+        if not started.wait(self.probe_timeout_s):
+            return None  # never scheduled: the box is starved, not the replica
+        # The probe itself gets the remaining budget, floored at half —
+        # a late-scheduled worker still deserves a real chance (the whole
+        # point of the started gate), but total per-probe blocking stays
+        # <= 1.5x the timeout so death detection doesn't crawl on a
+        # loaded box.
+        t.join(
+            max(deadline - time.monotonic(), self.probe_timeout_s * 0.5)
+        )
+        if not box:
+            # One short grace re-check: a long GIL hold (jit tracing on a
+            # busy service) stalls THIS thread and the worker together,
+            # so the deadline can expire with the trivial probe one
+            # bytecode-quantum from finishing — when the holder releases,
+            # the answer lands instantly. A real hang stays empty here
+            # and costs only these extra milliseconds to declare.
+            t.join(min(0.1, self.probe_timeout_s / 5))
         return bool(box) and box[0][0] == "ok"
 
     def _on_replica_death(self, r) -> None:
@@ -725,6 +853,16 @@ class FleetRouter:
                     "lease.revoke_race", cat="fleet", member=member
                 )
                 return
+            except OSError:
+                # The revocation did not durably land (store outage /
+                # torn writes past the lease store's retries): abort the
+                # WHOLE death handling — requeueing before a durable
+                # revoke would hand the zombie a license to corrupt.
+                # Next tick re-detects the death and retries.
+                self._tracer.instant(
+                    "lease.revoke_race", cat="fleet", member=member
+                )
+                return
             if epoch is not None:
                 self.counters["lease_revokes"] += 1
                 self._events.emit(
@@ -734,6 +872,9 @@ class FleetRouter:
             if r.idx in self._dead:
                 return
             self._dead.add(r.idx)
+            # A member dying DURING probation never made it back into the
+            # ring; dropping the probation entry is the whole cleanup.
+            self._probation.pop(r.idx, None)
             orphans = [
                 fj for fj in self._jobs.values()
                 if fj.replica == r.idx
@@ -875,7 +1016,15 @@ class FleetRouter:
         withdraw + fresh submit, and the `fleet.steal` fault point fires
         BEFORE the withdrawal so an injected fault leaves the job exactly
         where it was."""
-        healthy = sorted(self._healthy(), key=lambda r: r.idx)
+        healthy = sorted(
+            (
+                r for r in self._healthy()
+                # Probation members neither steal nor are stolen from:
+                # keys (and work) move back only after promotion.
+                if r.idx not in self._probation
+            ),
+            key=lambda r: r.idx,
+        )
         if len(healthy) < 2:
             return
         idle = [r for r in healthy if r.idle()]

@@ -50,9 +50,11 @@ from typing import Optional
 
 import numpy as np
 
+from ..faults.blobstore import blob_backend, is_blob_uri, normalize_root
 from ..faults.ckptio import (
     CheckpointCorrupt,
     LeaseRevoked,
+    any_generation,
     content_path,
     fenced_load_latest,
     fenced_savez,
@@ -213,10 +215,11 @@ class CorpusStore:
         summary_hashes: int = 4,
     ):
         summary_words(summary_log2)  # validates >= 5
-        self.root = root
+        self.root = normalize_root(root)
         self.summary_log2 = summary_log2
         self.summary_hashes = summary_hashes
-        os.makedirs(root, exist_ok=True)
+        if not is_blob_uri(self.root):
+            os.makedirs(self.root, exist_ok=True)
         self._lock = threading.Lock()
         # Epoch fence (service/lease.py, fleet replicas only): set by the
         # owning Replica driver via `set_lease`. A fenced corpus refuses
@@ -274,9 +277,7 @@ class CorpusStore:
             # Chaos-plane boundary: fires before any file is touched, so a
             # faulted load leaves the corpus (and the job) untouched.
             maybe_fault("corpus.load", key=key[:16])
-            if not (
-                os.path.exists(path) or os.path.exists(path + ".prev")
-            ):
+            if not any_generation(path):
                 self._count("misses")
                 return None
             def reject(*stamp):
@@ -402,9 +403,16 @@ class CorpusStore:
         before any file is removed — a fault leaves the directory intact)
         and never raises: a GC failure means a bigger directory, not a
         wrong result. Returns {evicted, bytes_freed, pinned_skips,
-        bytes_total}."""
-        import glob as _glob
+        bytes_total}.
 
+        The sweep runs on `BlobStore.list` METADATA through the backend
+        seam (faults/blobstore.py), so eviction order is identical on
+        ``file://`` and ``blob://`` roots — the local backend's listing is
+        the same names/sizes/mtimes the old glob+stat walk produced, and
+        the blob backend's is the server's. On a blob root the listing is
+        additionally the ``blob.list`` chaos surface: a stale listing
+        sweeps yesterday's view (bigger directory, never a wrong evict of
+        something it can't see)."""
         out = {"evicted": 0, "bytes_freed": 0, "pinned_skips": 0,
                "bytes_total": 0}
         try:
@@ -413,31 +421,37 @@ class CorpusStore:
             self._count("gc_faults")
             return out
         self._count("gc_sweeps")
+        backend = blob_backend(self.root)
         # Group generations (entry + .prev) by content key. ONLY the two
-        # committed generation names — a `corpus-*.npz*` wildcard would also
-        # match another process's in-flight `.npz.tmp.<pid>` staging file
-        # (fleet replicas share the directory), and unlinking that makes the
+        # committed generation names — a looser filter would also match
+        # another process's in-flight `.npz.tmp.<pid>` staging file (fleet
+        # replicas share the directory), and deleting that makes the
         # concurrent publish's atomic rename fail.
         entries: dict = {}
-        paths = _glob.glob(os.path.join(self.root, "corpus-*.npz"))
-        paths += _glob.glob(os.path.join(self.root, "corpus-*.npz.prev"))
-        for path in paths:
-            base = os.path.basename(path)
-            key = base[len("corpus-"):].split(".npz")[0]
-            try:
-                st = os.stat(path)
-            except OSError:
+        try:
+            stats = backend.list("corpus-")
+        except OSError:
+            self._count("gc_faults")
+            return out  # unreachable store: sweep later, never wrong
+        for st in stats:
+            if not (
+                st.name.endswith(".npz") or st.name.endswith(".npz.prev")
+            ):
                 continue
-            ent = entries.setdefault(key, {"paths": [], "bytes": 0, "mtime": 0.0})
-            ent["paths"].append(path)
-            ent["bytes"] += st.st_size
-            ent["mtime"] = max(ent["mtime"], st.st_mtime)
+            key = st.name[len("corpus-"):].split(".npz")[0]
+            ent = entries.setdefault(
+                key, {"names": [], "bytes": 0, "mtime": 0.0}
+            )
+            ent["names"].append(st.name)
+            ent["bytes"] += st.size
+            ent["mtime"] = max(ent["mtime"], st.mtime)
         total = sum(e["bytes"] for e in entries.values())
         out["bytes_total"] = total
         if total <= max_bytes:
             return out
         with self._lock:
             pinned = set(self._pinned)
+        stat_size = {st.name: st.size for st in stats}
         for key, ent in sorted(entries.items(), key=lambda kv: kv[1]["mtime"]):
             if total <= max_bytes:
                 break
@@ -446,11 +460,10 @@ class CorpusStore:
                 self._count("gc_pinned_skips")
                 continue
             freed = 0
-            for path in ent["paths"]:
+            for name in ent["names"]:
                 try:
-                    sz = os.path.getsize(path)
-                    os.unlink(path)
-                    freed += sz
+                    if backend.delete(name):
+                        freed += stat_size.get(name, 0)
                 except OSError:
                     pass  # raced with a concurrent publish/reader: skip
             total -= freed
@@ -519,7 +532,11 @@ class CorpusStore:
                 payload_extra["sem_verdicts"] = np.asarray(
                     sem_verdicts, dtype=np.uint8
                 )
-            fenced_savez(
+            # Conditional write (`if_absent`): on the blob backend this is
+            # a server-side If-None-Match put, so N replicas racing one
+            # content key through a real object store still keep exactly
+            # ONE generation — the pre-check above is just the cheap path.
+            written = fenced_savez(
                 path,
                 {
                     "key": np.asarray([key], dtype=np.str_),
@@ -547,7 +564,11 @@ class CorpusStore:
                     ),
                 },
                 lease=self._lease,
+                if_absent=True,
             )
+            if written is None:
+                self._count("publish_skipped")
+                return False
         except LeaseRevoked:
             # The write-side fence refused a publish whose lease was
             # revoked between the pre-check above and the write — stale,

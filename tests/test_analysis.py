@@ -212,6 +212,53 @@ def test_sr002_catches_np_save_path_open_and_io_open():
     assert f[2].line == 9 and "'ab'" in f[2].message
 
 
+def test_sr002_bare_blob_put_fires_outside_the_backend():
+    # ISSUE 15 satellite: the BlobStore write surface is SR002 territory
+    # exactly like a bare atomic_savez — a put that skips ckptio skips
+    # the CRC footer and the epoch fence. Both spellings: the URI helper
+    # by (resolved) name, and `.put`/`.put_if_absent` on a blob-shaped
+    # receiver. CACHE.put / queue.put stay out of scope.
+    f = _lint(
+        """\
+        from stateright_tpu.faults.blobstore import blob_backend, put_blob
+
+        def publish(uri, data, store_root):
+            put_blob(uri, data)
+            blob_backend(store_root).put("entry.npz", data)
+
+        def unrelated(queue, CACHE, fp):
+            queue.put(("run", None))
+            CACHE.put(fp, True, None)
+        """,
+        module="stateright_tpu.store.fixture",
+    )
+    assert _rules(f) == ["SR002", "SR002"]
+    assert f[0].line == 4 and "fenced_savez" in f[0].message
+    assert f[1].line == 5
+
+
+def test_sr002_blob_put_inside_backend_modules_is_sanctioned():
+    f = _lint(
+        """\
+        from .blobstore import put_blob
+
+        def write_record(path, data):
+            put_blob(path, data, rotate=True)
+        """,
+        module="stateright_tpu.faults.ckptio_fixture",
+    )
+    # Wrong-suffix module still fires; the real blessed suffixes pass.
+    assert _rules(f) == ["SR002"]
+    f = _lint(
+        """\
+        def put(self, name, data):
+            self._blob.put(name, data)
+        """,
+        module="stateright_tpu.faults.blobstore",
+    )
+    assert f == []
+
+
 def test_sr002_read_open_is_legal_and_ckpt_ok_silences():
     f = _lint(
         """\
@@ -292,6 +339,38 @@ def test_sr004_maybe_fault_boundary_or_annotation_passes():
                 raise RuntimeError("call run() first")
         """,
         module="stateright_tpu.store.fixture",
+    )
+    assert f == []
+
+
+def test_sr004_blob_backend_raise_surfaces_are_in_scope():
+    # ISSUE 15 satellite: the blob backend's failure surfaces (retry
+    # exhaustion -> BlobUnavailable) are engine-adjacent I/O — SR004
+    # scope, same as the stores; a maybe_fault boundary in the same
+    # function (the blob.* chaos points) is the sanctioned shape.
+    f = _lint(
+        """\
+        class BlobUnavailable(OSError):
+            pass
+
+        def op(fn):
+            raise BlobUnavailable("blob op exhausted retries")
+        """,
+        module="stateright_tpu.faults.blobstore_fixture",
+    )
+    assert _rules(f) == ["SR004"]
+    f = _lint(
+        """\
+        from stateright_tpu.faults.plan import maybe_fault
+
+        class BlobUnavailable(OSError):
+            pass
+
+        def op(fn):
+            maybe_fault("blob.get")
+            raise BlobUnavailable("blob op exhausted retries")
+        """,
+        module="stateright_tpu.faults.blobstore_fixture",
     )
     assert f == []
 

@@ -278,6 +278,32 @@ def test_device_detail_pins_fleet_row_keys():
     assert row["fleet_p99_ms"] == 8900.0
 
 
+def test_device_detail_pins_blob_row_keys():
+    # The BENCH_BLOB=1 local-vs-blob backend A/B row is part of the
+    # artifact contract: the local-filesystem wall time, the measured
+    # blob-backend overhead, and the blob client's op/retry counters
+    # must survive into detail.device so the ISSUE-15 "object store
+    # costs only the wire, never the answers" claim is auditable in
+    # every BENCH_r*.json.
+    for key in (
+        "sec_local_fs", "blob_overhead_pct", "blob_ops", "blob_retries",
+    ):
+        assert key in bench.DEVICE_DETAIL_FIELDS
+    row = bench.device_detail(
+        {
+            "states_per_sec": 2900.0,
+            "sec": 9.4,
+            "sec_local_fs": 9.1,
+            "blob_overhead_pct": 3.3,
+            "blob_ops": 412,
+            "blob_retries": 2,
+        }
+    )
+    assert row["sec_local_fs"] == 9.1
+    assert row["blob_overhead_pct"] == 3.3
+    assert row["blob_ops"] == 412
+
+
 def test_fleet_counter_keys_conform_to_obs_schema():
     # The fleet router's stats() vocabulary (its `/.status` body and the
     # "fleet" /metrics source) is the documented obs schema's — renames

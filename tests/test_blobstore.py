@@ -1,0 +1,410 @@
+"""Blob-store checkpoint backend (faults/blobstore.py + the blob-aware
+ckptio/lease/corpus/discovery planes) — ISSUE 15's tentpole.
+
+The contract under test is BACKEND INVARIANCE: everything the fleet
+persists (checkpoint generations, lease records, corpus entries, member
+records, synced journals) behaves bit-identically whether the store root
+is a local directory or the HTTP object-store emulator — including under
+the blob chaos points (injected 429/5xx retried with deterministic
+backoff, torn PUTs CRC-rejected with `.prev` serving, stale listings
+degrading to a bigger directory), and the whole in-proc fleet chaos story
+(partition -> false-positive death -> zombie fenced) replays over the
+blob backend with single-replica-golden results.
+
+Everything here is 2pc-3 scale or smaller; the subprocess matrix lives in
+scripts/fleet_procs_smoke.py (slow-marked wrapper in test_remote_fleet).
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from stateright_tpu.faults import FaultPlan, active
+from stateright_tpu.faults import ckptio
+from stateright_tpu.faults.blobstore import (
+    BlobUnavailable,
+    blob_backend,
+    serve_blobd,
+    uri_client,
+)
+
+
+@pytest.fixture(scope="module")
+def blobd():
+    # One emulator for the whole module (each test uses its own name
+    # prefix); per-test server teardown would pay a 0.5 s shutdown join
+    # thirteen times over — tier-1 budget discipline.
+    srv = serve_blobd()
+    yield srv
+    srv.shutdown()
+
+
+# -- the ckptio generation contract over blob ----------------------------------
+
+
+def test_blob_generations_roundtrip_prev_rotation(blobd):
+    p = blobd.root_uri + "/ckpt/job1.npz"
+    ckptio.atomic_savez(p, {"a": np.arange(4)})
+    ckptio.atomic_savez(p, {"a": np.arange(2)})
+    data, src = ckptio.load_latest(p)
+    assert list(data["a"]) == [0, 1] and src == p
+    # The server rotated the first generation to .prev.
+    prev, psrc = ckptio.read_verified(p + ".prev"), p + ".prev"
+    assert list(prev["a"]) == [0, 1, 2, 3] and psrc.endswith(".prev")
+
+
+def test_blob_torn_put_is_crc_rejected_and_prev_serves(blobd):
+    p = blobd.root_uri + "/ckpt/torn.npz"
+    ckptio.atomic_savez(p, {"a": np.arange(3)})
+    plan = FaultPlan().rule("blob.put", "torn", times=1)
+    with active(plan):
+        ckptio.atomic_savez(p, {"a": np.arange(9)})
+    assert plan.injected == {"blob.put:torn": 1}
+    # The torn current generation fails CRC; the fallback serves — the
+    # r13 torn-generation story, now over the wire.
+    data, src = ckptio.load_latest(p)
+    assert list(data["a"]) == [0, 1, 2]
+    assert src.endswith(".prev")
+    with pytest.raises(ckptio.CheckpointCorrupt):
+        ckptio.read_verified(p)
+
+
+def test_blob_injected_throttling_is_retried_and_counted(blobd):
+    p = blobd.root_uri + "/ckpt/retry.npz"
+    ckptio.atomic_savez(p, {"a": np.arange(5)})
+    client, _ = uri_client(p)
+    before = dict(client.counters)
+    plan = FaultPlan().rule("blob.get", "http", times=2)
+    with active(plan):
+        data, _src = ckptio.load_latest(p)
+    assert list(data["a"]) == [0, 1, 2, 3, 4]  # the answer, despite 5xx
+    assert plan.injected == {"blob.get:http": 2}
+    assert client.counters["retries"] >= before["retries"] + 2
+
+
+def test_blob_retry_exhaustion_degrades_not_raises(blobd, tmp_path):
+    """A persistent outage (every attempt faults) exhausts the bounded
+    retry and surfaces as unavailability — which every caller already
+    degrades on: load_latest reports no generation, the corpus runs
+    cold. Counted, never wrong."""
+    p = blobd.root_uri + "/ckpt/outage.npz"
+    ckptio.atomic_savez(p, {"a": np.arange(3)})
+    client, _ = uri_client(p)
+    client_retry, client.retry_limit = client.retry_limit, 1  # keep it fast
+    try:
+        plan = FaultPlan().rule("blob.get", "io", times=-1)
+        with active(plan):
+            with pytest.raises(ckptio.CheckpointCorrupt):
+                ckptio.load_latest(p)
+            assert ckptio.latest_generation(p) is None  # probe: fresh start
+        assert client.counters["unavailable"] >= 2
+    finally:
+        client.retry_limit = client_retry
+
+
+def test_blob_conditional_put_is_content_addressed_idempotence(blobd):
+    p = blobd.root_uri + "/corpus/entry.npz"
+    assert ckptio.atomic_savez(p, {"a": np.arange(3)}, if_absent=True) == p
+    # Second conditional write loses the race server-side: None, and the
+    # stored bytes stay the first writer's.
+    assert ckptio.atomic_savez(p, {"a": np.arange(9)}, if_absent=True) is None
+    data, _ = ckptio.load_latest(p)
+    assert list(data["a"]) == [0, 1, 2]
+
+
+def test_blob_conditional_put_repairs_a_torn_entry(blobd):
+    """Review-found asymmetry pin: the server's If-None-Match keys on
+    bare EXISTENCE, so without the torn-current repair a single torn
+    first publish would 412-skip every later publish of that content key
+    forever — while the local backend self-heals by overwriting. The
+    conditional write must treat a torn current generation as absent."""
+    p = blobd.root_uri + "/corpus/torn-entry.npz"
+    plan = FaultPlan(seed=1).rule("blob.put", "torn", times=1)
+    with active(plan):  # first publish torn, no .prev to rotate
+        ckptio.atomic_savez(p, {"a": np.arange(3)}, if_absent=True)
+    assert ckptio.latest_generation(p) is None  # nothing intact anywhere
+    # The republish must REPAIR (delete-torn + conditional write), not
+    # skip — and after it, lookups serve the repaired generation.
+    assert ckptio.atomic_savez(p, {"a": np.arange(3)}, if_absent=True) == p
+    data, src = ckptio.load_latest(p)
+    assert list(data["a"]) == [0, 1, 2] and src == p
+
+
+# -- lease records over blob ---------------------------------------------------
+
+
+def test_lease_store_over_blob_fences_across_instances(blobd):
+    from stateright_tpu.faults.ckptio import LeaseRevoked, fenced_savez
+    from stateright_tpu.service.lease import LeaseStore
+
+    root = blobd.root_uri + "/leases"
+    router_side = LeaseStore(root)
+    replica_side = LeaseStore(root)  # a second process's view
+    lease = router_side.grant("replica0")
+    acquired = replica_side.acquire("replica0")
+    assert (acquired.member, acquired.epoch) == ("replica0", lease.epoch)
+    assert acquired.valid()
+    p = blobd.root_uri + "/ckpt/fenced.npz"
+    fenced_savez(p, {"a": np.arange(2)}, lease=acquired)
+    router_side.revoke("replica0")
+    # The write-side fence reads the REVOKED record through the blob
+    # backend and refuses; the refusal is counted in the refuser's store.
+    assert not acquired.valid()
+    with pytest.raises(LeaseRevoked):
+        fenced_savez(p, {"a": np.arange(3)}, lease=acquired)
+    assert replica_side.counters["rejected_writes"] == 1
+
+
+def test_rejoin_racing_stale_zombie_is_fence_rejected(blobd):
+    """The rejoin-vs-zombie race (ISSUE 15 tentpole 2): a member's stale
+    zombie still holds epoch E when the restarted incarnation is granted
+    E+1 — every write the zombie attempts fails the exact-epoch check
+    write-side, and an E-stamped generation it raced through an open fd
+    is rejected read-side. Backend: blob (the race crosses hosts)."""
+    from stateright_tpu.faults.ckptio import (
+        LeaseRevoked,
+        fenced_load_latest,
+        fenced_savez,
+    )
+    from stateright_tpu.service.lease import LeaseStore
+
+    root = blobd.root_uri + "/leases"
+    store = LeaseStore(root)
+    zombie_lease = store.grant("replica0")  # epoch E, held by the zombie
+    p = blobd.root_uri + "/ckpt/race.npz"
+    fenced_savez(p, {"a": np.arange(2)}, lease=zombie_lease)
+    store.revoke("replica0")
+    rejoined = store.grant("replica0")  # the restart: fresh epoch E+1
+    assert rejoined.epoch == zombie_lease.epoch + 1
+    # Zombie write-side: refused.
+    with pytest.raises(LeaseRevoked):
+        fenced_savez(p, {"a": np.arange(9)}, lease=zombie_lease)
+    # The rejoined incarnation writes its own generation (the same move
+    # as the router's reseal: the newest valid stamp in the chain)...
+    fenced_savez(p, {"a": np.arange(4)}, lease=rejoined)
+    # ...then the zombie's RACED write (open-fd bypass) lands on top —
+    # and is stamp-rejected read-side: the loader serves the rejoined
+    # incarnation's generation from .prev, never the zombie's.
+    with active(FaultPlan().rule("fleet.zombie_write", "bypass", times=1)):
+        fenced_savez(p, {"a": np.arange(9)}, lease=zombie_lease)
+    rejected = []
+    data, src = fenced_load_latest(
+        p, validator=store.validate,
+        on_reject=lambda _p, m, e: rejected.append((m, e)),
+    )
+    assert rejected == [("replica0", zombie_lease.epoch)]
+    assert src.endswith(".prev")
+    assert list(data["a"]) == [0, 1, 2, 3]
+
+
+# -- corpus over blob + GC listing parity --------------------------------------
+
+
+def _publish_entries(store, keys, states=64):
+    for i, key in enumerate(keys):
+        fps = np.arange(states, dtype=np.uint64) + i
+        assert store.publish(
+            key, fps, np.zeros_like(fps),
+            {"state_count": states, "unique_count": states, "max_depth": 3,
+             "discoveries": {}},
+        )
+        time.sleep(0.01)  # strictly ordered mtimes on both backends
+
+
+def test_corpus_gc_eviction_order_identical_file_vs_blob(blobd, tmp_path):
+    """Satellite pin: `CorpusStore.gc` routes through `BlobStore.list`
+    metadata, so the mtime-LRU eviction order is THE SAME on both
+    backends — publish the same entries in the same order, sweep to the
+    same budget, keep the same survivors."""
+    from stateright_tpu.store.corpus import CorpusStore
+
+    keys = [f"{i:032x}" for i in range(4)]
+    survivors = {}
+    for root in (str(tmp_path / "corpus"), blobd.root_uri + "/corpus"):
+        store = CorpusStore(root, summary_log2=5)
+        _publish_entries(store, keys)
+        entry_bytes = blob_backend(root).list("corpus-")
+        per_entry = sum(s.size for s in entry_bytes) // len(keys)
+        out = store.gc(max_bytes=2 * per_entry + per_entry // 2)
+        assert out["evicted"] == 2, out  # oldest two swept on both
+        survivors[root] = sorted(
+            k for k in keys if store.lookup(k) is not None
+        )
+    (a, b) = survivors.values()
+    assert a == b == sorted(keys[2:])  # newest two survive, same order
+
+
+def test_corpus_blob_stale_list_degrades_gc_never_wrong(blobd):
+    from stateright_tpu.store.corpus import CorpusStore
+
+    root = blobd.root_uri + "/corpus-stale"
+    store = CorpusStore(root, summary_log2=5)
+    keys = [f"{i + 16:032x}" for i in range(2)]
+    backend = blob_backend(root)
+    backend.list("corpus-")  # prime the stale cache with the EMPTY view
+    _publish_entries(store, keys)
+    plan = FaultPlan().rule("blob.list", "stale", times=1)
+    with active(plan):
+        out = store.gc(max_bytes=0)
+    # The stale (empty) listing swept nothing: a bigger directory, never
+    # a wrong eviction; the next sweep sees the real listing.
+    assert plan.injected == {"blob.list:stale": 1}
+    assert out["evicted"] == 0
+    assert all(store.lookup(k) is not None for k in keys)
+    out = store.gc(max_bytes=0)
+    assert out["evicted"] == 2
+
+
+def test_corpus_injected_blob_fault_degrades_to_cold(blobd):
+    from stateright_tpu.store.corpus import CorpusStore
+
+    root = blobd.root_uri + "/corpus-cold"
+    store = CorpusStore(root, summary_log2=5)
+    key = f"{7:032x}"
+    _publish_entries(store, [key])
+    client, _ = uri_client(root)
+    client_retry, client.retry_limit = client.retry_limit, 1
+    try:
+        with active(FaultPlan().rule("blob.get", "io", times=-1)):
+            assert store.lookup(key) is None  # cold, never wrong
+        assert store.counters["misses"] >= 1
+    finally:
+        client.retry_limit = client_retry
+    assert store.lookup(key) is not None  # outage over: warm again
+
+
+# -- member discovery ----------------------------------------------------------
+
+
+@pytest.mark.parametrize("backend", ["file", "blob"])
+def test_member_directory_publish_lookup_list(backend, blobd, tmp_path):
+    from stateright_tpu.service.discovery import MemberDirectory
+
+    root = (
+        blobd.root_uri + "/fleetroot" if backend == "blob"
+        else str(tmp_path / "fleetroot")
+    )
+    d = MemberDirectory(root)
+    assert d.lookup("replica0") is None
+    d.publish("replica0", "http://localhost:1234", pid=111, epoch=3)
+    d.publish("replica1", "http://localhost:5678", pid=222, epoch=1)
+    rec = d.lookup("replica0")
+    assert rec["address"] == "http://localhost:1234"
+    assert rec["pid"] == 111 and rec["epoch"] == 3
+    members = {m["member"]: m for m in d.members()}
+    assert set(members) == {"replica0", "replica1"}
+    # Re-publish IS the heartbeat: fresh ts, fresh address on rejoin.
+    old_ts = rec["ts"]
+    time.sleep(0.01)
+    d.publish("replica0", "http://localhost:9999", pid=112, epoch=4)
+    rec2 = d.lookup("replica0")
+    assert rec2["address"] == "http://localhost:9999"
+    assert rec2["ts"] > old_ts
+    d.retire("replica1")
+    assert d.lookup("replica1") is None
+
+
+# -- journals: local-write, blob-synced, timeline from the root ----------------
+
+
+def test_journal_blob_sync_and_timeline_blob_root(blobd, tmp_path, capsys):
+    from stateright_tpu.obs import timeline
+    from stateright_tpu.obs.events import EventJournal, read_journal
+
+    jroot = blobd.root_uri + "/journal"
+    j = EventJournal(
+        str(tmp_path / "router.jsonl"), writer="router",
+        flush_every=2, sync_uri=jroot + "/router.jsonl",
+    )
+    j.emit("job.submitted", job=1, trace="t1")
+    j.emit("replica.admit", job=1, trace="t1")
+    j.emit("job.done", job=1, trace="t1")
+    j.close()
+    # The blob mirror carries the full journal after close...
+    assert [e["event"] for e in read_journal(jroot + "/router.jsonl")] == [
+        "job.submitted", "replica.admit", "job.done",
+    ]
+    # ...and the forensic CLI reads the BLOB ROOT directly.
+    rc = timeline.main([jroot, "--json"])
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert report["anomalies"] == []
+    assert report["traces"]["t1"]["terminal"] == "job.done"
+    # Stale tail: a mirror snapshotted mid-line (simulated by truncating
+    # the stored bytes) parses to the intact prefix — never raises.
+    name = "/journal/router.jsonl"
+    rec = blobd.store[name]
+    rec["data"] = rec["data"][: len(rec["data"]) - 7]
+    evs = read_journal(jroot + "/router.jsonl")
+    assert [e["event"] for e in evs] == ["job.submitted", "replica.admit"]
+
+
+# -- the fast chaos-matrix subset: in-proc fleet over the blob backend ---------
+
+
+def test_inproc_fleet_on_blob_backend_partition_zombie_bit_identical(blobd):
+    """The acceptance bar's fast subset: a 3-replica fleet whose
+    checkpoint plane AND lease plane live on the blob emulator survives a
+    router<->replica partition (false-positive death) with blob chaos
+    injected on top (throttled + torn puts) — all jobs bit-identical to
+    the single-replica goldens, the zombie's writes fenced and counted,
+    blob retries counted. The full subprocess matrix (kill -9 / SIGSTOP
+    zombie / partition / rejoin, file + blob) is slow-marked in
+    test_remote_fleet.py via scripts/fleet_procs_smoke.py."""
+    from stateright_tpu.service import ServiceFleet
+    from stateright_tpu.tensor.models import TensorTwoPhaseSys
+
+    m3 = TensorTwoPhaseSys(3)
+    root = blobd.root_uri + "/fleet"
+    fleet = ServiceFleet(
+        n_replicas=3, background=False, max_resident=1,
+        service_kwargs=dict(batch_size=128, table_log2=14),
+        ckpt_dir=root + "/ckpt", lease_dir=root + "/leases",
+        router_kwargs=dict(steal=False, unhealthy_after=2),
+    )
+    client, _ = uri_client(root)
+    retries_before = client.counters["retries"]
+    try:
+        handles = [fleet.submit(m3) for _ in range(4)]
+        owners = {h._job.replica for h in handles}
+        assert len(owners) == 1
+        victim = owners.pop()
+        while fleet.replicas[victim].service._engine.total_steps < 2:
+            fleet.pump(1)
+        plan = (
+            FaultPlan()
+            .rule("fleet.partition", "io", times=-1,
+                  match={"replica": victim})
+            .rule("blob.put", "http", times=2)
+            .rule("blob.put", "torn", times=1, after=6)
+        )
+        with active(plan):
+            deadline = time.monotonic() + 60
+            while fleet.stats()["replica_crashes"] < 1:
+                assert time.monotonic() < deadline, fleet.stats()
+                fleet.pump(1)
+            fleet.drain(timeout=600)
+        for h in handles:
+            r = h.result()
+            assert r.complete
+            assert (r.state_count, r.unique_state_count) == (1_146, 288)
+        s = fleet.stats()
+        assert s["replica_crashes"] == 1
+        assert s["lease_revokes"] == 1
+        assert s["requeued_jobs"] >= 1
+        # The fence engaged over the blob backend, refusals counted.
+        assert s["lease_rejected"] >= 1, s
+        # The injected 429/5xx puts were absorbed by bounded retry.
+        assert plan.injected.get("blob.put:http", 0) == 2
+        assert client.counters["retries"] >= retries_before + 2
+        assert plan.injected.get("blob.put:torn", 0) == 1
+    finally:
+        fleet.close()
+
+
+def test_blob_unavailable_is_oserror_and_on_the_chaos_plane():
+    # The degrade contract every caller relies on (and srlint SR004's
+    # scope extension assumes): retry exhaustion is an OSError.
+    assert issubclass(BlobUnavailable, OSError)

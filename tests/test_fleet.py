@@ -65,6 +65,24 @@ def test_hash_ring_preference_starts_at_owner_and_covers_all():
         assert sorted(pref) == [0, 1, 2]
 
 
+def test_hash_ring_rejoin_reclaims_exactly_its_pre_death_keys():
+    # The rejoin half of the consistent-hashing promise (ISSUE 15
+    # satellite): re-adding a member moves back EXACTLY the keys it owned
+    # before death — set-equality against the pre-death snapshot, zero
+    # churn on keys it never owned.
+    ring = HashRing([0, 1, 2])
+    keys = [f"model-{i}" for i in range(200)]
+    before = {k: ring.lookup(k) for k in keys}
+    ring.remove(1)
+    during = {k: ring.lookup(k) for k in keys}
+    ring.add(1)  # the rejoin promotion (router._promote)
+    after = {k: ring.lookup(k) for k in keys}
+    assert after == before  # full mapping restored, not just counts
+    reclaimed = {k for k in keys if during[k] != after[k]}
+    owned_before = {k for k in keys if before[k] == 1}
+    assert reclaimed == owned_before  # exactly its own keys, no others
+
+
 # -- queue requeue invariant (satellite: the r10 lane-unwind pin) --------------
 
 
@@ -123,6 +141,44 @@ def test_admission_queue_priority_order_survives_requeue():
     q.push(high2)
     q.push(high)
     assert [q.pop_next().id for _ in range(4)] == [4, 2, 1, 3]
+
+
+def test_admission_queue_inflight_jobs_survive_rejoin_exactly_once():
+    # ISSUE 15 satellite pin: jobs in flight during a rejoin are neither
+    # duplicated nor lost. Model the requeue/steal churn a rejoin causes
+    # at the queue level: a job popped for admission on the dying member
+    # re-enters through push (the requeue), a queued job withdrawn for
+    # the rejoined member leaves through remove (the steal) and re-enters
+    # on the thief — every id pops exactly once overall.
+    from stateright_tpu.service.queue import AdmissionQueue, Job
+
+    class _M:
+        lanes = 1
+
+    dying, rejoined = AdmissionQueue(), AdmissionQueue()
+    jobs = {i: Job(i, _M()) for i in range(1, 6)}
+    for j in jobs.values():
+        dying.push(j)
+    inflight = dying.pop_next()  # admitted on the dying member
+    # Death: the router requeues the in-flight job and every queued one.
+    survivors = [inflight] + [dying.pop_next() for _ in range(len(dying))]
+    assert dying.pop_next() is None  # the dead queue is empty — no dupes
+    for j in survivors:
+        rejoined.push(j)
+    # Rejoin steal: the promoted member withdraws half (atomic remove).
+    stolen = [rejoined.jobs()[-1], rejoined.jobs()[-2]]
+    assert all(rejoined.remove(j) for j in stolen)
+    assert not rejoined.remove(stolen[0])  # second withdraw refuses: gone
+    thief = AdmissionQueue()
+    for j in stolen:
+        thief.push(j)
+    popped = []
+    while len(rejoined) or len(thief):
+        for q in (rejoined, thief):
+            j = q.pop_next()
+            if j is not None:
+                popped.append(j.id)
+    assert sorted(popped) == [1, 2, 3, 4, 5]  # each exactly once
 
 
 # -- the acceptance bar: replica crash mid-run, zero lost jobs -----------------
@@ -431,5 +487,144 @@ def test_inproc_partition_zombie_is_fenced_and_results_bit_identical(tmp_path):
         # The zombie died crash-only AFTER being fenced out.
         assert not fleet.replicas[victim].alive
         assert "LeaseRevoked" in (fleet.replicas[victim].error or "")
+    finally:
+        fleet.close()
+
+
+# -- replica REJOIN (ISSUE 15 tentpole 2) --------------------------------------
+
+
+def test_probation_only_fleet_still_places_jobs():
+    """Edge pin (review-found): when EVERY live member is in rejoin
+    probation (e.g. the 1-replica fleet's only member mid-rejoin), the
+    ring is empty — submissions must fall back to the probation member
+    instead of hard-failing with a permanent job ERROR. No jax: stub
+    replicas at the router seam."""
+    import threading
+
+    from stateright_tpu.service.queue import JobStatus
+    from stateright_tpu.service.router import FleetRouter
+
+    class _StubJob:
+        def __init__(self):
+            self.status = JobStatus.QUEUED
+            self.event = threading.Event()
+            self.result = None
+            self.error = None
+
+    class _StubHandle:
+        def __init__(self, jid):
+            self.id = jid
+            self._job = _StubJob()
+
+    class _StubReplica:
+        def __init__(self, idx):
+            self.idx = idx
+            self.alive = True
+            self.error = None
+            self.submitted = []
+
+        def submit(self, spec, ckpt_path=None):
+            h = _StubHandle(len(self.submitted) + 1)
+            self.submitted.append(spec)
+            return h
+
+        def probe(self):
+            return {}
+
+        def idle(self):
+            return True
+
+        def withdraw(self, jid):
+            return False
+
+        def snapshot_row(self):
+            return {"alive": 1, "queued": 0}
+
+    r = _StubReplica(0)
+    router = FleetRouter([r], backoff_base_s=0.0)
+    try:
+        # Death then rejoin: the member sits in probation, ring empty.
+        router._dead.add(0)
+        router.ring.remove(0)
+        assert router.rejoin(_StubReplica(0))
+        assert router.ring.members() == []  # quarantined, not placed back
+        h = router.submit(object(), route_key="m")
+        assert h.status() == "routed"  # placed on the probation member,
+        assert router.replicas[0].submitted  # not hard-failed
+    finally:
+        router.close()
+
+
+def test_crashed_replica_rejoins_fresh_epoch_probation_then_work(tmp_path):
+    """The rejoin lifecycle end to end, foreground-deterministic: a
+    replica crashes mid-backlog (its jobs requeue onto the survivor),
+    an injected ``fleet.rejoin`` fault aborts the first rejoin attempt
+    (member stays dead, nothing leaks), the retry re-admits a FRESH
+    incarnation with a FRESH lease epoch behind probation probes, the
+    promotion moves its keys back (ring re-add), and the rejoined member
+    pulls requeued backlog through work stealing — every job completes
+    with the single-replica golden counts, zero lost, zero duplicated."""
+    fleet = ServiceFleet(
+        n_replicas=2, background=False, max_resident=1,
+        service_kwargs=SVC_KW, lease_dir=str(tmp_path / "leases"),
+        router_kwargs=dict(steal=True, unhealthy_after=2,
+                           probation_probes=2),
+    )
+    try:
+        handles = [fleet.submit(M3) for _ in range(4)]
+        owners = {h._job.replica for h in handles}
+        assert len(owners) == 1
+        victim = owners.pop()
+        from stateright_tpu.service.router import lease_member
+
+        member = lease_member(victim)
+        epoch0, _ = fleet.lease_store.state(member)
+        plan = FaultPlan().rule(
+            "fleet.replica_crash", "crash", after=6,
+            match={"replica": victim},
+        )
+        with active(plan):
+            deadline = time.monotonic() + 60
+            while fleet.stats()["replica_crashes"] < 1:
+                assert time.monotonic() < deadline, fleet.stats()
+                fleet.pump(1)
+        assert fleet.stats()["requeued_jobs"] >= 1
+        # First rejoin attempt: chaos-aborted BEFORE any state changes.
+        with active(FaultPlan().rule("fleet.rejoin", "io", times=1)):
+            assert not fleet.rejoin_replica(victim)
+        assert victim in fleet.router._dead
+        assert fleet.stats()["rejoins"] == 0
+        # The retry succeeds: fresh incarnation, fresh epoch, probation.
+        assert fleet.rejoin_replica(victim)
+        epoch1, state1 = fleet.lease_store.state(member)
+        assert (epoch1, state1) == (epoch0 + 1, "granted")
+        assert victim not in fleet.router._dead
+        assert victim not in fleet.router.ring.members()  # quarantined
+        deadline = time.monotonic() + 60
+        while fleet.stats()["rejoin_promotions"] < 1:
+            assert time.monotonic() < deadline, fleet.stats()
+            fleet.pump(1)
+        assert victim in fleet.router.ring.members()  # keys moved back
+        fleet.drain(timeout=600)
+        for h in handles:
+            r = h.result()
+            assert r.complete
+            assert (r.state_count, r.unique_state_count) == GOLD_2PC3
+        s = fleet.stats()
+        assert s["rejoins"] == 1 and s["rejoin_promotions"] == 1
+        # The rejoined member did real work: it stole requeued backlog
+        # off the survivor (max_resident=1 kept jobs queued there).
+        assert s["steals"] >= 1, s
+        assert any(h._job.replica == victim for h in handles), [
+            h._job.replica for h in handles
+        ]
+        # And new same-key submissions route to it again (ring ownership
+        # restored — the consistent-hashing rejoin promise, fleet-level).
+        h2 = fleet.submit(M3)
+        assert h2._job.replica == victim
+        fleet.drain(timeout=600)
+        r2 = h2.result()
+        assert (r2.state_count, r2.unique_state_count) == GOLD_2PC3
     finally:
         fleet.close()

@@ -121,13 +121,15 @@ def test_remote_fleet_zombie_replica_fenced_and_bit_identical(tmp_path):
 
 @pytest.mark.slow
 def test_fleet_procs_smoke_full_matrix():
-    """The whole acceptance matrix — kill -9, zombie, partition — as the
+    """The whole acceptance matrix — kill -9, zombie, partition, rejoin,
+    on BOTH store backends (shared directory + blob emulator) — as the
     smoke script runs it (real subprocesses, shared store root, timeline
-    verdicts). Slow-marked: three fleets' worth of subprocess boots."""
+    verdicts incl. the blob-root merge). Slow-marked: eight fleets'
+    worth of subprocess boots."""
     proc = subprocess.run(
         [sys.executable, os.path.join("scripts", "fleet_procs_smoke.py")],
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        capture_output=True, text=True, timeout=900,
+        capture_output=True, text=True, timeout=1800,
         env=dict(os.environ, JAX_PLATFORMS="cpu"),
     )
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
