@@ -1208,7 +1208,15 @@ def device_search_corpus(model_name: str = "2pc", n: int = 4):
     preloads the entry and completes warm. Acceptance: warm >= 5x faster,
     results bit-identical, and a corrupted entry (one flipped byte) is
     detected by the ckptio CRC and ignored — the third submission runs
-    cold and still completes correctly."""
+    cold and still completes correctly.
+
+    Corpus v2 edit-warm sub-rows: `warm_speedup_near` re-checks the same
+    definition under a RETUNED lowering (table_log2 + 1) — the family
+    index serves the published set through the near rung; and
+    `warm_speedup_partial` cancels a run past half the space — the cut
+    publishes the visited prefix + frontier snapshot and the successor
+    continues from it. Both must be >= 2x over their post-compile cold
+    reference with bit-identical results."""
     _pin_platform()
     import tempfile
 
@@ -1226,9 +1234,9 @@ def device_search_corpus(model_name: str = "2pc", n: int = 4):
         background=False,
     )
 
-    def timed_submit(svc):
+    def timed_submit(svc, **opts):
         t0 = time.monotonic()
-        h = svc.submit(model)
+        h = svc.submit(model, **opts)
         svc.drain(timeout=1800)
         return time.monotonic() - t0, h.result()
 
@@ -1246,13 +1254,18 @@ def device_search_corpus(model_name: str = "2pc", n: int = 4):
 
         # Satellite: flip one payload byte in the published entry — the
         # CRC footer must catch it and the next submission must complete
-        # correctly COLD (never wrong results).
+        # correctly COLD (never wrong results). (The directory also holds
+        # the v2 family index; target the ENTRY generation specifically.)
         import glob as _glob
 
         from stateright_tpu.faults.ckptio import corrupt_one_byte
 
         corrupt_one_byte(
-            _glob.glob(os.path.join(corpus_dir, "corpus-*.npz"))[0]
+            [
+                p
+                for p in _glob.glob(os.path.join(corpus_dir, "corpus-*.npz"))
+                if "-family-" not in os.path.basename(p)
+            ][0]
         )
         _sec3, third_r = timed_submit(warm_svc)
         stats = warm_svc.stats()
@@ -1261,8 +1274,46 @@ def device_search_corpus(model_name: str = "2pc", n: int = 4):
         ) >= 1
         warm_svc.close()
 
+        # -- corpus v2 edit-warm A/B: the NEAR rung ------------------------
+        # Same definition, retuned lowering (table_log2 + 1): the retuned
+        # key misses the exact rung, and the family index serves the
+        # published set for a delta-proportional (here: replay) re-check.
+        # Submissions with a huge target_state_count carry a different
+        # finish signature, so they absorb compile and give the retuned
+        # cold reference WITHOUT ever publishing a near-replayable member.
+        near_kw = dict(svc_kw, table_log2=svc_kw["table_log2"] + 1)
+        near_svc = CheckService(corpus_dir=corpus_dir, **near_kw)
+        big = 1 << 40
+        timed_submit(near_svc, target_state_count=big)  # compile warm-up
+        cold_near_sec, _ = timed_submit(near_svc, target_state_count=big + 1)
+        warm_near_sec, near_r = timed_submit(near_svc)
+        near_corpus = dict(near_r.detail.get("corpus") or {})
+        near_svc.close()
+
+    # -- corpus v2 edit-warm A/B: the PARTIAL rung -------------------------
+    # A mid-run cancel publishes the visited prefix + frontier snapshot;
+    # the successor continues from the cut instead of starting over. The
+    # cut lands past two thirds of the space so the continuation's win is
+    # the prefix it skips (cold reference: the post-compile cold_sec
+    # above) with headroom over the preload/pump overhead.
+    with tempfile.TemporaryDirectory(prefix="srtpu-corpus-p-") as pdir:
+        part_svc = CheckService(corpus_dir=pdir, **svc_kw)
+        hp = part_svc.submit(model)
+        cut = 2 * (golden[0] if golden else 1 << 20) // 3
+        while part_svc.pump() and hp._job.state_count < cut:
+            pass
+        hp.cancel()
+        warm_part_sec, part_r = timed_submit(part_svc)
+        part_corpus = dict(part_r.detail.get("corpus") or {})
+        part_svc.close()
+
     err = None
-    for name, r in (("warm", warm_r), ("corrupt-cold", third_r)):
+    for name, r in (
+        ("warm", warm_r),
+        ("corrupt-cold", third_r),
+        ("near-warm", near_r),
+        ("partial-warm", part_r),
+    ):
         got = (r.state_count, r.unique_state_count, r.max_depth)
         want = (
             cold_r.state_count, cold_r.unique_state_count, cold_r.max_depth,
@@ -1294,6 +1345,28 @@ def device_search_corpus(model_name: str = "2pc", n: int = 4):
             f"warm submission only {warm_speedup}x faster than cold "
             "(acceptance >= 5x)"
         )
+    warm_speedup_near = round(cold_near_sec / max(warm_near_sec, 1e-9), 2)
+    warm_speedup_partial = round(cold_sec / max(warm_part_sec, 1e-9), 2)
+    if err is None and near_corpus.get("warm_kind") != "near":
+        err = (
+            "retuned submission did not take the near rung "
+            f"(detail: {near_corpus})"
+        )
+    if err is None and part_corpus.get("warm_kind") != "partial":
+        err = (
+            "post-cut submission did not take the partial rung "
+            f"(detail: {part_corpus})"
+        )
+    if err is None and warm_speedup_near < 2.0:
+        err = (
+            f"near-warm submission only {warm_speedup_near}x faster than "
+            "cold (acceptance >= 2x)"
+        )
+    if err is None and warm_speedup_partial < 2.0:
+        err = (
+            f"partial-warm submission only {warm_speedup_partial}x faster "
+            "than cold (acceptance >= 2x)"
+        )
 
     out = {
         "states": warm_r.state_count,
@@ -1303,6 +1376,8 @@ def device_search_corpus(model_name: str = "2pc", n: int = 4):
         "compile_sec": 0.0,  # both sides measured post-compile (A/B fair)
         "sec_cold": round(cold_sec, 4),
         "warm_speedup": warm_speedup,
+        "warm_speedup_near": warm_speedup_near,
+        "warm_speedup_partial": warm_speedup_partial,
         "corpus_preloaded": int(warm_corpus.get("preloaded_states", 0)),
         "corrupt_detected": corrupt_detected,
     }
@@ -1477,7 +1552,12 @@ DEVICE_DETAIL_FIELDS = (
     # the warm submission's (`sec`), the cold/warm ratio (acceptance >=
     # 5x), the preloaded-state count, and the corrupted-entry CRC verdict
     # (True = a flipped byte was detected and the run fell back cold).
-    "sec_cold", "warm_speedup", "corpus_preloaded", "corrupt_detected",
+    # v2 edit-warm sub-rows: the near rung (same definition, retuned
+    # lowering — family-index replay) and the partial rung (mid-run cut,
+    # frontier continuation), each against a post-compile cold reference
+    # (acceptance >= 2x each).
+    "sec_cold", "warm_speedup", "warm_speedup_near", "warm_speedup_partial",
+    "corpus_preloaded", "corrupt_detected",
     # Dedup-first semantics (BENCH_SEMANTICS=1 row): the cache-only wall
     # time next to the plane's (`sec`), the measured ratio (acceptance >=
     # 2x with bit-identical verdicts), and the plane's own evidence —

@@ -72,6 +72,16 @@ SIM_DEDUP_KINDS = ("trace", "shared")
 #: distinguishes. Kept here so the mapping below is checkable by lint/tests.
 COST_VARIANTS = ("split", "kv", "phased", "capped", "capped-kv", "pallas")
 
+#: Corpus warm-start match kinds (store/warm.py — the ONE warm-start seam,
+#: ROADMAP item 4): "exact" replays a complete entry published under this
+#: run's own content key, "near" replays a complete entry from the same
+#: definition-hash family (different table packing; membership and results
+#: are packing-invariant), "partial" resumes an incomplete entry's frontier
+#: snapshot and continues the search naturally. Every engine's warm path
+#: and the `job.warm_start` event `kind` field draw from this tuple;
+#: check_registry() pins the per-engine aliases against it.
+WARM_KINDS = ("exact", "near", "partial")
+
 
 def check_registry() -> list:
     """Cross-module drift probe used by `python -m stateright_tpu.analysis`:
@@ -117,10 +127,23 @@ def check_registry() -> list:
                 "knobs.COST_VARIANTS"
             )
 
+    # The warm-start seam (store/warm.py) is jax-free like this module:
+    # probe its alias before the jax-importing engine block so even a
+    # jax-free image catches a restated WARM_KINDS copy there.
+    from .store import warm
+
+    if warm.WARM_KINDS is not WARM_KINDS:
+        problems.append(
+            "store.warm.WARM_KINDS is a restated copy, not the "
+            "knobs.WARM_KINDS alias"
+        )
+
     try:
+        from .parallel.sharded import ShardedSearch
         from .service.scheduler import ServiceEngine
         from .tensor import inserts
         from .tensor.frontier import FrontierSearch
+        from .tensor.resident import ResidentSearch
         from .tensor.simulation import DeviceSimulation
     except ModuleNotFoundError as e:
         # jax-free images run the lint half only (`--skip-audit`); the
@@ -164,4 +187,22 @@ def check_registry() -> list:
             "DeviceSimulation.DEDUP_KINDS is a restated copy, not the "
             "knobs.SIM_DEDUP_KINDS alias"
         )
+    # Corpus warm-start: every engine (and the service scheduler) must
+    # alias the one WARM_KINDS tuple AND the one preload seam — a private
+    # per-engine warm path is exactly the restatement ROADMAP item 4(c)
+    # removed (the resident/sharded/simulation warm-start gap).
+    for cls in (
+        FrontierSearch, ResidentSearch, ShardedSearch, DeviceSimulation,
+        ServiceEngine,
+    ):
+        if getattr(cls, "WARM_KINDS", None) is not WARM_KINDS:
+            problems.append(
+                f"{cls.__name__}.WARM_KINDS is a restated copy, not the "
+                "knobs.WARM_KINDS alias"
+            )
+        if getattr(cls, "WARM_SEAM", None) is not warm:
+            problems.append(
+                f"{cls.__name__}.WARM_SEAM is not the store.warm module "
+                "(the one warm-start/preload seam)"
+            )
     return problems

@@ -46,15 +46,30 @@ DETAIL_KEYS = {
 }
 
 #: Keys of `detail["corpus"]` (service/scheduler.py `build_result`, the
-#: frontier engine's warm_start) — present only on corpus-enabled runs.
+#: engines' warm_start paths) — present only on corpus-enabled runs.
 CORPUS_DETAIL_KEYS = {
     "warm_start": "True when the job preloaded a published visited set",
+    "warm_kind": "which warm-ladder rung served the preload: 'exact' | "
+                 "'near' | 'partial' (knobs.WARM_KINDS; absent on cold "
+                 "runs)",
     "preloaded_states": "states preloaded into the spill tier + summary",
     "verdict_preloads": "semantics verdict bits the warm preload seeded "
                         "into the canonical cache (dedup-first semantics)",
-    "published": "True when this job published a NEW corpus entry",
+    "published": "True when this job published a NEW corpus entry "
+                 "(complete or partial)",
     "key": "content-key prefix (model definition + lowering + finish hash)",
 }
+
+#: Corpus-v2 REGISTRY counters (store/corpus.py `metrics()`, "corpus"
+#: source) — the delta-proportional re-verification plane's scrape names,
+#: pinned here (and in tests/test_bench_contract.py) exactly like the
+#: detail keys above so dashboards never chase a renamed counter.
+CORPUS_V2_COUNTERS = (
+    "partial_publishes",    # partial entries written on non-DONE exits
+    "partial_preloads",     # warm-from-partial admissions
+    "near_match_hits",      # family-index fallbacks that served an entry
+    "superseded_entries",   # partials deleted by a later complete publish
+)
 
 #: Keys of `detail["service"]` (service/metrics.py JobMetrics.to_dict).
 SERVICE_DETAIL_KEYS = {
@@ -203,7 +218,8 @@ EVENT_TYPES = {
     "job.preempted": ("job",),       # parked for waiting jobs (re-admits)
     "job.requeued": ("job", "src"),  # moved off a dead replica
     "job.resumed": ("job",),         # re-admitted from a checkpoint journal
-    "job.warm_start": ("job",),      # corpus preloaded at admission (states=n)
+    "job.warm_start": ("job", "kind"),  # corpus preloaded at admission
+    # (states=n; kind=exact|near|partial — the warm-ladder rung served)
     "job.quarantined": ("job",),     # poison job parked by the retry policy
     "job.done": ("job",),
     "job.cancelled": ("job",),

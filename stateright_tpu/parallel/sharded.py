@@ -67,8 +67,9 @@ else:  # pragma: no cover - exercised on jax 0.4.x images
 
 from ..faults.ckptio import fenced_savez, load_latest
 from ..faults.plan import maybe_fault
-from ..knobs import INSERT_VARIANTS, STORE_KINDS
+from ..knobs import INSERT_VARIANTS, STORE_KINDS, WARM_KINDS
 from ..obs import N_COLS, REGISTRY, StepRing, as_tracer
+from ..store import warm as warm_seam
 from ..tensor.fingerprint import pack_fp
 from ..core.discovery import HasDiscoveries
 from ..core.model import Expectation
@@ -202,6 +203,11 @@ class _Carry(NamedTuple):
 class ShardedSearch:
     """Whole-search multi-chip engine for a `TensorModel` over a 1-D mesh."""
 
+    # Warm-knob registry pins (knobs.check_registry): the kind vocabulary
+    # and the mechanics both alias the ONE seam, never a local copy.
+    WARM_KINDS = WARM_KINDS
+    WARM_SEAM = warm_seam
+
     def __init__(
         self,
         model: TensorModel,
@@ -318,6 +324,12 @@ class ShardedSearch:
         # calls so budget/timeout suspensions and overflows are resumable.
         self._carry = None
         self._q_compacted = False
+        # Corpus warm start (store/warm.py): replay meta for a complete
+        # entry, plus the kind/count surfaced in SearchResult.detail.
+        self._warm = None
+        self._warm_states = 0
+        self._warm_kind = None
+        self._warm_summary_pending = False
 
     def _fresh_stores(self) -> None:
         """(Re)build the rank-local spill tiers, one per shard."""
@@ -942,6 +954,153 @@ class ShardedSearch:
 
     # -- host entry ------------------------------------------------------------
 
+    def warm_start(self, entry, kind: Optional[str] = None) -> int:
+        """Seed this search from a published `CorpusEntry` (store/warm.py).
+
+        The entry's visited set is split by the fingerprint→owner map
+        (`lo % n_chips` — the same routing the all-to-all uses) and each
+        shard's slice preloads that shard's rank-local spill tier; the
+        entry's serialized Bloom summary OR-s into every shard (a sound
+        superset — shards only probe states they own).
+
+        Complete entries replay: the run drains its re-expanded seed
+        against the preloaded set and the published result is restored
+        verbatim (caller gates on `warm.can_replay`). Partial entries
+        continue: the frontier snapshot is routed to its owner shards as
+        each shard's live queue and the run picks up mid-search (caller
+        gates on `warm.can_continue`). Returns states preloaded."""
+        if self._stores is None:
+            raise ValueError(
+                "warm_start requires store='tiered' (the preloaded set "
+                "lives in the per-shard spill tiers)"
+            )
+        if self._carry is not None:
+            # srlint: fault-ok caller-contract guard, not an I/O/device surface
+            raise RuntimeError(
+                "cannot warm-start a suspended search; reset() first"
+            )
+        lo, _hi = warm_seam.split_fps(entry.fps)
+        owners = lo % np.uint32(self.n_chips)
+        n = 0
+        for i, s in enumerate(self._stores):
+            n += warm_seam.preload_store(s, entry, mask=(owners == i))
+        self._warm_states = n
+        if getattr(entry, "complete", True):
+            self._warm = dict(entry.meta)
+            self._warm_kind = kind or "exact"
+            self._warm_summary_pending = True
+            return n
+        if getattr(entry, "frontier", None) is None:
+            raise ValueError(
+                "partial corpus entry has no frontier snapshot (coverage-"
+                "only entries cannot seed a continuation)"
+            )
+        self._warm_kind = "partial"
+        self._seed_partial_carry(entry)
+        return n
+
+    def _seed_partial_carry(self, entry) -> None:
+        """Host-build the suspended per-shard carry for a partial-entry
+        continuation (the `load_checkpoint` recipe, sourced from a corpus
+        frontier snapshot instead of a checkpoint archive)."""
+        from jax.sharding import NamedSharding
+
+        N_ = self.n_chips
+        Q = self._Q
+        S = 1 << self.table_log2
+        L = self.model.lanes
+        P_ = max(len(self.props), 1)
+        f = entry.frontier
+        st = np.asarray(f["states"], dtype=np.uint32)
+        f_lo = np.asarray(f["lo"], dtype=np.uint32)
+        f_hi = np.asarray(f["hi"], dtype=np.uint32)
+        eb = warm_seam.pack_ebits(np.asarray(f["ebits"], dtype=bool))
+        dp = np.asarray(f["depths"], dtype=np.uint32)
+        owners = (f_lo % np.uint32(N_)).astype(np.int64)
+        meta = entry.meta
+        q_states = np.zeros((N_, Q, L), dtype=np.uint32)
+        q_lo = np.zeros((N_, Q), dtype=np.uint32)
+        q_hi = np.zeros((N_, Q), dtype=np.uint32)
+        q_ebits = np.zeros((N_, Q), dtype=np.uint32)
+        q_depth = np.zeros((N_, Q), dtype=np.uint32)
+        tail = np.zeros(N_, dtype=np.int32)
+        for i in range(N_):
+            rows = np.flatnonzero(owners == i)  # FIFO order preserved
+            m = rows.size
+            if m > Q - self.batch_size:
+                raise ValueError(
+                    "frontier snapshot too large for a shard's queue "
+                    f"(shard {i}: {m} rows, capacity {Q}); raise "
+                    "table_log2"
+                )
+            q_states[i, :m] = st[rows]
+            q_lo[i, :m] = f_lo[rows]
+            q_hi[i, :m] = f_hi[rows]
+            q_ebits[i, :m] = eb[rows]
+            q_depth[i, :m] = dp[rows]
+            tail[i] = m
+        sc = int(meta.get("state_count", 0))
+        disc_mask = 0
+        disc_lo = np.zeros((N_, P_), dtype=np.uint32)
+        disc_hi = np.zeros((N_, P_), dtype=np.uint32)
+        names = [p.name for p in self.props]
+        for name, fp in dict(meta.get("discoveries", {})).items():
+            if name in names:
+                j = names.index(name)
+                disc_mask |= 1 << j
+                w_lo = np.uint32(int(fp) & 0xFFFFFFFF)
+                disc_lo[int(w_lo) % N_, j] = w_lo
+                disc_hi[int(w_lo) % N_, j] = np.uint32(int(fp) >> 32)
+        # unique/max_depth are per-shard locals the result sums/maxes; the
+        # prefix totals ride on shard 0 so the reduction lands on the
+        # published counts plus whatever the continuation adds.
+        unique = np.zeros(N_, dtype=np.int32)
+        unique[0] = int(meta.get("unique_count", 0))
+        fields = {
+            "t_lo": np.zeros((N_, S), np.uint32),
+            "t_hi": np.zeros((N_, S), np.uint32),
+            "p_lo": np.zeros((N_, S), np.uint32),
+            "p_hi": np.zeros((N_, S), np.uint32),
+            "q_states": q_states,
+            "q_lo": q_lo,
+            "q_hi": q_hi,
+            "q_ebits": q_ebits,
+            "q_depth": q_depth,
+            "head": np.zeros(N_, np.int32),
+            "tail": tail,
+            "gen_lo": np.full(N_, sc & 0xFFFFFFFF, np.uint32),
+            "gen_hi": np.full(N_, sc >> 32, np.uint32),
+            "unique_count": unique,
+            "max_depth": np.full(
+                N_, int(meta.get("max_depth", 0)), np.uint32
+            ),
+            "discovered": np.full(N_, disc_mask, np.uint32),
+            "disc_lo": disc_lo,
+            "disc_hi": disc_hi,
+            "cont": np.full(N_, bool(tail.sum() > 0)),
+            "overflow": np.zeros(N_, np.uint32),
+            "steps": np.zeros(N_, np.int32),
+            "hot_claims": np.zeros(N_, np.int32),
+            "s_states": np.zeros((N_, self._SQ, L), np.uint32),
+            "s_lo": np.zeros((N_, self._SQ), np.uint32),
+            "s_hi": np.zeros((N_, self._SQ), np.uint32),
+            "s_ebits": np.zeros((N_, self._SQ), np.uint32),
+            "s_depth": np.zeros((N_, self._SQ), np.uint32),
+            "s_tail": np.zeros(N_, np.int32),
+            "summary": np.stack([s.summary_np for s in self._stores]),
+            "tm_rows": np.zeros((N_, self._TMR, N_COLS), np.uint32),
+        }
+        sh = NamedSharding(self.mesh, P(self.axis))
+        self._carry = _Carry(
+            **{
+                f_: jax.device_put(jnp.asarray(v), sh)
+                for f_, v in fields.items()
+            }
+        )
+        # Queue rows no longer cover every unique state (the prefix lives
+        # in the spill tiers) — dump_states must decline.
+        self._q_compacted = True
+
     def run(
         self,
         finish_when: HasDiscoveries = HasDiscoveries.ALL,
@@ -1086,6 +1245,23 @@ class ShardedSearch:
                     *seed32,
                     jnp.int32(max_steps),
                 )
+                if self._warm_summary_pending:
+                    # Complete-entry replay: seed_carry built empty Bloom
+                    # words; swap in each shard's preloaded summary so the
+                    # re-expanded seed dedups against the published set.
+                    from jax.sharding import NamedSharding
+
+                    self._carry = self._carry._replace(
+                        summary=jax.device_put(
+                            jnp.asarray(
+                                np.stack(
+                                    [s.summary_np for s in self._stores]
+                                )
+                            ),
+                            NamedSharding(self.mesh, P(self.axis)),
+                        )
+                    )
+                    self._warm_summary_pending = False
             req = jnp.uint32(required_mask)
             anym = jnp.uint32(any_mask)
             tmd = jnp.uint32(target_max_depth or 0)
@@ -1211,9 +1387,21 @@ class ShardedSearch:
                 )
                 witnesses = witnesses[witnesses != 0]
                 discoveries[p.name] = int(witnesses[0])
+        unique_total = int(unique_counts.sum())
+        if self._warm is not None and complete:
+            # Complete-entry replay: the drain above only proves the seed
+            # re-closes against the preloaded set; the published result is
+            # the result (can_replay guarantees the cold run would match).
+            m = self._warm
+            state_count = int(m.get("state_count", state_count))
+            unique_total = int(m.get("unique_count", unique_total))
+            result_max_depth = int(m.get("max_depth", result_max_depth))
+            discoveries = {
+                k: int(v) for k, v in m.get("discoveries", {}).items()
+            }
         return SearchResult(
             state_count=state_count,
-            unique_state_count=int(unique_counts.sum()),
+            unique_state_count=unique_total,
             max_depth=result_max_depth,
             discoveries=discoveries,
             complete=complete,
@@ -1222,6 +1410,17 @@ class ShardedSearch:
             detail={
                 # fp-sharding balance evidence (task: per-chip spread).
                 "per_chip_unique": [int(x) for x in unique_counts],
+                **(
+                    {
+                        "corpus": {
+                            "warm_start": True,
+                            "preloaded_states": self._warm_states,
+                            "warm_kind": self._warm_kind,
+                        }
+                    }
+                    if self._warm_kind is not None
+                    else {}
+                ),
                 **(self.store_stats() or {}),
                 **(
                     {"telemetry": self.telemetry_summary()}
@@ -1420,6 +1619,10 @@ class ShardedSearch:
         self._parent_map = None
         self._last_tables = None
         self._q_compacted = False
+        self._warm = None
+        self._warm_states = 0
+        self._warm_kind = None
+        self._warm_summary_pending = False
         if self._ring is not None:
             self._ring = self._ring.fresh()  # telemetry starts over too
         if self._stores is not None:

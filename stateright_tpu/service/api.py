@@ -182,6 +182,11 @@ class CheckService:
         # payload) triples whose off-lock half (_drain_finalizers) still
         # has to run — corpus npz write, result build, event, wakeup.
         self._finalizing: list = []
+        # Fire-and-forget corpus publish payloads from the NON-finalize
+        # terminal/park paths (cancel, preemption, shutdown): partial
+        # entries whose npz write must still happen off-lock, but whose
+        # job needs no result/event completion here.
+        self._publishing: list = []
         self._next_id = 1
         self._lock = threading.RLock()
         self._work = threading.Condition(self._lock)
@@ -267,6 +272,7 @@ class CheckService:
             if prefetch is not None:
                 job.content_key = prefetch.content_key
                 job.warm_entry = prefetch.warm_entry
+                job.warm_entry_kind = prefetch.warm_entry_kind
                 job.warm_checked = prefetch.warm_checked
                 # The off-lock prefetch already seeded the canonical verdict
                 # cache (scheduler.prefetch_warm); carry the count so the
@@ -344,17 +350,24 @@ class CheckService:
             if job.status in JobStatus.FINISHED:
                 return False
             self._adm.remove(job)
-            self._engine.retire(job)
             job.status = JobStatus.CANCELLED
+            # Partial-publish what the job visited (corpus v2) BEFORE
+            # retire drops the frontier and the journal is released — a
+            # cancelled check's successor warm-starts from the cut.
+            payload = self._engine.prepare_publish(job)
+            if payload is not None:
+                self._publishing.append(payload)
+            self._engine.retire(job)
             job.metrics.finished_at = time.monotonic()
-            job.journal = None  # finished: no checkpoint/publish consumer
+            job.journal = None  # finished: no checkpoint consumer
             self._events.emit(
                 "job.cancelled", job=job.id, trace=job.trace
             )
             job.event.set()
             self._work.notify_all()
             self._idle.notify_all()
-            return True
+        self._drain_publishes()  # npz write off-lock, on the caller
+        return True
 
     def discovery_paths(self, job_id: int) -> dict:
         job = self._get(job_id)
@@ -471,11 +484,24 @@ class CheckService:
         )
         job.status = status
         job.metrics.finished_at = time.monotonic()
-        self._engine.retire(job)
-        # Under-lock half of the publish: gate + journal snapshot (memory
-        # concatenation only).
+        # Under-lock half of the publish: gate + journal/frontier snapshot
+        # (memory concatenation only). MUST run before retire — retire
+        # drops the frontier a partial publish snapshots.
         payload = self._engine.prepare_publish(job)
+        self._engine.retire(job)
         self._finalizing.append((job, status, payload))
+
+    def _drain_publishes(self) -> None:
+        """Write out fire-and-forget partial-publish payloads (cancel /
+        preemption / shutdown cuts) off-lock. Chaos-covered like every
+        publish: an aborted write degrades to an unpublished entry, never
+        a wrong one."""
+        while True:
+            with self._lock:
+                if not self._publishing:
+                    return
+                payload = self._publishing.pop(0)
+            self._engine.publish_payload(payload)  # never raises
 
     def _drain_finalizers(self) -> None:
         """Complete every deferred finalize: publish off-lock, then (back
@@ -483,6 +509,7 @@ class CheckService:
         terminal event, and wake waiters. Called with the service lock
         NOT held (pump()/_loop() drain after releasing it; close() after
         joining the scheduler thread)."""
+        self._drain_publishes()
         while True:
             with self._lock:
                 if not self._finalizing:
@@ -615,6 +642,14 @@ class CheckService:
             g.jobs.remove(job)
         job.status = JobStatus.PREEMPTED
         job.metrics.preemptions += 1
+        # Partial-publish the preemption cut (corpus v2) BEFORE the spill
+        # drops the in-memory frontier: if this replica dies while the job
+        # is parked, a successor process warm-starts from the published
+        # prefix instead of cold. The npz write drains off-lock with the
+        # round's other deferred completion work.
+        payload = self._engine.prepare_publish(job)
+        if payload is not None:
+            self._publishing.append(payload)
         if self.spill_dir is not None and job.pending_lanes:
             job.spill_frontier(
                 os.path.join(self.spill_dir, f"job{job.id}.frontier.npz")
@@ -812,8 +847,13 @@ class CheckService:
             for job in list(self._jobs.values()):
                 if job.status not in JobStatus.FINISHED:
                     self._adm.remove(job)
-                    self._engine.retire(job)
                     job.status = JobStatus.CANCELLED
+                    # Shutdown cut: publish the visited prefix so a fresh
+                    # process resumes warm (drained below, off-lock).
+                    payload = self._engine.prepare_publish(job)
+                    if payload is not None:
+                        self._publishing.append(payload)
+                    self._engine.retire(job)
                     self._events.emit(
                         "job.cancelled", job=job.id, trace=job.trace,
                         shutdown=True,

@@ -195,6 +195,16 @@ class Job:
         self.warm_checked = False
         self.warm_states = 0
         self.published = False
+        # Corpus v2 warm ladder (store/warm.py): which rung served the
+        # preload ("exact" | "near" | "partial", knobs.WARM_KINDS; None
+        # on cold runs), a continuable partial entry parked by
+        # `_maybe_warm` for `admit` to convert into a resume payload, and
+        # the key the GC pin was taken under (the SERVED entry's key —
+        # for the near rung that differs from this job's own content key).
+        self.warm_kind = None
+        self.partial_entry = None
+        self.warm_entry_kind = None
+        self.corpus_pin_key = None
         # Dedup-first semantics (semantics/canonical.py): verdict bits the
         # warm preload seeded into the canonical cache, and whether this
         # job holds a corpus GC pin on its entry (released at retire).

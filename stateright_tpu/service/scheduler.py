@@ -46,7 +46,8 @@ import jax.numpy as jnp
 
 from ..core.model import Expectation
 from ..faults.plan import maybe_fault
-from ..knobs import STORE_KINDS
+from ..knobs import STORE_KINDS, WARM_KINDS
+from ..store import warm as warm_seam
 from ..obs import StepRing, as_events, as_tracer
 from ..tensor.fingerprint import pack_fp, salt_fp, unpack_fp
 from ..tensor.frontier import (
@@ -58,7 +59,7 @@ from ..tensor.frontier import (
     replay_fp_chain,
     seed_init,
 )
-from .queue import Job, JobStatus
+from .queue import Job, JobResume, JobStatus
 
 
 def _build_service_step(model, K, props, insert, store):
@@ -172,6 +173,11 @@ class ServiceEngine:
 
     # Same visited-set designs the standalone engines race.
     INSERT_VARIANTS = FrontierSearch.INSERT_VARIANTS
+    # Corpus warm ladder: the ONE kind vocabulary (knobs.WARM_KINDS) and
+    # the ONE preload/soundness seam (store/warm.py) — alias identity
+    # pinned by knobs.check_registry, like INSERT_VARIANTS above.
+    WARM_KINDS = WARM_KINDS
+    WARM_SEAM = warm_seam
 
     def __init__(
         self,
@@ -319,13 +325,25 @@ class ServiceEngine:
         return None if self._corpus is None else self._corpus.metrics()
 
     def _content_key_for(self, job: Job) -> str:
+        """The job's corpus content address (see `_key_and_components_for`)."""
+        return self._key_and_components_for(job)[0]
+
+    def _components_for(self, job: Job) -> dict:
+        """The job's factored content-key components (corpus v2: the
+        family index's near-match vocabulary)."""
+        return self._key_and_components_for(job)[1]
+
+    def _key_and_components_for(self, job: Job) -> tuple:
         """The job's corpus content address: model definition hash x the
         engine lowering/table config x the job's finish policy — exactly
-        the inputs that determine a cold run's visited set and result.
-        Cached per (model instance, finish signature): the jaxpr trace
-        behind the definition hash costs milliseconds and submissions
-        repeat."""
-        from ..store.corpus import content_key, finish_signature
+        the inputs that determine a cold run's visited set and result —
+        plus the same address factored into its near-match components
+        (store/corpus.key_components). Cached per (model instance, finish
+        signature): the jaxpr trace behind the definition hash costs
+        milliseconds and submissions repeat."""
+        from ..store.corpus import (
+            content_key, finish_signature, key_components,
+        )
 
         fin = finish_signature(
             job.finish_when, job.target_state_count, job.target_max_depth
@@ -336,25 +354,24 @@ class ServiceEngine:
         # key only serves if the weakly-held model is the SAME object —
         # a stale hit after id reuse would preload the wrong corpus.
         if hit is not None and hit[0]() is job.model:
-            return hit[1]
+            return hit[1], hit[2]
         cfg = self._store.config
-        key = content_key(
-            job.model,
-            lowering={
-                "batch_size": self.batch_size,
-                "table_log2": self.table.size.bit_length() - 1,
-                "insert_variant": self.insert_variant,
-                "store": self.store,
-                "summary_log2": cfg.summary_log2,
-                "summary_hashes": cfg.summary_hashes,
-                "finish": fin,
-            },
-        )
+        lowering = {
+            "batch_size": self.batch_size,
+            "table_log2": self.table.size.bit_length() - 1,
+            "insert_variant": self.insert_variant,
+            "store": self.store,
+            "summary_log2": cfg.summary_log2,
+            "summary_hashes": cfg.summary_hashes,
+            "finish": fin,
+        }
+        key = content_key(job.model, lowering)
+        comp = key_components(job.model, lowering)
         try:
-            self._corpus_keys[sig] = (weakref.ref(job.model), key)
+            self._corpus_keys[sig] = (weakref.ref(job.model), key, comp)
         except TypeError:
             pass  # weakref-less exotic model: re-derive next time
-        return key
+        return key, comp
 
     def prefetch_warm(self, job: Job) -> None:
         """The OFF-LOCK half of warm-start (ROADMAP item 4 leftover):
@@ -370,41 +387,92 @@ class ServiceEngine:
         if job.content_key is None:
             job.content_key = self._content_key_for(job)
         job.warm_checked = True
-        job.warm_entry = self._corpus.lookup(job.content_key)
-        if job.warm_entry is not None:
+        entry, kind = self._warm_lookup(job)
+        job.warm_entry = entry
+        job.warm_entry_kind = kind
+        if entry is not None and entry.complete:
             # Dedup-first semantics: seed the canonical verdict cache HERE,
             # still on the client thread — inserting a 2^16-entry packed
             # table under the service lock would stall unrelated polls, the
             # same invariant the publish side honors (publish_payload).
             # Verdict bits are class-addressed, so preloading before the
             # job is admitted (or even if it never is) cannot be wrong.
-            job.verdict_preloads = self._corpus.preload_verdicts(
-                job.warm_entry
-            )
+            job.verdict_preloads = self._corpus.preload_verdicts(entry)
+
+    def _warm_lookup(self, job: Job):
+        """The corpus-v2 warm ladder, best rung first (knobs.WARM_KINDS;
+        soundness rules in store/warm.py): (1) "exact" — a complete entry
+        under this job's own content key (key identity IS the gate: the
+        key already encodes batch + finish); (2) "partial" — this key's
+        own partial entry, continuable; (3) "near" — a family entry with
+        the same definition hash and a different table packing, replayed
+        when complete (same batch + finish) or continued when partial.
+        Returns (entry, kind) or (None, None) — every miss, gate decline,
+        corrupt entry, or injected `corpus.load` fault means cold."""
+        from ..store.corpus import finish_signature
+
+        entry = self._corpus.lookup(job.content_key)
+        if entry is not None and entry.complete:
+            return entry, "exact"
+        props = list(job.model.properties())
+        entry = self._corpus.lookup_partial(job.content_key)
+        if entry is not None and warm_seam.can_continue(
+            entry, self.batch_size, job.finish_when, props,
+            job.target_state_count, job.target_max_depth,
+        ):
+            return entry, "partial"
+        comp = self._components_for(job)
+        entry = self._corpus.lookup_near(comp, exclude=(job.content_key,))
+        if entry is not None:
+            if entry.complete and warm_seam.can_replay(
+                entry,
+                self.batch_size,
+                finish_signature(
+                    job.finish_when, job.target_state_count,
+                    job.target_max_depth,
+                ),
+            ):
+                return entry, "near"
+            if not entry.complete and warm_seam.can_continue(
+                entry, self.batch_size, job.finish_when, props,
+                job.target_state_count, job.target_max_depth,
+            ):
+                return entry, "partial"
+        return None, None
 
     def _maybe_warm(self, job: Job) -> None:
-        """Corpus preload at admission. On a hit, the published visited
-        set lands in the spill tier + Bloom summary RE-SALTED with this
-        job's salt (so co-resident jobs never see each other's preload)
-        and the publisher's result metadata is kept on the job for the
-        completion-time replay. The entry itself was prefetched OFF the
-        service lock (`prefetch_warm`); only the device/host preload —
-        engine state — happens here. Every failure mode — miss, corrupt
-        entry, injected `corpus.load` fault — degrades to a cold run."""
+        """Corpus preload at admission. On a replayable (complete) hit,
+        the published visited set lands in the spill tier + Bloom summary
+        RE-SALTED with this job's salt (so co-resident jobs never see
+        each other's preload) and the publisher's result metadata is kept
+        on the job for the completion-time replay. A continuable PARTIAL
+        hit parks the entry on `job.partial_entry` instead — `admit`
+        converts it into a resume payload and takes the journal-reseed
+        path. The entry itself was prefetched OFF the service lock
+        (`prefetch_warm`); only the device/host preload — engine state —
+        happens here. Every failure mode — miss, corrupt entry, injected
+        `corpus.load` fault — degrades to a cold run."""
         if self._corpus is None:
             return
         if job.content_key is None:
             job.content_key = self._content_key_for(job)
         if job.warm is not None:
             return  # already preloaded (re-admission path)
-        entry, job.warm_entry = job.warm_entry, None
+        prefetched = job.warm_checked
+        entry, kind = job.warm_entry, job.warm_entry_kind
+        job.warm_entry = None
+        job.warm_entry_kind = None
         if entry is None and not job.warm_checked:
             # No prefetch reached this admission (direct engine use): one
-            # inline lookup. A prefetch that MISSED (or was degraded by an
-            # injected corpus.load fault) is never retried here — the
-            # chaos plane's "fault => cold run" contract stands.
-            entry = self._corpus.lookup(job.content_key)
+            # inline ladder walk. A prefetch that MISSED (or was degraded
+            # by an injected corpus.load fault) is never retried here —
+            # the chaos plane's "fault => cold run" contract stands.
+            entry, kind = self._warm_lookup(job)
+            job.warm_checked = True
         if entry is None:
+            return
+        if not entry.complete:
+            job.partial_entry = entry
             return
         with self._tracer.span(
             "corpus.preload", cat="store", job=job.id, trace=job.trace,
@@ -418,44 +486,82 @@ class ServiceEngine:
             )
         self._corpus.note_preload(n)
         job.warm = entry.meta
+        job.warm_kind = kind or "exact"
         job.warm_states = n
         # Dedup-first semantics: the verdict table was preloaded OFF-LOCK
         # by prefetch_warm; only the rare no-prefetch admissions (direct
         # engine use, crash-resume on a survivor) seed it here — single-job
         # paths where holding the lock over the insert loop stalls nobody.
-        # Gate on warm_checked, not the preload COUNT: a prefetch that found
-        # every fingerprint already cached legitimately returns 0.
-        if not job.warm_checked:
+        # Gate on whether a prefetch RAN, not the preload COUNT: a prefetch
+        # that found every fingerprint already cached legitimately
+        # returns 0.
+        if not prefetched:
             job.verdict_preloads = self._corpus.preload_verdicts(entry)
-        # Pin the entry against corpus GC while this job depends on it
-        # (released at retire).
-        self._corpus.pin(job.content_key)
+        # Pin the SERVED entry against corpus GC while this job depends on
+        # it (released at retire) — for the near rung that is the family
+        # entry's key, not this job's own.
+        self._corpus.pin(entry.key)
         job.corpus_pinned = True
+        job.corpus_pin_key = entry.key
         self._events.emit(
             "job.warm_start", job=job.id, trace=job.trace, states=n,
-            key=job.content_key[:16],
+            key=job.content_key[:16], kind=job.warm_kind,
         )
 
     def prepare_publish(self, job: Job) -> Optional[tuple]:
-        """The UNDER-LOCK half of a corpus publish: apply the gate (a
-        COMPLETE exhaustive cold run only — never early-exited, timed out,
-        or cancelled; only then is the journal the full reachable set) and
-        snapshot the journal into packed arrays + metadata. Returns the
-        payload for `publish_payload`, or None when the job must not
-        publish. Cheap (memory concatenation) by design: the npz write
-        and the Bloom rehash — the slow parts — happen off-lock."""
+        """The UNDER-LOCK half of a corpus publish: apply the gate and
+        snapshot the journal into packed arrays + metadata. A COMPLETE
+        exhaustive cold run (never early-exited, timed out, or cancelled
+        — only then is the journal the full reachable set) publishes a
+        complete entry; every OTHER terminal outcome with a non-empty
+        journal — early exit, timeout, cancellation, budget cap — plus
+        the preemption snapshot publishes a PARTIAL entry (corpus v2):
+        what the job visited, and (when the cut is a clean step boundary,
+        i.e. the frontier is still pending) the frontier snapshot a
+        successor continues from. Discovery early-exits drop their
+        frontier (the triggering batch's successors were discarded, so
+        the snapshot would not be a true FIFO prefix) and publish
+        coverage-only. Returns the payload for `publish_payload`, or
+        None when the job must not publish. Cheap (memory concatenation)
+        by design: the npz write and the Bloom rehash — the slow parts —
+        happen off-lock. MUST run before `retire` (retire drops the
+        frontier this snapshots)."""
         if (
             self._corpus is None
             or job.content_key is None
             or job.warm is not None
             or job.journal is None
             or not job.journal
-            or job.status != JobStatus.DONE
-            or job.early_exit
-            or job.timed_out
-            or job.pending_lanes != 0
+            or job.quarantined
+            or job.error is not None
+            or job.status == JobStatus.ERROR
         ):
             return None
+        if getattr(job, "_spill_path", None) is not None:
+            # Parked with a live frontier spill: the preemption cut
+            # already published this exact prefix WITH its frontier; a
+            # shutdown cancel here would overwrite that entry with a
+            # frontier-less (continuation-blind) one.
+            return None
+        complete = (
+            job.status == JobStatus.DONE
+            and not job.early_exit
+            and not job.timed_out
+            and job.pending_lanes == 0
+        )
+        frontier = None
+        if not complete and job.pending_lanes:
+            # A pending frontier means the cut is a clean step boundary
+            # (steps fully account their successors before the scheduler
+            # loop returns) — a sound continuation prefix.
+            frontier = job._frontier_arrays()
+            frontier = {
+                "states": frontier["q_states"],
+                "lo": frontier["q_lo"],
+                "hi": frontier["q_hi"],
+                "ebits": frontier["q_ebits"],
+                "depths": frontier["q_depths"],
+            }
         j_lo = np.concatenate([c[0] for c in job.journal])
         j_hi = np.concatenate([c[1] for c in job.journal])
         jp_lo = np.concatenate([c[2] for c in job.journal])
@@ -470,6 +576,9 @@ class ServiceEngine:
                 "max_depth": job.max_depth,
                 "discoveries": dict(job.discoveries),
             },
+            complete,
+            frontier,
+            self._components_for(job),
         )
 
     def publish_payload(self, payload: tuple) -> bool:
@@ -477,19 +586,22 @@ class ServiceEngine:
         (ROADMAP item 4 leftover — a slow publish must not stall an
         unrelated job's poll against the service lock). The CorpusStore
         is internally thread-safe; never raises. Dedup-first semantics:
-        the packed canonical verdict table rides along, snapshotted HERE
-        (off the service lock — walking a 2^16-entry cache under it would
-        stall unrelated polls); verdict bits are class-addressed, so
-        over-inclusion is harmless and a repeat register-model submission
-        in a fresh process warm-starts its consistency properties, not
-        just its visited set."""
-        key, fps, parents, meta = payload
-        from ..semantics.batch import export_verdicts
+        the packed canonical verdict table rides along on COMPLETE
+        entries, snapshotted HERE (off the service lock — walking a
+        2^16-entry cache under it would stall unrelated polls); verdict
+        bits are class-addressed, so over-inclusion is harmless and a
+        repeat register-model submission in a fresh process warm-starts
+        its consistency properties, not just its visited set."""
+        key, fps, parents, meta, complete, frontier, components = payload
+        sem_fps = sem_verdicts = None
+        if complete:
+            from ..semantics.batch import export_verdicts
 
-        sem_fps, sem_verdicts = export_verdicts()
+            sem_fps, sem_verdicts = export_verdicts()
         return self._corpus.publish(
             key, fps, parents, meta,
             sem_fps=sem_fps, sem_verdicts=sem_verdicts,
+            complete=complete, frontier=frontier, components=components,
         )
 
     def admit(self, job: Job) -> Optional[Job]:
@@ -520,6 +632,13 @@ class ServiceEngine:
         # into the spill tier + Bloom summary BEFORE seeding, so the very
         # first expansion's successors already dedup-filter against it.
         self._maybe_warm(job)
+        if job.partial_entry is not None:
+            # Partial rung (corpus v2): the entry's visited prefix +
+            # frontier snapshot IS a resume payload — take the fleet
+            # journal-reseed path, which restores the table, counters,
+            # discoveries, and pop order bit-identically, then continues
+            # the search naturally under THIS job's finish policy.
+            return self._admit_partial(job)
 
         K = self.batch_size
         slo, shi = salt_fp(init_lo, init_hi, job.salt_lo, job.salt_hi)
@@ -560,6 +679,57 @@ class ServiceEngine:
         if job.pending_lanes == 0:
             return job  # empty reachable space: complete immediately
         return None
+
+    def _admit_partial(self, job: Job) -> Optional[Job]:
+        """Warm-from-partial admission (corpus v2): convert the parked
+        partial entry into a `JobResume` payload and run the journal-
+        reseed admission. The prefix's (fp, parent) pairs land in the
+        shared table re-salted with THIS job's salt, the frontier snapshot
+        restores at its exact pop order, and the job's journal continues
+        accumulating — so a natural DONE later publishes the COMPLETE
+        visited set and supersedes the partial entry it grew from."""
+        entry = job.partial_entry
+        job.partial_entry = None
+        j_lo, j_hi = warm_seam.split_fps(entry.fps)
+        jp_lo, jp_hi = warm_seam.split_fps(entry.parents)
+        f = entry.frontier
+        chunks = []
+        if f is not None and f["lo"].size:
+            # One chunk carrying the whole snapshot: Job.take flattens
+            # chunks FIFO and depth is a per-row array, so splitting by
+            # depth run is unnecessary.
+            chunks.append(
+                (
+                    np.asarray(f["states"], np.uint32),
+                    np.asarray(f["lo"], np.uint32),
+                    np.asarray(f["hi"], np.uint32),
+                    np.asarray(f["ebits"], bool),
+                    np.asarray(f["depths"], np.uint32),
+                )
+            )
+        meta = entry.meta
+        job.resume = JobResume(
+            chunks=chunks,
+            journal=(j_lo, j_hi, jp_lo, jp_hi),
+            state_count=meta["state_count"],
+            unique_count=meta["unique_count"],
+            max_depth=meta["max_depth"],
+            discoveries=dict(meta.get("discoveries", {})),
+        )
+        job.warm_kind = "partial"
+        job.warm_states = entry.states
+        self._corpus.note_partial_preload()
+        self._corpus.note_preload(entry.states)
+        # Pin the SERVED entry (its own key — the near-partial rung serves
+        # a different family member's partial) until retire.
+        self._corpus.pin(entry.key)
+        job.corpus_pinned = True
+        job.corpus_pin_key = entry.key
+        self._events.emit(
+            "job.warm_start", job=job.id, trace=job.trace,
+            states=entry.states, key=job.content_key[:16], kind="partial",
+        )
+        return self._admit_resumed(job)
 
     def _admit_resumed(self, job: Job) -> Optional[Job]:
         """Fleet requeue admission: re-seed the job's ENTIRE visited set
@@ -661,7 +831,9 @@ class ServiceEngine:
         the "semantics" REGISTRY source) — a fleet replica serving
         thousands of register jobs stops growing without bound."""
         if job.corpus_pinned and self._corpus is not None:
-            self._corpus.unpin(job.content_key)
+            # The near/partial rungs pin the SERVED entry's key, which may
+            # differ from this job's own content key.
+            self._corpus.unpin(job.corpus_pin_key or job.content_key)
             job.corpus_pinned = False
         from ..semantics import maintain_caches
 
@@ -997,8 +1169,11 @@ class ServiceEngine:
                 job.target_state_count is not None
                 and job.state_count >= job.target_state_count
             ):
+                # Budget-cap cut: unlike the discovery early-exit above,
+                # this check runs AFTER successor attribution, so the
+                # pending frontier IS a sound continuation prefix — keep
+                # it for the partial-publish snapshot (retire drops it).
                 job.early_exit = True
-                job.drop_frontier()
                 finished.append(job)
             elif job.pending_lanes == 0:
                 finished.append(job)
@@ -1031,12 +1206,14 @@ class ServiceEngine:
         detail["service"] = job.metrics.to_dict(job.unique_count)
         if self._corpus is not None and job.content_key is not None:
             detail["corpus"] = {
-                "warm_start": job.warm is not None,
+                "warm_start": job.warm is not None or job.warm_kind is not None,
                 "preloaded_states": job.warm_states,
                 "verdict_preloads": job.verdict_preloads,
                 "published": job.published,
                 "key": job.content_key[:16],
             }
+            if job.warm_kind is not None:
+                detail["corpus"]["warm_kind"] = job.warm_kind
         if any(self.fault_counters.values()):
             # Engine-wide recovery counters (documented schema:
             # obs/schema.py FAULTS_DETAIL_KEYS) — present only once a

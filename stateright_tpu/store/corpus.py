@@ -37,11 +37,34 @@ results. Both sides of the corpus are chaos-plane boundaries
 (``corpus.load`` / ``corpus.publish`` in faults/plan.py): an injected
 fault at either degrades to a cold run / an unpublished entry, proven by
 tests/test_corpus.py.
+
+Corpus v2 adds delta-proportional re-verification on top of the exact-key
+store above (the "CI for protocol specs" end state of ROADMAP item 4):
+
+- PARTIAL entries (`complete=False`): a run cut short — early exit,
+  preemption, timeout, budget cap — publishes what it visited plus a
+  frontier snapshot at ``corpus-partial-<key>.npz``; a successor resumes
+  the snapshot as a FIFO prefix instead of starting cold, and the first
+  COMPLETE publish under the key deletes the partial it supersedes
+  (`superseded_entries`). Partials are latest-wins (not if_absent): a
+  longer prefix replaces a shorter one.
+- The FAMILY index (`corpus-family-<def_hash>.npz`): one tiny advisory
+  record per model-definition hash listing every published key with its
+  factored components (`key_components`: batch_size, finish signature,
+  table packing). An exact-key miss falls back to a family match — same
+  definition, different `table_log2`/`insert_variant`/finish — because
+  set MEMBERSHIP is packing-invariant; only the salt-rekeying
+  `TieredStore.preload` packing differs (`near_match_hits`). The index is
+  best-effort and latest-wins: a stale or missing record only costs a
+  cold run, never a wrong one.
+- The soundness rules for which entry may warm which run (replay vs
+  continue vs membership-only) live in ONE place: store/warm.py.
 """
 
 from __future__ import annotations
 
 import hashlib
+import json
 import os
 import threading
 import weakref
@@ -152,6 +175,30 @@ def content_key(model, lowering: dict) -> str:
     return h.hexdigest()
 
 
+def key_components(model, lowering: dict) -> dict:
+    """The content key factored into its near-match components (corpus v2):
+    the definition hash (the family address), the result-affecting run
+    shape (batch_size + finish policy — pop order and the stop point), and
+    the result-INVARIANT table packing (everything else in the lowering:
+    table_log2, insert_variant, summary geometry, store kind). Two runs
+    whose "def"/"batch_size"/"finish" components agree produce identical
+    results from identical prefixes regardless of "table" — that is the
+    near-match rung of the warm ladder (store/warm.py)."""
+    fin = lowering.get("finish")
+    return {
+        "def": model_def_hash(model),
+        "batch_size": int(lowering.get("batch_size", 0)),
+        "finish": repr(tuple(fin)) if fin is not None else repr(None),
+        "table": repr(
+            sorted(
+                (k, v)
+                for k, v in lowering.items()
+                if k not in ("batch_size", "finish")
+            )
+        ),
+    }
+
+
 def finish_signature(finish_when, target_state_count, target_max_depth):
     """The finish-policy component of a content key (HasDiscoveries is a
     frozen dataclass; its kind + sorted names identify it exactly)."""
@@ -185,6 +232,18 @@ class CorpusEntry:
     meta: dict  # state_count / unique_count / max_depth / discoveries
     sem_fps: np.ndarray = None  # uint64[m] canonical history fingerprints
     sem_verdicts: np.ndarray = None  # uint8[m] serialization verdict bits
+    #: Corpus v2: False for a partial entry (run cut short — the meta
+    #: counts cover only the published prefix). v1 payloads decode True.
+    complete: bool = True
+    #: Partial entries only: the FIFO frontier snapshot at the cut —
+    #: {"states" u32[n,L], "lo" u32[n], "hi" u32[n], "ebits" bool[n,P],
+    #: "depths" u32[n]}, unsalted, in pop order. None for complete
+    #: entries and for coverage-only partials (simulation), which warm
+    #: membership but cannot be continued.
+    frontier: Optional[dict] = None
+    #: The factored content-key components (`key_components`) recorded at
+    #: publish — what the near-match ladder (store/warm.py) reasons over.
+    components: Optional[dict] = None
 
     def __post_init__(self):
         if self.sem_fps is None:
@@ -243,6 +302,10 @@ class CorpusStore:
             "preload_states": 0,
             "verdict_preloads": 0,
             "verdicts_published": 0,
+            "partial_publishes": 0,
+            "partial_preloads": 0,
+            "near_match_hits": 0,
+            "superseded_entries": 0,
             "gc_sweeps": 0,
             "gc_evicted": 0,
             "gc_bytes_freed": 0,
@@ -259,6 +322,15 @@ class CorpusStore:
     def path_for(self, key: str) -> str:
         return content_path(self.root, key)
 
+    def partial_path_for(self, key: str) -> str:
+        """The partial entry's generation path — a sibling name under the
+        same ``corpus-`` gc listing prefix, never colliding with the
+        complete entry (content keys are hex; "partial-<key>" is not)."""
+        return content_path(self.root, key, kind="corpus-partial")
+
+    def _family_path(self, def_hash: str) -> str:
+        return content_path(self.root, def_hash, kind="corpus-family")
+
     def _count(self, counter: str, n: int = 1) -> None:
         with self._lock:
             self.counters[counter] += n
@@ -266,12 +338,23 @@ class CorpusStore:
     # -- read side -------------------------------------------------------------
 
     def lookup(self, key: str) -> Optional[CorpusEntry]:
-        """The newest intact generation for `key`, or None. NEVER raises:
-        a missing entry is a miss, a corrupt one (CRC/container failure on
-        every generation) is counted and ignored, and an injected
-        ``corpus.load`` fault degrades to a miss — warm-start is an
-        optimization, so every failure mode here means "run cold"."""
-        path = self.path_for(key)
+        """The newest intact COMPLETE generation for `key`, or None. NEVER
+        raises: a missing entry is a miss, a corrupt one (CRC/container
+        failure on every generation) is counted and ignored, and an
+        injected ``corpus.load`` fault degrades to a miss — warm-start is
+        an optimization, so every failure mode here means "run cold"."""
+        return self._lookup_at(key, self.path_for(key))
+
+    def lookup_partial(self, key: str) -> Optional[CorpusEntry]:
+        """The newest intact PARTIAL generation for `key`, or None — same
+        never-raises contract (and the same ``corpus.load`` chaos point)
+        as `lookup`. Callers must gate continuation on
+        `store/warm.can_continue`; a decoded complete-flagged payload at
+        the partial path (cannot happen via `publish`, but the ladder is
+        defensive) is returned as-is and declined there."""
+        return self._lookup_at(key, self.partial_path_for(key))
+
+    def _lookup_at(self, key: str, path: str) -> Optional[CorpusEntry]:
         fenced_out = []
         try:
             # Chaos-plane boundary: fires before any file is touched, so a
@@ -336,6 +419,23 @@ class CorpusStore:
             # the keys — warm-start degrades to visited-set-only).
             names = getattr(data, "files", data)
             has_sem = "sem_fps" in names and "sem_verdicts" in names
+            complete = True
+            if "complete" in names:
+                complete = bool(int(np.asarray(data["complete"]).reshape(-1)[0]))
+            frontier = None
+            if "f_lo" in names:
+                frontier = {
+                    "states": np.asarray(data["f_states"], dtype=np.uint32),
+                    "lo": np.asarray(data["f_lo"], dtype=np.uint32),
+                    "hi": np.asarray(data["f_hi"], dtype=np.uint32),
+                    "ebits": np.asarray(data["f_ebits"], dtype=bool),
+                    "depths": np.asarray(data["f_depths"], dtype=np.uint32),
+                }
+            components = None
+            if "comp" in names:
+                components = json.loads(
+                    str(np.asarray(data["comp"]).reshape(-1)[0])
+                )
             return CorpusEntry(
                 key=key,
                 fps=np.asarray(data["fps"], dtype=np.uint64),
@@ -357,6 +457,9 @@ class CorpusStore:
                     np.asarray(data["sem_verdicts"], dtype=np.uint8)
                     if has_sem else None
                 ),
+                complete=complete,
+                frontier=frontier,
+                components=components,
             )
         except (KeyError, ValueError, IndexError):
             return None
@@ -364,6 +467,156 @@ class CorpusStore:
     def note_preload(self, n: int) -> None:
         """Account states actually preloaded into a tiered store."""
         self._count("preload_states", n)
+
+    def note_partial_preload(self) -> None:
+        """Account one warm-from-partial admission (the `partial_preloads`
+        REGISTRY counter; per-state accounting stays in `note_preload`)."""
+        self._count("partial_preloads")
+
+    # -- near-match family index (corpus v2) -----------------------------------
+
+    def family_members(self, def_hash: str) -> list:
+        """The advisory member list for a definition-hash family: dicts of
+        {key, complete, states, batch_size, finish, table}. Best-effort —
+        a missing, corrupt, faulted, or lease-rejected record reads as an
+        empty family (a near-match miss, never an error)."""
+        try:
+            maybe_fault("corpus.load", key=def_hash[:16])
+            path = self._family_path(def_hash)
+            if not any_generation(path):
+                return []
+            data, _src = fenced_load_latest(
+                path,
+                validator=(
+                    self._lease.store.validate
+                    if self._lease is not None else None
+                ),
+            )
+            members = json.loads(str(np.asarray(data["members"]).reshape(-1)[0]))
+            return members if isinstance(members, list) else []
+        except (FaultError, OSError, CheckpointCorrupt, KeyError, ValueError):
+            return []
+
+    def _family_note(
+        self, components: dict, key: str, complete: bool, states: int
+    ) -> None:
+        """Record (or refresh) one family member after a publish. Read-
+        modify-write, latest-wins: the in-process lock serializes THIS
+        replica's writers; a cross-replica race can only drop the loser's
+        advisory row (a future near-match miss), never corrupt the record
+        (every write is a whole crash-atomic generation). Best-effort:
+        any failure leaves the index stale and the publish valid."""
+        if not components or "def" not in components:
+            return
+        member = {
+            "key": key,
+            "complete": bool(complete),
+            "states": int(states),
+            "batch_size": int(components.get("batch_size", -1)),
+            "finish": components.get("finish"),
+            "table": components.get("table"),
+        }
+        try:
+            with self._lock:
+                members = [
+                    m for m in self.family_members(components["def"])
+                    if m.get("key") != key or m.get("complete") != member["complete"]
+                ]
+                members.append(member)
+                fenced_savez(
+                    self._family_path(components["def"]),
+                    {
+                        "members": np.asarray(
+                            [json.dumps(members)], dtype=np.str_
+                        )
+                    },
+                    lease=self._lease,
+                )
+        except (FaultError, OSError, LeaseRevoked, RuntimeError):
+            pass  # advisory only: a stale index is a near-match miss
+
+    def _family_drop(self, def_hash: str, key: str, complete: bool) -> None:
+        """Drop one member row (the superseded partial) — same best-effort
+        read-modify-write contract as `_family_note`."""
+        try:
+            with self._lock:
+                members = [
+                    m for m in self.family_members(def_hash)
+                    if m.get("key") != key or m.get("complete") != bool(complete)
+                ]
+                fenced_savez(
+                    self._family_path(def_hash),
+                    {
+                        "members": np.asarray(
+                            [json.dumps(members)], dtype=np.str_
+                        )
+                    },
+                    lease=self._lease,
+                )
+        except (FaultError, OSError, LeaseRevoked, RuntimeError):
+            pass
+
+    def lookup_near(
+        self,
+        components: dict,
+        exclude: tuple = (),
+        allow_partial: bool = True,
+    ) -> Optional[CorpusEntry]:
+        """Family fallback for an exact-key miss: the best published entry
+        sharing `components["def"]` — ranked replayable-complete first
+        (same batch_size AND finish: `warm.can_replay` will accept it),
+        then continuable partials (same batch_size, any finish, most
+        states first: `warm.can_continue` decides). Keys in `exclude`
+        (the caller's own exact key, already tried) are skipped. A hit is
+        counted as `near_match_hits`; soundness gating stays with the
+        caller through store/warm.py."""
+        if not components or "def" not in components:
+            return None
+        bs = int(components.get("batch_size", -1))
+        fin = components.get("finish")
+        replayable, continuable = [], []
+        for m in self.family_members(components["def"]):
+            if m.get("key") in exclude or m.get("batch_size") != bs:
+                continue
+            if m.get("complete"):
+                if m.get("finish") == fin:
+                    replayable.append(m)
+            elif allow_partial:
+                continuable.append(m)
+        replayable.sort(key=lambda m: -int(m.get("states", 0)))
+        continuable.sort(key=lambda m: -int(m.get("states", 0)))
+        for m in replayable + continuable:
+            entry = (
+                self.lookup(m["key"]) if m.get("complete")
+                else self.lookup_partial(m["key"])
+            )
+            if entry is not None:
+                self._count("near_match_hits")
+                return entry
+        return None
+
+    def lookup_family(self, def_hash: str) -> Optional[CorpusEntry]:
+        """Membership-only family lookup: ANY intact entry for the
+        definition hash, preferring complete entries with the most states.
+        This is the simulation engine's rung — a shared visited table
+        cares only about set membership, which every component except the
+        definition is invariant to."""
+        members = self.family_members(def_hash)
+        members.sort(
+            key=lambda m: (
+                0 if m.get("complete") else 1,
+                -int(m.get("states", 0)),
+            )
+        )
+        for m in members:
+            entry = (
+                self.lookup(m["key"]) if m.get("complete")
+                else self.lookup_partial(m["key"])
+            )
+            if entry is not None:
+                self._count("near_match_hits")
+                return entry
+        return None
 
     def preload_verdicts(self, entry: CorpusEntry) -> int:
         """Seed the semantics plane's canonical verdict cache from the
@@ -439,8 +692,14 @@ class CorpusStore:
             ):
                 continue
             key = st.name[len("corpus-"):].split(".npz")[0]
+            if key.startswith("family-"):
+                # Family index records are tiny advisory metadata shared
+                # by every key in the family — never evicted, never
+                # counted toward the budget.
+                continue
             ent = entries.setdefault(
-                key, {"names": [], "bytes": 0, "mtime": 0.0}
+                key, {"names": [], "bytes": 0, "mtime": 0.0,
+                      "partial": key.startswith("partial-")}
             )
             ent["names"].append(st.name)
             ent["bytes"] += st.size
@@ -452,10 +711,21 @@ class CorpusStore:
         with self._lock:
             pinned = set(self._pinned)
         stat_size = {st.name: st.size for st in stats}
-        for key, ent in sorted(entries.items(), key=lambda kv: kv[1]["mtime"]):
+        # Eviction order: mtime-LRU, with PARTIAL entries sorting before
+        # complete ones at equal recency — a partial is a strict subset of
+        # the complete entry a future run would prefer, so it is always
+        # the cheaper loss (the corpus-v2 order pin in tests/test_corpus).
+        for key, ent in sorted(
+            entries.items(),
+            key=lambda kv: (kv[1]["mtime"], 0 if kv[1]["partial"] else 1),
+        ):
             if total <= max_bytes:
                 break
-            if key in pinned:
+            # A pin protects BOTH generations of a content key: a live job
+            # warmed from the partial must keep it as surely as one warmed
+            # from the complete entry.
+            real_key = key[len("partial-"):] if ent["partial"] else key
+            if real_key in pinned:
                 out["pinned_skips"] += 1
                 self._count("gc_pinned_skips")
                 continue
@@ -484,16 +754,26 @@ class CorpusStore:
         meta: dict,
         sem_fps: Optional[np.ndarray] = None,
         sem_verdicts: Optional[np.ndarray] = None,
+        complete: bool = True,
+        frontier: Optional[dict] = None,
+        components: Optional[dict] = None,
     ) -> bool:
-        """Publish one completed visited set under `key`. Idempotent by
-        content address: when an intact generation already exists the
-        write is SKIPPED — that is the fleet-sharing contract (N replicas
-        finishing the same key keep ONE generation, not N private
-        copies). Crash-atomic through faults/ckptio.atomic_savez (CRC32
-        footer, tmp/fsync/rename). Never raises: a publish failure
-        (injected ``corpus.publish`` fault or real I/O error) is counted
-        and the job's own result is unaffected."""
-        path = self.path_for(key)
+        """Publish one visited set under `key`. Complete entries are
+        idempotent by content address: when an intact generation already
+        exists the write is SKIPPED — that is the fleet-sharing contract
+        (N replicas finishing the same key keep ONE generation, not N
+        private copies) — and a successful complete publish deletes the
+        partial entry it supersedes and notes the key in the family
+        index. Partial entries (`complete=False`, usually with a
+        `frontier` snapshot) live at a sibling path, are latest-wins (a
+        longer prefix replaces a shorter one; the family index's recorded
+        prefix length gates pointless re-writes), and are skipped
+        entirely once a complete generation exists. Crash-atomic through
+        faults/ckptio.atomic_savez (CRC32 footer, tmp/fsync/rename).
+        Never raises: a publish failure (injected ``corpus.publish``
+        fault or real I/O error) is counted and the job's own result is
+        unaffected — degraded to unpublished, never wrong."""
+        path = self.path_for(key) if complete else self.partial_path_for(key)
         if self._lease is not None and not self._lease.valid():
             # Write-side fence: a revoked replica (the zombie) must never
             # publish — not even content-identical bytes; the fence is the
@@ -502,11 +782,25 @@ class CorpusStore:
             self._lease.store.count_rejected("write")
             return False
         try:
-            if latest_generation(path) is not None:
+            if latest_generation(self.path_for(key)) is not None:
+                # A complete generation makes both publish kinds moot: the
+                # exact entry already serves every warm rung.
                 self._count("publish_skipped")
                 return False
+            if not complete and components:
+                for m in self.family_members(components.get("def", "")):
+                    if (
+                        m.get("key") == key
+                        and not m.get("complete")
+                        and int(m.get("states", 0)) >= int(len(fps))
+                    ):
+                        # An equal-or-longer prefix is already published;
+                        # overwriting with a shorter one is sound but a
+                        # strict regression — skip.
+                        self._count("publish_skipped")
+                        return False
             # Chaos-plane boundary: fires before the write, so a faulted
-            # publish leaves no partial entry behind.
+            # publish leaves no torn entry behind.
             maybe_fault("corpus.publish", key=key[:16], states=int(len(fps)))
             fps = np.asarray(fps, dtype=np.uint64)
             parents = np.asarray(parents, dtype=np.uint64)
@@ -532,10 +826,34 @@ class CorpusStore:
                 payload_extra["sem_verdicts"] = np.asarray(
                     sem_verdicts, dtype=np.uint8
                 )
+            if not complete:
+                payload_extra["complete"] = np.asarray([0], dtype=np.int64)
+                if frontier is not None:
+                    payload_extra["f_states"] = np.asarray(
+                        frontier["states"], dtype=np.uint32
+                    )
+                    payload_extra["f_lo"] = np.asarray(
+                        frontier["lo"], dtype=np.uint32
+                    )
+                    payload_extra["f_hi"] = np.asarray(
+                        frontier["hi"], dtype=np.uint32
+                    )
+                    payload_extra["f_ebits"] = np.asarray(
+                        frontier["ebits"], dtype=bool
+                    )
+                    payload_extra["f_depths"] = np.asarray(
+                        frontier["depths"], dtype=np.uint32
+                    )
+            if components is not None:
+                payload_extra["comp"] = np.asarray(
+                    [json.dumps(components)], dtype=np.str_
+                )
             # Conditional write (`if_absent`): on the blob backend this is
             # a server-side If-None-Match put, so N replicas racing one
             # content key through a real object store still keep exactly
             # ONE generation — the pre-check above is just the cheap path.
+            # Partial entries are the opposite contract: latest-wins, a
+            # successor's longer prefix replaces its predecessor's.
             written = fenced_savez(
                 path,
                 {
@@ -564,7 +882,7 @@ class CorpusStore:
                     ),
                 },
                 lease=self._lease,
-                if_absent=True,
+                if_absent=complete,
             )
             if written is None:
                 self._count("publish_skipped")
@@ -578,10 +896,36 @@ class CorpusStore:
         except (FaultError, OSError):
             self._count("publish_faults")
             return False
-        self._count("publishes")
+        self._count("publishes" if complete else "partial_publishes")
         if "sem_fps" in payload_extra:
             self._count("verdicts_published", int(len(payload_extra["sem_fps"])))
+        if complete:
+            # A complete entry supersedes the partial published under the
+            # same key (if any) — delete it and drop its family row; both
+            # best-effort (gc's partial-first ordering mops up a miss).
+            self._supersede_partial(key, components)
+        if components is not None:
+            self._family_note(components, key, complete, int(fps.size))
         return True
+
+    def _supersede_partial(
+        self, key: str, components: Optional[dict]
+    ) -> None:
+        """Delete the partial generations a complete publish supersedes
+        (counted once as `superseded_entries` when anything was removed)."""
+        backend = blob_backend(self.root)
+        base = os.path.basename(self.partial_path_for(key))
+        removed = False
+        for name in (base, base + ".prev"):
+            try:
+                if backend.delete(name):
+                    removed = True
+            except OSError:
+                pass  # raced with a reader/gc: the sweep gets it later
+        if removed:
+            self._count("superseded_entries")
+            if components and "def" in components:
+                self._family_drop(components["def"], key, complete=False)
 
     # -- reporting -------------------------------------------------------------
 

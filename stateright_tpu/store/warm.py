@@ -1,0 +1,238 @@
+"""The ONE corpus warm-start/preload seam (ROADMAP item 4c).
+
+Before this module each warm path was hand-wired where it existed
+(`FrontierSearch.warm_start`, the service scheduler's `_maybe_warm`) and
+simply absent everywhere else — the resident, sharded, and simulation
+engines started cold on every job. This module is the single place the
+warm-start mechanics are spelled:
+
+- `preload_store`: seed a `TieredStore` (spill tier + Bloom summary) from a
+  published `CorpusEntry`, with optional per-job salt re-keying — the
+  mechanism behind every exhaustive engine's exact/near warm path.
+- `preload_table`: batched insert of an entry's visited set into a
+  host-side `tensor/inserts.make_table` handle — the simulation engine's
+  shared visited table (and any other raw-table consumer), best-effort on
+  overflow.
+- The soundness ladder (`can_replay` / `can_continue`): which entry kinds
+  may warm which runs. Replay of a complete entry is sound exactly when
+  the publisher's run and this run would provably pop the same states in
+  the same order to the same finish point — same definition, same
+  batch_size, same finish policy; table packing (table_log2 /
+  insert_variant / summary geometry / store kind) is free because
+  membership and pop order are packing-invariant. Continuation of a
+  partial entry is sound when the entry's frontier snapshot is a true
+  FIFO prefix of this run (same definition, same batch_size) AND this
+  run's finish policy is not already satisfied inside the prefix — the
+  continuation then applies its own finish naturally, so even a
+  different finish policy warm-starts (the near-partial rung).
+- `frontier_chunks` / `pack_ebits`: decode a partial entry's frontier
+  snapshot into the per-depth chunk runs the engines enqueue.
+
+`knobs.WARM_KINDS` is the kind vocabulary ("exact" | "near" | "partial");
+`knobs.check_registry()` pins every engine's `WARM_KINDS`/`WARM_SEAM`
+aliases against this module so the warm knob stays defined exactly once.
+
+Deliberately jax-free at import time (knobs.check_registry probes the
+alias on jax-free images): the one salted-table path imports lazily.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..knobs import WARM_KINDS
+
+__all__ = [
+    "WARM_KINDS",
+    "preload_store",
+    "preload_table",
+    "can_replay",
+    "can_continue",
+    "frontier_chunks",
+    "pack_ebits",
+]
+
+_M32 = np.uint64(0xFFFFFFFF)
+
+
+def split_fps(fps) -> tuple:
+    """uint64[n] packed fingerprints -> (lo, hi) uint32[n] halves."""
+    fps = np.asarray(fps, dtype=np.uint64)
+    return (fps & _M32).astype(np.uint32), (fps >> np.uint64(32)).astype(
+        np.uint32
+    )
+
+
+def preload_store(
+    store, entry, salt_lo=None, salt_hi=None, use_summary: bool = True,
+    mask=None,
+) -> int:
+    """Seed a TieredStore's spill tier + Bloom summary from a corpus entry
+    (the exact/near/partial warm mechanism for every exhaustive engine).
+    Salted callers (service jobs) re-key the set per job; unsalted callers
+    with a matching summary geometry take the serialized-summary fast
+    path. `mask` restricts the preload to a row subset — the sharded
+    engine's per-owner split (the FULL entry summary is still OR-ed in:
+    a superset Bloom is sound, each shard only ever probes states it
+    owns, and extra bits at worst cost a false suspect resolved exactly
+    against that shard's spill tier). Returns states preloaded."""
+    fps, parents = entry.fps, entry.parents
+    if mask is not None:
+        mask = np.asarray(mask, dtype=bool)
+        fps = np.asarray(fps, dtype=np.uint64)[mask]
+        parents = np.asarray(parents, dtype=np.uint64)[mask]
+    return store.preload(
+        fps,
+        parents,
+        salt_lo=salt_lo,
+        salt_hi=salt_hi,
+        summary_words_arr=entry.summary if use_summary else None,
+        summary_cfg=(entry.summary_log2, entry.summary_hashes),
+    )
+
+
+def preload_table(table, fps, parents, salt: int = 0, batch: int = 4096) -> int:
+    """Batched insert of packed unsalted (fps, parents) into a host-side
+    `tensor/inserts.make_table` handle — the simulation engine's shared
+    visited table warm path. `salt` re-keys through `job_salt` exactly as
+    the engine's own inserts do (root parents survive as 0, preserving the
+    chain-walk sentinel). Best-effort: stops at table overflow (a partial
+    preload only costs coverage accounting, never correctness). Returns
+    states actually inserted as new."""
+    import jax.numpy as jnp
+
+    from ..tensor.fingerprint import job_salt, salt_fp
+
+    fps = np.asarray(fps, dtype=np.uint64)
+    parents = np.asarray(parents, dtype=np.uint64)
+    if fps.size == 0:
+        return 0
+    lo, hi = split_fps(fps)
+    plo, phi = split_fps(parents)
+    if salt:
+        s_lo, s_hi = job_salt(salt)
+        lo, hi = salt_fp(lo, hi, s_lo, s_hi)
+        root = (plo == 0) & (phi == 0)
+        plo, phi = salt_fp(plo, phi, s_lo, s_hi)
+        plo = np.where(root, np.uint32(0), plo).astype(np.uint32)
+        phi = np.where(root, np.uint32(0), phi).astype(np.uint32)
+    inserted = 0
+    n = int(fps.size)
+    for b0 in range(0, n, batch):
+        b1 = min(b0 + batch, n)
+        m = b1 - b0
+        pad = [np.zeros(batch, dtype=np.uint32) for _ in range(4)]
+        for p, a in zip(pad, (lo, hi, plo, phi)):
+            p[:m] = a[b0:b1]
+        res = table.insert(
+            jnp.asarray(pad[0]),
+            jnp.asarray(pad[1]),
+            jnp.asarray(pad[2]),
+            jnp.asarray(pad[3]),
+            jnp.asarray(np.arange(batch) < m),
+        )
+        inserted += int(np.asarray(res.is_new).sum())
+        if bool(res.overflow):
+            break  # best-effort coverage: stop, never raise
+    return inserted
+
+
+def _finish_repr(finish_sig) -> str:
+    """Stable string form of a corpus.finish_signature tuple (the family
+    index stores strings; repr of the tuple is deterministic)."""
+    return repr(tuple(finish_sig))
+
+
+def can_replay(entry, batch_size: int, finish_sig) -> bool:
+    """True when `entry` (complete) may be replayed verbatim as this run's
+    result: same batch_size and same finish signature — pop/claim order
+    and the finish point are then provably identical, and everything else
+    (table packing) is result-invariant. The "exact" and "near" rungs."""
+    if not getattr(entry, "complete", True):
+        return False
+    comp = getattr(entry, "components", None) or {}
+    return (
+        int(comp.get("batch_size", -1)) == int(batch_size)
+        and comp.get("finish") == _finish_repr(finish_sig)
+    )
+
+
+def can_continue(
+    entry,
+    batch_size: int,
+    finish_when,
+    properties,
+    target_state_count: Optional[int] = None,
+    target_max_depth: Optional[int] = None,
+) -> bool:
+    """True when `entry` (partial, with a frontier snapshot) may seed this
+    run as a FIFO prefix: same batch_size (chunk/batch boundaries must
+    reproduce), any finish policy — PROVIDED the prefix has not already
+    passed this run's finish point (a finish satisfied inside the prefix
+    means the cold run would have stopped earlier with smaller counts, so
+    the continuation must decline and run cold). `properties` is the
+    model's property list (HasDiscoveries.matches needs it)."""
+    if getattr(entry, "complete", True):
+        return False
+    if getattr(entry, "frontier", None) is None:
+        return False  # coverage-only entry (e.g. simulation): no prefix
+    comp = getattr(entry, "components", None) or {}
+    if int(comp.get("batch_size", -1)) != int(batch_size):
+        return False
+    meta = entry.meta
+    disc = set(meta.get("discoveries", {}))
+    props = list(properties)
+    if props and len(disc) >= len(props):
+        return False  # every property already discovered inside the prefix
+    if finish_when is not None and finish_when.matches(props, disc):
+        return False
+    if target_state_count is not None and int(
+        meta.get("state_count", 0)
+    ) >= int(target_state_count):
+        return False
+    if target_max_depth is not None and int(
+        meta.get("max_depth", 0)
+    ) >= int(target_max_depth):
+        return False
+    return True
+
+
+def pack_ebits(ebits: np.ndarray) -> np.ndarray:
+    """bool[n, P] pending-eventually bits -> uint32[n] bitmask rows (the
+    device-resident engines' in-queue encoding)."""
+    ebits = np.asarray(ebits, dtype=bool)
+    n, p = ebits.shape
+    out = np.zeros(n, dtype=np.uint32)
+    for i in range(p):
+        out |= ebits[:, i].astype(np.uint32) << np.uint32(i)
+    return out
+
+
+def frontier_chunks(entry) -> list:
+    """Decode a partial entry's frontier snapshot into per-depth runs
+    [(states u32[m,L], lo u32[m], hi u32[m], ebits bool[m,P], depth int)]
+    in FIFO order — depths in a snapshot are monotonically nondecreasing,
+    so contiguous equal-depth runs are exactly the engines' chunk shape."""
+    f = entry.frontier
+    if f is None or f["lo"].size == 0:
+        return []
+    depths = np.asarray(f["depths"])
+    out = []
+    start = 0
+    n = int(depths.size)
+    for i in range(1, n + 1):
+        if i == n or depths[i] != depths[start]:
+            sl = slice(start, i)
+            out.append(
+                (
+                    np.asarray(f["states"][sl], dtype=np.uint32),
+                    np.asarray(f["lo"][sl], dtype=np.uint32),
+                    np.asarray(f["hi"][sl], dtype=np.uint32),
+                    np.asarray(f["ebits"][sl], dtype=bool),
+                    int(depths[start]),
+                )
+            )
+            start = i
+    return out

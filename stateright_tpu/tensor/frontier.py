@@ -33,9 +33,10 @@ import jax.numpy as jnp
 from ..core.discovery import HasDiscoveries
 from ..core.model import Expectation
 from ..core.path import Path
-from ..knobs import APPEND_KINDS, STORE_KINDS
+from ..knobs import APPEND_KINDS, STORE_KINDS, WARM_KINDS
 from ..faults.ckptio import fenced_savez, load_latest
 from ..faults.plan import maybe_fault
+from ..store import warm as warm_seam
 from ..obs import REGISTRY, StepRing, as_tracer, build_detail
 from .fingerprint import device_fingerprint, pack_fp
 from .hashtable import _insert_impl
@@ -370,6 +371,11 @@ class FrontierSearch:
     # in tensor/inserts.py, aliased (never restated) here; knobs.
     # check_registry() pins the alias.
     INSERT_VARIANTS = INSERT_TABLE
+    # Corpus warm ladder: the ONE kind vocabulary and the ONE preload seam
+    # (store/warm.py) — aliased, never restated; knobs.check_registry()
+    # pins both on every engine.
+    WARM_KINDS = WARM_KINDS
+    WARM_SEAM = warm_seam
 
     def __init__(
         self,
@@ -462,6 +468,7 @@ class FrontierSearch:
         # Warm-start corpus payload (store/corpus.py; see warm_start).
         self._warm: Optional[dict] = None
         self._warm_states = 0
+        self._warm_kind: Optional[str] = None  # knobs.WARM_KINDS rung served
 
     # -- the fused device step -------------------------------------------------
 
@@ -600,38 +607,75 @@ class FrontierSearch:
         self._q = deque()
         self._q.append(_Chunk(init, init_lo, init_hi, ebits0, depth=1))
 
-    def warm_start(self, entry) -> int:
+    def warm_start(self, entry, kind: Optional[str] = None) -> int:
         """Preload a published corpus entry (store/corpus.py CorpusEntry:
         packed unsalted fps/parents + serialized Bloom summary) into the
         tiered store BEFORE the first run() — the standalone-engine half of
-        the cross-job warm-start: known states dedup-filter on device from
+        the cross-job warm-start, routed through the one seam
+        (store/warm.py; knobs.WARM_KINDS).
+
+        A COMPLETE entry replays: known states dedup-filter on device from
         the very first expansion (the seeding inserts init states into the
         device table as usual; their successors hit the pre-warmed summary
         and resolve as spilled duplicates on host), the search collapses to
         the init frontier, and the result replays the publisher's
-        bookkeeping bit-identically. Standalone engines run unsalted, so a
-        matching summary geometry takes the serialized-summary fast path
-        (no re-hash). Call before run(); applies to an uninterrupted run
-        (checkpoints do not carry the replay payload). The caller owns key
-        discipline here: the entry must have been published for THIS model
-        + lowering config, and run() must use the publisher's finish
-        policy — the service path (service/scheduler.py) derives and
-        checks the content key for you. Returns the state count
-        preloaded."""
+        bookkeeping bit-identically. A PARTIAL entry (corpus v2: an
+        interrupted run's visited prefix + frontier snapshot) CONTINUES:
+        the prefix preloads the same way, the frontier snapshot seeds the
+        queue in place of the init states, counters/discoveries restore
+        from the entry's meta, and run() picks up exactly where the
+        publisher was cut — the completed result is bit-identical to a
+        cold run and (on the service path) supersedes the partial.
+
+        Standalone engines run unsalted, so a matching summary geometry
+        takes the serialized-summary fast path (no re-hash). Call before
+        run(); applies to an uninterrupted run (checkpoints do not carry
+        the replay payload). The caller owns key discipline here: the
+        entry must have been published for THIS model + lowering config
+        (`warm.can_replay` / `warm.can_continue` are the gates), and a
+        replay's run() must use the publisher's finish policy — the
+        service path (service/scheduler.py) derives and checks the
+        content key for you. `kind` labels the rung served ("exact" when
+        omitted; "near" for a family match; partials are always
+        "partial"). Returns the state count preloaded."""
         if self._store is None:
             raise ValueError(
                 "warm_start requires store='tiered' (known states are "
                 "dedup-filtered through the spill tier's Bloom suspect "
                 "path)"
             )
-        n = self._store.preload(
-            entry.fps,
-            entry.parents,
-            summary_words_arr=entry.summary,
-            summary_cfg=(entry.summary_log2, entry.summary_hashes),
-        )
-        self._warm = dict(entry.meta)
+        n = warm_seam.preload_store(self._store, entry)
         self._warm_states = n
+        if getattr(entry, "complete", True):
+            self._warm = dict(entry.meta)
+            self._warm_kind = kind or "exact"
+            return n
+        # Partial continuation: frontier snapshot -> queue (in place of
+        # _seed(); the prefix's states — init included — live in the
+        # preloaded spill tier), counters/discoveries -> meta baselines.
+        # No self._warm: the run accumulates real counts, never replays.
+        if entry.frontier is None:
+            raise ValueError(
+                "partial corpus entry has no frontier snapshot (coverage-"
+                "only); a continuation needs the publisher's cut frontier"
+            )
+        self._warm_kind = "partial"
+        m = entry.meta
+        self._q = deque()
+        for states, c_lo, c_hi, ebits, depth in warm_seam.frontier_chunks(
+            entry
+        ):
+            self._q.append(_Chunk(states, c_lo, c_hi, ebits, depth))
+        self._counts = dict(
+            state_count=int(m["state_count"]),
+            unique_count=int(m["unique_count"]),
+            max_depth=int(m["max_depth"]),
+            steps=0,
+            early_exit=False,
+        )
+        self._disc = dict(m.get("discoveries", {}))
+        self._hot_claims = 0
+        self._ring = StepRing(self._tm_capacity) if self._telemetry else None
         return n
 
     def run(
@@ -918,11 +962,12 @@ class FrontierSearch:
         counts["max_depth"] = max_depth
         counts["steps"] = steps
         detail = self._detail()
-        if self._warm is not None:
+        if self._warm_kind is not None:
             detail = dict(detail or {})
             detail["corpus"] = {
                 "warm_start": True,
                 "preloaded_states": self._warm_states,
+                "warm_kind": self._warm_kind,
             }
         return SearchResult(
             state_count=state_count,

@@ -36,9 +36,16 @@ import jax.numpy as jnp
 
 from ..core.discovery import HasDiscoveries
 from ..core.model import Expectation
-from ..knobs import INSERT_VARIANTS, PHASED_VARIANTS, STORE_KINDS, TABLE_LAYOUTS
+from ..knobs import (
+    INSERT_VARIANTS,
+    PHASED_VARIANTS,
+    STORE_KINDS,
+    TABLE_LAYOUTS,
+    WARM_KINDS,
+)
 from ..faults.ckptio import fenced_savez, load_latest, normalize_ckpt_path
 from ..faults.plan import maybe_fault
+from ..store import warm as warm_seam
 from ..obs import N_COLS, REGISTRY, StepRing, as_tracer, build_detail
 from .fingerprint import pack_fp
 from .frontier import (
@@ -289,6 +296,12 @@ def _inject_rows(
 class ResidentSearch:
     """One-dispatch whole-search engine for a `TensorModel`."""
 
+    # Corpus warm ladder: the ONE kind vocabulary and the ONE preload seam
+    # (store/warm.py) — aliased, never restated; knobs.check_registry()
+    # pins both on every engine.
+    WARM_KINDS = WARM_KINDS
+    WARM_SEAM = warm_seam
+
     def __init__(
         self,
         model: TensorModel,
@@ -449,6 +462,11 @@ class ResidentSearch:
         # Suspended-search carry (chunked runs only): retained across run()
         # calls so budget/timeout suspensions and overflows are resumable.
         self._carry = None
+        # Warm-start corpus payload (store/warm.py; see warm_start).
+        self._warm: Optional[dict] = None
+        self._warm_states = 0
+        self._warm_kind: Optional[str] = None  # knobs.WARM_KINDS rung served
+        self._warm_summary_pending = False
         # Abort code of the last overflow (ABORT_TABLE | ABORT_QUEUE bits);
         # written into checkpoint meta so recovery grows the right resource.
         self._last_abort = 0
@@ -920,6 +938,111 @@ class ResidentSearch:
 
     # -- host entry ------------------------------------------------------------
 
+    def warm_start(self, entry, kind: Optional[str] = None) -> int:
+        """Preload a published corpus entry before the first run() — the
+        resident engine's leg of the ONE warm-start seam (store/warm.py;
+        knobs.WARM_KINDS), closing the gap where this engine started cold
+        on every job.
+
+        A COMPLETE entry replays: the prefix lands in the spill tier and
+        the Bloom summary, the summary is patched into the seeded carry
+        (make_carry builds an empty one), so the init frontier's children
+        all resolve as spilled duplicates at the stop-drain and the run
+        collapses to one expansion wave; the result then replays the
+        publisher's bookkeeping. A PARTIAL entry (corpus v2) CONTINUES:
+        the frontier snapshot is packed into a host-built carry (the
+        load_checkpoint recipe against an empty hot table — the visited
+        prefix dedups through the preloaded spill tier), counters and
+        discoveries restore from the entry's meta, and run() finishes the
+        remainder. The caller owns key discipline (`warm.can_replay` /
+        `warm.can_continue`); a replay must use the publisher's finish
+        policy. Returns the state count preloaded."""
+        if self._store is None:
+            raise ValueError(
+                "warm_start requires store='tiered' (known states are "
+                "dedup-filtered through the spill tier's Bloom suspect "
+                "path)"
+            )
+        if self._carry is not None:
+            raise ValueError("warm_start must run before the first run()")
+        n = warm_seam.preload_store(self._store, entry)
+        self._warm_states = n
+        if getattr(entry, "complete", True):
+            self._warm = dict(entry.meta)
+            self._warm_kind = kind or "exact"
+            # The seeded carry's summary is patched in run() — the preload
+            # above already rebuilt self._store.summary_np.
+            self._warm_summary_pending = True
+            return n
+        if entry.frontier is None:
+            raise ValueError(
+                "partial corpus entry has no frontier snapshot (coverage-"
+                "only); a continuation needs the publisher's cut frontier"
+            )
+        self._warm_kind = "partial"
+        meta = entry.meta
+        f = entry.frontier
+        nf = int(np.asarray(f["lo"]).size)
+        if nf > (1 << self.queue_log2):
+            raise ValueError(
+                f"partial entry's frontier ({nf} rows) exceeds the queue "
+                f"(queue_log2={self.queue_log2}); raise queue_log2"
+            )
+        model = self.model
+        P = len(self.props)
+        Q, L = self._Q, model.lanes
+        q_states = np.zeros((Q, L), np.uint32)
+        q_lo = np.zeros(Q, np.uint32)
+        q_hi = np.zeros(Q, np.uint32)
+        q_ebits = np.zeros(Q, np.uint32)
+        q_depth = np.zeros(Q, np.uint32)
+        q_states[:nf] = np.asarray(f["states"], np.uint32)
+        q_lo[:nf] = np.asarray(f["lo"], np.uint32)
+        q_hi[:nf] = np.asarray(f["hi"], np.uint32)
+        q_ebits[:nf] = warm_seam.pack_ebits(np.asarray(f["ebits"]))
+        q_depth[:nf] = np.asarray(f["depths"], np.uint32)
+        disc = meta.get("discoveries", {})
+        discovered = 0
+        disc_lo = np.zeros(max(P, 1), np.uint32)
+        disc_hi = np.zeros(max(P, 1), np.uint32)
+        for i, p in enumerate(self.props):
+            if p.name in disc:
+                discovered |= 1 << i
+                fp = int(disc[p.name])
+                disc_lo[i] = np.uint32(fp & 0xFFFFFFFF)
+                disc_hi[i] = np.uint32(fp >> 32)
+        S = 1 << self.table_log2
+        sc = int(meta["state_count"])
+        fields = dict(
+            t_lo=np.zeros(S, np.uint32),
+            t_hi=np.zeros(S, np.uint32),
+            p_lo=np.zeros(S, np.uint32),
+            p_hi=np.zeros(S, np.uint32),
+            q_states=q_states, q_lo=q_lo, q_hi=q_hi,
+            q_ebits=q_ebits, q_depth=q_depth,
+            head=np.int32(0), tail=np.int32(nf),
+            gen_lo=np.uint32(sc & 0xFFFFFFFF),
+            gen_hi=np.uint32(sc >> 32),
+            unique_count=np.int32(meta["unique_count"]),
+            max_depth=np.uint32(meta["max_depth"]),
+            discovered=np.uint32(discovered),
+            disc_lo=disc_lo, disc_hi=disc_hi,
+            overflow=np.uint32(0), steps=np.int32(0),
+            hot_claims=np.int32(0),
+            s_states=np.zeros((self._SQ, L), np.uint32),
+            s_lo=np.zeros(self._SQ, np.uint32),
+            s_hi=np.zeros(self._SQ, np.uint32),
+            s_ebits=np.zeros(self._SQ, np.uint32),
+            s_depth=np.zeros(self._SQ, np.uint32),
+            s_tail=np.int32(0),
+            summary=self._store.summary_np,
+            tm_rows=np.zeros((self._TMR, N_COLS), np.uint32),
+        )
+        self._carry = _Carry(
+            **{k: jax.device_put(jnp.asarray(v)) for k, v in fields.items()}
+        )
+        return n
+
     def run(
         self,
         finish_when: HasDiscoveries = HasDiscoveries.ALL,
@@ -1049,6 +1172,17 @@ class ResidentSearch:
                     jnp.uint32(n_raw & 0xFFFFFFFF),
                     jnp.uint32(n_raw >> 32),
                 )
+                if self._warm_summary_pending:
+                    # Warm replay: make_carry built an empty Bloom summary;
+                    # patch in the preloaded one (warm_start already rebuilt
+                    # the store's words) so the very first expansion's
+                    # children dedup-filter against the corpus prefix.
+                    self._warm_summary_pending = False
+                    self._carry = self._carry._replace(
+                        summary=jax.device_put(
+                            jnp.asarray(self._store.summary_np)
+                        )
+                    )
             req = jnp.uint32(required_mask)
             anym = jnp.uint32(any_mask)
             if self.donate_chunks:
@@ -1183,15 +1317,35 @@ class ResidentSearch:
             for i, p in enumerate(self.props)
             if discovered & (1 << i)
         }
+        state_count = gen_lo | (gen_hi << 32)
+        if self._warm is not None and head >= tail and not timed_out:
+            # Warm-start replay (store/warm.py): the run only re-expanded
+            # the init frontier (everything deeper dedup-filtered against
+            # the preloaded corpus through the Bloom suspect path), so the
+            # result bookkeeping is the publisher's — bit-identical to this
+            # engine's own cold run for this content key.
+            w = self._warm
+            state_count = w["state_count"]
+            unique_count = w["unique_count"]
+            max_depth = w["max_depth"]
+            discoveries = dict(w["discoveries"])
+        detail = self._detail()
+        if self._warm_kind is not None:
+            detail = dict(detail or {})
+            detail["corpus"] = {
+                "warm_start": True,
+                "preloaded_states": self._warm_states,
+                "warm_kind": self._warm_kind,
+            }
         return SearchResult(
-            state_count=gen_lo | (gen_hi << 32),
+            state_count=state_count,
             unique_state_count=unique_count,
             max_depth=max_depth,
             discoveries=discoveries,
             complete=head >= tail and not timed_out,
             duration=time.monotonic() - start,
             steps=steps,
-            detail=self._detail(),
+            detail=detail,
         )
 
     def telemetry_summary(self) -> Optional[dict]:

@@ -72,8 +72,9 @@ from ..core.model import Expectation
 from ..core.path import Path
 from ..faults.ckptio import fenced_savez, load_latest
 from ..faults.plan import maybe_fault
-from ..knobs import SIM_DEDUP_KINDS
+from ..knobs import SIM_DEDUP_KINDS, WARM_KINDS
 from ..obs import REGISTRY, build_detail
+from ..store import warm as warm_seam
 from .fingerprint import job_salt, pack_fp, salt_fp
 from .frontier import SearchResult, state_fingerprint
 from .inserts import make_table, resolve_insert
@@ -132,6 +133,10 @@ class DeviceSimulation:
     #: THE dedup-design universe — aliased from the one knob registry
     #: (stateright_tpu/knobs.py); knobs.check_registry() pins the alias.
     DEDUP_KINDS = SIM_DEDUP_KINDS
+    # Warm-knob registry pins (knobs.check_registry): the kind vocabulary
+    # and the mechanics both alias the ONE seam, never a local copy.
+    WARM_KINDS = WARM_KINDS
+    WARM_SEAM = warm_seam
 
     def __init__(
         self,
@@ -198,7 +203,30 @@ class DeviceSimulation:
             duration=0.0,
         )
         self._discoveries: dict = {}  # name -> list of packed fps (the path)
+        self._warm_states = 0
+        self._warm_kind: Optional[str] = None
         self._metrics_name = REGISTRY.register("simulation", self.metrics)
+
+    def warm_start(self, entry, kind: Optional[str] = None) -> int:
+        """Preload the shared visited table from a published `CorpusEntry`
+        (store/warm.py seam): walks re-entering the published set then
+        count as `dedup_hits` instead of fresh coverage, so a warm second
+        job spends its walk budget on the NEW part of the space. Any entry
+        kind serves — coverage is sound whether the source run completed
+        or not (`salt=` re-keys exactly as the engine's own inserts do).
+        Best-effort on table overflow. Returns states inserted."""
+        if self.table is None:
+            raise ValueError(
+                "warm_start needs the shared visited table (dedup='shared')"
+            )
+        n = warm_seam.preload_table(
+            self.table, entry.fps, entry.parents, salt=self.salt
+        )
+        self._warm_states += n
+        self._warm_kind = kind or (
+            "exact" if getattr(entry, "complete", True) else "partial"
+        )
+        return n
 
     # -- kernel ----------------------------------------------------------------
 
@@ -699,7 +727,18 @@ class DeviceSimulation:
             complete=False,  # simulation never proves exhaustion
             duration=duration,
             steps=t["steps"],
-            detail=build_detail(None, self.telemetry_summary()),
+            detail=build_detail(
+                {
+                    "corpus": {
+                        "warm_start": True,
+                        "preloaded_states": self._warm_states,
+                        "warm_kind": self._warm_kind,
+                    }
+                }
+                if self._warm_kind is not None
+                else None,
+                self.telemetry_summary(),
+            ),
         )
 
     # -- observability ---------------------------------------------------------

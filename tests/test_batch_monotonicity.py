@@ -65,6 +65,7 @@ def _lane_throughput(batch, variant, fresh=False):
     return _measure_cache[key]
 
 
+@pytest.mark.slow  # ~33s perf-monotonicity sweep: tier-2 (tier-1 is timeout-bound)
 def test_capped_lane_throughput_non_decreasing_with_batch():
     # Compare the BEST observed throughput per batch across up to 3
     # measurement rounds: best-case timing reflects the algorithmic
@@ -90,6 +91,7 @@ def test_capped_lane_throughput_non_decreasing_with_batch():
     )
 
 
+@pytest.mark.slow  # ~23s measured A/B: tier-2 with its sweep sibling above
 def test_capped_beats_sort_at_scale():
     # The A/B the capped path exists for: at a batch the sort term hurts,
     # capped must win outright (measured ~1.9x at b=4096 on the dev box;

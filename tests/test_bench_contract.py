@@ -328,7 +328,8 @@ def test_device_detail_pins_corpus_row_keys():
     # detail.device so the "repeat checks are ~free and never wrong"
     # claim is auditable in every BENCH_r*.json.
     for key in (
-        "sec_cold", "warm_speedup", "corpus_preloaded", "corrupt_detected",
+        "sec_cold", "warm_speedup", "warm_speedup_near",
+        "warm_speedup_partial", "corpus_preloaded", "corrupt_detected",
     ):
         assert key in bench.DEVICE_DETAIL_FIELDS
     row = bench.device_detail(
@@ -337,19 +338,28 @@ def test_device_detail_pins_corpus_row_keys():
             "sec": 0.14,
             "sec_cold": 1.9,
             "warm_speedup": 13.6,
+            "warm_speedup_near": 11.2,
+            "warm_speedup_partial": 2.4,
             "corpus_preloaded": 1568,
             "corrupt_detected": True,
         }
     )
     assert row["warm_speedup"] == 13.6
+    assert row["warm_speedup_near"] == 11.2
+    assert row["warm_speedup_partial"] == 2.4
     assert row["corpus_preloaded"] == 1568
     assert row["corrupt_detected"] is True
     # And the corpus vocabulary itself is the documented obs schema's:
     # detail["corpus"] keys, the REGISTRY source, and the warm-start
     # event all resolve through obs/schema.py (srlint SR003 gates the
-    # literal sites; this pins the schema's own shape).
+    # literal sites; this pins the schema's own shape). v2: the event
+    # carries the warm KIND (exact | near | partial — knobs.WARM_KINDS),
+    # detail["corpus"] may carry it too, and the v2 counters are part of
+    # the registry vocabulary.
+    from stateright_tpu.knobs import WARM_KINDS
     from stateright_tpu.obs.schema import (
         CORPUS_DETAIL_KEYS,
+        CORPUS_V2_COUNTERS,
         DETAIL_KEYS,
         EVENT_TYPES,
         REGISTRY_SOURCES,
@@ -357,7 +367,14 @@ def test_device_detail_pins_corpus_row_keys():
     )
 
     assert "corpus" in DETAIL_KEYS and "corpus" in REGISTRY_SOURCES
-    assert EVENT_TYPES["job.warm_start"] == ("job",)
+    assert EVENT_TYPES["job.warm_start"] == ("job", "kind")
+    assert WARM_KINDS == ("exact", "near", "partial")
+    assert "warm_kind" in CORPUS_DETAIL_KEYS
+    for key in (
+        "partial_publishes", "partial_preloads", "near_match_hits",
+        "superseded_entries",
+    ):
+        assert key in CORPUS_V2_COUNTERS
     detail = {"corpus": {k: 1 for k in CORPUS_DETAIL_KEYS}}
     assert validate_detail(detail) == []
 
