@@ -920,6 +920,109 @@ def device_search_fleet(n_replicas: int = 3):
     return out, err
 
 
+def device_search_autoscale(max_replicas: int = 3):
+    """BENCH_AUTOSCALE=1 row: the autoscaler A/B (ISSUE 17). The SAME
+    mixed job burst runs twice — once through a fleet pinned at 1 replica,
+    once through a fleet that STARTS at 1 replica with an aggressive
+    Autoscaler allowed up to `max_replicas` — and the row reports both
+    throughputs, the ratio, the autoscaled run's p99, and the control
+    loop's own evidence (replicas_high_water, scale_outs, scale_ins).
+    Parity = every autoscaled job's counts and discovery fingerprints
+    equal its fixed-1 twin's: scaling mid-burst must be invisible in the
+    answers, only in the wall clock."""
+    _pin_platform()
+    from stateright_tpu.service import (
+        AutoscaleConfig,
+        Autoscaler,
+        ServiceFleet,
+    )
+    from stateright_tpu.tensor.models import (
+        TensorIncrementLock,
+        TensorTwoPhaseSys,
+    )
+
+    m3, m4, mi = (
+        TensorTwoPhaseSys(3), TensorTwoPhaseSys(4), TensorIncrementLock(4)
+    )
+    jobs = [m3] * 3 + [m4] * 3 + [mi] * 2
+
+    def run(n_max, autoscale):
+        fleet = ServiceFleet(
+            n_replicas=1,
+            background=True,
+            max_resident=2,
+            service_kwargs=dict(batch_size=1024, table_log2=17),
+        )
+        scaler = None
+        if autoscale:
+            # Aggressive bands: any queue is "over", one tick is enough,
+            # short cooldown — the burst should force growth fast enough
+            # to show up inside one bench row's wall clock.
+            scaler = Autoscaler(fleet, AutoscaleConfig(
+                min_replicas=1,
+                max_replicas=n_max,
+                queue_high=1.0,
+                scale_out_after=1,
+                scale_in_after=6,
+                cooldown_ticks=2,
+            ))
+            scaler.start(interval_s=0.1)
+        t0 = time.monotonic()
+        handles = [fleet.submit(m) for m in jobs]
+        fleet.drain(timeout=1800)
+        sec = time.monotonic() - t0
+        results = [h.result() for h in handles]
+        lat_ms = sorted(
+            (h._job.finished_at - h._job.submitted_at) * 1000.0
+            for h in handles
+        )
+        counters = dict(scaler.counters) if scaler else {}
+        if scaler is not None:
+            scaler.close()
+        fleet.close()
+        return sec, results, lat_ms, counters
+
+    fixed_sec, fixed_results, _fixed_lat, _ = run(1, autoscale=False)
+    sec, results, lat_ms, counters = run(max_replicas, autoscale=True)
+
+    err = None
+    for i, (r, s) in enumerate(zip(results, fixed_results)):
+        got = (r.state_count, r.unique_state_count, r.max_depth)
+        want = (s.state_count, s.unique_state_count, s.max_depth)
+        if got != want or sorted(r.discoveries.items()) != sorted(
+            s.discoveries.items()
+        ):
+            err = (
+                f"autoscale parity failure on job {i}: {got} / "
+                f"{sorted(r.discoveries.items())} != fixed-1 {want} / "
+                f"{sorted(s.discoveries.items())}"
+            )
+            break
+
+    def pct(sorted_ms, q):
+        return sorted_ms[min(int(q * (len(sorted_ms) - 1)), len(sorted_ms) - 1)]
+
+    states = sum(r.state_count for r in results)
+    out = {
+        "states": states,
+        "unique": sum(r.unique_state_count for r in results),
+        "sec": round(sec, 4),
+        "states_per_sec": states / max(sec, 1e-9),
+        "compile_sec": 0.0,  # compiles inside both wall clocks (A/B fair)
+        "n_jobs": len(jobs),
+        "auto_max_replicas": max_replicas,
+        "auto_jobs_per_sec": round(len(jobs) / max(sec, 1e-9), 4),
+        "auto_p50_ms": round(pct(lat_ms, 0.50), 1),
+        "auto_p99_ms": round(pct(lat_ms, 0.99), 1),
+        "auto_replicas_high_water": counters.get("replicas_high_water", 0),
+        "auto_scale_outs": counters.get("scale_outs", 0),
+        "auto_scale_ins": counters.get("scale_ins", 0),
+        "sec_fixed_one": round(fixed_sec, 4),
+        "vs_fixed_one": round(fixed_sec / max(sec, 1e-9), 3),
+    }
+    return out, err
+
+
 def device_search_blob(n_replicas: int = 2):
     """BENCH_BLOB=1 row: local-vs-blob checkpoint-backend overhead A/B
     (ISSUE 15). The SAME mixed job set runs through an N-replica in-proc
@@ -1543,6 +1646,13 @@ DEVICE_DETAIL_FIELDS = (
     "n_replicas", "fleet_jobs_per_sec", "sec_one_replica",
     "vs_one_replica", "fleet_p50_ms", "fleet_p99_ms",
     "fleet_steals", "fleet_requeued",
+    # Autoscaling fleet (BENCH_AUTOSCALE=1 row): fixed 1-replica vs a
+    # fleet that starts at 1 and grows under the Autoscaler on the same
+    # burst — both throughputs, the ratio, the autoscaled run's latency
+    # digest, and the control loop's own scale-event evidence.
+    "auto_max_replicas", "auto_jobs_per_sec", "auto_p50_ms", "auto_p99_ms",
+    "auto_replicas_high_water", "auto_scale_outs", "auto_scale_ins",
+    "sec_fixed_one", "vs_fixed_one",
     # Blob checkpoint backend (BENCH_BLOB=1 row): the local-filesystem
     # wall time next to the blob-emulator run's (`sec`), the measured
     # overhead percentage, and the blob client's op/retry counters —
@@ -1803,6 +1913,13 @@ def main(argv: list | None = None) -> int:
         # in detail.device["fleet-mixed-3"]).
         if os.environ.get("BENCH_FLEET") == "1" and not smoke:
             workloads += (("fleet-mixed", 3, 2400.0, "--worker-fleet", None),)
+        # BENCH_AUTOSCALE=1: add the autoscaler A/B on the mixed job set
+        # (fixed 1-replica fleet vs a fleet that starts at 1 and may grow
+        # to 3 under an aggressive Autoscaler; jobs/s both ways, the
+        # ratio, p99, and replicas_high_water/scale_outs/scale_ins land
+        # in detail.device["fleet-auto-3"]).
+        if os.environ.get("BENCH_AUTOSCALE") == "1" and not smoke:
+            workloads += (("fleet-auto", 3, 2400.0, "--worker-autoscale", None),)
         # BENCH_BLOB=1: add the local-vs-blob checkpoint-backend overhead
         # A/B (the mixed job set through a 2-replica fleet with the
         # requeue-resume plane + lease fence on a local dir vs the blob
@@ -1847,6 +1964,7 @@ def main(argv: list | None = None) -> int:
                     "--worker-semantics": "-semantics",
                     "--worker-sim": "-sim",
                     "--worker-fleet": "",
+                    "--worker-autoscale": "",
                     "--worker-blob": "",
                 }.get(mode, "")
             )
@@ -1925,6 +2043,8 @@ def worker_main(model_name: str, n: int, mode: str = "--worker") -> int:
             r, perr = device_search_service(n)
         elif mode == "--worker-fleet":
             r, perr = device_search_fleet(n)
+        elif mode == "--worker-autoscale":
+            r, perr = device_search_autoscale(n)
         elif mode == "--worker-blob":
             r, perr = device_search_blob(n)
         elif mode == "--worker-sharded":
@@ -1958,8 +2078,8 @@ if __name__ == "__main__":
     if len(sys.argv) == 4 and sys.argv[1] in (
         "--worker", "--worker-sharded", "--worker-service", "--worker-obs",
         "--worker-journal", "--worker-faults", "--worker-pallas",
-        "--worker-fleet", "--worker-blob", "--worker-corpus",
-        "--worker-semantics", "--worker-sim",
+        "--worker-fleet", "--worker-autoscale", "--worker-blob",
+        "--worker-corpus", "--worker-semantics", "--worker-sim",
     ):
         sys.exit(worker_main(sys.argv[2], int(sys.argv[3]), mode=sys.argv[1]))
     if len(sys.argv) == 2 and sys.argv[1] == "--worker-analysis":

@@ -124,19 +124,19 @@ def run_load(n_replicas, clients, jobs, crash=False, corpus_dir=None,
         for j in range(per_client):
             name, args, gold = MIX[(ci + j) % len(MIX)]
             t0 = time.monotonic()
-            while True:  # submit with Retry-After backoff
+            while True:  # submit with Retry-After backoff (503 and 429)
                 try:
                     jid = post("/jobs", {"model": name, "args": args})["job"]
                     break
                 except urllib.error.HTTPError as e:
-                    if e.code != 503:
+                    if e.code not in (503, 429):
                         raise
                     time.sleep(float(e.headers.get("Retry-After") or 1))
             while True:  # poll to completion
                 try:
                     p = get(f"/jobs/{jid}")
                 except urllib.error.HTTPError as e:
-                    if e.code != 503:
+                    if e.code not in (503, 429):
                         raise
                     time.sleep(float(e.headers.get("Retry-After") or 1))
                     continue
@@ -222,6 +222,172 @@ def run_load(n_replicas, clients, jobs, crash=False, corpus_dir=None,
     return row, failures
 
 
+def run_tenants_load(max_replicas, clients, jobs, slo_ms):
+    """Mixed-tenant load against an AUTOSCALING fleet: a quiet 1x tenant
+    and a noisy ~10x tenant (with an in-flight quota) share the front
+    door; the Autoscaler grows the fleet from its own signals. Reports
+    per-tenant p50/p99 and asserts the isolation claims: the quiet
+    tenant's p99 stays under `slo_ms`, the noisy tenant's flood trips
+    the quota (counted + journaled), and every 429'd submission
+    eventually succeeds on retry (the Retry-After contract)."""
+    from stateright_tpu.service import ServiceFleet, TenantQuotas, serve_fleet
+    from stateright_tpu.service.autoscale import AutoscaleConfig, Autoscaler
+
+    quotas = TenantQuotas()
+    quotas.set_quota("noisy", max_in_flight=6)
+    fleet = ServiceFleet(
+        n_replicas=1,
+        background=True,
+        max_resident=4,
+        service_kwargs=dict(batch_size=512, table_log2=16),
+        quotas=quotas,
+    )
+    auto = Autoscaler(
+        fleet,
+        AutoscaleConfig(
+            min_replicas=1,
+            max_replicas=max_replicas,
+            queue_high=2.0,
+            scale_out_after=2,
+            scale_in_after=10,
+            cooldown_ticks=4,
+        ),
+    )
+    auto.start(interval_s=0.2)
+    srv = serve_fleet(fleet, address="localhost:0")
+    base = "http://" + srv.address
+    lock = threading.Lock()
+    lat = {"quiet": [], "noisy": []}
+    rejected = {"quiet": 0, "noisy": 0}
+    failures = []
+
+    def post(path, body):
+        req = urllib.request.Request(
+            base + path, data=json.dumps(body).encode(), method="POST"
+        )
+        return json.loads(urllib.request.urlopen(req, timeout=30).read())
+
+    def get(path):
+        return json.loads(
+            urllib.request.urlopen(base + path, timeout=30).read()
+        )
+
+    def client(tenant, ci, n_jobs):
+        for j in range(n_jobs):
+            name, margs, gold = MIX[(ci + j) % len(MIX)]
+            t0 = time.monotonic()
+            while True:  # submit honoring 503 AND 429 Retry-After
+                try:
+                    jid = post(
+                        "/jobs",
+                        {"model": name, "args": margs, "tenant": tenant},
+                    )["job"]
+                    break
+                except urllib.error.HTTPError as e:
+                    if e.code not in (503, 429):
+                        raise
+                    if e.code == 429:
+                        with lock:
+                            rejected[tenant] += 1
+                    time.sleep(float(e.headers.get("Retry-After") or 1))
+            while True:  # poll to completion
+                try:
+                    p = get(f"/jobs/{jid}")
+                except urllib.error.HTTPError as e:
+                    if e.code not in (503, 429):
+                        raise
+                    time.sleep(float(e.headers.get("Retry-After") or 1))
+                    continue
+                if p["status"] in ("done", "error", "cancelled"):
+                    break
+                time.sleep(0.01)
+            got = (p.get("state_count"), p.get("unique_state_count"))
+            with lock:
+                lat[tenant].append(time.monotonic() - t0)
+                if p["status"] != "done" or got != gold:
+                    failures.append(
+                        f"{tenant} client {ci} job {jid} ({name}): "
+                        f"status={p['status']} counts={got} != {gold}"
+                    )
+
+    # ~10x asymmetry: the noisy tenant floods, the quiet tenant trickles.
+    quiet_jobs = max(jobs // 11, 2)
+    noisy_jobs = max(jobs - quiet_jobs, quiet_jobs)
+    quiet_clients = max(clients // 10, 1)
+    noisy_clients = max(clients - quiet_clients, 1)
+    threads = [
+        threading.Thread(
+            target=client,
+            args=("quiet", i, max(quiet_jobs // quiet_clients, 1)),
+        )
+        for i in range(quiet_clients)
+    ] + [
+        threading.Thread(
+            target=client,
+            args=("noisy", i, max(noisy_jobs // noisy_clients, 1)),
+        )
+        for i in range(noisy_clients)
+    ]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    stats = fleet.stats()
+    am = auto.metrics()
+    auto.close()
+    srv.shutdown()
+    fleet.close()
+
+    def pct(samples, q):
+        s = sorted(x * 1000 for x in samples) or [0.0]
+        return round(s[min(int(q * (len(s) - 1)), len(s) - 1)], 1)
+
+    for tenant in ("quiet", "noisy"):
+        print(
+            f"{tenant}:",
+            json.dumps(
+                {
+                    "jobs": len(lat[tenant]),
+                    "p50_ms": pct(lat[tenant], 0.50),
+                    "p99_ms": pct(lat[tenant], 0.99),
+                    "throttled_429": rejected[tenant],
+                }
+            ),
+        )
+    print(
+        "autoscale:",
+        json.dumps(
+            {
+                "jobs_per_sec": round(
+                    sum(len(v) for v in lat.values()) / max(wall, 1e-9), 2
+                ),
+                "replicas_high_water": am["replicas_high_water"],
+                "scale_outs": am["scale_outs"],
+                "scale_ins": am["scale_ins"],
+                "quota_rejected": stats["quota_rejected"],
+            }
+        ),
+    )
+    quiet_p99 = pct(lat["quiet"], 0.99)
+    if quiet_p99 > slo_ms:
+        failures.append(
+            f"quiet tenant p99 {quiet_p99}ms blew the {slo_ms}ms SLO "
+            "(noisy tenant leaked through the isolation)"
+        )
+    if stats["quota_rejected"] < 1:
+        failures.append(
+            "noisy flood never tripped its quota (gate not exercised)"
+        )
+    if max_replicas > 1 and am["replicas_high_water"] < 2:
+        failures.append(
+            "autoscaler never scaled out under the flood "
+            f"(high water {am['replicas_high_water']})"
+        )
+    return failures
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--replicas", type=int, default=3)
@@ -242,6 +408,13 @@ def main(argv=None) -> int:
                     help="shared store root behind the in-proc object-store "
                          "emulator (blob:// backend: conditional puts, "
                          "bounded retry, member discovery)")
+    ap.add_argument("--tenants", action="store_true",
+                    help="mixed-tenant isolation run: quiet 1x + noisy 10x "
+                         "tenants against an AUTOSCALING fleet (--replicas "
+                         "is the autoscaler's max); asserts the quiet "
+                         "tenant's p99 SLO and the noisy tenant's quota")
+    ap.add_argument("--slo-ms", type=float, default=30_000.0,
+                    help="quiet-tenant p99 SLO for --tenants (ms)")
     args = ap.parse_args(argv)
 
     import jax
@@ -266,6 +439,18 @@ def main(argv=None) -> int:
 
         blobd = serve_blobd()
         print(f"blob emulator at {blobd.root_uri}")
+
+    if args.tenants:
+        bad = run_tenants_load(
+            args.replicas, args.clients, args.jobs, args.slo_ms
+        )
+        if blobd is not None:
+            blobd.shutdown()
+        if bad:
+            print("FAILURES:", "; ".join(bad[:10]), file=sys.stderr)
+            return 1
+        print("tenant load OK")
+        return 0
 
     if args.warm:
         # Warm-vs-cold A/B: pre-publish the mixed set into one shared

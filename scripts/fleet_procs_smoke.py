@@ -18,7 +18,15 @@ it is interrupted), then:
    rejected read-side), counted as lease.rejected > 0, never read back;
 3. **partition** — inject `fleet.partition` against the victim: the
    router sees it dead while the PROCESS keeps running — the
-   false-positive death, fenced exactly like the zombie.
+   false-positive death, fenced exactly like the zombie;
+4. **rejoin** — kill -9, then re-admit a fresh incarnation through the
+   probation quarantine mid-backlog;
+5. **autoscale** — with a partition AND a zombie both active, the fleet
+   scales OUT (a brand-new subprocess joins through probation — after
+   an injected `fleet.autoscale` fault first aborts the grow with
+   nothing changed) and then IN (the least-loaded member drains
+   mid-backlog through `FleetRouter.retire`): zero lost jobs, counts
+   bit-identical, timeline clean.
 
 Backends:
 
@@ -254,6 +262,50 @@ def run_matrix(backend) -> None:
         print(f"   rejoin survived: rejoins={s['rejoins']} "
               f"promotions={s['rejoin_promotions']} "
               f"requeued={s['requeued_jobs']}; timeline clean")
+
+        print(f"== [{backend}] phase 5: AUTOSCALE out + in during an "
+              "active partition + zombie ==")
+        root = roots.fresh("autoscale")
+        fleet, handles, victim = start_fleet(root, n_jobs=6)
+        partner = fleet.replicas[(victim.idx + 1) % 3]
+        plan = FaultPlan().rule(
+            "fleet.partition", "io", times=-1, match={"replica": partner.idx}
+        )
+        # The reconciler's own chaos seam: the FIRST scale attempt is
+        # killed mid-decision and must change nothing.
+        plan.rule("fleet.autoscale", "crash", times=1)
+        with active(plan):
+            os.kill(victim.proc.pid, signal.SIGSTOP)
+            wait_crashes(fleet, 2)  # zombie-to-be + partitioned member
+            n_before = len(fleet.replicas)
+            assert fleet.scale_out() is None, (
+                "injected fleet.autoscale fault did not abort the grow"
+            )
+            assert len(fleet.replicas) == n_before, (
+                "aborted scale_out changed the fleet"
+            )
+            idx_new = fleet.scale_out()
+            assert idx_new is not None, "scale_out refused mid-chaos"
+            deadline = time.monotonic() + 90
+            while fleet.stats()["rejoin_promotions"] < 1:
+                assert time.monotonic() < deadline, fleet.stats()
+                time.sleep(0.05)
+            os.kill(victim.proc.pid, signal.SIGCONT)  # the zombie rises
+            retired = fleet.scale_in()  # mid-backlog: drain is loss-free
+            assert retired is not None, "scale_in refused mid-chaos"
+            fleet.drain(timeout=300)
+        check_golden(handles)
+        rejected = zombie_rejections(victim)
+        assert rejected > 0, "zombie was not fenced during autoscale"
+        s = fleet.stats()
+        assert s["scale_outs"] == 1 and s["scale_ins"] == 1, s
+        fleet.close()
+        report = run_timeline(roots.journal_root(root))
+        assert report["anomalies"] == [], report["anomalies"]
+        print(f"   autoscale survived chaos: grew to replica{idx_new}, "
+              f"retired replica{retired}, lease.rejected={rejected}, "
+              f"requeued={s['requeued_jobs']} restored={s['restored_jobs']}; "
+              "timeline clean")
     finally:
         roots.close()
 

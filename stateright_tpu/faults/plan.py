@@ -116,6 +116,16 @@ Injection points (the name is the contract; grep for `maybe_fault(`):
                         fence-rejected: the restarted member holds a
                         FRESH epoch, so the old incarnation's writes
                         fail the exact-epoch check
+- ``fleet.autoscale`` — autoscaler actuation entry (service/autoscale.py
+                        reconcile tick and ServiceFleet.scale_out /
+                        scale_in, ctx ``action="tick"|"scale_out"|
+                        "scale_in"``), BEFORE any signal is acted on, any
+                        lease granted, or any member touched — an
+                        injected fault aborts that reconcile tick with
+                        the fleet EXACTLY as it was (no spawned process,
+                        no burned epoch, no drained member); the
+                        autoscaler counts it (``aborted_ticks``) and the
+                        next tick re-reads the signals and re-decides
 
 Determinism: every decision is a pure function of (plan seed, per-point hit
 counter, rule spec) — no RNG state, no wall clock — so a failing chaos run

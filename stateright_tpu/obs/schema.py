@@ -43,6 +43,10 @@ DETAIL_KEYS = {
     "trace": "job-scoped trace correlation id (service/fleet jobs)",
     # warm-start corpus (store/corpus.py)
     "corpus": "cross-job warm-start sub-dict (CORPUS_DETAIL_KEYS)",
+    # multi-tenant control plane (service/tenancy.py) — present only on
+    # jobs submitted under a non-default tenant, so default-tenant results
+    # stay byte-identical to the pre-tenancy goldens.
+    "tenant": "per-tenant accounting sub-dict (TENANT_DETAIL_KEYS)",
 }
 
 #: Keys of `detail["corpus"]` (service/scheduler.py `build_result`, the
@@ -81,6 +85,37 @@ SERVICE_DETAIL_KEYS = {
     "suspects_dup": "...of which were confirmed spilled duplicates",
     "spill_share": "suspects_checked / unique states (spill pressure)",
 }
+
+#: Keys of `detail["tenant"]` (service/scheduler.py `build_result`) —
+#: present only when the job ran under a non-default tenant, so the
+#: default namespace's result dicts (and every pre-tenancy golden) are
+#: untouched.
+TENANT_DETAIL_KEYS = {
+    "name": "the tenant identity the job was submitted under",
+    "lane_seconds": "device lane-seconds the job charged against the "
+                    "tenant's budget (lanes x wall-seconds of fused "
+                    "steps it held lanes in)",
+}
+
+#: Autoscaler REGISTRY counters (service/autoscale.py `metrics()`, the
+#: "autoscaler" source) — the reconciliation loop's scrape names, pinned
+#: here (and in tests/test_bench_contract.py) like every other source.
+AUTOSCALE_COUNTER_KEYS = {
+    "ticks": "reconcile ticks completed (signal read + decision)",
+    "scale_outs": "replicas spawned into probation by the autoscaler",
+    "scale_ins": "replicas drained, lease-revoked, and retired",
+    "aborted_ticks": "reconcile ticks abandoned by an injected "
+                     "`fleet.autoscale` fault with NOTHING changed",
+    "cooldown_skips": "wanted moves suppressed by the cooldown window",
+    "hysteresis_holds": "ticks where the signals sat between the "
+                        "scale-out and scale-in bands (no move wanted)",
+    "replicas": "current fleet size as of the last tick",
+    "replicas_high_water": "peak fleet size the autoscaler ever reached",
+    "last_queue_depth": "fleet-wide queued jobs as of the last tick",
+    "last_lane_util": "mean per-replica lane utilization, last tick",
+    "last_p99_ms": "p99 admission latency (ms) as of the last tick",
+}
+
 
 #: Keys of `detail["telemetry"]` (obs/ring.py StepRing.summary).
 TELEMETRY_KEYS = {
@@ -159,6 +194,8 @@ REGISTRY_SOURCES = {
                   "walks, restarts, shared-table dedup hits)",
     "blob": "object-store backend client (faults/blobstore.py — ops, "
             "retries, backoff, torn puts, stale lists, unavailability)",
+    "autoscaler": "elastic control plane reconciliation loop "
+                  "(service/autoscale.py — AUTOSCALE_COUNTER_KEYS)",
 }
 
 
@@ -188,6 +225,13 @@ FLEET_COUNTER_KEYS = {
     "rejoin_promotions": "rejoined members that passed their probation "
                          "probes and re-entered the ring (only their own "
                          "keys move back)",
+    "scale_outs": "replicas joined at a BRAND-NEW index (autoscaler "
+                  "scale-out; enters probation exactly like a rejoin)",
+    "scale_ins": "replicas gracefully drained and retired (autoscaler "
+                 "scale-in; backlog requeued loss-free, lease revoked)",
+    "quota_rejected": "submissions refused at admission because the "
+                      "tenant was over its in-flight or lane-seconds "
+                      "quota (HTTP 429 + Retry-After; retryable)",
     "lease_revokes": "ring-member leases revoked before requeueing "
                      "(0 on a lease-less fleet)",
     "lease_reseals": "orphan checkpoint generations re-sealed under the "
@@ -221,6 +265,7 @@ EVENT_TYPES = {
     "job.warm_start": ("job", "kind"),  # corpus preloaded at admission
     # (states=n; kind=exact|near|partial — the warm-ladder rung served)
     "job.quarantined": ("job",),     # poison job parked by the retry policy
+    "job.quota_rejected": ("tenant",),  # admission refused over-quota (429)
     "job.done": ("job",),
     "job.cancelled": ("job",),
     "job.error": ("job",),
@@ -232,6 +277,11 @@ EVENT_TYPES = {
     "replica.crash": ("replica",),         # declared dead, removed from ring
     "replica.rejoin": ("replica", "phase"),  # probation entered / ring re-add
     "fleet.steal": ("job", "src", "dst"),  # queued job pulled to idle replica
+    # elastic control plane (service/autoscale.py): every scale decision
+    # the reconciler actuates is journaled — the flight recorder is the
+    # audit log that explains why the fleet is the size it is.
+    "fleet.scale_out": ("replica",),  # new member spawned into probation
+    "fleet.scale_in": ("replica",),   # member drained, revoked, retired
     # engine / durability plane
     "engine.chunk": ("jobs",),       # one fused service step (jobs: id list)
     "ckpt.write": ("job",),          # atomic checkpoint generation written
@@ -283,6 +333,7 @@ DETAIL_SUBSCHEMAS = (
     ("telemetry", TELEMETRY_KEYS),
     ("faults", FAULTS_DETAIL_KEYS),
     ("corpus", CORPUS_DETAIL_KEYS),
+    ("tenant", TENANT_DETAIL_KEYS),
 )
 
 

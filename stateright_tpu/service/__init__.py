@@ -47,9 +47,17 @@ Pieces:
 - `remote`    — the HTTP replica stub (`RemoteReplica`), the per-host
                 server (`serve_replica`), and the subprocess spawner
                 behind `ServiceFleet(remote=True)`.
+- `tenancy`   — per-tenant identity, quotas (in-flight cap +
+                windowed lane-seconds budget → `QuotaExceeded`/HTTP 429
+                with Retry-After), and the corpus namespace salt.
+- `autoscale` — the reconciliation loop (`Autoscaler`) that grows and
+                shrinks a ServiceFleet from queue depth, lane
+                utilization, and p99 admission latency, with hysteresis
+                bands and cooldowns.
 """
 
 from .api import CheckService, JobHandle, ServiceChecker
+from .autoscale import AutoscaleConfig, Autoscaler
 from .fleet import Replica, ServiceFleet
 from .lease import FencedEvents, Lease, LeaseRevoked, LeaseStore
 from .metrics import JobMetrics
@@ -67,9 +75,21 @@ from .router import (
 )
 from .scheduler import ServiceEngine, ServiceError
 from .server import ModelRegistry, default_registry, serve_service, status_view
+from .tenancy import (
+    DEFAULT_TENANT,
+    QuotaExceeded,
+    TenantQuota,
+    TenantQuotas,
+)
 
 __all__ = [
     "CheckService",
+    "AutoscaleConfig",
+    "Autoscaler",
+    "DEFAULT_TENANT",
+    "QuotaExceeded",
+    "TenantQuota",
+    "TenantQuotas",
     "JobHandle",
     "ServiceChecker",
     "JobMetrics",

@@ -32,6 +32,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+from collections import deque
 from typing import Optional
 
 from ..core.discovery import HasDiscoveries
@@ -49,6 +50,7 @@ from ..obs import (
 )
 from .queue import AdmissionQueue, Job, JobStatus
 from .scheduler import ServiceEngine, ServiceError, StepFault
+from .tenancy import DEFAULT_TENANT, QuotaExceeded, TenantQuotas
 
 
 class JobHandle:
@@ -110,6 +112,8 @@ class CheckService:
         events=None,
         events_out: Optional[str] = None,
         corpus_dir: Optional[str] = None,
+        quotas: Optional[TenantQuotas] = None,
+        quota_gate: bool = True,
     ):
         """`telemetry=True` records one step-metrics row per fused device
         step (obs/ring.py; digest in `stats()["telemetry"]`, `/.status`,
@@ -137,6 +141,20 @@ class CheckService:
         ONE directory share generations (ServiceFleet(corpus_dir=...)).
         Corrupt entries are detected by the ckptio CRC footer and ignored
         (cold run, never wrong results).
+
+        `quotas` (a service/tenancy.py TenantQuotas) arms per-tenant
+        admission control: submissions carrying a non-default `tenant=`
+        are gated on the tenant's in-flight cap and lane-seconds budget
+        (over-quota raises tenancy.QuotaExceeded → HTTP 429 with
+        Retry-After on the front ends), and each successful fused step
+        charges its lane-seconds against the submitting tenant. The
+        default tenant is never gated, so tenant-less deployments are
+        unchanged. `quota_gate=False` keeps the CHARGING but disables
+        the admission gate — how fleet replicas run: the FleetRouter is
+        the single admission authority, and a requeued/stolen job
+        re-submitted here must never bounce off a budget its first
+        admission already passed (that would turn a replica death into
+        a quota-shaped job loss).
 
         `retry_limit` is the per-group step-fault budget: a group whose
         fused step keeps failing is retried that many times (the faulted
@@ -167,7 +185,14 @@ class CheckService:
             tracer=self._tracer if trace_out else None,
             events=events,
             corpus_dir=corpus_dir,
+            quotas=quotas,
         )
+        self.quotas = quotas
+        self._quota_gate = bool(quota_gate)
+        self._quota_rejected = 0
+        # Bounded recent queue-wait samples (seconds) — the autoscaler's
+        # p99 admission-latency signal, appended at each first admission.
+        self._queue_waits: deque = deque(maxlen=256)
         # Central counter registry (obs/registry.py): both HTTP front ends'
         # `/metrics` render every registered source; weakly held, so a
         # dropped service unregisters itself.
@@ -211,6 +236,7 @@ class CheckService:
         journal: bool = False,
         resume=None,
         trace: Optional[str] = None,
+        tenant: str = DEFAULT_TENANT,
     ) -> JobHandle:
         """Enqueue a check job; returns immediately. The model must be a
         TensorModel; submit the SAME model instance for jobs that should
@@ -223,7 +249,14 @@ class CheckService:
         fleet.py), not a client-facing knob. `trace` is the flight-recorder
         correlation id: the fleet router mints one at ITS front door and
         passes it through here, so the job's events on every replica key
-        to one timeline; a direct submission mints its own."""
+        to one timeline; a direct submission mints its own.
+
+        `tenant` is the tenancy-plane identity (service/tenancy.py): it
+        scopes quota enforcement, the two-level fair-share waterfill, and
+        the corpus namespace. Over-quota submissions raise
+        `tenancy.QuotaExceeded` (→ 429 + Retry-After on the HTTP front
+        ends). The default tenant is gate-free and byte-identical to the
+        pre-tenancy behavior."""
         from ..tensor.model import TensorModel
 
         if not isinstance(model, TensorModel):
@@ -243,6 +276,7 @@ class CheckService:
                 finish_when=finish_when,
                 target_state_count=target_state_count,
                 target_max_depth=target_max_depth,
+                tenant=tenant,
             )
             try:
                 self._engine.prefetch_warm(prefetch)
@@ -254,6 +288,23 @@ class CheckService:
                 raise RuntimeError("service is closed")
             if self._failed:
                 raise ServiceError(self._failed)
+            if (
+                self.quotas is not None and self._quota_gate
+                and tenant != DEFAULT_TENANT
+            ):
+                # Live in-flight scan (no release bookkeeping to leak):
+                # finished jobs simply stop counting.
+                in_flight = sum(
+                    1 for j in self._jobs.values()
+                    if j.tenant == tenant
+                    and j.status not in JobStatus.FINISHED
+                )
+                try:
+                    self.quotas.admit(tenant, in_flight)
+                except QuotaExceeded:
+                    self._quota_rejected += 1
+                    self._events.emit("job.quota_rejected", tenant=tenant)
+                    raise
             job = Job(
                 self._next_id,
                 model,
@@ -268,6 +319,7 @@ class CheckService:
                 journal=journal or self._engine.has_corpus,
                 resume=resume,
                 trace=trace or mint_trace_id(),
+                tenant=tenant,
             )
             if prefetch is not None:
                 job.content_key = prefetch.content_key
@@ -390,6 +442,11 @@ class CheckService:
             out = {
                 "jobs": by_status,
                 "queued": len(self._adm),
+                # The autoscaler-signal pair, in the same vocabulary as
+                # the fleet's per-replica rows (fleet.Replica._signal_row)
+                # so one dashboard reads both deployments.
+                "lane_util": round(self._engine.lane_util(), 4),
+                "adm_p99_ms": self.admission_p99_ms(),
                 "device_steps": self._engine.total_steps,
                 "groups": len(self._engine.groups),
                 "table_fill": round(
@@ -410,7 +467,28 @@ class CheckService:
             corpus = self._engine.corpus_stats()
             if corpus is not None:
                 out["corpus"] = corpus
+            # Tenancy accounting — present only on quota-armed services,
+            # so plain deployments' `/.status` stays byte-identical.
+            if self.quotas is not None:
+                out["tenants"] = self.quotas.snapshot()
+                out["quota_rejected"] = self._quota_rejected
             return out
+
+    def lane_util(self) -> float:
+        """Last fused step's batch occupancy (0..1) — the autoscaler's
+        per-replica lane-utilization signal (also in snapshot_row)."""
+        with self._lock:
+            return self._engine.lane_util()
+
+    def admission_p99_ms(self) -> float:
+        """p99 of recent queue waits, milliseconds (0.0 before any
+        admission) — the autoscaler's latency signal."""
+        with self._lock:
+            waits = sorted(self._queue_waits)
+        if not waits:
+            return 0.0
+        idx = min(len(waits) - 1, int(0.99 * len(waits)))
+        return round(waits[idx] * 1e3, 3)
 
     def store_stats(self) -> Optional[dict]:
         with self._lock:
@@ -602,6 +680,10 @@ class CheckService:
                 self._idle.notify_all()
                 continue
             job.metrics.admitted_at = time.monotonic()
+            qw = job.metrics.queue_wait()
+            if qw is not None:
+                # p99 admission-latency sample (autoscaler signal).
+                self._queue_waits.append(qw)
             job.status = JobStatus.RUNNING
             job.steps_since_admit = 0
             # `job.resumed` (a fleet requeue continuing from its journal

@@ -47,6 +47,7 @@ from .lease import (
 )
 from .queue import JobResume, JobStatus
 from .router import (  # noqa: F401
+    FleetJobStatus,
     FleetRouter,
     ReplicaDead,
     ResumeToken,
@@ -174,6 +175,28 @@ class Replica:
             "replica": self.idx,
             "queued": len(self.service._adm),
             "device_steps": self.service._engine.total_steps,
+            **self._signal_row(),
+        }
+
+    def _signal_row(self) -> dict:
+        """The autoscaler's per-replica signal pair (lane utilization,
+        p99 admission wait), read LOCK-FREE like the rest of the probe
+        plane: a replica mid-compile holds the service lock and must
+        still report. The racy deque snapshot degrades to empty — a
+        missing sample, never a wedged probe."""
+        svc = self.service
+        try:
+            waits = sorted(svc._queue_waits)
+        except RuntimeError:  # srlint: fault-ok racy deque snapshot
+            waits = []
+        p99 = 0.0
+        if waits:
+            p99 = round(
+                waits[min(len(waits) - 1, int(0.99 * len(waits)))] * 1e3, 3
+            )
+        return {
+            "lane_util": round(svc._engine.lane_util(), 4),
+            "adm_p99_ms": p99,
         }
 
     def idle(self) -> bool:
@@ -204,6 +227,9 @@ class Replica:
             "jobs": len(svc._jobs),
             "device_steps": svc._engine.total_steps,
             "spins": self._spins,
+            # Per-replica autoscaler signals, also the `/.status` +
+            # `/metrics` per-replica depth/utilization surface.
+            **self._signal_row(),
         }
 
     # -- the crash-only driver -------------------------------------------------
@@ -305,6 +331,22 @@ class Replica:
         if not self._dead:
             self.service.close()
 
+    def retire_driver(self) -> None:
+        """Graceful local teardown AFTER the router's scale-in drain
+        (`FleetRouter.retire` already revoked the lease and requeued
+        every fleet job): stop pumping, close the service, and read as
+        not-alive from here on — without the crash narrative `_die`
+        writes, because retirement is a decision, not a failure."""
+        self.stop()
+        if self._dead:
+            return
+        try:
+            self.service.close()
+        except Exception:  # noqa: BLE001 — best-effort teardown
+            pass
+        self._dead = True
+        self.error = "retired (scale-in)"
+
 
 class ServiceFleet:
     """N CheckService replicas behind one consistent-hash router — the
@@ -330,6 +372,7 @@ class ServiceFleet:
         remote: bool = False,
         store_root: Optional[str] = None,
         spawn_timeout_s: float = 180.0,
+        quotas=None,
     ):
         """`service_kwargs` configure every replica's CheckService
         (batch_size, table_log2, store, ...). `max_resident` bounds each
@@ -376,7 +419,17 @@ class ServiceFleet:
         the only configuration replicas share. Journals stay local-write
         (a scratch directory) and are blob-synced at flush boundaries;
         replica addresses are discovered from ``members/`` records in the
-        root (service/discovery.py) instead of hand-wired port files."""
+        root (service/discovery.py) instead of hand-wired port files.
+
+        `quotas` (service/tenancy.py `TenantQuotas`) arms the tenancy
+        plane fleet-wide: the ROUTER is the single admission gate
+        (per-tenant in-flight cap + lane-seconds budget → 429 with
+        Retry-After over HTTP), and every in-proc replica shares the
+        same ledger for lane-seconds charging with its own gate OFF
+        (`quota_gate=False` — a requeued job must never bounce off a
+        budget its first admission already passed). Remote replicas
+        cannot share the in-memory ledger across processes, so remote
+        fleets gate on the in-flight cap only."""
         if n_replicas < 1:
             raise ValueError("a fleet needs at least one replica")
         self._tracer = as_tracer(tracer)
@@ -442,6 +495,12 @@ class ServiceFleet:
             router_lease = self.lease_store.grant("router")
         kw = dict(service_kwargs or {})
         kw.setdefault("max_resident", max_resident)
+        self.quotas = quotas
+        if quotas is not None and not remote:
+            # Shared lane-seconds ledger on every in-proc replica —
+            # charging only; the router is the single admission gate.
+            kw["quotas"] = quotas
+            kw["quota_gate"] = False
         if corpus_dir is not None:
             if not is_blob_uri(corpus_dir):
                 os.makedirs(corpus_dir, exist_ok=True)
@@ -507,6 +566,7 @@ class ServiceFleet:
             events=router_journal,
             lease_store=self.lease_store,
             router_lease=router_lease,
+            quotas=quotas,
             **(router_kwargs or {}),
         )
         self.background = background
@@ -660,6 +720,135 @@ class ServiceFleet:
                 "lease.grant", member=member, epoch=epoch
             )
         return True
+
+    # -- autoscaling (service/autoscale.py drives these) -----------------------
+
+    def scale_out(self) -> Optional[int]:
+        """Grow the fleet by one replica at the next free index. The new
+        member enters through `FleetRouter.rejoin`'s brand-new-index door:
+        registered, leased, probed — but quarantined behind the same
+        probation the rejoin path uses, so a flapping new member never
+        receives work it would immediately orphan. Journals
+        `fleet.scale_out`; counts `scale_outs`. Returns the new index, or
+        None when the ``fleet.autoscale`` chaos point aborted the grow —
+        which fires FIRST, before the grant and the spawn, so an injected
+        fault changes literally nothing (not even a burned epoch).
+
+        Shares `_rejoin_lock` with rejoin_replica: membership growth and
+        member recovery are serialized against each other."""
+        with self._rejoin_lock:
+            try:
+                maybe_fault("fleet.autoscale", action="scale_out")
+            except FaultError:
+                return None
+            idx = len(self.replicas)
+            member = lease_member(idx)
+            proc = None
+            if self.remote:
+                from .remote import RemoteReplica, spawn_replica_proc
+
+                self.lease_store.grant(member)
+                proc, url = spawn_replica_proc(
+                    idx, self.store_root, self._service_kw,
+                    timeout_s=self._spawn_timeout_s,
+                    scratch=self.scratch_dir,
+                )
+                new = RemoteReplica(
+                    idx, url, proc=proc, tracer=self._tracer_raw,
+                    store_root=self.store_root,
+                )
+            else:
+                new = self._make_inproc_replica(idx)
+            if not self.router.rejoin(new):
+                # Unreachable for a brand-new index today; keep the same
+                # no-leak teardown discipline as rejoin_replica anyway.
+                if proc is not None:
+                    self._kill_one(proc)
+                else:
+                    new.close()
+                return None
+            self.replicas.append(new)
+            if proc is not None:
+                self._procs.append(proc)
+            if self.background:
+                new.start()
+            if self.lease_store is not None:
+                epoch, _state = self.lease_store.state(member)
+                self.router._events.emit(
+                    "lease.grant", member=member, epoch=epoch
+                )
+            return idx
+
+    def scale_in(self, idx: Optional[int] = None) -> Optional[int]:
+        """Retire one replica — by default the least-loaded healthy
+        member (ties retire the newest index). Loss-free by construction:
+        the replica's RUNNING journaled jobs get one final checkpoint
+        generation (in-proc; remote drivers checkpoint every spin
+        anyway), then `FleetRouter.retire` revokes the lease, drains the
+        backlog onto survivors (resumed where a generation exists), and
+        only then is the local driver stopped. Journals `fleet.scale_in`;
+        counts `scale_ins`. Returns the retired index, or None when
+        there is no eligible member (never drains below one healthy
+        replica) or the ``fleet.autoscale`` chaos point aborted the
+        retirement — fired FIRST, so an injected fault leaves the fleet
+        exactly as it was."""
+        with self._rejoin_lock:
+            try:
+                maybe_fault("fleet.autoscale", action="scale_in")
+            except FaultError:
+                return None
+            if idx is None:
+                idx = self._scale_in_candidate()
+            if idx is None or not (0 <= idx < len(self.replicas)):
+                return None
+            r = self.replicas[idx]
+            if not self.remote and r.alive:
+                # Final flush BEFORE the lease revoke inside retire():
+                # after the revoke this driver's own writes would refuse
+                # themselves, and the drain would restart instead of
+                # resume.
+                try:
+                    r._checkpoint_jobs()
+                except Exception:  # noqa: BLE001 — flush is best-effort
+                    pass
+            if not self.router.retire(idx):
+                return None
+            if self.remote:
+                r.stop()  # completion mirror: the handles were requeued
+                if getattr(r, "proc", None) is not None:
+                    self._kill_one(r.proc)
+            else:
+                r.retire_driver()
+            # The slot stays occupied (self.replicas is index-addressed;
+            # the router keeps reporting the member as a dead row, and
+            # close() reaps it from the list) — a later scale_out grows
+            # at the NEXT index, and rejoin_replica can even resurrect
+            # this one.
+            return idx
+
+    def _scale_in_candidate(self) -> Optional[int]:
+        """Least-loaded healthy member by unfinished fleet-job count;
+        probation/draining members are ineligible (mid-transition), and
+        the last healthy member is never a candidate."""
+        router = self.router
+        with router._lock:
+            live = [
+                i for i in router.replicas
+                if i not in router._dead
+                and i not in router._draining
+                and i not in router._probation
+                and router.replicas[i].alive
+            ]
+            if len(live) <= 1:
+                return None
+            load: dict[int, int] = {}
+            for fj in router._jobs.values():
+                if (
+                    fj.status not in FleetJobStatus.FINISHED
+                    and fj.replica is not None
+                ):
+                    load[fj.replica] = load.get(fj.replica, 0) + 1
+        return min(live, key=lambda i: (load.get(i, 0), -i))
 
     # -- client surface --------------------------------------------------------
 

@@ -32,6 +32,12 @@ class JobMetrics:
     preemptions: int = 0
     suspects_checked: int = 0  # tiered store: this job's Bloom-positive claims
     suspects_dup: int = 0  # ... of which were confirmed spilled duplicates
+    # Device lane-seconds: lanes x wall-seconds of the fused steps the job
+    # held lanes in — the tenancy plane's billing unit (charged against
+    # TenantQuotas after each successful step). Deliberately NOT in
+    # to_dict/SERVICE_DETAIL_KEYS: it surfaces through detail["tenant"]
+    # (TENANT_DETAIL_KEYS) only on non-default-tenant jobs.
+    lane_seconds: float = 0.0
 
     @classmethod
     def now(cls) -> "JobMetrics":

@@ -147,9 +147,14 @@ class Job:
         journal: bool = False,
         resume: Optional[JobResume] = None,
         trace: Optional[str] = None,
+        tenant: str = "default",
     ):
         self.id = job_id
         self.model = model
+        # Tenancy plane (service/tenancy.py): the identity the submission
+        # carried. "default" is the quota-free, unsalted namespace every
+        # pre-tenancy caller lands in — it changes nothing downstream.
+        self.tenant = tenant
         # Flight-recorder correlation id (obs/events.py): minted at the
         # outermost submission front door (fleet router or this service)
         # and carried through every replica hop — the key that joins this
@@ -404,12 +409,22 @@ class AdmissionQueue:
     """Waiting jobs ordered by (priority desc, arrival). Preempted jobs
     re-enter through `push` and land BEHIND queued peers of the same
     priority — the round-robin half of the fairness story (the other half
-    is the scheduler's per-step lane grants)."""
+    is the scheduler's per-step lane grants).
+
+    Tenancy makes admission TWO-LEVEL: within the top priority class,
+    `pop_next` round-robins across the tenants present (first-arrival
+    tenant order) instead of draining one tenant's backlog. A tenant
+    flooding 100 jobs therefore delays a 1-job tenant by at most one
+    grant per tenant present — bounded wait, pinned by
+    tests/test_tenancy.py. With a single tenant present (every
+    pre-tenancy caller) the pick is exactly the old head-of-queue, so
+    admission order is bit-identical to the jobs-only queue."""
 
     def __init__(self):
         self._q: list[Job] = []
         self._seq = 0
         self._order: dict[int, int] = {}
+        self._last_tenant: Optional[str] = None
 
     def __len__(self) -> int:
         return len(self._q)
@@ -421,7 +436,28 @@ class AdmissionQueue:
         self._q.sort(key=lambda j: (-j.priority, self._order[j.id]))
 
     def pop_next(self) -> Optional[Job]:
-        return self._q.pop(0) if self._q else None
+        if not self._q:
+            return None
+        top = self._q[0].priority
+        cls = [j for j in self._q if j.priority == top]
+        tenants: list[str] = []
+        for j in cls:
+            if j.tenant not in tenants:
+                tenants.append(j.tenant)
+        if len(tenants) == 1:
+            pick = cls[0]
+        else:
+            # Serve the first tenant cyclically after the last one served;
+            # an unseen/departed last-tenant resets to the head.
+            if self._last_tenant in tenants:
+                i = (tenants.index(self._last_tenant) + 1) % len(tenants)
+            else:
+                i = 0
+            t = tenants[i]
+            pick = next(j for j in cls if j.tenant == t)
+        self._last_tenant = pick.tenant
+        self._q.remove(pick)
+        return pick
 
     def peek(self) -> Optional[Job]:
         return self._q[0] if self._q else None

@@ -243,6 +243,7 @@ class RemoteReplica:
                 "target_max_depth": spec.get("target_max_depth"),
                 "timeout": spec.get("timeout"),
                 "priority": spec.get("priority", 0),
+                "tenant": spec.get("tenant", "default"),
             },
             "journal": bool(spec.get("journal")),
             "trace": spec.get("trace"),
@@ -344,6 +345,10 @@ class RemoteReplica:
             # empty: report zeros, not None — stats() SUMS these rows.
             "queued": p.get("queued") or 0,
             "device_steps": p.get("device_steps") or 0,
+            # Autoscaler signals ride the probe cache too (the serving
+            # process's Replica.probe computes them lock-free).
+            "lane_util": p.get("lane_util") or 0.0,
+            "adm_p99_ms": p.get("adm_p99_ms") or 0.0,
             "remote": self.base_url,
         }
 
@@ -542,6 +547,7 @@ def serve_replica(
                         target_max_depth=opts.get("target_max_depth"),
                         timeout=opts.get("timeout"),
                         priority=int(opts.get("priority") or 0),
+                        tenant=opts.get("tenant") or "default",
                         journal=bool(payload.get("journal")),
                         resume=load_resume(payload.get("resume_from")),
                         trace=payload.get("trace"),

@@ -77,6 +77,7 @@ from ..obs import (
     mint_trace_id,
 )
 from .queue import JobStatus
+from .tenancy import DEFAULT_TENANT, QuotaExceeded
 
 
 class ReplicaDead(RuntimeError):
@@ -274,6 +275,7 @@ class FleetRouter:
         probe_backoff_base: int = 1,
         probe_backoff_cap: int = 8,
         probation_probes: int = 2,
+        quotas=None,
     ):
         """`replicas` are service/fleet.py `Replica` drivers (one
         CheckService each). `background=True` makes probes run under a
@@ -303,7 +305,14 @@ class FleetRouter:
         re-registered through `rejoin` must answer this many CONSECUTIVE
         health probes before its keys move back (`HashRing.add` — only
         ITS keys, mirroring dead-member removal); until promotion it
-        receives no placements and neither steals nor is stolen from."""
+        receives no placements and neither steals nor is stolen from.
+
+        `quotas` (service/tenancy.py `TenantQuotas`) turns on the
+        fleet-wide admission gate: `submit(tenant=...)` counts the
+        tenant's unfinished fleet jobs against its `max_in_flight` and
+        its lane-seconds spend against its windowed budget, rejecting
+        with `QuotaExceeded` (rendered as HTTP 429 + Retry-After by
+        serve_fleet). The default tenant is never gated."""
         self.replicas = {r.idx: r for r in replicas}
         self.ckpt_dir = ckpt_dir
         self.ring = HashRing(list(self.replicas))
@@ -319,6 +328,7 @@ class FleetRouter:
         self._events = as_events(events)
         self.lease_store = lease_store
         self.router_lease = router_lease
+        self.quotas = quotas
         self.probe_backoff_base = max(int(probe_backoff_base), 1)
         self.probe_backoff_cap = max(int(probe_backoff_cap), 1)
         self._jobs: dict[int, FleetJob] = {}
@@ -330,6 +340,10 @@ class FleetRouter:
         self._tick_n = 0
         self._next_probe: dict[int, int] = {}  # idx -> earliest probe tick
         self._probation: dict[int, int] = {}  # idx -> healthy probes still owed
+        # Members mid-retirement (autoscale scale-in drain): excluded from
+        # placement AND from stealing (as thieves — their backlog is still
+        # fair game for others to steal away, which IS the drain).
+        self._draining: set = set()
         self.counters = {
             "jobs_routed": 0,
             "router_retries": 0,
@@ -344,6 +358,9 @@ class FleetRouter:
             "lease_reseals": 0,
             "rejoins": 0,
             "rejoin_promotions": 0,
+            "quota_rejected": 0,
+            "scale_outs": 0,
+            "scale_ins": 0,
         }
         self._metrics_name = REGISTRY.register("fleet", self.metrics)
         if self.lease_store is not None:
@@ -369,6 +386,7 @@ class FleetRouter:
         timeout: Optional[float] = None,
         priority: int = 0,
         model_ref: Optional[tuple] = None,
+        tenant: str = DEFAULT_TENANT,
     ) -> FleetJobHandle:
         """Route one job onto the fleet; returns immediately. `route_key`
         defaults to the model's type name — same-key jobs share a replica
@@ -376,7 +394,28 @@ class FleetRouter:
         `model_ref=(registry name, args)` is REQUIRED when any replica is
         remote: model objects cannot cross the process boundary, so the
         ref is what a RemoteReplica submits (both sides resolve it through
-        the same ModelRegistry; serve_fleet fills it in automatically)."""
+        the same ModelRegistry; serve_fleet fills it in automatically).
+        `tenant` is the job's billing identity: it rides in `opts` through
+        `_spec` into every replica's `CheckService.submit` (quota
+        accounting, tenant-fair admission, tenant-salted corpus keys all
+        key on it), and when the router was built with `quotas` a
+        non-default tenant over its in-flight or lane-seconds budget is
+        rejected HERE with `QuotaExceeded` before any replica is
+        touched."""
+        if self.quotas is not None and tenant != DEFAULT_TENANT:
+            with self._lock:
+                in_flight = sum(
+                    1 for fj in self._jobs.values()
+                    if fj.opts.get("tenant") == tenant
+                    and fj.status not in FleetJobStatus.FINISHED
+                )
+            try:
+                self.quotas.admit(tenant, in_flight)
+            except QuotaExceeded:
+                with self._lock:
+                    self.counters["quota_rejected"] += 1
+                self._events.emit("job.quota_rejected", tenant=tenant)
+                raise
         if not self._healthy():
             # One of the satellite 503/Retry-After surfaces: journaled so
             # a forensic pass can see WHY clients were bounced.
@@ -406,6 +445,7 @@ class FleetRouter:
             target_max_depth=target_max_depth,
             timeout=timeout,
             priority=priority,
+            tenant=tenant,
         )
         with self._lock:
             fj = FleetJob(
@@ -545,7 +585,8 @@ class FleetRouter:
             for attempt in range(self.retry_limit + 1):
                 order = [
                     i for i in self.ring.preference(fj.key)
-                    if i not in self._dead and self.replicas[i].alive
+                    if i not in self._dead and i not in self._draining
+                    and self.replicas[i].alive
                 ]
                 if not order:
                     # Ring empty but probation members alive (every live
@@ -559,6 +600,7 @@ class FleetRouter:
                         order = sorted(
                             i for i in self._probation
                             if i not in self._dead
+                            and i not in self._draining
                             and self.replicas[i].alive
                         )
                 if not order:
@@ -651,16 +693,39 @@ class FleetRouter:
         The ``fleet.rejoin`` chaos point fires at the TOP of the caller
         (`ServiceFleet.rejoin_replica`) — before the fresh grant, before
         the spawn — so an injected fault aborts the whole rejoin with
-        literally nothing changed (not even a burned epoch)."""
+        literally nothing changed (not even a burned epoch).
+
+        A BRAND-NEW index (autoscale scale-out, `ServiceFleet.scale_out`)
+        joins through the same door and the same quarantine: it is
+        registered and probed like any member but gets no keys (and no
+        placements) until `probation_probes` consecutive healthy probes
+        promote it — a flapping new member never receives work it would
+        immediately orphan. The only differences are the books: the join
+        counts as `scale_outs` (not `rejoins`) and journals
+        `fleet.scale_out` (not `replica.rejoin`)."""
         with self._lock:
-            if replica.idx not in self._dead:
-                return False  # alive (or never known): nothing to rejoin
+            grown = replica.idx not in self.replicas
+            if not grown and replica.idx not in self._dead:
+                return False  # alive: nothing to rejoin
             self._dead.discard(replica.idx)
+            self._draining.discard(replica.idx)
             self.replicas[replica.idx] = replica
             self._suspect[replica.idx] = 0
             self._next_probe.pop(replica.idx, None)
             self._probation[replica.idx] = self.probation_probes
-            self.counters["rejoins"] += 1
+            if grown:
+                self.counters["scale_outs"] += 1
+            else:
+                self.counters["rejoins"] += 1
+        if grown:
+            self._tracer.instant(
+                "fleet.scale_out", cat="fleet", replica=replica.idx
+            )
+            self._events.emit(
+                "fleet.scale_out", replica=replica.idx,
+                probes=self.probation_probes,
+            )
+            return True
         self._tracer.instant(
             "fleet.rejoin", cat="fleet", replica=replica.idx
         )
@@ -683,6 +748,100 @@ class FleetRouter:
         self._tracer.instant(
             "fleet.rejoin_promoted", cat="fleet", replica=idx
         )
+
+    # -- replica retire (autoscale scale-in) -----------------------------------
+
+    def retire(self, idx: int) -> bool:
+        """Gracefully remove a HEALTHY member (autoscale scale-in,
+        `ServiceFleet.scale_in`). The drain is loss-free by the same
+        argument as the death path, in a safer order:
+
+        1. mark the member DRAINING — no new placements land on it and it
+           stops stealing (its own queue stays stealable: steals away from
+           it are the drain working);
+        2. revoke its lease (persisted) — from here every write the
+           still-running member attempts is provably stale, exactly the
+           zombie discipline of `_on_replica_death`. A revocation that
+           does not durably land aborts the WHOLE retirement (the member
+           un-drains and keeps serving; the autoscaler retries next tick);
+        3. remove it from the ring, requeue every unfinished job it held
+           onto survivors — resumed from the newest intact re-sealed
+           checkpoint generation when one exists, restarted fresh
+           otherwise. BFS determinism keeps results bit-identical either
+           way (the scale-in drain golden test pins this).
+
+        Journals ONE `fleet.scale_in` (and counts `scale_ins`), not
+        `replica.crash` — the timeline must read as a decision, not a
+        failure. Refuses (False) to retire the last healthy member.
+        The ``fleet.autoscale`` chaos point fires in the CALLER before
+        anything here runs, so an injected fault changes nothing."""
+        with self._lock:
+            r = self.replicas.get(idx)
+            if r is None or idx in self._dead:
+                return False
+            survivors = [
+                i for i in self.replicas
+                if i != idx and i not in self._dead
+                and i not in self._draining and self.replicas[i].alive
+            ]
+            if not survivors:
+                return False  # never drain the fleet to zero members
+            self._draining.add(idx)
+        member = lease_member(idx)
+        if self.lease_store is not None:
+            try:
+                epoch = self.lease_store.revoke(member)
+            except (FaultError, OSError):
+                # The revocation did not durably land: abort the whole
+                # retirement — requeueing before a durable revoke would
+                # hand the still-running member a license to corrupt.
+                with self._lock:
+                    self._draining.discard(idx)
+                self._tracer.instant(
+                    "lease.revoke_race", cat="fleet", member=member
+                )
+                return False
+            if epoch is not None:
+                self.counters["lease_revokes"] += 1
+                self._events.emit(
+                    "lease.revoke", member=member, epoch=epoch
+                )
+        with self._lock:
+            self._dead.add(idx)
+            self._draining.discard(idx)
+            self._probation.pop(idx, None)
+            orphans = [
+                fj for fj in self._jobs.values()
+                if fj.replica == idx
+                and fj.status not in FleetJobStatus.FINISHED
+            ]
+            self.counters["scale_ins"] += 1
+        self.ring.remove(idx)
+        self._tracer.instant(
+            "fleet.scale_in", cat="fleet", replica=idx,
+            orphans=len(orphans),
+        )
+        self._events.emit(
+            "fleet.scale_in", replica=idx, orphans=len(orphans)
+        )
+        with self._tracer.span(
+            "fleet.drain", cat="fleet", replica=idx, orphans=len(orphans)
+        ):
+            for fj in orphans:
+                with self._lock:
+                    fj.requeues += 1
+                    fj.replica = None
+                    fj.handle = None
+                    self.counters["requeued_jobs"] += 1
+                resume = self._resume_token(fj, reseal=True)
+                if resume is not None:
+                    self.counters["restored_jobs"] += 1
+                self._events.emit(
+                    "job.requeued", job=fj.id, trace=fj.trace, src=idx,
+                    reason="scale-in drain", restored=resume is not None,
+                )
+                self._place(fj, resume=resume)
+        return True
 
     # -- supervision tick ------------------------------------------------------
 
@@ -1027,7 +1186,13 @@ class FleetRouter:
         )
         if len(healthy) < 2:
             return
-        idle = [r for r in healthy if r.idle()]
+        # A draining member (scale-in in progress) must not PULL work —
+        # it is leaving — but its queue stays stealable: the steals are
+        # part of the drain.
+        idle = [
+            r for r in healthy
+            if r.idle() and r.idx not in self._draining
+        ]
         if not idle:
             return
         with self._lock:
@@ -1250,6 +1415,16 @@ def serve_fleet(
                 {"error": msg}, 503, headers={"Retry-After": RETRY_AFTER_S}
             )
 
+        def _429(self, e: QuotaExceeded) -> None:
+            # Quota rejections are retryable by contract: the Retry-After
+            # is computed from the tenant's actual refill rate, so a
+            # well-behaved client that honors it succeeds on the retry.
+            self._json(
+                {"error": str(e), "tenant": e.tenant, "reason": e.reason},
+                429,
+                headers={"Retry-After": str(e.retry_after_s)},
+            )
+
         def _injected_503(self, method: str) -> bool:
             try:
                 maybe_fault("service.http", method=method, path=self.path)
@@ -1321,6 +1496,7 @@ def serve_fleet(
                     name = payload["model"]
                     args = dict(payload.get("args") or {})
                     opts = dict(payload.get("opts") or {})
+                    tenant = payload.get("tenant") or DEFAULT_TENANT
                     fw = opts.pop("finish_when", None)
                     if fw is not None:
                         opts["finish_when"] = {
@@ -1342,10 +1518,13 @@ def serve_fleet(
                         # registry — in-proc replicas just ignore it.
                         h = router.submit(
                             model, route_key=key,
-                            model_ref=(name, args), **opts,
+                            model_ref=(name, args), tenant=tenant, **opts,
                         )
                     except NoHealthyReplica as e:
                         self._503(str(e))
+                        return
+                    except QuotaExceeded as e:
+                        self._429(e)
                         return
                     self._json({"job": h.id})
                     return

@@ -193,6 +193,7 @@ class ServiceEngine:
         tracer=None,
         events=None,
         corpus_dir: Optional[str] = None,
+        quotas=None,
     ):
         self.batch_size = batch_size
         if insert_variant not in self.INSERT_VARIANTS:
@@ -287,6 +288,13 @@ class ServiceEngine:
             "quarantined_jobs": 0,
         }
         self.total_steps = 0
+        # Tenancy plane (service/tenancy.py): the shared lane-seconds
+        # ledger jobs charge after each successful step (None = free).
+        self.quotas = quotas
+        # Lanes the LAST fused step actually carried — the autoscaler's
+        # lane-utilization signal (last_active_lanes / batch_size),
+        # always available even with telemetry off.
+        self.last_active_lanes = 0
         self._table_stamp = 0  # bumped per step; parent-map cache key
         self._parent_map = None
         self._parent_map_stamp = -1
@@ -339,16 +347,23 @@ class ServiceEngine:
         the inputs that determine a cold run's visited set and result —
         plus the same address factored into its near-match components
         (store/corpus.key_components). Cached per (model instance, finish
-        signature): the jaxpr trace behind the definition hash costs
-        milliseconds and submissions repeat."""
+        signature, tenant): the jaxpr trace behind the definition hash
+        costs milliseconds and submissions repeat.
+
+        A non-default tenant salts the key AND the factored "def"
+        component (store/corpus.py) — per-tenant corpus namespaces, so
+        one tenant's published entries never warm another's runs. The
+        default tenant's keys are byte-identical to pre-tenancy."""
         from ..store.corpus import (
             content_key, finish_signature, key_components,
         )
+        from .tenancy import tenant_salt
 
         fin = finish_signature(
             job.finish_when, job.target_state_count, job.target_max_depth
         )
-        sig = (id(job.model), fin)
+        salt = tenant_salt(getattr(job, "tenant", None))
+        sig = (id(job.model), fin, salt)
         hit = self._corpus_keys.get(sig)
         # Same recycled-id() guard as corpus._DEF_HASH_CACHE: the cached
         # key only serves if the weakly-held model is the SAME object —
@@ -365,8 +380,8 @@ class ServiceEngine:
             "summary_hashes": cfg.summary_hashes,
             "finish": fin,
         }
-        key = content_key(job.model, lowering)
-        comp = key_components(job.model, lowering)
+        key = content_key(job.model, lowering, tenant=salt)
+        comp = key_components(job.model, lowering, tenant=salt)
         try:
             self._corpus_keys[sig] = (weakref.ref(job.model), key, comp)
         except TypeError:
@@ -858,14 +873,50 @@ class ServiceEngine:
     # -- lane grants -----------------------------------------------------------
 
     def _grants(self, jobs: list, K: int) -> list:
-        """Waterfill K lanes across jobs in rotation order: each pass gives
-        every still-hungry job an equal share (>= 1 lane), so small jobs
-        finish their frontier and big jobs absorb the slack."""
+        """TWO-LEVEL fair-share waterfill of K lanes (tenancy plane):
+        level 1 waterfills across the TENANTS present (each tenant's
+        demand = its jobs' pending lanes summed), level 2 waterfills each
+        tenant's allocation across that tenant's jobs — so a tenant with
+        one job and a tenant with a hundred get equal device share, and
+        within a tenant small jobs finish their frontier while big jobs
+        absorb the slack. With a single tenant present (every pre-tenancy
+        caller) level 1 degenerates to handing K straight to level 2,
+        which IS the old jobs-only waterfill — grants bit-identical."""
         pend = [j.pending_lanes for j in jobs]
+        tenants: list = []
+        for j in jobs:
+            t = getattr(j, "tenant", "default")
+            if t not in tenants:
+                tenants.append(t)
+        if len(tenants) <= 1:
+            return self._waterfill(pend, K)
+        demand = [
+            sum(
+                p for j, p in zip(jobs, pend)
+                if getattr(j, "tenant", "default") == t
+            )
+            for t in tenants
+        ]
+        t_alloc = self._waterfill(demand, K)
         grants = [0] * len(jobs)
+        for t, alloc in zip(tenants, t_alloc):
+            idxs = [
+                i for i, j in enumerate(jobs)
+                if getattr(j, "tenant", "default") == t
+            ]
+            sub = self._waterfill([pend[i] for i in idxs], alloc)
+            for i, g in zip(idxs, sub):
+                grants[i] = g
+        return grants
+
+    @staticmethod
+    def _waterfill(pend: list, K: int) -> list:
+        """One waterfill pass: each round gives every still-hungry entry
+        an equal share (>= 1 lane) until K is exhausted or demand is."""
+        grants = [0] * len(pend)
         left = K
         while left > 0:
-            live = [i for i in range(len(jobs)) if pend[i] > grants[i]]
+            live = [i for i in range(len(pend)) if pend[i] > grants[i]]
             if not live:
                 break
             share = max(left // len(live), 1)
@@ -1010,6 +1061,16 @@ class ServiceEngine:
             )
             raise StepFault(group, e) from e
         step_us = (time.monotonic() - t_step0) * 1e6
+        self.last_active_lanes = m
+        # Tenancy billing: lane-seconds = lanes held x step wall time,
+        # charged AFTER the step succeeded (the exactly-retriable unwind
+        # above never reaches here, so a faulted step cannot double-bill).
+        lane_s = step_us / 1e6
+        for job, s, e2 in segments:
+            share = (e2 - s) * lane_s
+            job.metrics.lane_seconds += share
+            if self.quotas is not None and job.tenant != "default":
+                self.quotas.charge(job.tenant, share)
 
         masks = np.asarray(prop_masks)
         gen_rows = np.asarray(gen_rows)
@@ -1225,6 +1286,14 @@ class ServiceEngine:
             # Engine-wide step digest (the shared batches this job rode in),
             # not a per-job slice — per-job shares live under "service".
             detail["telemetry"] = t
+        if job.tenant != "default":
+            # Tenancy accounting sub-dict (obs/schema.py
+            # TENANT_DETAIL_KEYS) — default-tenant results stay
+            # byte-identical to the pre-tenancy goldens.
+            detail["tenant"] = {
+                "name": job.tenant,
+                "lane_seconds": round(job.metrics.lane_seconds, 6),
+            }
         if job.timed_out:
             detail["timed_out"] = True
         if job.trace:
@@ -1274,6 +1343,11 @@ class ServiceEngine:
         if self._store is None:
             return None
         return self._store.stats(self.hot_claims)
+
+    def lane_util(self) -> float:
+        """Fraction of the batch the LAST fused step filled — the
+        autoscaler's utilization signal (0.0 before any step)."""
+        return self.last_active_lanes / max(self.batch_size, 1)
 
     def telemetry_summary(self) -> Optional[dict]:
         """Engine-wide step-telemetry digest (obs/ring.py; None with

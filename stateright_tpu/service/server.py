@@ -44,6 +44,7 @@ from ..explorer.server import ExplorerServer
 from ..faults.plan import FaultError, maybe_fault
 from ..obs import REGISTRY, render_prometheus
 from .api import CheckService
+from .tenancy import DEFAULT_TENANT, QuotaExceeded
 
 #: `Retry-After` seconds on every 503 this plane emits (injected faults,
 #: router overload) — deterministic, so load clients back off identically
@@ -140,7 +141,8 @@ def submit_view(
             "any_failures": HasDiscoveries.ANY_FAILURES,
         }[fw]
     model = registry.get(payload["model"], payload.get("args"))
-    handle = service.submit(model, **opts)
+    tenant = payload.get("tenant") or DEFAULT_TENANT
+    handle = service.submit(model, tenant=tenant, **opts)
     return {"job": handle.id}
 
 
@@ -289,7 +291,22 @@ def serve_service(
                     if "model" not in payload:
                         self._json({"error": "missing 'model'"}, 400)
                         return
-                    self._json(submit_view(service, reg, payload))
+                    try:
+                        self._json(submit_view(service, reg, payload))
+                    except QuotaExceeded as e:
+                        # Over-quota is retryable by contract, not a bad
+                        # request: 429 + a Retry-After computed from the
+                        # tenant's actual refill rate, mirroring the 503
+                        # discipline (clients back off, never hot-loop).
+                        self._json(
+                            {
+                                "error": str(e),
+                                "tenant": e.tenant,
+                                "reason": e.reason,
+                            },
+                            429,
+                            headers={"Retry-After": str(e.retry_after_s)},
+                        )
                     return
                 if self.path.startswith("/jobs/") and self.path.endswith(
                     "/cancel"

@@ -161,21 +161,30 @@ def model_def_hash(model) -> str:
     return digest
 
 
-def content_key(model, lowering: dict) -> str:
+def content_key(model, lowering: dict, tenant: Optional[str] = None) -> str:
     """The corpus content address for (model definition, lowering config).
 
     `lowering` must hold every knob that can change the visited set, the
     claim/pop order, or the finish point of a run: batch_size, table_log2,
     insert_variant, summary config, and the finish policy (finish_when
     kind+names, target_state_count, target_max_depth). Values must be
-    repr-stable scalars/tuples."""
+    repr-stable scalars/tuples.
+
+    `tenant` (service/tenancy.py) salts the key into a per-tenant
+    namespace so one tenant's published entries never warm another's
+    runs; ``None`` (the default tenant) leaves the bytes identical to the
+    pre-tenancy key, so existing corpora keep serving."""
     h = hashlib.blake2b(digest_size=16)
     h.update(model_def_hash(model).encode())
+    if tenant is not None:
+        h.update(b"tenant:" + tenant.encode())
     h.update(repr(sorted(lowering.items())).encode())
     return h.hexdigest()
 
 
-def key_components(model, lowering: dict) -> dict:
+def key_components(
+    model, lowering: dict, tenant: Optional[str] = None
+) -> dict:
     """The content key factored into its near-match components (corpus v2):
     the definition hash (the family address), the result-affecting run
     shape (batch_size + finish policy — pop order and the stop point), and
@@ -183,10 +192,21 @@ def key_components(model, lowering: dict) -> dict:
     table_log2, insert_variant, summary geometry, store kind). Two runs
     whose "def"/"batch_size"/"finish" components agree produce identical
     results from identical prefixes regardless of "table" — that is the
-    near-match rung of the warm ladder (store/warm.py)."""
+    near-match rung of the warm ladder (store/warm.py).
+
+    The tenant salt lands in the **"def"** component, not "table":
+    `lookup_near`/`lookup_family` match on def+batch_size+finish and
+    ignore "table", so salting anywhere weaker would let a near-match
+    rung serve one tenant's states to another. ``None`` keeps the
+    pre-tenancy component bytes."""
     fin = lowering.get("finish")
+    def_hash = model_def_hash(model)
+    if tenant is not None:
+        def_hash = hashlib.blake2b(
+            (def_hash + ":tenant:" + tenant).encode(), digest_size=16
+        ).hexdigest()
     return {
-        "def": model_def_hash(model),
+        "def": def_hash,
         "batch_size": int(lowering.get("batch_size", 0)),
         "finish": repr(tuple(fin)) if fin is not None else repr(None),
         "table": repr(

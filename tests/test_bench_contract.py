@@ -278,6 +278,84 @@ def test_device_detail_pins_fleet_row_keys():
     assert row["fleet_p99_ms"] == 8900.0
 
 
+def test_device_detail_pins_autoscale_row_keys():
+    # The BENCH_AUTOSCALE=1 A/B row is part of the artifact contract:
+    # fixed-1 vs autoscaled throughput, the ratio, the autoscaled run's
+    # latency digest, and the control loop's scale-event evidence must
+    # survive into detail.device so the ISSUE-17 "scaling is invisible in
+    # the answers, visible in the wall clock" claim is auditable in every
+    # BENCH_r*.json.
+    for key in (
+        "auto_max_replicas", "auto_jobs_per_sec", "auto_p50_ms",
+        "auto_p99_ms", "auto_replicas_high_water", "auto_scale_outs",
+        "auto_scale_ins", "sec_fixed_one", "vs_fixed_one",
+    ):
+        assert key in bench.DEVICE_DETAIL_FIELDS
+    row = bench.device_detail(
+        {
+            "states_per_sec": 4600.0,
+            "sec": 6.2,
+            "auto_max_replicas": 3,
+            "auto_jobs_per_sec": 1.28,
+            "auto_p50_ms": 4218.0,
+            "auto_p99_ms": 6240.0,
+            "auto_replicas_high_water": 3,
+            "auto_scale_outs": 2,
+            "auto_scale_ins": 1,
+            "sec_fixed_one": 13.3,
+            "vs_fixed_one": 2.13,
+        }
+    )
+    assert row["auto_replicas_high_water"] == 3
+    assert row["vs_fixed_one"] == 2.13
+    assert row["auto_p99_ms"] == 6240.0
+
+
+def test_autoscale_counter_keys_conform_to_obs_schema():
+    # The autoscaler's metrics() vocabulary (the "autoscaler" /metrics
+    # source) is the documented obs schema's — a stub fleet is enough to
+    # pin the shape without building a replica.
+    from stateright_tpu.obs.schema import (
+        AUTOSCALE_COUNTER_KEYS,
+        REGISTRY_SOURCES,
+    )
+    from stateright_tpu.service.autoscale import Autoscaler
+
+    assert "autoscaler" in REGISTRY_SOURCES
+
+    class _Router:
+        @staticmethod
+        def stats():
+            return {"healthy": 0, "queued": 0, "per_replica": {}}
+
+    class _Fleet:
+        router = _Router()
+
+    scaler = Autoscaler(_Fleet())
+    try:
+        assert set(scaler.metrics()) == set(AUTOSCALE_COUNTER_KEYS)
+        scaler.tick()
+        assert set(scaler.metrics()) == set(AUTOSCALE_COUNTER_KEYS)
+    finally:
+        scaler.close()
+
+
+def test_tenant_detail_keys_conform_to_obs_schema():
+    # detail["tenant"] (present only on non-default-tenant jobs) is a
+    # declared sub-schema: validate_detail accepts exactly its keys and
+    # flags drift, so a rename breaks this pin, not a dashboard later.
+    from stateright_tpu.obs.schema import (
+        TENANT_DETAIL_KEYS,
+        validate_detail,
+    )
+
+    tenant = {k: 0 for k in TENANT_DETAIL_KEYS}
+    assert validate_detail({"tenant": tenant}) == []
+    assert validate_detail(
+        {"tenant": dict(tenant, renamed_key=1)}
+    ) == ["tenant.renamed_key"]
+
+
 def test_device_detail_pins_blob_row_keys():
     # The BENCH_BLOB=1 local-vs-blob backend A/B row is part of the
     # artifact contract: the local-filesystem wall time, the measured

@@ -830,8 +830,14 @@ def test_refine_check_with_randoms():
     assert lowered.has_randoms
 
 
+@pytest.mark.slow
 def test_refine_check_with_timers_depth_bounded():
-    """kind-1 (timeout) poison payloads + a depth-bounded refinement loop on
+    """Slow-marked (tier-1 870s budget): timer lowering parity stays
+    fast-tier in test_timer_lowering_parity and the refinement loop in
+    test_refine_check_converges_on_ping_pong; this composes the two on
+    an unbounded model.
+
+    kind-1 (timeout) poison payloads + a depth-bounded refinement loop on
     an UNBOUNDED model (recurring timers): gaps only surface within the
     bound, so the closure stays finite and matches the host's bounded
     counts."""

@@ -344,8 +344,14 @@ def test_resident_tiered_pallas_fused_probe_spills_2pc4():
     assert r.detail["suspects_checked"] > 0  # the fused probe fired
 
 
+@pytest.mark.slow
 def test_service_tiered_pallas_salted_fused_probe_2pc4():
-    """The service is the most intricate pallas consumer: job seeding goes
+    """Slow-marked (tier-1 870s budget): the salted fused-probe spill
+    path stays fast-tier in
+    test_resident_tiered_pallas_fused_probe_spills_2pc4; this adds the
+    service front-end on top.
+
+    The service is the most intricate pallas consumer: job seeding goes
     through the PallasHashTable host handle, every key is job-salted
     BEFORE the kernel's routing, and the fused Bloom probe runs on the
     salted keys with suspects host-resolved against the shared spill tier.

@@ -128,7 +128,12 @@ def test_sharded_at_scale_2pc7():
 # -- chunked dispatch / checkpoint-resume -------------------------------------
 
 
+@pytest.mark.slow
 def test_sharded_chunked_matches_single_dispatch():
+    # Slow-marked (tier-1 870s budget): chunked-vs-single identity stays
+    # fast-tier in test_resident_chunked_matches_single_dispatch, and
+    # the sharded chunked golden in
+    # test_sharded_donated_chunked_run_matches_goldens.
     full = ShardedSearch(
         TensorTwoPhaseSys(4), mesh=make_mesh(4), batch_size=128, table_log2=13
     ).run()

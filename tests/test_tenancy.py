@@ -1,0 +1,280 @@
+"""Multi-tenancy plane (stateright_tpu/service/tenancy.py + the tenant
+threading through queue/scheduler/corpus — ISSUE 17).
+
+The contract under test is ISOLATION WITHOUT GOLDEN DRIFT: per-tenant
+quotas refuse floods at admission (QuotaExceeded -> HTTP 429 +
+Retry-After), two-level fairness bounds how long one tenant's backlog can
+delay another's (admission rotation in the queue, fair-share waterfill in
+the scheduler), and tenant-salted corpus namespaces keep one tenant's
+published states out of another's warm starts — while the DEFAULT tenant
+stays byte-identical everywhere: unsalted keys, un-gated admission, the
+old single-level grant math, no result-detail sub-dict. Everything here
+is engine-free (queue/ledger/key math only) — tier-1 milliseconds.
+"""
+
+import time
+
+import pytest
+
+from stateright_tpu.service.queue import AdmissionQueue, Job
+from stateright_tpu.service.tenancy import (
+    DEFAULT_TENANT,
+    QuotaExceeded,
+    TenantQuotas,
+    tenant_salt,
+)
+
+
+class _M:
+    lanes = 1
+
+
+def _job(jid, tenant=DEFAULT_TENANT, priority=0):
+    return Job(jid, _M(), priority=priority, tenant=tenant)
+
+
+# -- quota ledger (service/tenancy.py) -----------------------------------------
+
+
+def test_in_flight_quota_gates_only_configured_tenants():
+    q = TenantQuotas()
+    q.set_quota("capped", max_in_flight=2)
+    q.admit("capped", in_flight=1)  # under the cap: admitted
+    with pytest.raises(QuotaExceeded) as ei:
+        q.admit("capped", in_flight=2)
+    assert ei.value.tenant == "capped"
+    assert "in_flight 2 >= max 2" in ei.value.reason
+    assert ei.value.retry_after_s >= 0.1
+    # Unconfigured tenants and the default tenant are never gated.
+    q.admit("unmetered", in_flight=10_000)
+    q.admit(DEFAULT_TENANT, in_flight=10_000)
+
+
+def test_lane_seconds_budget_throttles_and_refills_linearly():
+    q = TenantQuotas()
+    q.set_quota("burny", lane_seconds=10.0, window_s=10.0)  # 1 lane-s/s
+    # Overshoot the budget (a charge exactly at the budget refills a hair
+    # under it by the time admit re-reads the clock).
+    q.charge("burny", 12.0)
+    with pytest.raises(QuotaExceeded) as ei:
+        q.admit("burny", in_flight=0)
+    assert "lane_seconds" in ei.value.reason
+    # Retry-After is the linear-refill estimate, capped at 30s.
+    assert 0.1 <= ei.value.retry_after_s <= 30.0
+    # The ledger refills as wall time passes: force the refill clock back
+    # rather than sleeping (tier-1 has no time for a real second).
+    q._last_refill["burny"] -= 5.0
+    assert q.spent("burny") == pytest.approx(7.0, abs=0.25)
+    q.admit("burny", in_flight=0)  # back under budget: admitted
+
+
+def test_charge_is_recorded_for_unmetered_tenants_too():
+    # Operators see who uses the device BEFORE deciding to fence them.
+    q = TenantQuotas()
+    q.charge("watched", 3.5)
+    assert q.spent("watched") == pytest.approx(3.5)
+    snap = q.snapshot()
+    assert snap["watched"]["max_in_flight"] is None
+    assert snap["watched"]["spent"] == pytest.approx(3.5)
+
+
+def test_snapshot_reports_quota_and_spend_per_tenant():
+    q = TenantQuotas()
+    q.set_quota("a", max_in_flight=4, lane_seconds=60.0, window_s=30.0)
+    q.charge("a", 1.25)
+    row = q.snapshot()["a"]
+    assert row["max_in_flight"] == 4
+    assert row["lane_seconds"] == 60.0
+    assert row["window_s"] == 30.0
+    assert row["spent"] == pytest.approx(1.25, abs=0.01)
+
+
+# -- two-level admission fairness (service/queue.py) ---------------------------
+
+
+def test_tenant_flood_cannot_starve_a_one_job_tenant():
+    # The bounded-wait pin: 100 queued jobs from one tenant delay another
+    # tenant's single job by at most one grant per tenant present — the
+    # quiet job is admitted by the SECOND pop, not the 101st.
+    q = AdmissionQueue()
+    for i in range(100):
+        q.push(_job(i, tenant="noisy"))
+    q.push(_job(100, tenant="quiet"))
+    order = [q.pop_next() for _ in range(3)]
+    assert [j.tenant for j in order] == ["noisy", "quiet", "noisy"]
+    assert [j.id for j in order] == [0, 100, 1]
+    # ...and with the quiet tenant drained, the flood proceeds in FIFO.
+    rest = [q.pop_next().id for _ in range(4)]
+    assert rest == [2, 3, 4, 5]
+
+
+def test_single_tenant_admission_is_bit_identical_to_jobs_only_queue():
+    # Every pre-tenancy caller is one tenant: the rotation must
+    # degenerate to exactly the old (priority desc, arrival) pop order.
+    q = AdmissionQueue()
+    jobs = [
+        _job(1, priority=0), _job(2, priority=5),
+        _job(3, priority=0), _job(4, priority=5),
+    ]
+    for j in jobs:
+        q.push(j)
+    assert [q.pop_next().id for _ in range(4)] == [2, 4, 1, 3]
+
+
+def test_priority_beats_tenant_rotation():
+    # Rotation happens WITHIN the top priority class only — a high-
+    # priority job from the flooding tenant still pops first.
+    q = AdmissionQueue()
+    for i in range(5):
+        q.push(_job(i, tenant="noisy"))
+    q.push(_job(10, tenant="quiet"))
+    q.push(_job(11, tenant="noisy", priority=9))
+    assert q.pop_next().id == 11
+
+
+def test_tenant_tagged_requeue_pops_exactly_once_in_original_order():
+    # The r10 lane-unwind invariant survives tenant tags: lanes a faulted
+    # step took are push_front'ed and every lane pops exactly once in the
+    # original order (the bit-identical-retry half of fairness).
+    import numpy as np
+
+    class _M2:
+        lanes = 2
+
+    job = Job(7, _M2(), tenant="tagged")
+    assert job.tenant == "tagged"
+    n = 8
+    states = np.arange(n * 2, dtype=np.uint32).reshape(n, 2)
+    lo = np.arange(1, n + 1, dtype=np.uint32)
+    hi = np.arange(100, 100 + n, dtype=np.uint32)
+    ebits = np.zeros((n, 1), dtype=bool)
+    depth = np.ones(n, dtype=np.uint32)
+    job.push(states, lo, hi, ebits, depth)
+    t = job.take(5)
+    job.push_front(*t)
+    popped = []
+    while job.pending_lanes:
+        _, p_lo, _, _, _ = job.take(3)
+        popped.extend(int(x) for x in p_lo)
+    assert popped == list(range(1, n + 1))
+
+
+def test_tenant_requeue_lands_behind_same_priority_peers():
+    # Preemption/requeue re-enters BEHIND queued peers of the same
+    # priority and the rotation still alternates tenants afterwards.
+    q = AdmissionQueue()
+    a1, b1, a2 = (
+        _job(1, tenant="a"), _job(2, tenant="b"), _job(3, tenant="a"),
+    )
+    for j in (a1, b1, a2):
+        q.push(j)
+    first = q.pop_next()
+    assert first is a1
+    q.push(first)  # requeued: behind a2 in tenant a's arrival order
+    assert [q.pop_next().id for _ in range(3)] == [2, 3, 1]
+
+
+# -- two-level fair-share waterfill (service/scheduler.py) ---------------------
+
+
+def _grants(jobs, K):
+    from stateright_tpu.service.scheduler import ServiceEngine
+
+    return ServiceEngine._grants(
+        ServiceEngine.__new__(ServiceEngine), jobs, K
+    )
+
+
+class _J:
+    def __init__(self, pending, tenant=DEFAULT_TENANT):
+        self.pending_lanes = pending
+        self.tenant = tenant
+
+
+def test_two_level_waterfill_splits_lanes_across_tenants_first():
+    # One tenant with 3 hungry jobs vs one tenant with 1: each tenant
+    # gets ~half the device, THEN the flood splits its half internally.
+    jobs = [
+        _J(100, "noisy"), _J(100, "noisy"), _J(100, "noisy"),
+        _J(100, "quiet"),
+    ]
+    g = _grants(jobs, 64)
+    assert sum(g) == 64
+    noisy, quiet = sum(g[:3]), g[3]
+    assert quiet >= 31  # the quiet tenant holds its fair half
+    assert noisy >= 31
+
+
+def test_two_level_waterfill_single_tenant_identity():
+    # With one tenant present the two-level math IS the old jobs-only
+    # waterfill — grants bit-identical (the pre-tenancy golden pin).
+    from stateright_tpu.service.scheduler import ServiceEngine
+
+    for pend, K in (
+        ([5, 50, 3], 16), ([1, 1, 1], 64), ([100, 100], 7), ([0, 9], 4),
+    ):
+        jobs = [_J(p) for p in pend]
+        assert _grants(jobs, K) == ServiceEngine._waterfill(pend, K)
+
+
+def test_two_level_waterfill_unused_share_spills_to_hungry_tenants():
+    # A tenant that can't use its share hands the slack over, exactly
+    # like small jobs do within a tenant.
+    jobs = [_J(2, "tiny"), _J(100, "big")]
+    g = _grants(jobs, 64)
+    assert g[0] == 2
+    assert g[1] == 62
+
+
+# -- tenant-salted corpus namespaces (store/corpus.py) -------------------------
+
+
+def test_corpus_keys_default_tenant_identical_salted_differs():
+    from stateright_tpu.store.corpus import content_key, key_components
+    from stateright_tpu.tensor.models import TensorTwoPhaseSys
+
+    m = TensorTwoPhaseSys(2)
+    low = dict(batch_size=64, table_log2=12, finish=("all", (), None, None))
+    # The default namespace is byte-identical to the pre-tenancy key, so
+    # existing corpora keep serving (tenant_salt maps default -> None).
+    assert tenant_salt(None) is None
+    assert tenant_salt(DEFAULT_TENANT) is None
+    assert tenant_salt("acme") == "acme"
+    base = content_key(m, low)
+    assert content_key(m, low, tenant=None) == base
+    ka = content_key(m, low, tenant="acme")
+    kb = content_key(m, low, tenant="zorg")
+    assert len({base, ka, kb}) == 3  # namespaces never collide
+    # Near-match soundness: the salt lands in the "def" COMPONENT, so the
+    # family/near rungs (which ignore "table") can never serve one
+    # tenant's states to another; the run-shape components stay shared.
+    cd = key_components(m, low)
+    ca = key_components(m, low, tenant="acme")
+    assert key_components(m, low, tenant=None) == cd
+    assert ca["def"] != cd["def"]
+    assert ca["batch_size"] == cd["batch_size"]
+    assert ca["finish"] == cd["finish"]
+    assert ca["table"] == cd["table"]
+
+
+# -- the 429 contract ----------------------------------------------------------
+
+
+def test_quota_exceeded_carries_the_http_429_pieces():
+    e = QuotaExceeded("acme", "in_flight 3 >= max 3", retry_after_s=2.5)
+    assert e.tenant == "acme"
+    assert e.retry_after_s == 2.5
+    assert "retry after 2.5s" in str(e)
+    # The floor: a zero/negative hint still tells clients to back off.
+    assert QuotaExceeded("a", "r", retry_after_s=0.0).retry_after_s == 0.1
+
+
+def test_default_tenant_admission_costs_no_ledger_entry():
+    # The quota-free fast path: default-tenant admission never touches
+    # the ledger (no lock contention on the hot pre-tenancy path).
+    q = TenantQuotas()
+    t0 = time.monotonic()
+    for _ in range(10_000):
+        q.admit(DEFAULT_TENANT, in_flight=0)
+    assert time.monotonic() - t0 < 1.0
+    assert q.snapshot() == {}

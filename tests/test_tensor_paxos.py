@@ -69,8 +69,14 @@ def test_linearizability_mask_spot_checks():
     assert masks.all()  # one Prepare broadcast deep: still linearizable
 
 
+@pytest.mark.slow
 def test_sharded_paxos_parity():
-    """The multi-chip sharded engine reproduces the host counts for the
+    """Slow-marked (tier-1 870s budget): the sharded engine's parity is
+    pinned fast-tier on 2pc (tests/test_sharded.py) and the Paxos
+    encoding's goldens in test_paxos2_golden_counts; this crosses the
+    two axes.
+
+    The multi-chip sharded engine reproduces the host counts for the
     tensor Paxos encoding on the virtual 8-device mesh (fingerprint-sharded
     visited set + all-to-all successor exchange)."""
     from stateright_tpu.parallel.sharded import ShardedSearch, make_mesh
