@@ -1368,6 +1368,7 @@ def device_search_corpus(model_name: str = "2pc", n: int = 4):
                 p
                 for p in _glob.glob(os.path.join(corpus_dir, "corpus-*.npz"))
                 if "-family-" not in os.path.basename(p)
+                and "-spec-" not in os.path.basename(p)
             ][0]
         )
         _sec3, third_r = timed_submit(warm_svc)
@@ -1483,6 +1484,128 @@ def device_search_corpus(model_name: str = "2pc", n: int = 4):
         "warm_speedup_partial": warm_speedup_partial,
         "corpus_preloaded": int(warm_corpus.get("preloaded_states", 0)),
         "corrupt_detected": corrupt_detected,
+    }
+    return out, err
+
+
+def device_search_delta(model_name: str = "2pc", n: int = 4):
+    """BENCH_DELTA=1 row: Spec-CI definition-delta A/B on the anchor —
+    cold exploration of a property-EDITED model vs the same edited model
+    served from the corpus on the "delta" rung (store/specdelta.py). The
+    corpus side first publishes the base model's visited set, then
+    submits an edited model whose first property condition is negated
+    (class name preserved, so the geometry digest keeps it in the same
+    spec family); the delta classifier names the edit "properties-only"
+    and replays the published set with only the changed verdict
+    re-evaluated. Acceptance: rung == "delta", class == properties-only,
+    counts bit-identical to the edited model's own cold run, the edited
+    property's discovery present, and >= 2x over the post-compile cold
+    reference."""
+    _pin_platform()
+    import dataclasses
+    import tempfile
+
+    from stateright_tpu.service import CheckService
+
+    model, _batch, _tl2, _run_kwargs, _ekw, _golden, _cs = _build_workload(
+        model_name, n
+    )
+    svc_kw = dict(
+        batch_size=1024,
+        table_log2=18,
+        store="tiered",
+        high_water=0.9,
+        summary_log2=18,
+        background=False,
+    )
+
+    # The one-line edit: negate the first property's condition. The
+    # subclass keeps the base class's NAME — the geometry digest includes
+    # it, and a renamed model is a different spec family, not an edit.
+    base_cls = type(model)
+
+    def _edited_props(self, _base=base_cls):
+        props = list(_base.properties(self))
+        p0 = props[0]
+        props[0] = dataclasses.replace(
+            p0,
+            name=p0.name + " negated",
+            condition=lambda m, s, _c=p0.condition: ~_c(m, s),
+        )
+        return props
+
+    edited_cls = type(
+        base_cls.__name__, (base_cls,), {"properties": _edited_props}
+    )
+    edited = edited_cls(
+        **{f.name: getattr(model, f.name)
+           for f in dataclasses.fields(model)}
+    )
+
+    def timed_submit(svc, m):
+        t0 = time.monotonic()
+        h = svc.submit(m)
+        svc.drain(timeout=1800)
+        return time.monotonic() - t0, h.result()
+
+    # Cold reference: corpus-less service, post-compile second submission
+    # of the EDITED model (the delta rung's counts must match this).
+    cold_svc = CheckService(**svc_kw)
+    timed_submit(cold_svc, edited)  # compile warm-up (timing discarded)
+    cold_sec, cold_r = timed_submit(cold_svc, edited)
+    cold_svc.close()
+
+    with tempfile.TemporaryDirectory(prefix="srtpu-delta-") as corpus_dir:
+        svc = CheckService(corpus_dir=corpus_dir, **svc_kw)
+        timed_submit(svc, model)  # base model: compile + corpus publish
+        # A delta replay never publishes, so a second edited submission
+        # takes the delta rung again — the first absorbs the edited
+        # model's own kernel compiles (the cold side absorbed its in the
+        # warm-up above; the measured ratio is pure replay-vs-search).
+        timed_submit(svc, edited)
+        delta_sec, delta_r = timed_submit(svc, edited)
+        delta_corpus = dict(delta_r.detail.get("corpus") or {})
+        stats = dict(svc.stats().get("corpus") or {})
+        svc.close()
+
+    err = None
+    got = (delta_r.state_count, delta_r.unique_state_count, delta_r.max_depth)
+    want = (cold_r.state_count, cold_r.unique_state_count, cold_r.max_depth)
+    if got != want or sorted(delta_r.discoveries) != sorted(
+        cold_r.discoveries
+    ):
+        err = (
+            f"delta parity failure: {got} / {sorted(delta_r.discoveries)} "
+            f"!= cold {want} / {sorted(cold_r.discoveries)}"
+        )
+    if err is None and delta_corpus.get("warm_kind") != "delta":
+        err = (
+            "edited submission did not take the delta rung "
+            f"(detail: {delta_corpus})"
+        )
+    if err is None and delta_corpus.get("delta_class") != "properties-only":
+        err = (
+            "edit was not classified properties-only "
+            f"(detail: {delta_corpus})"
+        )
+    if err is None and not stats.get("delta_hits"):
+        err = f"delta_hits counter did not advance (stats: {stats})"
+    warm_speedup_delta = round(cold_sec / max(delta_sec, 1e-9), 2)
+    if err is None and warm_speedup_delta < 2.0:
+        err = (
+            f"delta submission only {warm_speedup_delta}x faster than "
+            "cold (acceptance >= 2x)"
+        )
+
+    out = {
+        "states": delta_r.state_count,
+        "unique": delta_r.unique_state_count,
+        "sec": round(delta_sec, 4),
+        "states_per_sec": delta_r.state_count / max(delta_sec, 1e-9),
+        "compile_sec": 0.0,  # both sides measured post-compile (A/B fair)
+        "sec_cold": round(cold_sec, 4),
+        "warm_speedup_delta": warm_speedup_delta,
+        "delta_class": delta_corpus.get("delta_class"),
     }
     return out, err
 
@@ -1668,6 +1791,12 @@ DEVICE_DETAIL_FIELDS = (
     # (acceptance >= 2x each).
     "sec_cold", "warm_speedup", "warm_speedup_near", "warm_speedup_partial",
     "corpus_preloaded", "corrupt_detected",
+    # Spec-CI definition delta (BENCH_DELTA=1 row): the property-edit
+    # cold reference next to the delta-rung submission's (`sec`), the
+    # measured ratio (acceptance >= 2x with bit-identical counts and the
+    # re-evaluated verdict present), and the classifier's named edit
+    # class ("properties-only" on this row).
+    "warm_speedup_delta", "delta_class",
     # Dedup-first semantics (BENCH_SEMANTICS=1 row): the cache-only wall
     # time next to the plane's (`sec`), the measured ratio (acceptance >=
     # 2x with bit-identical verdicts), and the plane's own evidence —
@@ -1935,6 +2064,15 @@ def main(argv: list | None = None) -> int:
         # CRC verdict).
         if os.environ.get("BENCH_CORPUS") == "1" and not smoke:
             workloads += (("2pc", 4, 2400.0, "--worker-corpus", None),)
+        # BENCH_DELTA=1: add the Spec-CI definition-delta A/B on the
+        # 2pc-4 anchor (publish the base model, then submit a
+        # property-edited variant; the classifier names the edit and the
+        # delta rung replays the published set with only the changed
+        # verdict re-evaluated — the measured ratio lands in
+        # detail.device["2pc-4-delta"].warm_speedup_delta, acceptance
+        # >= 2x with bit-identical counts).
+        if os.environ.get("BENCH_DELTA") == "1" and not smoke:
+            workloads += (("2pc", 4, 2400.0, "--worker-delta", None),)
         # BENCH_SEMANTICS=1: add the dedup-first verdict-plane A/B on the
         # single-copy-register 6c2s anchor (property-evaluation phase only,
         # host-side; the measured ratio lands in
@@ -1961,6 +2099,7 @@ def main(argv: list | None = None) -> int:
                     "--worker-faults": "-faults",
                     "--worker-pallas": "-pallas",
                     "--worker-corpus": "-corpus",
+                    "--worker-delta": "-delta",
                     "--worker-semantics": "-semantics",
                     "--worker-sim": "-sim",
                     "--worker-fleet": "",
@@ -2059,6 +2198,8 @@ def worker_main(model_name: str, n: int, mode: str = "--worker") -> int:
             r, perr = device_search_pallas(model_name, n)
         elif mode == "--worker-corpus":
             r, perr = device_search_corpus(model_name, n)
+        elif mode == "--worker-delta":
+            r, perr = device_search_delta(model_name, n)
         elif mode == "--worker-semantics":
             r, perr = device_search_semantics(model_name, n)
         elif mode == "--worker-sim":
@@ -2079,7 +2220,8 @@ if __name__ == "__main__":
         "--worker", "--worker-sharded", "--worker-service", "--worker-obs",
         "--worker-journal", "--worker-faults", "--worker-pallas",
         "--worker-fleet", "--worker-autoscale", "--worker-blob",
-        "--worker-corpus", "--worker-semantics", "--worker-sim",
+        "--worker-corpus", "--worker-delta", "--worker-semantics",
+        "--worker-sim",
     ):
         sys.exit(worker_main(sys.argv[2], int(sys.argv[3]), mode=sys.argv[1]))
     if len(sys.argv) == 2 and sys.argv[1] == "--worker-analysis":

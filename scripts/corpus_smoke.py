@@ -33,10 +33,12 @@ SVC_KW = dict(
 
 def _entry_files(corpus_dir):
     """Corpus ENTRY generations (complete + partial), excluding the v2
-    near-match family index riding in the same directory."""
+    near-match family index and the Spec-CI spec index riding in the
+    same directory."""
     return [
         p for p in glob.glob(os.path.join(corpus_dir, "corpus-*.npz"))
         if "-family-" not in os.path.basename(p)
+        and "-spec-" not in os.path.basename(p)
     ]
 
 

@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""Spec-CI smoke: the edit loop end to end through the REAL CLI
+(`python -m stateright_tpu.ci`), one command, exit 0 iff every leg held.
+
+The loop a spec author lives in: (1) check a model cold — the run
+publishes its visited set to the corpus; (2) flip ONE property condition
+and re-run — the delta classifier names the edit "properties-only" and
+the delta rung replays the published set with only the changed verdict
+re-evaluated (asserted: rung fires, counts and verdicts match the edited
+model's own cold run in a FRESH corpus); (3) edit `expand` — the
+classifier refuses salvage (asserted: counted in `delta_refusals`, run
+completes COLD with counts identical to a never-warmed check).
+
+    JAX_PLATFORMS=cpu python scripts/spec_ci_smoke.py
+"""
+
+import os
+import re
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BASE_SPEC = """\
+from stateright_tpu.tensor.models import TensorTwoPhaseSys as _Base
+
+TensorTwoPhaseSys = _Base
+
+def model():
+    return TensorTwoPhaseSys(3)
+"""
+
+# The one-line edit: negate one SOMETIMES condition. The subclass KEEPS
+# the base class name — the geometry digest includes it, and a renamed
+# model is a different spec family, not an edit of this one.
+PROP_EDIT_SPEC = """\
+import dataclasses
+from stateright_tpu.tensor.models import TensorTwoPhaseSys as _Base
+
+def _props(self):
+    props = list(_Base.properties(self))
+    p0 = props[0]
+    props[0] = dataclasses.replace(
+        p0, name=p0.name + " flipped",
+        condition=lambda model, s, _c=p0.condition: ~_c(model, s))
+    return props
+
+TensorTwoPhaseSys = type("TensorTwoPhaseSys", (_Base,), {"properties": _props})
+
+def model():
+    return TensorTwoPhaseSys(3)
+"""
+
+# A semantic `expand` edit (masking the last action) — unsalvageable: the
+# published visited set was explored under a different successor
+# relation, so the classifier must REFUSE and the run must go cold.
+EXPAND_EDIT_SPEC = """\
+from stateright_tpu.tensor.models import TensorTwoPhaseSys as _Base
+
+def _expand(self, states):
+    succs, valid = _Base.expand(self, states)
+    valid = valid.at[:, -1].set(False)
+    return succs, valid
+
+TensorTwoPhaseSys = type("TensorTwoPhaseSys", (_Base,), {"expand": _expand})
+
+def model():
+    return TensorTwoPhaseSys(3)
+"""
+
+_ROW = re.compile(
+    r"\[\s*(?P<status>ok|FAIL)\] (?P<spec>\S+): rung=(?P<rung>\S+)"
+    r"(?: \((?P<cls>[a-z/-]+)\))? states=(?P<states>\d+) "
+    r"unique=(?P<unique>\d+)"
+)
+_VERDICT = re.compile(r"^ {7}(?P<mark>[+-]) (?P<kind>\S+)\s+(?P<rest>.+)$")
+_STATS = re.compile(
+    r"corpus: delta_hits=(?P<hits>\d+) delta_refusals=(?P<refusals>\d+) "
+    r"component_reuse=(?P<reuse>\d+)"
+)
+
+
+def run_ci(spec_path, corpus_dir):
+    """Invoke the real `python -m stateright_tpu.ci` and parse its report:
+    (exit, rung, delta_class, (states, unique), verdict lines, stats)."""
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "stateright_tpu.ci",
+            "--corpus", corpus_dir, "--batch-size", "128",
+            "--table-log2", "14", f"{spec_path}:model",
+        ],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    print(proc.stdout, end="")
+    if proc.stderr.strip():
+        print(proc.stderr, end="", file=sys.stderr)
+    row = _ROW.search(proc.stdout)
+    stats = _STATS.search(proc.stdout)
+    if row is None or stats is None:
+        raise RuntimeError(f"unparseable CI report:\n{proc.stdout}")
+    verdicts = sorted(
+        m.group("mark") + " " + m.group("kind") + " " + m.group("rest")
+        for line in proc.stdout.splitlines()
+        if (m := _VERDICT.match(line))
+    )
+    return (
+        proc.returncode,
+        row.group("rung"),
+        row.group("cls"),
+        (int(row.group("states")), int(row.group("unique"))),
+        verdicts,
+        {k: int(v) for k, v in stats.groupdict().items()},
+    )
+
+
+def main() -> int:
+    failures = []
+    with tempfile.TemporaryDirectory(prefix="srtpu-specci-") as tmp:
+        corpus = os.path.join(tmp, "corpus")
+        spec = os.path.join(tmp, "spec.py")
+
+        # Leg 1: cold check publishes the base model's visited set.
+        with open(spec, "w") as f:
+            f.write(BASE_SPEC)
+        rc, rung, _cls, counts, _v, _s = run_ci(spec, corpus)
+        if rc != 0 or rung != "cold":
+            failures.append(f"base check: rc={rc} rung={rung} (want cold)")
+
+        # Cold references for both edits, in corpora that never saw the
+        # base model — what "never warmed" returns.
+        with open(spec, "w") as f:
+            f.write(PROP_EDIT_SPEC)
+        rc, rung, _cls, prop_cold, prop_cold_v, _s = run_ci(
+            spec, os.path.join(tmp, "cold-prop")
+        )
+        if rc != 0 or rung != "cold":
+            failures.append(f"prop cold ref: rc={rc} rung={rung}")
+        with open(spec, "w") as f:
+            f.write(EXPAND_EDIT_SPEC)
+        rc, rung, _cls, exp_cold, exp_cold_v, _s = run_ci(
+            spec, os.path.join(tmp, "cold-exp")
+        )
+        if rc != 0 or rung != "cold":
+            failures.append(f"expand cold ref: rc={rc} rung={rung}")
+
+        # Leg 2: the property edit re-runs on the delta rung with the
+        # re-evaluated verdicts matching its own cold check.
+        with open(spec, "w") as f:
+            f.write(PROP_EDIT_SPEC)
+        rc, rung, cls, got, verdicts, stats = run_ci(spec, corpus)
+        if rc != 0:
+            failures.append(f"prop edit: rc={rc}")
+        if rung != "delta" or cls != "properties-only":
+            failures.append(
+                f"prop edit: rung={rung} class={cls} "
+                "(want delta/properties-only)"
+            )
+        if got != prop_cold:
+            failures.append(f"prop edit counts {got} != cold {prop_cold}")
+        if verdicts != prop_cold_v:
+            failures.append(
+                f"prop edit verdicts {verdicts} != cold {prop_cold_v}"
+            )
+        if stats["hits"] < 1:
+            failures.append(f"prop edit: delta_hits never moved ({stats})")
+
+        # Leg 3: the expand edit is REFUSED (counted) and falls back to a
+        # cold run identical to the never-warmed reference.
+        with open(spec, "w") as f:
+            f.write(EXPAND_EDIT_SPEC)
+        rc, rung, _cls, got, verdicts, stats = run_ci(spec, corpus)
+        if rc != 0 or rung != "cold":
+            failures.append(f"expand edit: rc={rc} rung={rung} (want cold)")
+        if stats["refusals"] < 1:
+            failures.append(
+                f"expand edit: delta_refusals never moved ({stats})"
+            )
+        if got != exp_cold or verdicts != exp_cold_v:
+            failures.append(
+                f"expand edit {got}/{verdicts} != never-warmed "
+                f"{exp_cold}/{exp_cold_v}"
+            )
+
+    if failures:
+        print("FAILURES:", "; ".join(failures), file=sys.stderr)
+        return 1
+    print("spec-ci smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -77,10 +77,15 @@ COST_VARIANTS = ("split", "kv", "phased", "capped", "capped-kv", "pallas")
 #: run's own content key, "near" replays a complete entry from the same
 #: definition-hash family (different table packing; membership and results
 #: are packing-invariant), "partial" resumes an incomplete entry's frontier
-#: snapshot and continues the search naturally. Every engine's warm path
-#: and the `job.warm_start` event `kind` field draw from this tuple;
+#: snapshot and continues the search naturally. "delta" is the Spec-CI
+#: rung (store/specdelta.py): the DEFINITION changed, but the factored
+#: component digests prove the edit salvageable — a properties-only edit
+#: replays the visited set with verdicts re-evaluated, a boundary edit
+#: replays or continues from a re-derived frontier; expand/init edits
+#: refuse (counted, cold, never wrong). Every engine's warm path and the
+#: `job.warm_start` event `kind` field draw from this tuple;
 #: check_registry() pins the per-engine aliases against it.
-WARM_KINDS = ("exact", "near", "partial")
+WARM_KINDS = ("exact", "near", "partial", "delta")
 
 
 def check_registry() -> list:

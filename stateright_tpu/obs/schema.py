@@ -54,14 +54,17 @@ DETAIL_KEYS = {
 CORPUS_DETAIL_KEYS = {
     "warm_start": "True when the job preloaded a published visited set",
     "warm_kind": "which warm-ladder rung served the preload: 'exact' | "
-                 "'near' | 'partial' (knobs.WARM_KINDS; absent on cold "
-                 "runs)",
+                 "'near' | 'delta' | 'partial' (knobs.WARM_KINDS; absent "
+                 "on cold runs)",
     "preloaded_states": "states preloaded into the spill tier + summary",
     "verdict_preloads": "semantics verdict bits the warm preload seeded "
                         "into the canonical cache (dedup-first semantics)",
     "published": "True when this job published a NEW corpus entry "
                  "(complete or partial)",
     "key": "content-key prefix (model definition + lowering + finish hash)",
+    "delta_class": "Spec-CI edit class the delta rung salvaged: "
+                   "'properties-only' | 'boundary-only' "
+                   "(store/specdelta.py; absent off the delta rung)",
 }
 
 #: Corpus-v2 REGISTRY counters (store/corpus.py `metrics()`, "corpus"
@@ -73,6 +76,16 @@ CORPUS_V2_COUNTERS = (
     "partial_preloads",     # warm-from-partial admissions
     "near_match_hits",      # family-index fallbacks that served an entry
     "superseded_entries",   # partials deleted by a later complete publish
+)
+
+#: Spec-CI definition-delta counters (store/specdelta.py through
+#: store/corpus.py `metrics()`, same "corpus" scrape source) — pinned
+#: separately from CORPUS_V2_COUNTERS because they account EDITS, not
+#: re-checks of the same definition.
+CORPUS_DELTA_COUNTERS = (
+    "delta_hits",        # edits the delta rung salvaged (replay/continue)
+    "delta_refusals",    # candidate edits refused salvage (ran cold)
+    "component_reuse",   # per-hit unchanged definition components reused
 )
 
 #: Keys of `detail["service"]` (service/metrics.py JobMetrics.to_dict).
@@ -263,7 +276,7 @@ EVENT_TYPES = {
     "job.requeued": ("job", "src"),  # moved off a dead replica
     "job.resumed": ("job",),         # re-admitted from a checkpoint journal
     "job.warm_start": ("job", "kind"),  # corpus preloaded at admission
-    # (states=n; kind=exact|near|partial — the warm-ladder rung served)
+    # (states=n; kind=exact|near|delta|partial — the warm-ladder rung)
     "job.quarantined": ("job",),     # poison job parked by the retry policy
     "job.quota_rejected": ("tenant",),  # admission refused over-quota (429)
     "job.done": ("job",),

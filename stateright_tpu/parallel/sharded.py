@@ -968,7 +968,10 @@ class ShardedSearch:
         verbatim (caller gates on `warm.can_replay`). Partial entries
         continue: the frontier snapshot is routed to its owner shards as
         each shard's live queue and the run picks up mid-search (caller
-        gates on `warm.can_continue`). Returns states preloaded."""
+        gates on `warm.can_continue`). The Spec-CI rung rides the same
+        two paths: gate through `warm.salvage_delta` and pass its
+        salvaged entry here with kind="delta". Returns states
+        preloaded."""
         if self._stores is None:
             raise ValueError(
                 "warm_start requires store='tiered' (the preloaded set "
@@ -995,7 +998,7 @@ class ShardedSearch:
                 "partial corpus entry has no frontier snapshot (coverage-"
                 "only entries cannot seed a continuation)"
             )
-        self._warm_kind = "partial"
+        self._warm_kind = kind if kind == "delta" else "partial"
         self._seed_partial_carry(entry)
         return n
 

@@ -140,7 +140,11 @@ class CheckService:
         ≥5x faster with bit-identical results. Fleet replicas pointed at
         ONE directory share generations (ServiceFleet(corpus_dir=...)).
         Corrupt entries are detected by the ckptio CRC footer and ignored
-        (cold run, never wrong results).
+        (cold run, never wrong results). The corpus also powers Spec-CI
+        (store/specdelta.py, `python -m stateright_tpu.ci`): an EDITED
+        model definition of the same spec geometry is diffed against the
+        family's per-component digests, and a properties-only or
+        boundary-only edit still warm-starts on the "delta" rung.
 
         `quotas` (a service/tenancy.py TenantQuotas) arms per-tenant
         admission control: submissions carrying a non-default `tenant=`
@@ -330,6 +334,12 @@ class CheckService:
                 # cache (scheduler.prefetch_warm); carry the count so the
                 # real job's detail["corpus"] reports it.
                 job.verdict_preloads = prefetch.verdict_preloads
+                # Spec-CI rung state (scheduler._delta_lookup runs inside
+                # the prefetch): the named edit class, the "delta" partial
+                # kind, and the no-publish mark on widened continuations.
+                job.delta_class = prefetch.delta_class
+                job.partial_kind = prefetch.partial_kind
+                job.no_publish = prefetch.no_publish
             self._next_id += 1
             self._jobs[job.id] = job
             self._adm.push(job)

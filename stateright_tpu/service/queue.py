@@ -210,6 +210,16 @@ class Job:
         self.partial_entry = None
         self.warm_entry_kind = None
         self.corpus_pin_key = None
+        # Spec-CI delta rung (store/specdelta.py): the classified edit
+        # class when the delta rung served this job ("properties-only" |
+        # "boundary-only"; None otherwise), the WARM_KINDS kind a parked
+        # partial entry admits under ("partial" for the corpus-v2 rung,
+        # "delta" for a widened-boundary continuation), and the publish
+        # veto — a delta continuation's traversal-order statistics are
+        # not cold-bit-identical, so it must never publish an entry.
+        self.delta_class = None
+        self.partial_kind = "partial"
+        self.no_publish = False
         # Dedup-first semantics (semantics/canonical.py): verdict bits the
         # warm preload seeded into the canonical cache, and whether this
         # job holds a corpus GC pin on its entry (released at retire).
@@ -224,6 +234,17 @@ class Job:
         # replica re-salts with ITS job salt) so a crashed replica's jobs
         # re-seed a fresh table instead of restarting from scratch.
         self.journal: Optional[list] = [] if journal or resume else None
+        # Spec-CI journal-state plane (store/specdelta.py): the claimed
+        # STATE ROWS (+ pop depths), parallel to `journal`, which a
+        # complete publish records so a later definition edit can
+        # re-evaluate properties/boundaries instead of re-exploring.
+        # None-able independently: appending fingerprint rows WITHOUT
+        # their states (fleet-only journaling, resumed payloads) poisons
+        # the plane permanently, so a non-None plane is guaranteed
+        # row-parallel with the journal.
+        self.state_journal: Optional[list] = (
+            [] if journal or resume else None
+        )
         self.resume = resume
 
     # -- frontier --------------------------------------------------------------
@@ -289,15 +310,32 @@ class Job:
         self._chunks.clear()
         self._pending = 0
 
-    def journal_append(self, lo, hi, p_lo, p_hi) -> None:
+    def journal_append(
+        self, lo, hi, p_lo, p_hi, states=None, depths=None
+    ) -> None:
         """Record freshly-claimed unique states (unsalted fp + unsalted
-        parent fp; init states carry parent 0)."""
+        parent fp; init states carry parent 0). `states`/`depths` carry
+        the claimed state rows + pop depths into the parallel
+        `state_journal` (the Spec-CI plane); appending without them
+        poisons that plane — rows must stay parallel or the publish
+        would misalign states against fingerprints."""
         if self.journal is None or len(lo) == 0:
             return
         self.journal.append(
             (
                 np.asarray(lo, np.uint32), np.asarray(hi, np.uint32),
                 np.asarray(p_lo, np.uint32), np.asarray(p_hi, np.uint32),
+            )
+        )
+        if self.state_journal is None:
+            return
+        if states is None or depths is None:
+            self.state_journal = None  # incomplete plane: never publish it
+            return
+        self.state_journal.append(
+            (
+                np.asarray(states, np.uint32),
+                np.asarray(depths, np.uint32),
             )
         )
 

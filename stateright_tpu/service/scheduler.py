@@ -365,7 +365,7 @@ class ServiceEngine:
         salt = tenant_salt(getattr(job, "tenant", None))
         sig = (id(job.model), fin, salt)
         hit = self._corpus_keys.get(sig)
-        # Same recycled-id() guard as corpus._DEF_HASH_CACHE: the cached
+        # Same recycled-id() guard as specdelta._COMPONENT_CACHE: the cached
         # key only serves if the weakly-held model is the SAME object —
         # a stale hit after id reuse would preload the wrong corpus.
         if hit is not None and hit[0]() is job.model:
@@ -421,7 +421,12 @@ class ServiceEngine:
         key already encodes batch + finish); (2) "partial" — this key's
         own partial entry, continuable; (3) "near" — a family entry with
         the same definition hash and a different table packing, replayed
-        when complete (same batch + finish) or continued when partial.
+        when complete (same batch + finish) or continued when partial;
+        (4) "delta" — the Spec-CI rung (store/specdelta.py): a family
+        entry under a DIFFERENT definition hash of the same spec
+        geometry, salvaged when the factored component digests prove the
+        edit was properties-only or boundary-only (expand/init edits
+        refuse — counted, cold, never wrong).
         Returns (entry, kind) or (None, None) — every miss, gate decline,
         corrupt entry, or injected `corpus.load` fault means cold."""
         from ..store.corpus import finish_signature
@@ -453,6 +458,69 @@ class ServiceEngine:
                 job.target_state_count, job.target_max_depth,
             ):
                 return entry, "partial"
+        if comp.get("core"):
+            entry, kind = self._delta_lookup(job, comp)
+            if entry is not None:
+                return entry, kind
+        return None, None
+
+    def _delta_lookup(self, job: Job, comp: dict):
+        """The Spec-CI "delta" rung: walk the spec index (entries sharing
+        this job's spec GEOMETRY — class/lanes/max_actions — under a
+        different definition hash), classify each candidate's edit from
+        the factored component digests, and salvage the best-supported
+        one (store/warm.salvage_delta → store/specdelta). Candidates are
+        ordered largest-visited-set-first so the salvage that saves the
+        most work is tried first; unsalvageable classes and declined
+        salvages are counted as `delta_refusals` (the CI driver's "this
+        edit is provably cold" signal). A salvaged PARTIAL (boundary
+        widening) marks the job no-publish: its traversal-order
+        statistics are not cold-bit-identical (specdelta docstring)."""
+        new_comps = comp.get("comps")
+        if not isinstance(new_comps, dict):
+            return None, None
+        from ..store import specdelta
+
+        refusals = 0
+        members = [
+            m for m in self._corpus.spec_members(comp["core"])
+            if m.get("def") != comp.get("def")
+            and m.get("complete")
+            and int(m.get("batch_size", -1)) == self.batch_size
+        ]
+        members.sort(key=lambda m: int(m.get("states", 0)), reverse=True)
+        for m in members[:8]:
+            # Classify from the INDEX row first: a cheap digest diff
+            # avoids decoding candidate npz files that can never serve
+            # (pre-delta rows without a component vector land here too —
+            # classified unsalvageable, never misclassified).
+            cls = specdelta.classify(new_comps, m.get("comps"))
+            if cls not in ("properties-only", "boundary-only"):
+                refusals += 1
+                continue
+            entry = self._corpus.lookup(m.get("key"))
+            if entry is None:
+                continue  # corrupt/GC'd npz: not an edit-class refusal
+            cls, served = warm_seam.salvage_delta(
+                entry, job.model, new_comps, self.batch_size,
+                job.finish_when, job.target_state_count,
+                job.target_max_depth,
+            )
+            if served is None:
+                refusals += 1
+                continue
+            job.delta_class = cls
+            if not served.complete:
+                job.partial_kind = "delta"
+                job.no_publish = True
+            self._corpus.note_delta_hit(
+                specdelta.component_reuse(new_comps, m.get("comps"))
+            )
+            if refusals:
+                self._corpus.note_delta_refusal(refusals)
+            return served, "delta"
+        if refusals:
+            self._corpus.note_delta_refusal(refusals)
         return None, None
 
     def _maybe_warm(self, job: Job) -> None:
@@ -545,6 +613,7 @@ class ServiceEngine:
             self._corpus is None
             or job.content_key is None
             or job.warm is not None
+            or job.no_publish
             or job.journal is None
             or not job.journal
             or job.quarantined
@@ -581,6 +650,17 @@ class ServiceEngine:
         j_hi = np.concatenate([c[1] for c in job.journal])
         jp_lo = np.concatenate([c[2] for c in job.journal])
         jp_hi = np.concatenate([c[3] for c in job.journal])
+        # Spec-CI plane (store/specdelta.py): the journaled STATE rows +
+        # pop depths, row-parallel with the fp journal. Only a COMPLETE
+        # entry carries it (the salvage proofs are exhaustion arguments);
+        # a poisoned or misaligned plane is simply dropped — the entry is
+        # then delta-incapable but otherwise identical.
+        j_states = j_depths = None
+        if complete and job.state_journal:
+            j_states = np.concatenate([c[0] for c in job.state_journal])
+            j_depths = np.concatenate([c[1] for c in job.state_journal])
+            if len(j_states) != len(j_lo) or len(j_depths) != len(j_lo):
+                j_states = j_depths = None
         return (
             job.content_key,
             pack_fp(j_lo, j_hi),
@@ -594,6 +674,9 @@ class ServiceEngine:
             complete,
             frontier,
             self._components_for(job),
+            j_states,
+            j_depths,
+            job.model,
         )
 
     def publish_payload(self, payload: tuple) -> bool:
@@ -607,16 +690,33 @@ class ServiceEngine:
         bits are class-addressed, so over-inclusion is harmless and a
         repeat register-model submission in a fresh process warm-starts
         its consistency properties, not just its visited set."""
-        key, fps, parents, meta, complete, frontier, components = payload
+        (
+            key, fps, parents, meta, complete, frontier, components,
+            j_states, j_depths, model,
+        ) = payload
         sem_fps = sem_verdicts = None
         if complete:
             from ..semantics.batch import export_verdicts
 
             sem_fps, sem_verdicts = export_verdicts()
+        j_bound = None
+        if j_states is not None:
+            # Spec-CI boundary plane: evaluate within_boundary over the
+            # journaled states HERE, off the service lock (a batched jax
+            # eval over the full visited set is exactly the slow work
+            # prepare_publish defers). Best-effort like the npz write.
+            try:
+                from ..store import specdelta
+
+                j_bound = specdelta.eval_boundary(model, j_states)
+            except Exception:
+                j_states = j_depths = None
         return self._corpus.publish(
             key, fps, parents, meta,
             sem_fps=sem_fps, sem_verdicts=sem_verdicts,
             complete=complete, frontier=frontier, components=components,
+            journal_states=j_states, journal_depths=j_depths,
+            journal_bound=j_bound,
         )
 
     def admit(self, job: Job) -> Optional[Job]:
@@ -686,10 +786,20 @@ class ServiceEngine:
             init, init_lo, init_hi, ebits0,
             np.ones(n0, dtype=np.uint32),
         )
-        job.journal_append(
-            init_lo, init_hi,
-            np.zeros(n0, np.uint32), np.zeros(n0, np.uint32),
-        )
+        if self._corpus is not None:
+            # Spec-CI plane: journal the init STATE rows (depth 1) in the
+            # same order as the fp rows — specdelta replays property
+            # conditions against them at delta-salvage time.
+            job.journal_append(
+                init_lo, init_hi,
+                np.zeros(n0, np.uint32), np.zeros(n0, np.uint32),
+                states=init, depths=np.ones(n0, np.uint32),
+            )
+        else:
+            job.journal_append(
+                init_lo, init_hi,
+                np.zeros(n0, np.uint32), np.zeros(n0, np.uint32),
+            )
         g.jobs.append(job)
         if job.pending_lanes == 0:
             return job  # empty reachable space: complete immediately
@@ -731,7 +841,7 @@ class ServiceEngine:
             max_depth=meta["max_depth"],
             discoveries=dict(meta.get("discoveries", {})),
         )
-        job.warm_kind = "partial"
+        job.warm_kind = job.partial_kind
         job.warm_states = entry.states
         self._corpus.note_partial_preload()
         self._corpus.note_preload(entry.states)
@@ -742,7 +852,8 @@ class ServiceEngine:
         job.corpus_pin_key = entry.key
         self._events.emit(
             "job.warm_start", job=job.id, trace=job.trace,
-            states=entry.states, key=job.content_key[:16], kind="partial",
+            states=entry.states, key=job.content_key[:16],
+            kind=job.partial_kind,
         )
         return self._admit_resumed(job)
 
@@ -815,6 +926,10 @@ class ServiceEngine:
         # distinct by construction, so the insert claims agree).
         job.unique_count = rz.unique_count
         job.journal = [(j_lo, j_hi, jp_lo, jp_hi)] if n_j else []
+        # The resume payload carries no state rows: the Spec-CI plane for
+        # the restored prefix is unavailable, so the eventual publish is
+        # delta-incapable (valid, just never salvageable by specdelta).
+        job.state_journal = None
         for chunk in rz.chunks:
             job.push(*chunk)
         job.resume = None
@@ -1172,8 +1287,19 @@ class ServiceEngine:
                     depth[pr] + 1,
                 )
                 # Fleet requeue journal: the claimed (fp, parent fp) pairs,
-                # unsalted — all four arrays are already host-side.
-                job.journal_append(o_lo[rows], o_hi[rows], lo[pr], hi[pr])
+                # unsalted — all four arrays are already host-side. With a
+                # corpus attached, the Spec-CI plane also records the
+                # claimed STATE rows + pop depths (row-parallel with the
+                # fp rows by construction — same `rows` index).
+                if self._corpus is not None:
+                    job.journal_append(
+                        o_lo[rows], o_hi[rows], lo[pr], hi[pr],
+                        states=o_states[rows], depths=depth[pr] + 1,
+                    )
+                else:
+                    job.journal_append(
+                        o_lo[rows], o_hi[rows], lo[pr], hi[pr]
+                    )
 
         # -- spill eviction (tiered) -------------------------------------------
         if self._store is not None and self.hot_claims >= self._spill_trigger:
@@ -1275,6 +1401,8 @@ class ServiceEngine:
             }
             if job.warm_kind is not None:
                 detail["corpus"]["warm_kind"] = job.warm_kind
+            if job.delta_class is not None:
+                detail["corpus"]["delta_class"] = job.delta_class
         if any(self.fault_counters.values()):
             # Engine-wide recovery counters (documented schema:
             # obs/schema.py FAULTS_DETAIL_KEYS) — present only once a

@@ -67,7 +67,6 @@ import hashlib
 import json
 import os
 import threading
-import weakref
 from dataclasses import dataclass
 from typing import Optional
 
@@ -85,19 +84,12 @@ from ..faults.ckptio import (
 )
 from ..faults.plan import FaultError, maybe_fault
 from ..obs import REGISTRY
+from .specdelta import def_components, joint_def_hash, spec_core_hash
 from .summary import host_insert, summary_words
 
 #: Corpus payload format version (bumped on incompatible array layouts; a
 #: mismatched entry is treated exactly like a corrupt one: ignored, cold).
 FORMAT = 1
-
-#: Per-model definition-hash cache: tracing jaxprs costs milliseconds, and
-#: the service computes a key per submission. Keyed by id() with a weakref
-#: death callback (models override __eq__ without __hash__, so a
-#: WeakKeyDictionary cannot hold them) — caching never keeps a model alive
-#: and a recycled id can never serve a stale digest (the liveness check
-#: compares the referent by identity).
-_DEF_HASH_CACHE: dict = {}
 
 
 def model_def_hash(model) -> str:
@@ -109,56 +101,14 @@ def model_def_hash(model) -> str:
     given jax version (which is folded into the digest), so equal-config
     model instances hash equal across processes and fleet replicas while
     any change to the transition system, the properties, or the state
-    encoding changes the key."""
-    cache_key = id(model)
-    cached = _DEF_HASH_CACHE.get(cache_key)
-    if cached is not None and cached[0]() is model:
-        return cached[1]
-    import jax
-    import jax.numpy as jnp
+    encoding changes the key.
 
-    h = hashlib.blake2b(digest_size=16)
-
-    def feed(part) -> None:
-        h.update(repr(part).encode())
-        h.update(b"\x00")
-
-    feed(("jax", jax.__version__, FORMAT))
-    feed((type(model).__name__, int(model.lanes), int(model.max_actions)))
-    init = np.asarray(model.init_states(), dtype=np.uint32)
-    feed(("init", init.shape))
-    h.update(init.tobytes())
-    probe = jax.ShapeDtypeStruct((4, int(model.lanes)), jnp.uint32)
-    feed(("expand", str(jax.make_jaxpr(model.expand)(probe))))
-    feed(
-        ("boundary", str(jax.make_jaxpr(model.within_boundary)(probe)))
-    )
-    for p in model.properties():
-        cond = p.condition
-        feed(
-            (
-                "prop",
-                p.name,
-                p.expectation.value,
-                str(jax.make_jaxpr(lambda s: cond(model, s))(probe)),
-            )
-        )
-    if model.representative is not None:
-        feed(
-            (
-                "repr",
-                str(jax.make_jaxpr(model.representative)(probe)),
-            )
-        )
-    digest = h.hexdigest()
-    try:
-        ref = weakref.ref(
-            model, lambda _r, k=cache_key: _DEF_HASH_CACHE.pop(k, None)
-        )
-        _DEF_HASH_CACHE[cache_key] = (ref, digest)
-    except TypeError:
-        pass  # weakref-less exotic model: just re-trace next time
-    return digest
+    Spec-CI (store/specdelta.py): the digest is DERIVED from the
+    per-component digests of `specdelta.def_components` — the factored
+    vector the delta classifier diffs — so the joint key and the
+    factoring can never disagree (the per-model trace cache lives
+    there)."""
+    return joint_def_hash(def_components(model))
 
 
 def content_key(model, lowering: dict, tenant: Optional[str] = None) -> str:
@@ -198,15 +148,25 @@ def key_components(
     `lookup_near`/`lookup_family` match on def+batch_size+finish and
     ignore "table", so salting anywhere weaker would let a near-match
     rung serve one tenant's states to another. ``None`` keeps the
-    pre-tenancy component bytes."""
+    pre-tenancy component bytes.
+
+    Spec-CI (store/specdelta.py) adds two entries: "core" — the
+    geometry-only spec-index address (tenant-salted exactly like "def"),
+    under which EVERY edit of the same model geometry is findable — and
+    "comps" — the raw per-component digest vector the delta classifier
+    diffs (recorded verbatim in the family/spec index rows and the
+    entry payload at publish)."""
     fin = lowering.get("finish")
-    def_hash = model_def_hash(model)
+    comps = def_components(model)
+    def_hash = joint_def_hash(comps)
     if tenant is not None:
         def_hash = hashlib.blake2b(
             (def_hash + ":tenant:" + tenant).encode(), digest_size=16
         ).hexdigest()
     return {
         "def": def_hash,
+        "core": spec_core_hash(comps, tenant=tenant),
+        "comps": comps,
         "batch_size": int(lowering.get("batch_size", 0)),
         "finish": repr(tuple(fin)) if fin is not None else repr(None),
         "table": repr(
@@ -264,6 +224,15 @@ class CorpusEntry:
     #: The factored content-key components (`key_components`) recorded at
     #: publish — what the near-match ladder (store/warm.py) reasons over.
     components: Optional[dict] = None
+    #: Spec-CI journal planes (store/specdelta.py), COMPLETE entries only
+    #: and aligned row-for-row with `fps`: the claimed state rows in pop
+    #: order (uint32[n, L]), their pop depths (uint32[n]), and the
+    #: publisher boundary's verdict over them (bool[n]). None on entries
+    #: published before the delta subsystem (or grown from a resumed
+    #: journal) — the delta rung then refuses, degrading to exact/near.
+    journal_states: Optional[np.ndarray] = None
+    journal_depths: Optional[np.ndarray] = None
+    journal_bound: Optional[np.ndarray] = None
 
     def __post_init__(self):
         if self.sem_fps is None:
@@ -326,6 +295,9 @@ class CorpusStore:
             "partial_preloads": 0,
             "near_match_hits": 0,
             "superseded_entries": 0,
+            "delta_hits": 0,
+            "delta_refusals": 0,
+            "component_reuse": 0,
             "gc_sweeps": 0,
             "gc_evicted": 0,
             "gc_bytes_freed": 0,
@@ -350,6 +322,14 @@ class CorpusStore:
 
     def _family_path(self, def_hash: str) -> str:
         return content_path(self.root, def_hash, kind="corpus-family")
+
+    def _spec_path(self, core_hash: str) -> str:
+        """The spec index record for one model GEOMETRY (specdelta
+        `spec_core_hash`) — the cross-DEFINITION sibling of the family
+        index, listing every published key with its component-digest
+        vector so a definition edit can still find (and classify
+        against) its predecessors."""
+        return content_path(self.root, core_hash, kind="corpus-spec")
 
     def _count(self, counter: str, n: int = 1) -> None:
         with self._lock:
@@ -456,6 +436,12 @@ class CorpusStore:
                 components = json.loads(
                     str(np.asarray(data["comp"]).reshape(-1)[0])
                 )
+            j_states = j_depths = j_bound = None
+            if "j_states" in names:
+                j_states = np.asarray(data["j_states"], dtype=np.uint32)
+                j_depths = np.asarray(data["j_depths"], dtype=np.uint32)
+            if "j_bound" in names:
+                j_bound = np.asarray(data["j_bound"], dtype=bool)
             return CorpusEntry(
                 key=key,
                 fps=np.asarray(data["fps"], dtype=np.uint64),
@@ -480,6 +466,9 @@ class CorpusStore:
                 complete=complete,
                 frontier=frontier,
                 components=components,
+                journal_states=j_states,
+                journal_depths=j_depths,
+                journal_bound=j_bound,
             )
         except (KeyError, ValueError, IndexError):
             return None
@@ -492,6 +481,22 @@ class CorpusStore:
         """Account one warm-from-partial admission (the `partial_preloads`
         REGISTRY counter; per-state accounting stays in `note_preload`)."""
         self._count("partial_preloads")
+
+    def note_delta_hit(self, reused_components: int = 0) -> None:
+        """Account one delta-rung salvage (Spec-CI): a definition edit
+        served a warm start through store/specdelta.py. `reused_components`
+        is how many component digests carried over unchanged."""
+        self._count("delta_hits")
+        if reused_components:
+            self._count("component_reuse", reused_components)
+
+    def note_delta_refusal(self, n: int = 1) -> None:
+        """Account delta-rung candidates REFUSED by the salvage rules —
+        the counted, provably-cold path (`delta_refusals`): an expand/init
+        edit, a pre-delta record without a component vector, a narrowed
+        boundary, or an order-sensitive finish."""
+        if n:
+            self._count("delta_refusals", n)
 
     # -- near-match family index (corpus v2) -----------------------------------
 
@@ -535,6 +540,10 @@ class CorpusStore:
             "batch_size": int(components.get("batch_size", -1)),
             "finish": components.get("finish"),
             "table": components.get("table"),
+            # Spec-CI: the per-component digest vector rides in the family
+            # row too, alongside the joint hash the family is keyed by —
+            # so the factored key is recorded wherever the entry is listed.
+            "comps": components.get("comps"),
         }
         try:
             with self._lock:
@@ -566,6 +575,95 @@ class CorpusStore:
                 ]
                 fenced_savez(
                     self._family_path(def_hash),
+                    {
+                        "members": np.asarray(
+                            [json.dumps(members)], dtype=np.str_
+                        )
+                    },
+                    lease=self._lease,
+                )
+        except (FaultError, OSError, LeaseRevoked, RuntimeError):
+            pass
+
+    # -- cross-definition spec index (Spec-CI, store/specdelta.py) -------------
+
+    def spec_members(self, core_hash: str) -> list:
+        """The advisory member list for one model GEOMETRY (`specdelta.
+        spec_core_hash`): dicts of {key, def, complete, states,
+        batch_size, finish, comps} spanning EVERY published definition of
+        that geometry — the delta rung's candidate pool. Same best-effort
+        contract as `family_members`: any failure reads as empty (a delta
+        miss, never an error)."""
+        try:
+            maybe_fault("corpus.load", key=core_hash[:16])
+            path = self._spec_path(core_hash)
+            if not any_generation(path):
+                return []
+            data, _src = fenced_load_latest(
+                path,
+                validator=(
+                    self._lease.store.validate
+                    if self._lease is not None else None
+                ),
+            )
+            members = json.loads(str(np.asarray(data["members"]).reshape(-1)[0]))
+            return members if isinstance(members, list) else []
+        except (FaultError, OSError, CheckpointCorrupt, KeyError, ValueError):
+            return []
+
+    def _spec_note(
+        self, components: dict, key: str, complete: bool, states: int
+    ) -> None:
+        """Record one spec-index member after a publish — the family
+        note's cross-definition twin (same latest-wins read-modify-write,
+        same best-effort contract: a stale record costs a cold run)."""
+        if (
+            not components
+            or not components.get("core")
+            or not isinstance(components.get("comps"), dict)
+        ):
+            return  # pre-delta caller: no factored vector to index
+        member = {
+            "key": key,
+            "def": components.get("def"),
+            "complete": bool(complete),
+            "states": int(states),
+            "batch_size": int(components.get("batch_size", -1)),
+            "finish": components.get("finish"),
+            "comps": components.get("comps"),
+        }
+        try:
+            with self._lock:
+                members = [
+                    m for m in self.spec_members(components["core"])
+                    if m.get("key") != key
+                    or m.get("complete") != member["complete"]
+                ]
+                members.append(member)
+                fenced_savez(
+                    self._spec_path(components["core"]),
+                    {
+                        "members": np.asarray(
+                            [json.dumps(members)], dtype=np.str_
+                        )
+                    },
+                    lease=self._lease,
+                )
+        except (FaultError, OSError, LeaseRevoked, RuntimeError):
+            pass
+
+    def _spec_drop(self, core_hash: str, key: str, complete: bool) -> None:
+        """Drop one spec-index row (the superseded partial) — best-effort,
+        mirroring `_family_drop`."""
+        try:
+            with self._lock:
+                members = [
+                    m for m in self.spec_members(core_hash)
+                    if m.get("key") != key
+                    or m.get("complete") != bool(complete)
+                ]
+                fenced_savez(
+                    self._spec_path(core_hash),
                     {
                         "members": np.asarray(
                             [json.dumps(members)], dtype=np.str_
@@ -712,10 +810,10 @@ class CorpusStore:
             ):
                 continue
             key = st.name[len("corpus-"):].split(".npz")[0]
-            if key.startswith("family-"):
-                # Family index records are tiny advisory metadata shared
-                # by every key in the family — never evicted, never
-                # counted toward the budget.
+            if key.startswith("family-") or key.startswith("spec-"):
+                # Family/spec index records are tiny advisory metadata
+                # shared by every key in the family (resp. geometry) —
+                # never evicted, never counted toward the budget.
                 continue
             ent = entries.setdefault(
                 key, {"names": [], "bytes": 0, "mtime": 0.0,
@@ -777,6 +875,9 @@ class CorpusStore:
         complete: bool = True,
         frontier: Optional[dict] = None,
         components: Optional[dict] = None,
+        journal_states: Optional[np.ndarray] = None,
+        journal_depths: Optional[np.ndarray] = None,
+        journal_bound: Optional[np.ndarray] = None,
     ) -> bool:
         """Publish one visited set under `key`. Complete entries are
         idempotent by content address: when an intact generation already
@@ -868,6 +969,30 @@ class CorpusStore:
                 payload_extra["comp"] = np.asarray(
                     [json.dumps(components)], dtype=np.str_
                 )
+            if (
+                complete
+                and journal_states is not None
+                and journal_depths is not None
+                and len(journal_states) == len(fps)
+                and len(journal_depths) == len(fps)
+            ):
+                # Spec-CI journal planes (store/specdelta.py): the claimed
+                # state rows in pop order + their depths + the publisher
+                # boundary's verdict over them — what a later definition
+                # edit re-evaluates instead of re-exploring. Misaligned
+                # planes are dropped here (delta refuses, never misreads).
+                payload_extra["j_states"] = np.asarray(
+                    journal_states, dtype=np.uint32
+                )
+                payload_extra["j_depths"] = np.asarray(
+                    journal_depths, dtype=np.uint32
+                )
+                if journal_bound is not None and len(journal_bound) == len(
+                    fps
+                ):
+                    payload_extra["j_bound"] = np.asarray(
+                        journal_bound, dtype=bool
+                    )
             # Conditional write (`if_absent`): on the blob backend this is
             # a server-side If-None-Match put, so N replicas racing one
             # content key through a real object store still keep exactly
@@ -926,6 +1051,7 @@ class CorpusStore:
             self._supersede_partial(key, components)
         if components is not None:
             self._family_note(components, key, complete, int(fps.size))
+            self._spec_note(components, key, complete, int(fps.size))
         return True
 
     def _supersede_partial(
@@ -946,6 +1072,8 @@ class CorpusStore:
             self._count("superseded_entries")
             if components and "def" in components:
                 self._family_drop(components["def"], key, complete=False)
+            if components and components.get("core"):
+                self._spec_drop(components["core"], key, complete=False)
 
     # -- reporting -------------------------------------------------------------
 

@@ -1,0 +1,512 @@
+"""Definition-delta salvage: the Spec-CI subsystem (ROADMAP item 4, the
+"one-bit model edit" residue).
+
+The corpus content key hashes the WHOLE model definition, so editing one
+property's condition changes the key, the family hash, and every warm rung
+— a one-line spec edit re-explores the state space from scratch. This
+module factors the definition hash into PER-COMPONENT digests, classifies
+the edit between a new model and a published entry, and implements the
+sound salvage rules behind the ``"delta"`` rung of `knobs.WARM_KINDS`:
+
+- `def_components(model)`: one digest per definition component —
+  ``geometry`` (jax version x payload format x class name x lane/action
+  shape), ``init`` (concrete init-state bytes), ``expand`` /
+  ``boundary`` / ``repr`` (abstract jaxprs), and ``props`` (one digest
+  per property over its name, expectation, and condition jaxpr). The
+  joint definition hash (`corpus.model_def_hash`) is DERIVED from these
+  digests, so the factoring and the key can never drift apart.
+- `classify(new, old)`: name the edit class between two component
+  vectors — ``identical`` | ``properties-only`` | ``boundary-only`` |
+  ``expand/init`` (the unsalvageable class, which also absorbs missing
+  or pre-delta component records: never misclassify, degrade to cold).
+- `salvage_properties` / `salvage_boundary`: build the entry a delta
+  warm-start may serve, or refuse (return None).
+
+Soundness arguments (proved from the factored key)
+--------------------------------------------------
+
+**Properties-only** (``geometry``/``init``/``expand``/``boundary``/
+``repr`` digests all equal; only ``props`` differ). The engines' visited
+set, claim/pop order, generation counts, and depths are functions of the
+init states, the expand kernel, the boundary, the symmetry
+representative, and the batch size alone — properties only OBSERVE
+popped states. A published COMPLETE entry was, by the publish gate
+(scheduler.prepare_publish), a full-exhaustion run: never early-exited,
+so its traversal never depended on its property verdicts either. Under
+an equal batch size and an equal finish signature, a cold run of the
+edited model therefore pops the SAME states in the SAME order — its
+counts replay verbatim, and only the verdict plane must be recomputed:
+unchanged properties (equal per-property digest => identical condition
+jaxpr => identical verdict on every state) replay their recorded first
+witness; changed/added properties are re-evaluated over the entry's
+recorded journal-state plane (`journal_states`, exactly the claimed
+rows in pop order, with `journal_depths` reproducing the
+target_max_depth eval mask). Two refusals keep this exact: a
+changed/added EVENTUALLY property needs the pending-bit/terminality
+plane the entry does not record, and a re-evaluated discovery set that
+SATISFIES the run's finish policy means the cold run would have
+early-exited mid-stream with smaller counts (discovery sets grow
+monotonically and every finish kind is monotone in them, so "the final
+set does not satisfy" proves "no prefix did" — full exhaustion is then
+the cold behavior too).
+
+**Boundary-only** (only the ``boundary`` digest differs). Let V be the
+entry's visited set and B_old/B_new the two boundary predicates. The
+engines apply the boundary when a successor is CLAIMED (an
+out-of-boundary successor is never inserted, journaled, or queued), so
+V contains only B_old-true states and B_old's values on the successors
+the old run declined — exactly the states a wider predicate would
+admit — are UNOBSERVABLE from the entry. No boundary edit is therefore
+provably vacuous from recorded planes; the one sound salvage is a
+re-expansion continuation, gated by two checks evaluated on what IS
+recorded:
+
+- *Prefix validity*: B_new must hold on EVERY row of V (one False row
+  means a visited state is excluded under the edit — V
+  over-approximates Reach_new — refuse). The served prefix is then
+  exactly the ISSUE's "states inside both boundaries": all of V.
+- *Root coverage*: every init state B_new admits must already be in V
+  (a formerly-excluded init would root a subtree no continuation from
+  V's rows can reach — refuse).
+
+Under both, V is a subset of Reach_new (each V-path runs through
+B_new-true states), and re-expanding ALL of V as the continuation
+frontier explores exactly Reach_new: for any reachable x not in V, the
+last state of x's path inside V is re-expanded and claims the next hop
+(induction). Every state of Reach_new is popped exactly once (V rows
+are pushed once each; new states claim once through the preloaded
+table), so with the baseline ``state_count`` RESET to the raw
+B_new-admitted init count — the re-expansion re-counts every pop, the
+prefix's own generation tally must not double in — state_count and
+unique_count at full exhaustion equal a cold run's exactly.
+Traversal-order statistics (max_depth — the re-pushed rows keep their
+OLD claim depths and a widened space can shorten paths — and witness
+fingerprints) may differ from a cold BFS, and a finish policy that
+fires MID-continuation stops at an order-dependent point; so the
+continuation never publishes (no_publish), refuses depth/count targets
+and EVENTUALLY properties (the pending-bit plane for re-pushed rows is
+not recorded), refuses when the prefix's discoveries already satisfy
+the finish policy, and documents that counts are cold-exact only at
+full exhaustion — discoveries and verdicts are correct always.
+
+**expand/init** (any other difference, including a missing/corrupt/
+pre-delta component record): no subset of V is provably reachable under
+the edited kernel — refuse explicitly; the refusal is counted
+(`delta_refusals`) and the run is cold, bit-identical to never-warmed.
+
+Deliberately jax-free at import time (store/warm.py and knobs.py probe
+jax-free): jaxpr tracing and batched evaluation import lazily.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import weakref
+from typing import Optional
+
+import numpy as np
+
+from ..core.model import Expectation
+
+__all__ = [
+    "DELTA_CLASSES",
+    "def_components",
+    "joint_def_hash",
+    "spec_core_hash",
+    "classify",
+    "component_reuse",
+    "salvage_properties",
+    "salvage_boundary",
+    "eval_boundary",
+]
+
+#: The delta-classifier vocabulary, best case first. "identical" never
+#: reaches the delta rung (equal components => equal definition hash =>
+#: the exact/near family already served); "expand/init" is the explicit
+#: refusal class.
+DELTA_CLASSES = (
+    "identical", "properties-only", "boundary-only", "expand/init",
+)
+
+#: The component names every well-formed vector carries. "props" is a
+#: {property name: digest} sub-dict; "repr" is "" for symmetry-less models.
+_CORE_PARTS = ("geometry", "init", "expand", "boundary", "repr")
+
+#: Per-model component-vector cache, keyed by id() with a weakref death
+#: callback (models override __eq__ without __hash__, so a
+#: WeakKeyDictionary cannot hold them): tracing jaxprs costs milliseconds
+#: and the service traces per submission; caching never keeps a model
+#: alive and a recycled id can never serve a stale vector (the liveness
+#: check compares the referent by identity).
+_COMPONENT_CACHE: dict = {}
+
+#: Batched host evaluation chunk for boundary/condition re-evaluation.
+_EVAL_BATCH = 4096
+
+
+def _digest(*parts) -> str:
+    h = hashlib.blake2b(digest_size=16)
+    for part in parts:
+        if isinstance(part, bytes):
+            h.update(part)
+        else:
+            h.update(repr(part).encode())
+        h.update(b"\x00")
+    return h.hexdigest()
+
+
+def def_components(model) -> dict:
+    """The model definition factored into per-component digests:
+    ``{"geometry", "init", "expand", "boundary", "repr", "props"}`` —
+    abstract jaxpr tracing only, nothing executes on a device. The
+    vector is recorded (JSON) in the family/spec index rows at publish,
+    which is what `classify` diffs a new model against."""
+    cache_key = id(model)
+    cached = _COMPONENT_CACHE.get(cache_key)
+    if cached is not None and cached[0]() is model:
+        return cached[1]
+    import jax
+    import jax.numpy as jnp
+
+    from .corpus import FORMAT
+
+    probe = jax.ShapeDtypeStruct((4, int(model.lanes)), jnp.uint32)
+    init = np.asarray(model.init_states(), dtype=np.uint32)
+    comps = {
+        "geometry": _digest(
+            "geometry", jax.__version__, FORMAT, type(model).__name__,
+            int(model.lanes), int(model.max_actions),
+        ),
+        "init": _digest("init", init.shape, init.tobytes()),
+        "expand": _digest(
+            "expand", str(jax.make_jaxpr(model.expand)(probe))
+        ),
+        "boundary": _digest(
+            "boundary", str(jax.make_jaxpr(model.within_boundary)(probe))
+        ),
+        "repr": (
+            _digest(
+                "repr", str(jax.make_jaxpr(model.representative)(probe))
+            )
+            if model.representative is not None else ""
+        ),
+        "props": {
+            p.name: _digest(
+                "prop", p.name, p.expectation.value,
+                str(
+                    jax.make_jaxpr(
+                        lambda s, _c=p.condition: _c(model, s)
+                    )(probe)
+                ),
+            )
+            for p in model.properties()
+        },
+    }
+    try:
+        ref = weakref.ref(
+            model, lambda _r, k=cache_key: _COMPONENT_CACHE.pop(k, None)
+        )
+        _COMPONENT_CACHE[cache_key] = (ref, comps)
+    except TypeError:
+        pass  # weakref-less exotic model: just re-trace next time
+    return comps
+
+
+def joint_def_hash(comps: dict) -> str:
+    """The joint definition hash DERIVED from the component digests —
+    `corpus.model_def_hash` is exactly this over `def_components(model)`,
+    so the factored vector and the monolithic key cannot drift. Property
+    digests fold in sorted-name order (results are property-order
+    invariant: each property observes states independently)."""
+    h = hashlib.blake2b(digest_size=16)
+    for part in _CORE_PARTS:
+        h.update(str(comps[part]).encode())
+        h.update(b"\x00")
+    for name in sorted(comps["props"]):
+        h.update(name.encode())
+        h.update(b"\x01")
+        h.update(str(comps["props"][name]).encode())
+        h.update(b"\x00")
+    return h.hexdigest()
+
+
+def spec_core_hash(comps: dict, tenant: Optional[str] = None) -> str:
+    """The spec-index address: the GEOMETRY digest alone (salted per
+    tenant exactly like the family "def" component). Keying the index by
+    geometry — not the joint hash — is what makes EVERY edit class
+    findable: an `expand` edit still lands in the same spec family, so
+    its refusal is classified and counted instead of silently missing."""
+    core = str(comps["geometry"])
+    if tenant is not None:
+        core = hashlib.blake2b(
+            (core + ":tenant:" + tenant).encode(), digest_size=16
+        ).hexdigest()
+    return core
+
+
+def classify(new_comps: dict, old_comps) -> str:
+    """Name the edit class between a new model's component vector and a
+    recorded one. Any malformed, missing, or pre-delta `old_comps` (a
+    family row written before this subsystem recorded component vectors)
+    classifies ``"expand/init"`` — unsalvageable, never misclassified —
+    which degrades to the existing exact/near/partial ladder."""
+    if not isinstance(old_comps, dict):
+        return "expand/init"
+    old_props = old_comps.get("props")
+    new_props = new_comps.get("props")
+    if not isinstance(old_props, dict) or not isinstance(new_props, dict):
+        return "expand/init"
+    for part in ("geometry", "init", "expand", "repr"):
+        if old_comps.get(part) != new_comps.get(part):
+            return "expand/init"
+    if not old_comps.get("boundary") or not new_comps.get("boundary"):
+        return "expand/init"
+    boundary_same = old_comps["boundary"] == new_comps["boundary"]
+    props_same = old_props == new_props
+    if boundary_same and props_same:
+        return "identical"
+    if boundary_same:
+        return "properties-only"
+    if props_same:
+        return "boundary-only"
+    return "expand/init"  # mixed edit: no sound salvage rule
+
+
+def component_reuse(new_comps: dict, old_comps: dict) -> int:
+    """How many component digests a salvage reuses unchanged (the
+    `component_reuse` REGISTRY counter): the equal core parts plus every
+    per-property digest present unchanged in both vectors."""
+    n = sum(
+        1
+        for part in _CORE_PARTS
+        if old_comps.get(part) == new_comps.get(part)
+    )
+    old_props = old_comps.get("props") or {}
+    new_props = new_comps.get("props") or {}
+    n += sum(
+        1 for name, d in new_props.items() if old_props.get(name) == d
+    )
+    return n
+
+
+def _batched_eval(fn, states: np.ndarray) -> np.ndarray:
+    """Evaluate a batched bool predicate over uint32[n, L] host rows in
+    `_EVAL_BATCH` chunks (eager, no jit — salvage runs once per lookup)."""
+    import jax.numpy as jnp
+
+    n = int(len(states))
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+    out = []
+    for b0 in range(0, n, _EVAL_BATCH):
+        out.append(
+            np.asarray(fn(jnp.asarray(states[b0 : b0 + _EVAL_BATCH])))
+        )
+    return np.concatenate(out).astype(bool)
+
+
+def eval_boundary(model, states: np.ndarray) -> np.ndarray:
+    """bool[n]: `model.within_boundary` over packed journal rows — the
+    publish-side hook that records `journal_bound` (the B_old plane the
+    boundary-only salvage rule diffs against)."""
+    return _batched_eval(model.within_boundary, states)
+
+
+def _journal_planes(entry):
+    """The entry's recorded journal planes, alignment-checked against the
+    fingerprint rows, or None when the entry predates them (published by
+    a pre-delta version, or grown from a resumed journal whose states
+    were unrecoverable)."""
+    j_states = getattr(entry, "journal_states", None)
+    j_depths = getattr(entry, "journal_depths", None)
+    if j_states is None or j_depths is None:
+        return None
+    if len(j_states) != len(entry.fps) or len(j_depths) != len(entry.fps):
+        return None
+    return np.asarray(j_states, np.uint32), np.asarray(j_depths, np.uint32)
+
+
+def _finish_matches(finish_when, props, discovered: set) -> bool:
+    """Would a run with this discovery set early-exit? (The scheduler's
+    per-step check: all properties discovered, or finish_when satisfied.)"""
+    if props and len(discovered) == len(props):
+        return True
+    return finish_when is not None and finish_when.matches(
+        props, discovered
+    )
+
+
+def salvage_properties(
+    entry,
+    model,
+    finish_when,
+    target_state_count: Optional[int],
+    target_max_depth: Optional[int],
+    new_comps: dict,
+):
+    """The properties-only salvage rule (soundness argument in the module
+    docstring): returns a COMPLETE entry whose meta carries the
+    re-evaluated discovery set — served exactly like an exact/near
+    replay, under the ``"delta"`` kind — or None (refuse, cold)."""
+    old_comps = (getattr(entry, "components", None) or {}).get("comps")
+    if classify(new_comps, old_comps) != "properties-only":
+        return None
+    if not getattr(entry, "complete", False):
+        return None
+    planes = _journal_planes(entry)
+    if planes is None:
+        return None
+    j_states, j_depths = planes
+    from .corpus import finish_signature
+
+    comp = entry.components or {}
+    fin = finish_signature(finish_when, target_state_count, target_max_depth)
+    if comp.get("finish") != repr(tuple(fin)):
+        return None  # different stop policy: pop order parity unproven
+    props = list(model.properties())
+    old_props = old_comps.get("props") or {}
+    new_props = new_comps.get("props") or {}
+    old_disc = entry.meta.get("discoveries", {})
+    ev = (
+        np.ones(len(j_states), dtype=bool)
+        if target_max_depth is None
+        else j_depths < np.uint32(target_max_depth)
+    )
+    merged: dict = {}
+    for p in props:
+        if new_props.get(p.name) == old_props.get(p.name):
+            # Unchanged digest => identical condition jaxpr => identical
+            # verdicts on the identical pop stream: the recorded first
+            # witness (or recorded absence) replays verbatim.
+            if p.name in old_disc:
+                merged[p.name] = int(old_disc[p.name])
+            continue
+        if p.expectation is Expectation.EVENTUALLY:
+            # Liveness needs the pending-bit/terminality plane the entry
+            # does not record — refuse rather than approximate.
+            return None
+        cond = p.condition
+        sat = _batched_eval(lambda s, _c=cond: _c(model, s), j_states)
+        if p.expectation is Expectation.ALWAYS:
+            hit = ev & ~sat
+        else:  # SOMETIMES: first witness
+            hit = ev & sat
+        if hit.any():
+            merged[p.name] = int(
+                np.asarray(entry.fps, np.uint64)[int(np.argmax(hit))]
+            )
+    if _finish_matches(finish_when, props, set(merged)):
+        # The edited properties make the finish policy satisfiable: a
+        # cold run would early-exit mid-stream with smaller counts than
+        # this full-exhaustion entry — refuse, never replay wrong counts.
+        return None
+    meta = dict(entry.meta)
+    meta["discoveries"] = merged
+    return dataclasses.replace(entry, meta=meta)
+
+
+def salvage_boundary(
+    entry,
+    model,
+    finish_when,
+    target_state_count: Optional[int],
+    target_max_depth: Optional[int],
+    new_comps: dict,
+):
+    """The boundary-only salvage rule (soundness argument in the module
+    docstring): returns a PARTIAL entry whose frontier re-expands the
+    WHOLE visited set under the edited predicate (the engines mask the
+    boundary at claim time, so the edit's effect is only visible on the
+    successors the old run never recorded — every visited row may have
+    declined one). The caller must mark the job no-publish. Refuses
+    (returns None) when any visited row or any newly-admitted init
+    falls outside the new predicate, when the stop point is
+    traversal-order sensitive (count/depth targets, a prefix-satisfied
+    finish), or when any property is EVENTUALLY."""
+    old_comps = (getattr(entry, "components", None) or {}).get("comps")
+    if classify(new_comps, old_comps) != "boundary-only":
+        return None
+    if not getattr(entry, "complete", False):
+        return None
+    planes = _journal_planes(entry)
+    if planes is None:
+        return None
+    j_states, j_depths = planes
+    b_new = eval_boundary(model, j_states)
+    if not bool(b_new.all()):
+        # A visited state is excluded under the edit (narrowing — or a
+        # mixed reshape that narrows anywhere the old run looked): V
+        # over-approximates Reach_new.
+        return None
+    # Refuse whenever the stop point is traversal-order sensitive —
+    # count/depth targets, a prefix-satisfied finish, or liveness.
+    if target_state_count is not None or target_max_depth is not None:
+        return None
+    props = list(model.properties())
+    if any(p.expectation is Expectation.EVENTUALLY for p in props):
+        return None
+    prefix_disc = set(entry.meta.get("discoveries", {}))
+    if _finish_matches(finish_when, props, prefix_disc):
+        return None  # already satisfied inside the prefix: cold stops sooner
+    # Root coverage: every init the new predicate admits must already be
+    # in V, else it roots a subtree unreachable from V's rows.
+    import jax.numpy as jnp
+
+    from ..tensor.fingerprint import pack_fp
+    from ..tensor.frontier import state_fingerprint
+    from .warm import split_fps
+
+    init = np.asarray(model.init_states(), dtype=np.uint32)
+    in_b = eval_boundary(model, init)
+    n_raw = int(in_b.sum())
+    init = init[in_b]
+    fps = np.asarray(entry.fps, np.uint64)
+    if len(init):
+        i_lo, i_hi = (
+            np.asarray(x)
+            for x in state_fingerprint(model, jnp.asarray(init))
+        )
+        if not set(pack_fp(i_lo, i_hi).tolist()) <= set(fps.tolist()):
+            return None
+    lo, hi = split_fps(fps)
+    frontier = {
+        "states": j_states,
+        "lo": lo,
+        "hi": hi,
+        "ebits": np.zeros((len(j_states), len(props)), dtype=bool),
+        "depths": j_depths,
+    }
+    meta = dict(entry.meta)
+    # The continuation re-pops every prefix row, re-counting its
+    # generated successors: the baseline must be the raw admitted-init
+    # count (scheduler.admit's own seed), not the prefix's full tally.
+    meta["state_count"] = n_raw
+    return dataclasses.replace(
+        entry, meta=meta, complete=False, frontier=frontier
+    )
+
+
+def salvage(
+    entry,
+    model,
+    delta_class: str,
+    finish_when,
+    target_state_count: Optional[int],
+    target_max_depth: Optional[int],
+    new_comps: dict,
+):
+    """Dispatch the classified edit to its salvage rule. Returns the
+    servable entry (complete => replay, partial => continuation the
+    caller must mark no-publish) or None — every unknown class refuses."""
+    if delta_class == "properties-only":
+        return salvage_properties(
+            entry, model, finish_when, target_state_count,
+            target_max_depth, new_comps,
+        )
+    if delta_class == "boundary-only":
+        return salvage_boundary(
+            entry, model, finish_when, target_state_count,
+            target_max_depth, new_comps,
+        )
+    return None

@@ -27,10 +27,14 @@ warm-start mechanics are spelled:
   different finish policy warm-starts (the near-partial rung).
 - `frontier_chunks` / `pack_ebits`: decode a partial entry's frontier
   snapshot into the per-depth chunk runs the engines enqueue.
+- `salvage_delta`: the Spec-CI rung's gate (ROADMAP item 4's definition-
+  delta residue) — structural checks here, the edit classifier and the
+  per-class soundness proofs in store/specdelta.py.
 
-`knobs.WARM_KINDS` is the kind vocabulary ("exact" | "near" | "partial");
-`knobs.check_registry()` pins every engine's `WARM_KINDS`/`WARM_SEAM`
-aliases against this module so the warm knob stays defined exactly once.
+`knobs.WARM_KINDS` is the kind vocabulary ("exact" | "near" | "partial"
+| "delta"); `knobs.check_registry()` pins every engine's
+`WARM_KINDS`/`WARM_SEAM` aliases against this module so the warm knob
+stays defined exactly once.
 
 Deliberately jax-free at import time (knobs.check_registry probes the
 alias on jax-free images): the one salted-table path imports lazily.
@@ -50,6 +54,7 @@ __all__ = [
     "preload_table",
     "can_replay",
     "can_continue",
+    "salvage_delta",
     "frontier_chunks",
     "pack_ebits",
 ]
@@ -197,6 +202,48 @@ def can_continue(
     ) >= int(target_max_depth):
         return False
     return True
+
+
+def salvage_delta(
+    entry,
+    model,
+    new_comps: dict,
+    batch_size: int,
+    finish_when,
+    target_state_count: Optional[int] = None,
+    target_max_depth: Optional[int] = None,
+):
+    """The Spec-CI rung's gate (knobs.WARM_KINDS "delta"): may `entry` —
+    published under a DIFFERENT definition hash of the same geometry —
+    warm this run? Structural soundness lives here, mirroring
+    `can_replay`/`can_continue`: the entry must be complete (the salvage
+    proofs are exhaustion arguments) and share this run's batch_size
+    (pop/claim order must reproduce). The edit classifier and the
+    per-class salvage rules live in store/specdelta.py (lazily imported:
+    salvage re-traces and re-evaluates jaxprs, and this module stays
+    jax-free at import time).
+
+    Returns ``(delta_class, servable_entry_or_None)``: a complete entry
+    replays verbatim (verdicts already re-evaluated into its meta), a
+    partial one continues from the re-derived frontier (the caller must
+    mark the job no-publish — a widened continuation's traversal-order
+    statistics are not cold-bit-identical), and ``None`` refuses —
+    counted by the caller as `delta_refusals`, provably cold."""
+    from . import specdelta
+
+    old_comps = (getattr(entry, "components", None) or {}).get("comps")
+    delta_class = specdelta.classify(new_comps, old_comps)
+    if delta_class not in ("properties-only", "boundary-only"):
+        return delta_class, None
+    if not getattr(entry, "complete", True):
+        return delta_class, None
+    comp = getattr(entry, "components", None) or {}
+    if int(comp.get("batch_size", -1)) != int(batch_size):
+        return delta_class, None
+    return delta_class, specdelta.salvage(
+        entry, model, delta_class, finish_when,
+        target_state_count, target_max_depth, new_comps,
+    )
 
 
 def pack_ebits(ebits: np.ndarray) -> np.ndarray:

@@ -635,9 +635,12 @@ class FrontierSearch:
         (`warm.can_replay` / `warm.can_continue` are the gates), and a
         replay's run() must use the publisher's finish policy — the
         service path (service/scheduler.py) derives and checks the
-        content key for you. `kind` labels the rung served ("exact" when
-        omitted; "near" for a family match; partials are always
-        "partial"). Returns the state count preloaded."""
+        content key for you. `kind` labels the rung served, drawn from
+        knobs.WARM_KINDS ("exact" when omitted; "near" for a family
+        match; "delta" for a Spec-CI salvage — an entry store/warm.
+        salvage_delta already re-evaluated/re-derived for an edited
+        definition; partials default to "partial"). Returns the state
+        count preloaded."""
         if self._store is None:
             raise ValueError(
                 "warm_start requires store='tiered' (known states are "
@@ -659,7 +662,7 @@ class FrontierSearch:
                 "partial corpus entry has no frontier snapshot (coverage-"
                 "only); a continuation needs the publisher's cut frontier"
             )
-        self._warm_kind = "partial"
+        self._warm_kind = kind if kind == "delta" else "partial"
         m = entry.meta
         self._q = deque()
         for states, c_lo, c_hi, ebits, depth in warm_seam.frontier_chunks(

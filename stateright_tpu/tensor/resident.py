@@ -955,8 +955,10 @@ class ResidentSearch:
         prefix dedups through the preloaded spill tier), counters and
         discoveries restore from the entry's meta, and run() finishes the
         remainder. The caller owns key discipline (`warm.can_replay` /
-        `warm.can_continue`); a replay must use the publisher's finish
-        policy. Returns the state count preloaded."""
+        `warm.can_continue`, and `warm.salvage_delta` for the Spec-CI
+        "delta" rung — pass the salvaged entry it returns with
+        kind="delta"); a replay must use the publisher's finish policy.
+        Returns the state count preloaded."""
         if self._store is None:
             raise ValueError(
                 "warm_start requires store='tiered' (known states are "
@@ -979,7 +981,7 @@ class ResidentSearch:
                 "partial corpus entry has no frontier snapshot (coverage-"
                 "only); a continuation needs the publisher's cut frontier"
             )
-        self._warm_kind = "partial"
+        self._warm_kind = kind if kind == "delta" else "partial"
         meta = entry.meta
         f = entry.frontier
         nf = int(np.asarray(f["lo"]).size)

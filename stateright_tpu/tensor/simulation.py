@@ -213,8 +213,12 @@ class DeviceSimulation:
         count as `dedup_hits` instead of fresh coverage, so a warm second
         job spends its walk budget on the NEW part of the space. Any entry
         kind serves — coverage is sound whether the source run completed
-        or not (`salt=` re-keys exactly as the engine's own inserts do).
-        Best-effort on table overflow. Returns states inserted."""
+        or not (`salt=` re-keys exactly as the engine's own inserts do),
+        including frontier-less coverage-only entries published by
+        `publish_coverage` and Spec-CI salvages (pass kind="delta";
+        coverage needs no edit gate — a visited SET is sound under any
+        property/boundary edit of the same geometry). Best-effort on
+        table overflow. Returns states inserted."""
         if self.table is None:
             raise ValueError(
                 "warm_start needs the shared visited table (dedup='shared')"
@@ -227,6 +231,67 @@ class DeviceSimulation:
             "exact" if getattr(entry, "complete", True) else "partial"
         )
         return n
+
+    def publish_coverage(self, corpus, tenant: Optional[str] = None) -> bool:
+        """Publish this simulation's shared visited table as a COVERAGE-ONLY
+        partial corpus entry (complete=False, no frontier) — the random-walk
+        campaign's contribution to the corpus: a later campaign on the same
+        model definition preloads it through `corpus.lookup_family` +
+        `warm_start` and spends its walk budget on the unexplored part of
+        the space. The exhaustive ladder stays safe by construction:
+        `warm.can_continue` refuses frontier-less entries, the service's
+        near rung never matches the simulation's batch_size=0 lowering, and
+        the Spec-CI delta rung serves complete entries only. Requires
+        dedup="shared"; the dumped table is UNSALTED back to canonical
+        fingerprints before publish (salt_fp is an involution; the parent-0
+        root sentinel survives). Returns True when the entry was written."""
+        if self.table is None:
+            raise ValueError(
+                "publish_coverage needs the shared visited table "
+                "(dedup='shared')"
+            )
+        from ..store.corpus import content_key, key_components
+
+        dump = self.table.dump()
+        fps = np.fromiter(dump.keys(), dtype=np.uint64, count=len(dump))
+        parents = np.fromiter(
+            dump.values(), dtype=np.uint64, count=len(dump)
+        )
+        if self.salt:
+            s_lo, s_hi = job_salt(self.salt)
+            lo, hi = warm_seam.split_fps(fps)
+            lo, hi = salt_fp(lo, hi, s_lo, s_hi)
+            fps = pack_fp(lo, hi)
+            plo, phi = warm_seam.split_fps(parents)
+            root = parents == 0
+            plo, phi = salt_fp(plo, phi, s_lo, s_hi)
+            parents = np.where(root, np.uint64(0), pack_fp(plo, phi))
+        lowering = {
+            "engine": "simulation",
+            "dedup": self.dedup,
+            "table_log2": self.table_log2,
+            "insert_variant": self.insert_variant,
+            # batch_size 0 / finish None: a coverage lowering can never
+            # collide with (or near-match) an exhaustive engine's key.
+            "batch_size": 0,
+            "finish": None,
+        }
+        key = content_key(self.model, lowering, tenant=tenant)
+        comp = key_components(self.model, lowering, tenant=tenant)
+        meta = {
+            "state_count": int(self._totals["states"]),
+            "unique_count": int(fps.size),
+            "max_depth": int(self._totals["max_depth"]),
+            # Coverage only: simulation witnesses are walk paths, not the
+            # exhaustive engines' first-match fingerprints — replaying
+            # them from a membership preload would claim discoveries the
+            # warmed run never re-verified.
+            "discoveries": {},
+        }
+        return corpus.publish(
+            key, fps, parents, meta,
+            complete=False, frontier=None, components=comp,
+        )
 
     # -- kernel ----------------------------------------------------------------
 

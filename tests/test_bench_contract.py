@@ -431,11 +431,12 @@ def test_device_detail_pins_corpus_row_keys():
     # detail["corpus"] keys, the REGISTRY source, and the warm-start
     # event all resolve through obs/schema.py (srlint SR003 gates the
     # literal sites; this pins the schema's own shape). v2: the event
-    # carries the warm KIND (exact | near | partial — knobs.WARM_KINDS),
-    # detail["corpus"] may carry it too, and the v2 counters are part of
-    # the registry vocabulary.
+    # carries the warm KIND (exact | near | delta | partial —
+    # knobs.WARM_KINDS), detail["corpus"] may carry it too, and the v2 +
+    # Spec-CI delta counters are part of the registry vocabulary.
     from stateright_tpu.knobs import WARM_KINDS
     from stateright_tpu.obs.schema import (
+        CORPUS_DELTA_COUNTERS,
         CORPUS_DETAIL_KEYS,
         CORPUS_V2_COUNTERS,
         DETAIL_KEYS,
@@ -446,15 +447,40 @@ def test_device_detail_pins_corpus_row_keys():
 
     assert "corpus" in DETAIL_KEYS and "corpus" in REGISTRY_SOURCES
     assert EVENT_TYPES["job.warm_start"] == ("job", "kind")
-    assert WARM_KINDS == ("exact", "near", "partial")
+    assert WARM_KINDS == ("exact", "near", "partial", "delta")
     assert "warm_kind" in CORPUS_DETAIL_KEYS
+    assert "delta_class" in CORPUS_DETAIL_KEYS
     for key in (
         "partial_publishes", "partial_preloads", "near_match_hits",
         "superseded_entries",
     ):
         assert key in CORPUS_V2_COUNTERS
+    assert CORPUS_DELTA_COUNTERS == (
+        "delta_hits", "delta_refusals", "component_reuse",
+    )
     detail = {"corpus": {k: 1 for k in CORPUS_DETAIL_KEYS}}
     assert validate_detail(detail) == []
+
+
+def test_device_detail_pins_delta_row_keys():
+    # The BENCH_DELTA=1 Spec-CI A/B row: the property-edit cold wall
+    # time, the delta-rung ratio (ISSUE 18 acceptance >= 2x with
+    # bit-identical counts), and the classifier's named edit class must
+    # survive into detail.device so "a one-line model edit is a warm
+    # run" is auditable in every BENCH_r*.json.
+    for key in ("sec_cold", "warm_speedup_delta", "delta_class"):
+        assert key in bench.DEVICE_DETAIL_FIELDS
+    row = bench.device_detail(
+        {
+            "states_per_sec": 94000.0,
+            "sec": 0.09,
+            "sec_cold": 0.85,
+            "warm_speedup_delta": 9.8,
+            "delta_class": "properties-only",
+        }
+    )
+    assert row["warm_speedup_delta"] == 9.8
+    assert row["delta_class"] == "properties-only"
 
 
 def test_analysis_row_pins_budget_keys():

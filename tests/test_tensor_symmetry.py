@@ -67,7 +67,14 @@ def tpc5_runs():
     }
 
 
+@pytest.mark.slow
 def test_2pc5_symmetry_golden_all_engines(tpc5_runs):
+    # Slow-marked (r22 tier-1 budget trade; the shared tpc5_runs fixture
+    # is the heaviest setup in the fast tier). Fast-tier twins: the SAME
+    # 314-orbit reduction is cross-validated host-side by
+    # test_host_dfs_matches_device_reduction, the 8,832 full space by
+    # test_tensor_checker.py::test_2pc_5_golden, and per-engine device
+    # symmetry by the increment-lock goldens below.
     # Full space: 8,832 (ref: examples/2pc.rs:158-159). The device
     # full-per-RM-key canonicalization is a true orbit invariant, so its
     # reduced count (314) is traversal-order-independent and STRONGER than the
@@ -118,6 +125,7 @@ def _full_key_rep(state):
     )
 
 
+@pytest.mark.slow
 def test_2pc5_verdict_parity_reduced_vs_unreduced(tpc5_runs):
     """VERDICT r3 #4a: on a space where reduced/unreduced counts diverge
     (2PC-5: 314 vs 8,832), property VERDICTS must be identical — reduction

@@ -218,7 +218,12 @@ def test_resident_tiered_spills_and_hits_2pc4_golden():
     ).last_state() is not None
 
 
+@pytest.mark.slow
 def test_resident_tiered_checkpoint_resume_and_regrow(tmp_path):
+    """Slow-marked (r22 tier-1 budget trade). Fast-tier twins: resident
+    checkpoint kill/resume is covered by test_checkpoint.py's resident
+    kill-and-resume golden, and tiered-store resume-while-spilled by
+    test_frontier_tiered_checkpoint_resume_while_spilled above."""
     from stateright_tpu.tensor.resident import ResidentSearch
 
     rs = ResidentSearch(
