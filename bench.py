@@ -556,6 +556,47 @@ def device_search_obs(model_name: str, n: int):
     return out, perr
 
 
+def device_search_calib(model_name: str, n: int):
+    """BENCH_CALIB=1 row: the 2pc-4 anchor run twice on the resident
+    engine — calibration comparator OFF (SR_TPU_CALIB=0) then ON —
+    proving the measured-vs-predicted join's overhead on the pinned row
+    (host arithmetic at chunk granularity, no device work; acceptance:
+    within noise). The ON run's `detail.calib` digest (predicted vs
+    measured ms, drift ratio, per-term attribution) rides in the row."""
+    _pin_platform()
+    from stateright_tpu.tensor.resident import ResidentSearch
+
+    model, batch, table_log2, run_kwargs, engine_kwargs, golden, closure_s = (
+        _build_workload(model_name, n)
+    )
+    runs = {}
+    try:
+        for enabled in (False, True):
+            os.environ["SR_TPU_CALIB"] = "1" if enabled else "0"
+            search = ResidentSearch(
+                model, batch_size=batch, table_log2=table_log2,
+                telemetry=True, **engine_kwargs,
+            )
+            best, out = _time_search(search, run_kwargs, repeats=2,
+                                     closure_s=closure_s)
+            runs[enabled] = (best, out)
+    finally:
+        os.environ.pop("SR_TPU_CALIB", None)
+    best_on, out = runs[True]
+    _attach_telemetry(out, best_on)
+    if best_on.detail and "calib" in best_on.detail:
+        out["calib"] = best_on.detail["calib"]
+    sec_off = runs[False][1]["sec"]
+    out["sec_off"] = sec_off
+    out["calib_overhead_pct"] = round(
+        100.0 * (out["sec"] - sec_off) / max(sec_off, 1e-9), 2
+    )
+    perr = _parity_err(model_name, n, best_on, golden) or _parity_err(
+        model_name, n, runs[False][0], golden
+    )
+    return out, perr
+
+
 def device_search_journal(model_name: str, n: int):
     """BENCH_OBS=1 journal sub-row: the anchor workload through a
     foreground CheckService twice — flight recorder OFF then ON
@@ -1756,6 +1797,12 @@ DEVICE_DETAIL_FIELDS = (
     # check service (acceptance: <= 5%), and how many events the run
     # recorded.
     "sec_journal_off", "journal_overhead_pct", "journal_events",
+    # Calibration observatory (obs/calib.py, BENCH_CALIB=1 A/B row): the
+    # measured-vs-predicted join's digest (predicted/measured ms, drift
+    # ratio, per-term attribution) plus the comparator-off wall time and
+    # the measured on-vs-off overhead (acceptance: within noise — the
+    # comparator is host arithmetic at chunk granularity).
+    "calib", "calib_overhead_pct",
     # Chaos plane / supervisor (BENCH_FAULTS=1 A/B row): the recovery
     # digest plus the unsupervised wall time and the measured supervisor
     # overhead with injection disabled (expected within noise).
@@ -2020,6 +2067,14 @@ def main(argv: list | None = None) -> int:
             # detail.device["2pc-4-journal"].journal_overhead_pct,
             # acceptance <= 5%).
             workloads += (("2pc", 4, 2400.0, "--worker-journal", None),)
+        # BENCH_CALIB=1: add the calibration-comparator on/off A/B on the
+        # 2pc-4 anchor (resident engine; the measured-vs-predicted join of
+        # obs/calib.py costs host arithmetic per 32-step chunk — the
+        # measured overhead lands in
+        # detail.device["2pc-4-calib"].calib_overhead_pct, acceptance
+        # within noise, with the drift digest in .calib).
+        if os.environ.get("BENCH_CALIB") == "1" and not smoke:
+            workloads += (("2pc", 4, 2400.0, "--worker-calib", None),)
         # BENCH_FAULTS=1: add the supervisor-overhead A/B on the 2pc-4
         # anchor (plain resident vs run_supervised with injection off; the
         # measured overhead lands in
@@ -2096,6 +2151,7 @@ def main(argv: list | None = None) -> int:
                     "--worker-sharded": "-sharded8",
                     "--worker-obs": "-obs",
                     "--worker-journal": "-journal",
+                    "--worker-calib": "-calib",
                     "--worker-faults": "-faults",
                     "--worker-pallas": "-pallas",
                     "--worker-corpus": "-corpus",
@@ -2192,6 +2248,8 @@ def worker_main(model_name: str, n: int, mode: str = "--worker") -> int:
             r, perr = device_search_obs(model_name, n)
         elif mode == "--worker-journal":
             r, perr = device_search_journal(model_name, n)
+        elif mode == "--worker-calib":
+            r, perr = device_search_calib(model_name, n)
         elif mode == "--worker-faults":
             r, perr = device_search_faults(model_name, n)
         elif mode == "--worker-pallas":
@@ -2218,7 +2276,8 @@ def worker_main(model_name: str, n: int, mode: str = "--worker") -> int:
 if __name__ == "__main__":
     if len(sys.argv) == 4 and sys.argv[1] in (
         "--worker", "--worker-sharded", "--worker-service", "--worker-obs",
-        "--worker-journal", "--worker-faults", "--worker-pallas",
+        "--worker-journal", "--worker-calib", "--worker-faults",
+        "--worker-pallas",
         "--worker-fleet", "--worker-autoscale", "--worker-blob",
         "--worker-corpus", "--worker-delta", "--worker-semantics",
         "--worker-sim",

@@ -23,6 +23,19 @@ Usage:
   python scripts/tpu_tune.py --sweep MODEL N TABLE_LOG2 --sim \
       [--traces 1024,2048,4096] [--dedup trace,shared] [--walks W] \
       [--max-depth D] [--repeats R] [--timeout SEC] [--out ...]
+  python scripts/tpu_tune.py --calibrate ROOT \
+      [--device KIND] [--ridge R] [--out overlay.json]
+
+`--calibrate` is the calibration-observatory fitter (obs/calib.py): it
+loads every durable observation record the comparators flushed under
+ROOT (a store root or blob:// URI; records land in ROOT/calib/),
+least-squares-fits the costmodel coefficient vector per device kind,
+prints stock-vs-fitted rates plus a leave-one-key-out holdout table,
+writes the loadable overlay JSON (activate with
+SR_TPU_COSTMODEL_CALIB=<overlay>), and re-evaluates the two committed
+pre-hardware rankings (r12 capped-vs-pallas insert crossover, r18
+sim-walk shared-table overhead) under the fitted coefficients, printing
+whether either committed default flips.
 
 The `sim` forms race the fourth engine (tensor/simulation.py, the device
 random-walk checker): `--sim` switches the sweep axes to traces x dedup
@@ -585,6 +598,168 @@ def run_sim_sweep(model_name, n, table_log2, traces_axis, dedup_axis,
     return 0 if ranking else 1
 
 
+def _reeval_rankings(cm, stock_dev, fitted_dev) -> list:
+    """Re-derive the two committed pre-hardware rankings under the fitted
+    coefficients, next to the stock derivation. Returns the list of grid
+    points whose winner flipped (empty = both committed defaults hold).
+
+    r12 (ROUND12_NOTES): capped-vs-pallas insert on the paxos-3 geometry
+    (lanes 21, max_actions 14) — committed call: capped stays the default
+    at the r4 anchor (batch 3072, table 2^22); pallas wins small tables
+    and huge batches. r18 (ROUND14/18 sim notes): shared-table sim dedup
+    is priced as the same insert ops at batch=traces — committed call:
+    trace-dedup stays the sim default; shared's insert term is under ~7%
+    of the step until traces ~4k.
+    """
+    flips = []
+
+    def w12(dev, table_log2, batch):
+        capped = cm.step_cost(
+            21, 14, batch, table_log2, variant="capped", device=dev
+        ).total_ms
+        pallas = cm.step_cost(
+            21, 14, batch, table_log2, variant="pallas", device=dev
+        ).total_ms
+        return ("capped" if capped <= pallas else "pallas", capped, pallas)
+
+    print("\nr12 capped-vs-pallas insert crossover (paxos-3, lanes 21 x "
+          "acts 14) — stock | fitted:")
+    grid = [(t, 3072) for t in (16, 18, 20, 22)]
+    grid += [(22, 32768), (22, 131072)]
+    for table_log2, batch in grid:
+        s_win, s_c, s_p = w12(stock_dev, table_log2, batch)
+        f_win, f_c, f_p = w12(fitted_dev, table_log2, batch)
+        mark = ""
+        if s_win != f_win:
+            mark = "  <-- FLIP"
+            flips.append(f"r12 table=2^{table_log2} batch={batch}: "
+                         f"{s_win} -> {f_win}")
+        print(f"  table=2^{table_log2:<2} batch={batch:<6} "
+              f"stock: {s_win:<6} (capped {s_c:.2f} / pallas {s_p:.2f} ms)"
+              f" | fitted: {f_win:<6} (capped {f_c:.2f} / pallas "
+              f"{f_p:.2f} ms){mark}")
+    s_anchor = w12(stock_dev, 22, 3072)[0]
+    f_anchor = w12(fitted_dev, 22, 3072)[0]
+    if s_anchor == f_anchor:
+        print(f"  committed default at the r4 anchor holds: {f_anchor}")
+    else:
+        print(f"  COMMITTED DEFAULT FLIPS at the r4 anchor: "
+              f"{s_anchor} -> {f_anchor}")
+
+    def sim_row(dev, traces):
+        tr = cm.sim_step_cost(21, 14, traces, dedup="trace", device=dev)
+        sh = cm.sim_step_cost(
+            21, 14, traces, dedup="shared", table_log2=22, device=dev
+        )
+        ins = sum(o.ms for o in sh.ops if o.name.startswith("insert"))
+        return tr.total_ms, sh.total_ms, ins / max(sh.total_ms, 1e-12)
+
+    print("\nr18 sim-walk shared-table overhead (paxos-3, table 2^22) — "
+          "stock | fitted:")
+    for traces in (1024, 2048, 4096, 8192):
+        s_tr, s_sh, s_frac = sim_row(stock_dev, traces)
+        f_tr, f_sh, f_frac = sim_row(fitted_dev, traces)
+        s_win = "trace" if s_tr <= s_sh else "shared"
+        f_win = "trace" if f_tr <= f_sh else "shared"
+        mark = ""
+        if s_win != f_win:
+            mark = "  <-- FLIP"
+            flips.append(f"r18 traces={traces}: {s_win} -> {f_win}")
+        print(f"  traces={traces:<5} stock: trace {s_tr:.2f} / shared "
+              f"{s_sh:.2f} ms, insert {100 * s_frac:.1f}% | fitted: "
+              f"trace {f_tr:.2f} / shared {f_sh:.2f} ms, insert "
+              f"{100 * f_frac:.1f}%{mark}")
+    crossed = [t for t in (1024, 2048, 4096, 8192)
+               if sim_row(fitted_dev, t)[2] > 0.07]
+    if crossed:
+        print(f"  fitted shared-insert term exceeds 7% of the step from "
+              f"traces={crossed[0]} (committed call said ~4k)")
+    else:
+        print("  fitted shared-insert term stays under 7% across the grid")
+
+    if flips:
+        print("\nRANKING FLIPS under fitted coefficients:")
+        for f in flips:
+            print(f"  {f}")
+    else:
+        print("\nno committed ranking flips under fitted coefficients")
+    return flips
+
+
+def run_calibrate(argv: list) -> int:
+    from stateright_tpu.obs.calib import (
+        THETA_FIELDS,
+        device_from_theta,
+        fit_theta,
+        holdout_eval,
+        load_observations,
+        overlay_dict,
+    )
+    from stateright_tpu.tensor import costmodel as cm
+
+    def opt(name, default):
+        if name in argv:
+            return argv[argv.index(name) + 1]
+        return default
+
+    root = argv[0]
+    only = opt("--device", None)
+    ridge = float(opt("--ridge", 1e-2))
+    out_arg = opt("--out", None)
+
+    records = load_observations(root)
+    if not records:
+        print(f"no calibration records under {root} "
+              f"(comparators flush to <root>/calib/)")
+        return 1
+    by_dev: dict = {}
+    for rec in records:
+        by_dev.setdefault(rec.get("device") or "tpu-v5e", []).append(rec)
+    kinds = [only] if only else sorted(by_dev)
+    rc = 0
+    for kind in kinds:
+        recs = by_dev.get(kind)
+        if not recs:
+            print(f"no records for device kind {kind!r} "
+                  f"(have: {sorted(by_dev)})")
+            rc = 1
+            continue
+        base = cm.stock_device(kind)
+        theta, report = fit_theta(recs, base, ridge=ridge)
+        n_rows = report["rows"]
+        print(f"== {kind}: {len(recs)} record(s), {n_rows} observation "
+              f"row(s) ==")
+        print(f"  median |drift-1|: stock "
+              f"{report['median_abs_drift_stock']:.4f} -> fitted "
+              f"{report['median_abs_drift_fitted']:.4f}")
+        fitted_dev = device_from_theta(base, theta)
+        print("  coefficient rates (stock -> fitted):")
+        for name, field, _kind in THETA_FIELDS:
+            print(f"    {field:<16} {getattr(base, field):>12.4g} -> "
+                  f"{getattr(fitted_dev, field):>12.4g}")
+        holdout = holdout_eval(recs, base, ridge=ridge)
+        if holdout:
+            print("  leave-one-key-out holdout (median |drift-1|):")
+            for key, h in sorted(holdout.items()):
+                verdict = "better" if h["fitted"] < h["stock"] else "WORSE"
+                print(f"    {key}: stock {h['stock']:.4f} -> fitted "
+                      f"{h['fitted']:.4f} ({verdict})")
+
+        overlay = overlay_dict(base, theta, report)
+        out_path = out_arg or f"calib-overlay-{kind}.json"
+        try:
+            with open(out_path, "w") as f:
+                json.dump(overlay, f, indent=2)
+            print(f"  overlay written to {out_path}; activate with "
+                  f"SR_TPU_COSTMODEL_CALIB={out_path}")
+        except OSError as e:
+            print(f"  overlay write failed: {e}")
+            rc = 1
+
+        _reeval_rankings(cm, base, fitted_dev)
+    return rc
+
+
 def main() -> int:
     argv = sys.argv[1:]
     if argv and argv[0] == "sim":
@@ -598,6 +773,11 @@ def main() -> int:
             max(1, int(argv[7])) if len(argv) > 7 else 3,
             int(argv[8]) if len(argv) > 8 else 20,
         )
+    if argv and argv[0] == "--calibrate":
+        if len(argv) < 2:
+            print(__doc__)
+            return 2
+        return run_calibrate(argv[1:])
     if argv and argv[0] == "--sweep":
         if len(argv) < 4:
             print(__doc__)

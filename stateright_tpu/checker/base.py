@@ -78,6 +78,12 @@ class Checker:
         and `/metrics`."""
         return None
 
+    def drift_ratio(self) -> Optional[float]:
+        """Measured/predicted step-cost ratio from the calibration
+        comparator (obs/calib.py) when the checker runs one; None
+        otherwise. Feeds the WriteReporter `drift=` field."""
+        return None
+
     # -- conveniences ----------------------------------------------------------
 
     def discovery(self, name: str) -> Optional[Path]:
@@ -118,6 +124,7 @@ class Checker:
                     done=False,
                     rate=rate,
                     fill=self.table_fill(),
+                    drift=self.drift_ratio(),
                 )
             )
             time.sleep(reporter.delay())

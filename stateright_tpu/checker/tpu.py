@@ -315,6 +315,13 @@ class TpuChecker(Checker):
             log2 = self._search.table.log2_size
         return min(self.unique_state_count() / (1 << log2), 1.0)
 
+    def drift_ratio(self) -> Optional[float]:
+        """Measured/predicted ratio of the engine's live calibration
+        comparator (obs/calib.py) for the WriteReporter `drift=` field;
+        None until its first chunk closes (or with calibration off)."""
+        calib = getattr(self._search, "_calib", None)
+        return calib.drift_ratio() if calib is not None else None
+
     def discoveries(self) -> dict[str, Path]:
         if self._result is None:
             return {}

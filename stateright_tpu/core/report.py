@@ -26,6 +26,9 @@ class ReportData:
     done: bool
     rate: Optional[float] = None
     fill: Optional[float] = None
+    # measured/predicted step-cost ratio from the calibration comparator
+    # (obs/calib.py); None until a chunk closes or when calibration is off.
+    drift: Optional[float] = None
 
 
 class Reporter:
@@ -68,6 +71,8 @@ class WriteReporter(Reporter):
                 line += f", rate={data.rate:.0f}"
             if data.fill is not None:
                 line += f", fill={100.0 * data.fill:.1f}%"
+            if data.drift is not None:
+                line += f", drift={data.drift:.2f}"
             self.stream.write(line + "\n")
         self.stream.flush()
 

@@ -35,6 +35,7 @@ from .events import (
 from .registry import (
     REGISTRY,
     CounterRegistry,
+    LogHistogram,
     flatten_metrics,
     render_prometheus,
 )
@@ -57,6 +58,7 @@ __all__ = [
     "build_detail",
     "REGISTRY",
     "CounterRegistry",
+    "LogHistogram",
     "flatten_metrics",
     "render_prometheus",
     "DETAIL_KEYS",

@@ -34,10 +34,62 @@ def _sanitize(name: str) -> str:
     return name
 
 
+class LogHistogram:
+    """A bounded log-bucket histogram with real Prometheus exposition.
+
+    The r21 latency windows (`admission_p99_ms`, `lane_util`) lived only
+    as point gauges in `/.status` rows; dashboards need the distribution.
+    Buckets are geometric — `lo * factor^i` up to `hi`, plus +Inf — so a
+    wide dynamic range (microseconds to minutes) costs a few dozen
+    counters, fixed at construction. `observe()` is two adds and a
+    bisect-free index; safe on hot paths.
+
+    A provider dict may hold a LogHistogram as a leaf value:
+    `flatten_metrics` passes the instance through and `render_prometheus`
+    emits the native `*_bucket{le=...}` / `*_sum` / `*_count` triplet
+    instead of a gauge.
+    """
+
+    def __init__(self, lo: float = 0.125, hi: float = 8192.0,
+                 factor: float = 2.0):
+        assert lo > 0 and hi > lo and factor > 1
+        self.bounds: list = []
+        b = lo
+        while b <= hi * (1 + 1e-12):
+            self.bounds.append(b)
+            b *= factor
+        self.counts = [0] * (len(self.bounds) + 1)  # [-1] is +Inf
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        self.sum += v
+        self.count += 1
+        for i, b in enumerate(self.bounds):
+            if v <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def render(self, name: str) -> list:
+        """Prometheus text lines for this histogram under `name`."""
+        lines = [f"# TYPE {name} histogram"]
+        cum = 0
+        for b, c in zip(self.bounds, self.counts):
+            cum += c
+            lines.append(f'{name}_bucket{{le="{_num(float(b))}"}} {cum}')
+        lines.append(f'{name}_bucket{{le="+Inf"}} {self.count}')
+        lines.append(f"{name}_sum {_num(self.sum)}")
+        lines.append(f"{name}_count {self.count}")
+        return lines
+
+
 def flatten_metrics(d: dict, prefix: str = "") -> dict:
     """Flatten nested dicts to `a_b_c -> number`; bools become 0/1, None and
     non-numeric leaves are dropped, numeric lists survive as lists (rendered
-    with an index label)."""
+    with an index label) and LogHistogram leaves pass through (rendered as
+    native histograms)."""
     out: dict = {}
     for k, v in (d or {}).items():
         key = f"{prefix}{_sanitize(k)}"
@@ -46,6 +98,8 @@ def flatten_metrics(d: dict, prefix: str = "") -> dict:
         elif isinstance(v, bool):
             out[key] = int(v)
         elif isinstance(v, (int, float)):
+            out[key] = v
+        elif isinstance(v, LogHistogram):
             out[key] = v
         elif isinstance(v, (list, tuple)) and all(
             isinstance(x, (int, float)) and not isinstance(x, bool) for x in v
@@ -65,6 +119,9 @@ def render_prometheus(groups: dict, prefix: str = "stateright") -> str:
         for key in sorted(flat):
             name = f"{prefix}_{src}_{key}"
             value = flat[key]
+            if isinstance(value, LogHistogram):
+                lines.extend(value.render(name))
+                continue
             lines.append(f"# TYPE {name} gauge")
             if isinstance(value, list):
                 for i, x in enumerate(value):

@@ -47,6 +47,49 @@ DETAIL_KEYS = {
     # jobs submitted under a non-default tenant, so default-tenant results
     # stay byte-identical to the pre-tenancy goldens.
     "tenant": "per-tenant accounting sub-dict (TENANT_DETAIL_KEYS)",
+    # calibration observatory (obs/calib.py Comparator) — present only
+    # when the comparator is enabled AND closed at least one chunk, so
+    # calib-off runs (SR_TPU_CALIB=0) keep their pre-observatory shape.
+    "calib": "measured-vs-predicted cost sub-dict (CALIB_DETAIL_KEYS)",
+}
+
+
+#: Keys of `detail["calib"]` (obs/calib.py Comparator.detail) — the
+#: live measured-vs-predicted join for the run's exact config. `terms`
+#: is the one intentionally-dynamic sub-dict: one predicted-ms entry per
+#: costmodel OpCost name the active variant prices.
+CALIB_DETAIL_KEYS = {
+    "engine": "which engine the comparator observed "
+              "(frontier/resident/sharded/simulation/service)",
+    "variant": "costmodel variant the prediction priced "
+               "(costmodel.ENGINE_VARIANTS value)",
+    "device": "DeviceSpec kind predictions used (overlay-aware)",
+    "predicted_ms": "costmodel ms/step for the last chunk's new_frac",
+    "measured_p50_ms": "measured ms/step, step-weighted p50 over chunks",
+    "measured_p95_ms": "measured ms/step, step-weighted p95 over chunks",
+    "drift_ratio": "measured/predicted, step-weighted p50 over chunks",
+    "new_frac": "populated-lane fraction the capped prediction used "
+                "(quantized; from drained generated counts)",
+    "chunks": "comparison chunks closed (~chunk_steps steps each)",
+    "out_of_band": "chunks whose ratio left the seeded drift band",
+    "drift_events": "drift episodes journaled (K consecutive chunks out)",
+    "terms": "per-term predicted-ms attribution sub-dict (OpCost names)",
+    "top_term": "largest predicted term — the blame heuristic a drift "
+                "episode names",
+}
+
+#: The `"calib"` REGISTRY source (obs/calib.py Comparator.metrics) —
+#: scrape names on both /metrics front doors, pinned like every source.
+CALIB_COUNTER_KEYS = {
+    "chunks": "comparison chunks closed",
+    "out_of_band": "chunks outside the seeded drift band",
+    "drift_events": "drift episodes (K consecutive out-of-band chunks)",
+    "drift_active": "1 while an episode is open, else 0",
+    "last_ratio": "latest chunk's measured/predicted",
+    "last_predicted_ms": "latest chunk's predicted ms/step",
+    "last_measured_ms": "latest chunk's measured ms/step",
+    "records_flushed": "durable observation-record merges written",
+    "record_errors": "record writes that failed (store unreachable)",
 }
 
 #: Keys of `detail["corpus"]` (service/scheduler.py `build_result`, the
@@ -209,6 +252,8 @@ REGISTRY_SOURCES = {
             "retries, backoff, torn puts, stale lists, unavailability)",
     "autoscaler": "elastic control plane reconciliation loop "
                   "(service/autoscale.py — AUTOSCALE_COUNTER_KEYS)",
+    "calib": "calibration observatory comparator (obs/calib.py — "
+             "CALIB_COUNTER_KEYS; one provider per live engine)",
 }
 
 
@@ -307,6 +352,13 @@ EVENT_TYPES = {
     "lease.grant": ("member", "epoch"),
     "lease.revoke": ("member", "epoch"),
     "lease.reject": ("member",),     # surface=write|read|event, epoch=n
+    # calibration observatory (obs/calib.py): the comparator's ratio left
+    # the seeded band for K consecutive chunks — `term` names the largest
+    # predicted term (the recalibration suspect); ratio/predicted_ms/
+    # measured_ms/variant/device/jobs ride along as optional evidence so
+    # the timeline CLI can answer "which job, which engine, which term,
+    # when" from the journal alone.
+    "calib.drift": ("engine", "term"),
 }
 
 #: Event types that end a job's timeline — obs/timeline.py flags a trace
@@ -347,6 +399,7 @@ DETAIL_SUBSCHEMAS = (
     ("faults", FAULTS_DETAIL_KEYS),
     ("corpus", CORPUS_DETAIL_KEYS),
     ("tenant", TENANT_DETAIL_KEYS),
+    ("calib", CALIB_DETAIL_KEYS),
 )
 
 

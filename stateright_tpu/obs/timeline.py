@@ -193,6 +193,30 @@ def lifecycle(evs: list) -> dict:
     }
 
 
+def drift_report(events: list) -> list:
+    """Calibration drift digest: one row per journaled `calib.drift`
+    event (obs/calib.py) answering "which job, which engine, which term,
+    when". Drift is a costmodel-accuracy signal, NOT a lifecycle anomaly
+    — it never changes the exit code."""
+    out: list = []
+    for e in events:
+        if e.get("event") != "calib.drift":
+            continue
+        out.append(
+            {
+                "ts": e.get("ts"),
+                "engine": e.get("engine"),
+                "term": e.get("term"),
+                "ratio": e.get("ratio"),
+                "device": e.get("device"),
+                "trace": e.get("trace"),
+                "jobs": e.get("jobs"),
+                "writer": e.get("writer"),
+            }
+        )
+    return out
+
+
 def find_anomalies(traces: dict, gap_s: float = 30.0) -> list:
     """The forensic verdicts: per-trace lifecycle violations.
 
@@ -395,6 +419,7 @@ def main(argv=None) -> int:
     events, lease_rejected = fence_events(events)
     traces, untraced = group_traces(events)
     anomalies = find_anomalies(traces, gap_s=args.gap_s)
+    drift = drift_report(events)
     counts = event_counts(events)
 
     chrome_path = None
@@ -416,6 +441,7 @@ def main(argv=None) -> int:
                 "traces": {t: lifecycle(evs) for t, evs in traces.items()},
                 "untraced": len(untraced),
                 "anomalies": anomalies,
+                "drift": drift,
                 "lease_rejected_events": len(lease_rejected),
                 "chrome_out": chrome_path,
             },
@@ -453,6 +479,22 @@ def main(argv=None) -> int:
         )
     if chrome_path:
         print(f"chrome trace written to {chrome_path}")
+    if drift:
+        print(
+            f"{len(drift)} calibration drift event(s) (costmodel accuracy, "
+            "not lifecycle anomalies — exit code unchanged):"
+        )
+        for d in drift:
+            jobs = d["jobs"]
+            who = (
+                ",".join(str(j) for j in jobs)
+                if isinstance(jobs, (list, tuple)) and jobs
+                else (d["trace"] or "-")
+            )
+            print(
+                f"  [calib.drift] engine {d['engine']} term {d['term']} "
+                f"ratio {d['ratio']} jobs {who} ts {d['ts']}"
+            )
     if anomalies:
         print(f"{len(anomalies)} ANOMALIES:")
         for a in anomalies:

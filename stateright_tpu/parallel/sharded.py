@@ -315,6 +315,29 @@ class ShardedSearch:
         self._ring = StepRing(self._TMR) if telemetry else None
         self._tracer = as_tracer(tracer)
         self._metrics_name = REGISTRY.register("sharded", self.metrics)
+        # Calibration comparator (obs/calib.py): prices ONE shard's
+        # lockstep step (per-shard batch/table — every shard dispatches the
+        # same program) and consumes the already-synced ring drains below.
+        self._calib = None
+        if telemetry:
+            # Lazy import: obs.calib prices through tensor.costmodel, so a
+            # module-level import would cycle when obs loads first.
+            from ..obs.calib import CalibConfig, Comparator, calib_enabled
+            from ..tensor.costmodel import ENGINE_VARIANTS
+
+        if telemetry and calib_enabled():
+            self._calib = Comparator(CalibConfig(
+                engine="sharded",
+                variant=ENGINE_VARIANTS.get(
+                    ("split", insert_variant), "split"
+                ),
+                lanes=model.lanes,
+                max_actions=model.max_actions,
+                batch=batch_size,
+                table_log2=table_log2,
+                spill=(store == "tiered"),
+            ))
+            REGISTRY.register("calib", self._calib.metrics)
         self.props = model.properties()
         self._kernel, self._seed_k, self._chunk_k = self._build()
         self._last_tables = None
@@ -1216,11 +1239,13 @@ class ShardedSearch:
             if self._ring is not None:
                 # Whole-search dispatch: one bulk drain of every shard's
                 # ring (includes compile time in the window average).
-                self._ring.drain_sharded(
-                    tm_rows,
-                    int(steps.max()),
-                    window_us=(time.monotonic() - start) * 1e6,
-                )
+                w_us = (time.monotonic() - start) * 1e6
+                self._ring.drain_sharded(tm_rows, int(steps.max()),
+                                         window_us=w_us)
+                if self._calib is not None:
+                    self._calib.observe(
+                        self._ring.steps, w_us, self._ring.generated_total
+                    )
             if bool(overflow.any()):
                 # A previous run's snapshot must not silently serve paths
                 # for states this failed run discovered.
@@ -1283,11 +1308,15 @@ class ShardedSearch:
                 if self._ring is not None:
                     # The chunk already synced (summary gather); the ring
                     # drain is one more bulk copy, never a per-step sync.
-                    self._ring.drain_sharded(
-                        _host(carry.tm_rows),
-                        int(s[:, 8].max()),
-                        window_us=(time.monotonic() - t_chunk0) * 1e6,
-                    )
+                    w_us = (time.monotonic() - t_chunk0) * 1e6
+                    self._ring.drain_sharded(_host(carry.tm_rows),
+                                             int(s[:, 8].max()),
+                                             window_us=w_us)
+                    if self._calib is not None:
+                        self._calib.observe(
+                            self._ring.steps, w_us,
+                            self._ring.generated_total,
+                        )
                 codes = s[:, 7].astype(np.uint32)
                 if (codes & EXIT_SERVICE).any() and not (
                     codes & (ABORT_TABLE | ABORT_QUEUE | ABORT_ROUTE)
@@ -1402,6 +1431,10 @@ class ShardedSearch:
             discoveries = {
                 k: int(v) for k, v in m.get("discoveries", {}).items()
             }
+        if self._calib is not None:
+            self._calib.finish()
+            if self._calib.chunks:
+                self._calib.flush_records()
         return SearchResult(
             state_count=state_count,
             unique_state_count=unique_total,
@@ -1428,6 +1461,11 @@ class ShardedSearch:
                 **(
                     {"telemetry": self.telemetry_summary()}
                     if self._ring is not None
+                    else {}
+                ),
+                **(
+                    {"calib": self._calib.detail()}
+                    if self._calib is not None and self._calib.chunks
                     else {}
                 ),
             },

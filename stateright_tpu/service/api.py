@@ -43,6 +43,7 @@ from ..obs import (
     REGISTRY,
     TERMINAL_EVENT_BY_STATUS,
     EventJournal,
+    LogHistogram,
     Tracer,
     as_events,
     as_tracer,
@@ -197,6 +198,12 @@ class CheckService:
         # Bounded recent queue-wait samples (seconds) — the autoscaler's
         # p99 admission-latency signal, appended at each first admission.
         self._queue_waits: deque = deque(maxlen=256)
+        # Prometheus-shaped distributions behind the two autoscaler
+        # signals: queue waits in ms, lane occupancy in 0..1. The
+        # `/.status` scalars above stay; these add `*_bucket`/`_sum`/
+        # `_count` text on both `/metrics` front doors.
+        self._adm_hist = LogHistogram()
+        self._lane_hist = LogHistogram(lo=1.0 / 128, hi=1.0)
         # Central counter registry (obs/registry.py): both HTTP front ends'
         # `/metrics` render every registered source; weakly held, so a
         # dropped service unregisters itself.
@@ -482,6 +489,12 @@ class CheckService:
             if self.quotas is not None:
                 out["tenants"] = self.quotas.snapshot()
                 out["quota_rejected"] = self._quota_rejected
+            # Measured-vs-predicted calibration join (obs/calib.py) —
+            # present only once the comparator has closed a chunk, so
+            # calibration-less deployments' `/.status` stays byte-identical.
+            calib = self._engine.calib_detail()
+            if calib is not None:
+                out["calib"] = calib
             return out
 
     def lane_util(self) -> float:
@@ -516,12 +529,25 @@ class CheckService:
                 self._engine.hot_claims / self._engine.table.size, 4
             )
 
+    def drift_ratio(self) -> Optional[float]:
+        """Last closed calibration chunk's measured/predicted ratio
+        (obs/calib.py) — the reporter's `drift=` read; lock-free plain
+        attribute access like the fleet's signal row."""
+        calib = self._engine._calib
+        return calib.drift_ratio() if calib is not None else None
+
     def metrics(self) -> dict:
         """Flat counters for the obs registry / `GET /metrics` (service
         stats plus the engine's step digest; per-job rows stay in
         `/.status` — unbounded label cardinality does not belong in
         Prometheus gauges)."""
-        return self.stats()
+        out = self.stats()
+        # Real histograms (registry.LogHistogram) for the two autoscaler
+        # signals — render_prometheus turns each into a `*_bucket`/`_sum`/
+        # `_count` triplet on both `/metrics` doors.
+        out["admission_wait_ms"] = self._adm_hist
+        out["lane_util_window"] = self._lane_hist
+        return out
 
     def events_tail(
         self, job_id: Optional[int] = None, since: int = 0,
@@ -694,6 +720,7 @@ class CheckService:
             if qw is not None:
                 # p99 admission-latency sample (autoscaler signal).
                 self._queue_waits.append(qw)
+                self._adm_hist.observe(qw * 1000.0)
             job.status = JobStatus.RUNNING
             job.steps_since_admit = 0
             # `job.resumed` (a fleet requeue continuing from its journal
@@ -829,6 +856,9 @@ class CheckService:
         except StepFault as e:
             self._handle_step_fault(e)
             return True
+        # Lane-occupancy sample per fused step (the distribution behind
+        # the `/.status` `lane_util` point value).
+        self._lane_hist.observe(self._engine.lane_util())
         for job in finished:
             self._finalize(job)
         return True
@@ -1015,6 +1045,9 @@ class ServiceChecker(Checker):
 
     def table_fill(self) -> Optional[float]:
         return self._handle._service.table_fill()
+
+    def drift_ratio(self) -> Optional[float]:
+        return self._handle._service.drift_ratio()
 
     def telemetry_summary(self) -> Optional[dict]:
         return self._handle._service.telemetry_summary()

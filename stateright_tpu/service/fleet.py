@@ -194,10 +194,19 @@ class Replica:
             p99 = round(
                 waits[min(len(waits) - 1, int(0.99 * len(waits)))] * 1e3, 3
             )
-        return {
+        row = {
             "lane_util": round(svc._engine.lane_util(), 4),
             "adm_p99_ms": p99,
         }
+        # Measured/predicted cost ratio from the calibration comparator
+        # (obs/calib.py) — same lock-free discipline: a plain attribute
+        # read of the last closed chunk, absent until one closes.
+        calib = svc._engine._calib
+        if calib is not None:
+            ratio = calib.drift_ratio()
+            if ratio is not None:
+                row["drift"] = round(ratio, 3)
+        return row
 
     def idle(self) -> bool:
         """True iff this replica has nothing queued and nothing runnable —

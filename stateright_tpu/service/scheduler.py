@@ -48,7 +48,7 @@ from ..core.model import Expectation
 from ..faults.plan import maybe_fault
 from ..knobs import STORE_KINDS, WARM_KINDS
 from ..store import warm as warm_seam
-from ..obs import StepRing, as_events, as_tracer
+from ..obs import REGISTRY, StepRing, as_events, as_tracer
 from ..tensor.fingerprint import pack_fp, salt_fp, unpack_fp
 from ..tensor.frontier import (
     FrontierSearch,
@@ -278,6 +278,13 @@ class ServiceEngine:
                 summary_hashes=self._store.config.summary_hashes,
             )
         self.hot_claims = 0
+        # Calibration comparator (obs/calib.py): created lazily on the
+        # first group (the prediction needs a model geometry) and
+        # re-pointed as groups change; drift events journal through this
+        # engine's flight recorder with the active jobs' trace ids, and
+        # observation records flush into the corpus root when one exists.
+        self._calib = None
+        self._calib_root = corpus_dir
         self.groups: dict[int, _Group] = {}
         self._group_rr: list[int] = []
         # Robustness accounting (surfaced in stats()["faults"] and each
@@ -321,7 +328,44 @@ class ServiceEngine:
                         f"({self._store.low_slots} slots); raise table_log2 "
                         "or lower batch_size/low_water"
                     )
+            self._configure_calib()
         return g
+
+    def _configure_calib(self) -> None:
+        """(Re)point the comparator at the widest live group geometry —
+        the fused step is padded to the max (lanes, max_actions) across
+        groups, which is exactly what the costmodel should price."""
+        from ..obs.calib import calib_enabled
+
+        if self._ring is None or not calib_enabled() or not self.groups:
+            return
+        lanes = max(g.model.lanes for g in self.groups.values())
+        acts = max(g.model.max_actions for g in self.groups.values())
+        if self._calib is None:
+            from ..obs.calib import CalibConfig, Comparator
+            from ..tensor.costmodel import ENGINE_VARIANTS
+
+            self._calib = Comparator(
+                CalibConfig(
+                    # The calib source tag for the SERVICE plane's fused
+                    # step — deliberately outside the four device-engine
+                    # spines the knob registry names.
+                    engine="service",  # srlint: knob-ok calib source label
+                    variant=ENGINE_VARIANTS.get(
+                        ("split", self.insert_variant), "split"
+                    ),
+                    lanes=lanes,
+                    max_actions=acts,
+                    batch=self.batch_size,
+                    table_log2=self.table.size.bit_length() - 1,
+                    spill=self._store is not None,
+                ),
+                events=self._events,
+                record_root=self._calib_root,
+            )
+            REGISTRY.register("calib", self._calib.metrics)
+        else:
+            self._calib.configure(lanes, acts)
 
     # -- warm-start corpus -----------------------------------------------------
 
@@ -1347,6 +1391,18 @@ class ServiceEngine:
                 depth=int(depth[:m].max()) if m else 0,
                 step_us=step_us,
             )
+            if self._calib is not None:
+                # Same already-fetched scalars, joined against the
+                # costmodel at chunk granularity; active traces ride onto
+                # any drift event so the timeline can name the jobs.
+                self._calib.observe(
+                    self._ring.steps,
+                    step_us,
+                    self._ring.generated_total,
+                    traces=[
+                        j.trace for j, _s, _e in segments if j.trace
+                    ] or None,
+                )
 
         # -- per-job finish checks ---------------------------------------------
         for job, _s, _e in segments:
@@ -1414,6 +1470,12 @@ class ServiceEngine:
             # Engine-wide step digest (the shared batches this job rode in),
             # not a per-job slice — per-job shares live under "service".
             detail["telemetry"] = t
+        c = self.calib_detail()
+        if c is not None:
+            # Engine-wide measured-vs-predicted join, same scope as the
+            # telemetry digest above (obs/schema.py CALIB_DETAIL_KEYS).
+            detail["calib"] = c
+            self._calib.flush_records()
         if job.tenant != "default":
             # Tenancy accounting sub-dict (obs/schema.py
             # TENANT_DETAIL_KEYS) — default-tenant results stay
@@ -1471,6 +1533,16 @@ class ServiceEngine:
         if self._store is None:
             return None
         return self._store.stats(self.hot_claims)
+
+    def calib_detail(self) -> Optional[dict]:
+        """The comparator's `detail["calib"]` sub-dict, or None before the
+        first closed chunk (also the `/.status` and fleet-row surface)."""
+        if self._calib is None:
+            return None
+        self._calib.finish()
+        if not self._calib.chunks:
+            return None
+        return self._calib.detail()
 
     def lane_util(self) -> float:
         """Fraction of the batch the LAST fused step filled — the

@@ -138,6 +138,64 @@ CPU1 = DeviceSpec(
 )
 
 
+# -- calibration overlay (obs/calib.py fits it; this module only loads) ----
+#: Env var naming a fitted-overlay JSON ({"base": <kind>, "rates": {...}})
+#: written by `tpu_tune --calibrate`. Loading yields a NEW DeviceSpec — the
+#: committed V5E/CPU1 anchors are never mutated, so the r4 anchor pin holds
+#: with or without an overlay active.
+CALIB_ENV = "SR_TPU_COSTMODEL_CALIB"
+
+#: The committed per-kind specs, by DeviceSpec.name.
+DEVICE_KINDS = {V5E.name: V5E, CPU1.name: CPU1}
+
+
+def stock_device(kind: str) -> "DeviceSpec":
+    """The committed spec for a device-kind name ("tpu-v5e" | "cpu-1core")."""
+    try:
+        return DEVICE_KINDS[kind]
+    except KeyError:
+        raise ValueError(
+            f"unknown device kind {kind!r}; known: {sorted(DEVICE_KINDS)}"
+        )
+
+
+_CALIB_CACHE: dict = {}  # path -> (mtime, DeviceSpec)
+
+
+def load_calibration(path: Optional[str] = None) -> Optional["DeviceSpec"]:
+    """The fitted-overlay DeviceSpec from `path` (default: $CALIB_ENV), or
+    None when no overlay is configured/readable. The returned spec keeps
+    the base kind's `name` and `hbm_gbps` (roofline denominator) and
+    overrides only the achieved rates present in the overlay's "rates"
+    dict — a NEW instance every load path; stock specs stay frozen."""
+    import json
+    import os
+
+    path = path or os.environ.get(CALIB_ENV)
+    if not path:
+        return None
+    try:
+        mtime = os.path.getmtime(path)
+        hit = _CALIB_CACHE.get(path)
+        if hit is not None and hit[0] == mtime:
+            return hit[1]
+        with open(path, "r") as f:
+            doc = json.load(f)
+        base = stock_device(doc["base"])
+        rates = doc.get("rates") or {}
+        fields = {
+            k: float(v) for k, v in rates.items()
+            if k in DeviceSpec.__dataclass_fields__ and float(v) > 0
+        }
+        from dataclasses import replace
+
+        spec = replace(base, **fields)
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+    _CALIB_CACHE[path] = (mtime, spec)
+    return spec
+
+
 class OpCost(NamedTuple):
     name: str
     bytes: float  # HBM bytes touched

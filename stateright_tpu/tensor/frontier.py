@@ -38,6 +38,7 @@ from ..faults.ckptio import fenced_savez, load_latest
 from ..faults.plan import maybe_fault
 from ..store import warm as warm_seam
 from ..obs import REGISTRY, StepRing, as_tracer, build_detail
+from .costmodel import ENGINE_VARIANTS
 from .fingerprint import device_fingerprint, pack_fp
 from .hashtable import _insert_impl
 from .inserts import INSERT_TABLE, make_table, resolve_insert
@@ -456,6 +457,28 @@ class FrontierSearch:
         # Weakly registered: /metrics scrapes can see any live engine, and
         # the registry never keeps a finished search alive (obs/registry.py).
         self._metrics_name = REGISTRY.register("frontier", self.metrics)
+        # Calibration comparator (obs/calib.py): joins the step times this
+        # engine already measures against the costmodel prediction for this
+        # exact config — host arithmetic only, observes and never steers.
+        self._calib = None
+        if telemetry:
+            # Lazy import: obs.calib prices through tensor.costmodel, so a
+            # module-level import would cycle when obs loads first.
+            from ..obs.calib import CalibConfig, Comparator, calib_enabled
+
+        if telemetry and calib_enabled():
+            self._calib = Comparator(CalibConfig(
+                engine="frontier",
+                variant=ENGINE_VARIANTS.get(
+                    ("split", insert_variant), "split"
+                ),
+                lanes=model.lanes,
+                max_actions=model.max_actions,
+                batch=batch_size,
+                table_log2=table_log2,
+                spill=(store == "tiered"),
+            ))
+            REGISTRY.register("calib", self._calib.metrics)
         # Placeholder summary operand for store="device" (the step signature
         # is uniform so both modes share one code path).
         self._no_summary = jnp.zeros(1, dtype=jnp.uint32)
@@ -912,6 +935,10 @@ class FrontierSearch:
                         depth=chunk.depth,
                         step_us=step_us,
                     )
+                    if self._calib is not None:
+                        # Same already-fetched scalars, joined against the
+                        # costmodel prediction at chunk granularity.
+                        self._calib.observe(steps, step_us, state_count)
                 if (
                     target_state_count is not None
                     and state_count >= target_state_count
@@ -1030,7 +1057,14 @@ class FrontierSearch:
     def _detail(self) -> Optional[dict]:
         """SearchResult.detail under the one documented schema
         (obs/schema.py, shared assembly in obs.build_detail)."""
-        return build_detail(self.store_stats(), self.telemetry_summary())
+        detail = build_detail(self.store_stats(), self.telemetry_summary())
+        if self._calib is not None:
+            self._calib.finish()
+        if self._calib is not None and self._calib.chunks:
+            detail = dict(detail or {})
+            detail["calib"] = self._calib.detail()
+            self._calib.flush_records()
+        return detail
 
     # -- checkpoint / resume ---------------------------------------------------
     # SURVEY.md §5: the reference has no partial-search checkpointing; with

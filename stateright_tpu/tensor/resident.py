@@ -47,6 +47,7 @@ from ..faults.ckptio import fenced_savez, load_latest, normalize_ckpt_path
 from ..faults.plan import maybe_fault
 from ..store import warm as warm_seam
 from ..obs import N_COLS, REGISTRY, StepRing, as_tracer, build_detail
+from .costmodel import ENGINE_VARIANTS
 from .fingerprint import pack_fp
 from .frontier import (
     SearchResult,
@@ -444,6 +445,27 @@ class ResidentSearch:
         self._ring = StepRing(self._TMR) if telemetry else None
         self._tracer = as_tracer(tracer)
         self._metrics_name = REGISTRY.register("resident", self.metrics)
+        # Calibration comparator (obs/calib.py): consumes the already-synced
+        # ring drains below — no extra device work, observes, never steers.
+        self._calib = None
+        if telemetry:
+            # Lazy import: obs.calib prices through tensor.costmodel, so a
+            # module-level import would cycle when obs loads first.
+            from ..obs.calib import CalibConfig, Comparator, calib_enabled
+
+        if telemetry and calib_enabled():
+            self._calib = Comparator(CalibConfig(
+                engine="resident",
+                variant=ENGINE_VARIANTS.get(
+                    (table_layout, insert_variant), "split"
+                ),
+                lanes=model.lanes,
+                max_actions=model.max_actions,
+                batch=batch_size,
+                table_log2=table_log2,
+                spill=(store == "tiered"),
+            ))
+            REGISTRY.register("calib", self._calib.metrics)
         self.props = model.properties()
         self._kernel, self._seed_k, self._chunk_k = self._build()
         self._last_tables = None
@@ -1155,11 +1177,13 @@ class ResidentSearch:
                 # holds the LAST 2^telemetry_log2 steps; earlier rows count
                 # as dropped). The window average includes compile time on a
                 # cold first run.
-                self._ring.drain(
-                    np.asarray(tm_rows),
-                    int(summary[8]),
-                    window_us=(time.monotonic() - start) * 1e6,
-                )
+                w_us = (time.monotonic() - start) * 1e6
+                self._ring.drain(np.asarray(tm_rows), int(summary[8]),
+                                 window_us=w_us)
+                if self._calib is not None:
+                    self._calib.observe(
+                        self._ring.steps, w_us, self._ring.generated_total
+                    )
             # On overflow the failed run's tables are unsound AND a previous
             # run's snapshot must not silently serve paths for states this
             # run discovered — invalidate (matches the sharded engine).
@@ -1214,11 +1238,14 @@ class ResidentSearch:
                 if self._ring is not None:
                     # The chunk already synced (summary fetch); pulling the
                     # ring here adds a bulk copy, never a per-step sync.
-                    self._ring.drain(
-                        np.asarray(carry.tm_rows),
-                        int(summary[8]),
-                        window_us=(time.monotonic() - t_chunk0) * 1e6,
-                    )
+                    w_us = (time.monotonic() - t_chunk0) * 1e6
+                    self._ring.drain(np.asarray(carry.tm_rows),
+                                     int(summary[8]), window_us=w_us)
+                    if self._calib is not None:
+                        self._calib.observe(
+                            self._ring.steps, w_us,
+                            self._ring.generated_total,
+                        )
                 code = int(summary[7])
                 if code & EXIT_SERVICE and not (
                     code & (ABORT_TABLE | ABORT_QUEUE)
@@ -1378,7 +1405,14 @@ class ResidentSearch:
     def _detail(self) -> Optional[dict]:
         """SearchResult.detail under the one documented schema
         (obs/schema.py, shared assembly in obs.build_detail)."""
-        return build_detail(self.store_stats(), self.telemetry_summary())
+        detail = build_detail(self.store_stats(), self.telemetry_summary())
+        if self._calib is not None:
+            self._calib.finish()
+        if self._calib is not None and self._calib.chunks:
+            detail = dict(detail or {})
+            detail["calib"] = self._calib.detail()
+            self._calib.flush_records()
+        return detail
 
     def _service(self) -> None:
         """Host half of the tiered store, run between chunked dispatches on

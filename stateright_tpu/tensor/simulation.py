@@ -74,6 +74,7 @@ from ..faults.ckptio import fenced_savez, load_latest
 from ..faults.plan import maybe_fault
 from ..knobs import SIM_DEDUP_KINDS, WARM_KINDS
 from ..obs import REGISTRY, build_detail
+from .costmodel import ENGINE_VARIANTS
 from ..store import warm as warm_seam
 from .fingerprint import job_salt, pack_fp, salt_fp
 from .frontier import SearchResult, state_fingerprint
@@ -206,6 +207,31 @@ class DeviceSimulation:
         self._warm_states = 0
         self._warm_kind: Optional[str] = None
         self._metrics_name = REGISTRY.register("simulation", self.metrics)
+        # Calibration comparator (obs/calib.py): one observation per run()
+        # round (the engine's only sync boundary) against sim_step_cost for
+        # this exact walk config — observes, never steers.
+        self._calib = None
+        if telemetry:
+            # Lazy import: obs.calib prices through tensor.costmodel, so a
+            # module-level import would cycle when obs loads first.
+            from ..obs.calib import CalibConfig, Comparator, calib_enabled
+
+        if telemetry and calib_enabled():
+            self._calib = Comparator(CalibConfig(
+                engine="simulation",
+                variant=ENGINE_VARIANTS.get(
+                    ("split", insert_variant), "capped"
+                ),
+                lanes=model.lanes,
+                max_actions=model.max_actions,
+                batch=traces,
+                table_log2=table_log2,
+                sim=True,
+                dedup=dedup,
+                cycle_log2=cycle_log2,
+                ring=ring,
+            ))
+            REGISTRY.register("calib", self._calib.metrics)
 
     def warm_start(self, entry, kind: Optional[str] = None) -> int:
         """Preload the shared visited table from a published `CorpusEntry`
@@ -780,6 +806,29 @@ class DeviceSimulation:
         t["overflow_steps"] += overflow_steps
         duration = time.monotonic() - start
         t["duration"] += duration
+        if self._calib is not None:
+            # One observation per round: cumulative walk steps vs the
+            # round's wall window (cold first rounds include compile time;
+            # the K-consecutive drift guard absorbs that).
+            self._calib.observe(t["steps"], duration * 1e6, t["states"])
+        detail = build_detail(
+            {
+                "corpus": {
+                    "warm_start": True,
+                    "preloaded_states": self._warm_states,
+                    "warm_kind": self._warm_kind,
+                }
+            }
+            if self._warm_kind is not None
+            else None,
+            self.telemetry_summary(),
+        )
+        if self._calib is not None:
+            self._calib.finish()
+        if self._calib is not None and self._calib.chunks:
+            detail = dict(detail or {})
+            detail["calib"] = self._calib.detail()
+            self._calib.flush_records()
         return SearchResult(
             state_count=t["states"],
             unique_state_count=(
@@ -792,18 +841,7 @@ class DeviceSimulation:
             complete=False,  # simulation never proves exhaustion
             duration=duration,
             steps=t["steps"],
-            detail=build_detail(
-                {
-                    "corpus": {
-                        "warm_start": True,
-                        "preloaded_states": self._warm_states,
-                        "warm_kind": self._warm_kind,
-                    }
-                }
-                if self._warm_kind is not None
-                else None,
-                self.telemetry_summary(),
-            ),
+            detail=detail,
         )
 
     # -- observability ---------------------------------------------------------

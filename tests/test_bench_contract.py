@@ -654,3 +654,49 @@ def test_semantics_counters_exported_through_registry_schema():
         "trims", "canonical_entries",
     ):
         assert key in stats
+
+
+def test_device_detail_pins_calib_row_keys():
+    # The BENCH_CALIB=1 measured-vs-predicted A/B row (ISSUE 19): the
+    # drift digest and the comparator-off wall time / overhead must ride
+    # in the artifact so the within-noise acceptance is auditable, and
+    # the digest vocabulary is the obs schema's.
+    from stateright_tpu.obs.schema import DETAIL_KEYS, REGISTRY_SOURCES
+
+    for key in ("calib", "sec_off", "calib_overhead_pct"):
+        assert key in bench.DEVICE_DETAIL_FIELDS
+    assert "calib" in DETAIL_KEYS and "calib" in REGISTRY_SOURCES
+    row = bench.device_detail(
+        {
+            "states_per_sec": 1000.0,
+            "sec": 2.0,
+            "calib": {"drift_ratio": 1.02, "predicted_ms": 12.9},
+            "sec_off": 1.98,
+            "calib_overhead_pct": 1.0,
+        }
+    )
+    assert row["calib"]["drift_ratio"] == 1.02
+    assert row["calib_overhead_pct"] == 1.0
+
+
+def test_calib_comparator_conforms_to_obs_schema():
+    # A live comparator's metrics() is exactly the pinned counter set
+    # (the "calib" REGISTRY source) and its detail() exactly the pinned
+    # detail sub-dict — renames break this pin, not a dashboard later.
+    from stateright_tpu.obs.calib import CalibConfig, Comparator
+    from stateright_tpu.obs.schema import (
+        CALIB_COUNTER_KEYS,
+        CALIB_DETAIL_KEYS,
+        validate_detail,
+    )
+    from stateright_tpu.tensor.costmodel import V5E
+
+    cfg = CalibConfig(engine="resident", variant="split", lanes=8,
+                      max_actions=4, batch=256, table_log2=12)
+    comp = Comparator(cfg, device=V5E, chunk_steps=4)
+    comp.observe(4, 4000.0, generated_total=2048)
+    assert comp.chunks == 1
+    assert set(comp.metrics()) == set(CALIB_COUNTER_KEYS)
+    detail = comp.detail()
+    assert set(detail) == set(CALIB_DETAIL_KEYS)
+    assert validate_detail({"calib": detail}) == []

@@ -1,0 +1,362 @@
+"""Calibration observatory contracts (ISSUE 19, obs/calib.py): the
+measured-vs-predicted comparator, the drift detector, durable observation
+records, the least-squares fitter, the loadable costmodel overlay, and
+the Prometheus histogram helper. Fast tier: everything here is host
+arithmetic except one tiny frontier integration run."""
+
+import json
+import math
+import os
+
+import pytest
+
+from stateright_tpu.obs.calib import (
+    CALIB_MAGIC,
+    DRIFT_BAND,
+    CalibConfig,
+    Comparator,
+    THETA_FIELDS,
+    device_from_theta,
+    fit_theta,
+    holdout_eval,
+    load_observations,
+    overlay_dict,
+    theta_of,
+    write_observations,
+)
+from stateright_tpu.tensor import costmodel as cm
+
+
+V5E = cm.V5E
+ANCHOR = dict(lanes=21, max_actions=14, batch=3072, table_log2=22)
+
+
+# -- theta linearity (what makes the fitter a pure lstsq) ---------------------
+
+
+@pytest.mark.parametrize("variant,spill", [
+    ("split", None),
+    ("capped", {"summary_hashes": 4}),
+    ("pallas", None),
+])
+def test_step_cost_is_linear_in_theta(variant, spill):
+    # predicted total_ms == c0 + f . theta exactly, for features extracted
+    # at basis DeviceSpecs — the property the durable records rely on
+    # (rows store features, so the fitter never re-runs the costmodel).
+    cfg = CalibConfig(engine="resident", variant=variant, spill=bool(spill),
+                      **ANCHOR)
+    c0, feats = cfg.features(0.5)
+    direct = cfg.predict(V5E, 0.5).total_ms
+    recon = c0 + sum(f * t for f, t in zip(feats, theta_of(V5E)))
+    assert math.isclose(recon, direct, rel_tol=1e-9)
+
+
+def test_sim_step_cost_is_linear_in_theta():
+    for dedup in ("trace", "shared"):
+        cfg = CalibConfig(engine="simulation", variant="capped", lanes=21,
+                          max_actions=14, batch=4096, table_log2=22,
+                          sim=True, dedup=dedup)
+        c0, feats = cfg.features(0.5)
+        direct = cfg.predict(V5E, 0.5).total_ms
+        recon = c0 + sum(f * t for f, t in zip(feats, theta_of(V5E)))
+        assert math.isclose(recon, direct, rel_tol=1e-9)
+
+
+def test_device_from_theta_roundtrips():
+    spec = device_from_theta(V5E, theta_of(V5E))
+    for _name, field, _kind in THETA_FIELDS:
+        assert math.isclose(getattr(spec, field), getattr(V5E, field))
+
+
+# -- comparator: chunks, band, drift episodes ---------------------------------
+
+
+def _comparator(**kw):
+    cfg = CalibConfig(engine="resident", variant="split", lanes=8,
+                      max_actions=4, batch=256, table_log2=12)
+    kw.setdefault("device", V5E)
+    kw.setdefault("chunk_steps", 4)
+    return Comparator(cfg, **kw)
+
+
+def test_comparator_in_band_measurement_stays_quiet():
+    comp = _comparator()
+    pred = comp.config.predict(V5E, 0.5).total_ms
+    steps = 0
+    for _ in range(5):
+        steps += 4
+        comp.observe(steps, 4 * pred * 1000.0,
+                     generated_total=int(steps * 256 * 4 * 0.5))
+    assert comp.chunks == 5
+    assert comp.out_of_band == 0 and comp.drift_events == 0
+    assert abs(comp.drift_ratio() - 1.0) < 1e-6
+    d = comp.detail()
+    assert d["top_term"] in d["terms"]
+    assert abs(d["predicted_ms"] - pred) / pred < 0.2  # new_frac quantized
+
+
+def test_comparator_k_consecutive_chunks_arm_one_drift_episode(tmp_path):
+    events = []
+
+    class Rec:
+        def emit(self, kind, **fields):
+            events.append((kind, fields))
+
+    comp = _comparator(events=Rec(), k_consecutive=3)
+    pred = comp.config.predict(V5E, 0.5).total_ms
+    steps = 0
+    for i in range(6):  # 6 consecutive chunks at 10x predicted
+        steps += 4
+        comp.observe(steps, 4 * pred * 1000.0 * 10.0,
+                     generated_total=int(steps * 256 * 4 * 0.5))
+    assert comp.out_of_band == 6
+    assert comp.drift_events == 1  # one episode, not one event per chunk
+    assert len(events) == 1
+    kind, fields = events[0]
+    assert kind == "calib.drift"
+    assert fields["engine"] == "resident" and fields["term"]
+    assert fields["ratio"] > DRIFT_BAND[1]
+
+
+def test_comparator_single_outlier_chunk_does_not_trip():
+    comp = _comparator(k_consecutive=3)
+    pred = comp.config.predict(V5E, 0.5).total_ms
+    scales = [1.0, 10.0, 1.0, 10.0, 1.0, 10.0]  # never 3 consecutive
+    steps = 0
+    for s in scales:
+        steps += 4
+        comp.observe(steps, 4 * pred * 1000.0 * s,
+                     generated_total=int(steps * 256 * 4 * 0.5))
+    assert comp.out_of_band == 3 and comp.drift_events == 0
+
+
+def test_comparator_watermark_resets_on_engine_restart():
+    comp = _comparator()
+    pred = comp.config.predict(V5E, 0.5).total_ms
+    comp.observe(4, 4 * pred * 1000.0, generated_total=2048)
+    comp.observe(2, 2 * pred * 1000.0, generated_total=1024)  # steps shrank
+    comp.observe(4, 2 * pred * 1000.0, generated_total=2048)
+    comp.finish()
+    assert comp.chunks >= 2  # restart absorbed, no negative windows
+
+
+# -- durable records + fitter -------------------------------------------------
+
+
+def _record_corpus(tmp_path, scale=2.5):
+    """Three-geometry corpus with measurements at `scale` x predicted."""
+    root = str(tmp_path / "root")
+    for lanes, acts, batch, t in [
+        (21, 14, 3072, 22), (21, 14, 1024, 20), (12, 6, 2048, 18),
+    ]:
+        cfg = CalibConfig(engine="resident", variant="split", lanes=lanes,
+                          max_actions=acts, batch=batch, table_log2=t)
+        comp = Comparator(cfg, device=V5E, record_root=root, chunk_steps=4)
+        steps = 0
+        for _ in range(6):
+            pred = cfg.predict(V5E, 0.5).total_ms
+            steps += 4
+            comp.observe(steps, 4 * pred * 1000.0 * scale,
+                         generated_total=int(steps * batch * acts * 0.5))
+        comp.finish()
+        assert comp.flush_records() > 0
+    return root
+
+
+def test_records_roundtrip_through_ckptio_seam(tmp_path):
+    root = _record_corpus(tmp_path)
+    recs = load_observations(root)
+    assert len(recs) == 3
+    for rec in recs:
+        assert rec["device"] == V5E.name
+        assert rec["engine"] == "resident"
+        assert all(len(r["f"]) == len(THETA_FIELDS) for r in rec["rows"])
+
+
+def test_corrupt_record_is_skipped_not_fatal(tmp_path):
+    root = _record_corpus(tmp_path)
+    calib_dir = os.path.join(root, "calib")
+    victim = sorted(os.listdir(calib_dir))[0]
+    path = os.path.join(calib_dir, victim)
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF  # flip one payload byte under the CRC
+    open(path, "wb").write(bytes(blob))
+    recs = load_observations(root)
+    assert len(recs) == 2  # corrupt one dropped, others intact
+
+
+def test_write_observations_caps_merged_rows(tmp_path):
+    root = str(tmp_path)
+    rows = [{"ms": 1.0, "steps": 4, "new_frac": 0.5, "c0": 0.0,
+             "f": [0.0] * len(THETA_FIELDS), "ratio": 1.0}] * 40
+    n1 = write_observations(root, "k", rows, max_rows=64)
+    n2 = write_observations(root, "k", rows, max_rows=64)
+    assert n1 == 40 and n2 == 64  # merge-on-write, bounded
+
+
+def test_fitter_recovers_injected_drift_2x_on_holdout(tmp_path):
+    # The acceptance criterion's shape: measurements generated at 2.5x the
+    # stock prediction; the fit must cut median |drift-1| >= 2x vs stock
+    # on EVERY leave-one-key-out holdout.
+    root = _record_corpus(tmp_path, scale=2.5)
+    recs = load_observations(root)
+    theta, report = fit_theta(recs, V5E)
+    assert report["median_abs_drift_fitted"] * 2 <= (
+        report["median_abs_drift_stock"]
+    )
+    holdout = holdout_eval(recs, V5E)
+    assert len(holdout) == 3
+    for h in holdout.values():
+        assert h["fitted"] * 2 <= h["stock"]
+
+
+def test_fit_theta_keeps_unexcited_terms_at_committed_values(tmp_path):
+    # No spill runs in the corpus -> the pcie term has zero feature mass;
+    # the ridge prior must hold it at the committed value instead of
+    # letting lstsq pick min-norm garbage.
+    root = _record_corpus(tmp_path)
+    theta, _ = fit_theta(load_observations(root), V5E)
+    spec = device_from_theta(V5E, theta)
+    assert math.isclose(spec.pcie_gbps, V5E.pcie_gbps, rel_tol=1e-6)
+
+
+# -- overlay: loadable, never a mutation --------------------------------------
+
+
+def test_overlay_loads_and_stock_anchor_is_untouched(tmp_path, monkeypatch):
+    root = _record_corpus(tmp_path, scale=2.0)
+    theta, report = fit_theta(load_observations(root), V5E)
+    overlay = overlay_dict(V5E, theta, report)
+    path = tmp_path / "overlay.json"
+    path.write_text(json.dumps(overlay))
+    monkeypatch.setenv(cm.CALIB_ENV, str(path))
+    loaded = cm.load_calibration()
+    assert loaded is not None and loaded.name == V5E.name
+    assert not math.isclose(loaded.gbps_sort, V5E.gbps_sort, rel_tol=1e-3)
+    # The committed r4 anchor pin NEVER moves: the overlay is a separate
+    # DeviceSpec, the module constants stay byte-identical.
+    sc = cm.step_cost(**ANCHOR, variant="split", append="dus")
+    assert abs(sc.total_ms - 12.9) / 12.9 < 0.01
+    assert V5E.gbps_sort == 8.0 and cm.CPU1.gbps_sort == 0.8
+
+
+def test_load_calibration_rejects_garbage(tmp_path, monkeypatch):
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    monkeypatch.setenv(cm.CALIB_ENV, str(bad))
+    assert cm.load_calibration() is None
+    monkeypatch.setenv(cm.CALIB_ENV, str(tmp_path / "missing.json"))
+    assert cm.load_calibration() is None
+
+
+# -- registry histogram + timeline report -------------------------------------
+
+
+def test_log_histogram_renders_prometheus_triplet():
+    from stateright_tpu.obs.registry import LogHistogram
+
+    h = LogHistogram()
+    for v in (0.3, 5.0, 5.0, 900.0, 1e9):  # 1e9 -> +Inf bucket
+        h.observe(v)
+    lines = h.render("sr_adm_wait_ms")
+    assert lines[0] == "# TYPE sr_adm_wait_ms histogram"
+    assert any('le="+Inf"} 5' in ln for ln in lines)
+    assert lines[-1] == "sr_adm_wait_ms_count 5"
+    # cumulative buckets are monotone
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in lines
+              if "_bucket" in ln]
+    assert counts == sorted(counts)
+
+
+def test_registry_renders_histogram_sources():
+    from stateright_tpu.obs.registry import (
+        CounterRegistry,
+        LogHistogram,
+        render_prometheus,
+    )
+
+    reg = CounterRegistry()
+    h = LogHistogram()
+    h.observe(3.0)
+    provider = lambda: {"wait_ms": h, "jobs": 2}  # noqa: E731
+    reg.register("svc", provider)
+    text = render_prometheus(reg.collect())
+    assert "stateright_svc_wait_ms_bucket" in text
+    assert "stateright_svc_wait_ms_sum" in text
+    assert "stateright_svc_jobs 2" in text
+
+
+def test_timeline_drift_report_names_engine_term_jobs(tmp_path, capsys):
+    from stateright_tpu.obs import timeline
+
+    journal = tmp_path / "j.jsonl"
+    evs = [
+        {"event": "job.submitted", "trace": "t1", "ts": 1.0, "job": 1,
+         "writer": "svc"},
+        {"event": "replica.admit", "trace": "t1", "ts": 1.1, "job": 1,
+         "writer": "svc"},
+        {"event": "calib.drift", "ts": 1.5, "engine": "service",
+         "term": "insert_gather", "ratio": 3.2, "device": "cpu-1core",
+         "jobs": ["t1"], "writer": "svc"},
+        {"event": "job.done", "trace": "t1", "ts": 2.0, "job": 1,
+         "writer": "svc"},
+    ]
+    journal.write_text("\n".join(json.dumps(e) for e in evs) + "\n")
+    rc = timeline.main([str(journal), "--json"])
+    assert rc == 0  # drift is NOT an anomaly
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["drift"] == [{
+        "ts": 1.5, "engine": "service", "term": "insert_gather",
+        "ratio": 3.2, "device": "cpu-1core", "trace": None,
+        "jobs": ["t1"], "writer": "svc",
+    }]
+    rc = timeline.main([str(journal)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "engine service term insert_gather" in out
+    assert "jobs t1" in out
+
+
+def test_reporter_checking_line_carries_drift_done_line_unchanged():
+    import io
+
+    from stateright_tpu.core.report import ReportData, WriteReporter
+
+    buf = io.StringIO()
+    rep = WriteReporter(buf)
+    rep.report_checking(ReportData(10, 5, 2, 0.5, done=False, drift=1.23))
+    rep.report_checking(ReportData(10, 5, 2, 0.5, done=True))
+    lines = buf.getvalue().splitlines()
+    assert lines[0].endswith("drift=1.23")
+    assert lines[1] == "Done. states=10, unique=5, depth=2, sec=0.5"
+
+
+# -- engine integration (one tiny run) ----------------------------------------
+
+
+def test_frontier_run_populates_calib_detail(monkeypatch, tmp_path):
+    from stateright_tpu.obs.schema import validate_detail
+    from stateright_tpu.tensor.frontier import FrontierSearch
+    from stateright_tpu.tensor.models import TensorTwoPhaseSys
+
+    monkeypatch.setenv("SR_TPU_CALIB_DIR", str(tmp_path / "rec"))
+    search = FrontierSearch(TensorTwoPhaseSys(2), batch_size=64,
+                            table_log2=10, telemetry=True)
+    result = search.run()
+    calib = (result.detail or {}).get("calib")
+    assert calib is not None and calib["chunks"] >= 1
+    assert calib["engine"] == "frontier"
+    assert validate_detail(result.detail) == []
+    assert load_observations(str(tmp_path / "rec"))  # records flushed
+
+
+def test_calib_kill_switch_disables_comparator(monkeypatch):
+    from stateright_tpu.tensor.frontier import FrontierSearch
+    from stateright_tpu.tensor.models import TensorTwoPhaseSys
+
+    monkeypatch.setenv("SR_TPU_CALIB", "0")
+    search = FrontierSearch(TensorTwoPhaseSys(2), batch_size=64,
+                            table_log2=10, telemetry=True)
+    assert search._calib is None
+    result = search.run()
+    assert "calib" not in (result.detail or {})
