@@ -1065,15 +1065,18 @@ def device_search_autoscale(max_replicas: int = 3):
 
 
 def device_search_blob(n_replicas: int = 2):
-    """BENCH_BLOB=1 row: local-vs-blob checkpoint-backend overhead A/B
-    (ISSUE 15). The SAME mixed job set runs through an N-replica in-proc
-    fleet twice — requeue-resume checkpoint plane + lease fence on a
-    local directory, then on the in-proc blob emulator
-    (faults/blobstore.py: HTTP conditional puts, bounded retry, CRC'd
-    generations) — and the measured overhead lands next to the blob
-    client's own op/retry counters. Parity = every blob-side job's counts
-    and discoveries equal its local twin's (the backend must be
-    bit-identical, only slower by the wire)."""
+    """BENCH_BLOB=1 row: local-vs-wire checkpoint-backend overhead A/B
+    (ISSUE 15, managed dialects ISSUE 20). The SAME mixed job set runs
+    through an N-replica in-proc fleet once per backend —
+    requeue-resume checkpoint plane + lease fence on a local directory,
+    then on the in-proc blob emulator (faults/blobstore.py: HTTP
+    conditional puts, bounded retry, CRC'd generations), then on the
+    s3 and gcs managed-dialect emulators (faults/blobdialect.py:
+    SigV4 / OAuth-bearer signing plus the credential chain per op) —
+    and each measured overhead lands next to that backend client's own
+    op/retry counters. Parity = every wire-side job's counts and
+    discoveries equal its local twin's (the backend must be
+    bit-identical, only slower by the wire + signing)."""
     _pin_platform()
     from stateright_tpu.faults.blobstore import serve_blobd, uri_client
     from stateright_tpu.service import ServiceFleet
@@ -1101,30 +1104,56 @@ def device_search_blob(n_replicas: int = 2):
         fleet.close()
         return sec, results
 
-    run({})  # untimed warm-up: compiles land here, not in either side
+    def run_wire(dialect):
+        """One timed leg on an in-proc wire backend: the native blob
+        emulator or an s3/gcs managed dialect (whose endpoint +
+        credential environment is installed for the leg's duration —
+        the fleet is in-proc, so os.environ is the live config)."""
+        srv = serve_blobd(dialect=dialect)
+        saved = {k: os.environ.get(k) for k in srv.env}
+        os.environ.update(srv.env)
+        root = srv.root_uri + "/bench"
+        try:
+            sec, results = run(
+                {"ckpt_dir": root + "/ckpt", "lease_dir": root + "/leases"}
+            )
+            client, _name = uri_client(root)
+            counters = dict(client.counters)
+        finally:
+            for key, old in saved.items():
+                if old is None:
+                    os.environ.pop(key, None)
+                else:
+                    os.environ[key] = old
+            srv.shutdown()
+        return sec, results, counters
+
+    run({})  # untimed warm-up: compiles land here, not in any timed leg
     sec_local, local_results = run({})
-    srv = serve_blobd()
-    root = srv.root_uri + "/bench"
-    try:
-        sec_blob, blob_results = run(
-            {"ckpt_dir": root + "/ckpt", "lease_dir": root + "/leases"}
-        )
-        client, _name = uri_client(root)
-        blob_counters = dict(client.counters)
-    finally:
-        srv.shutdown()
+    sec_blob, blob_results, blob_counters = run_wire("blob")
+    sec_s3, s3_results, s3_counters = run_wire("s3")
+    sec_gcs, gcs_results, gcs_counters = run_wire("gcs")
 
     err = None
-    for i, (r, s) in enumerate(zip(blob_results, local_results)):
-        got = (r.state_count, r.unique_state_count, r.max_depth)
-        want = (s.state_count, s.unique_state_count, s.max_depth)
-        if got != want or sorted(r.discoveries.items()) != sorted(
-            s.discoveries.items()
-        ):
-            err = (
-                f"blob-backend parity failure on job {i}: {got} != {want}"
-            )
+    for leg, results in (
+        ("blob", blob_results), ("s3", s3_results), ("gcs", gcs_results)
+    ):
+        for i, (r, s) in enumerate(zip(results, local_results)):
+            got = (r.state_count, r.unique_state_count, r.max_depth)
+            want = (s.state_count, s.unique_state_count, s.max_depth)
+            if got != want or sorted(r.discoveries.items()) != sorted(
+                s.discoveries.items()
+            ):
+                err = (
+                    f"{leg}-backend parity failure on job {i}: "
+                    f"{got} != {want}"
+                )
+                break
+        if err is not None:
             break
+
+    def overhead_pct(sec):
+        return round((sec - sec_local) / max(sec_local, 1e-9) * 100.0, 2)
 
     states = sum(r.state_count for r in blob_results)
     out = {
@@ -1136,11 +1165,17 @@ def device_search_blob(n_replicas: int = 2):
         "n_replicas": n_replicas,
         "n_jobs": len(jobs),
         "sec_local_fs": round(sec_local, 4),
-        "blob_overhead_pct": round(
-            (sec_blob - sec_local) / max(sec_local, 1e-9) * 100.0, 2
-        ),
+        "blob_overhead_pct": overhead_pct(sec_blob),
         "blob_ops": int(blob_counters.get("ops", 0)),
         "blob_retries": int(blob_counters.get("retries", 0)),
+        "sec_s3": round(sec_s3, 4),
+        "s3_overhead_pct": overhead_pct(sec_s3),
+        "s3_ops": int(s3_counters.get("ops", 0)),
+        "s3_retries": int(s3_counters.get("retries", 0)),
+        "sec_gcs": round(sec_gcs, 4),
+        "gcs_overhead_pct": overhead_pct(sec_gcs),
+        "gcs_ops": int(gcs_counters.get("ops", 0)),
+        "gcs_retries": int(gcs_counters.get("retries", 0)),
     }
     return out, err
 
@@ -1827,7 +1862,12 @@ DEVICE_DETAIL_FIELDS = (
     # wall time next to the blob-emulator run's (`sec`), the measured
     # overhead percentage, and the blob client's op/retry counters —
     # the "object store costs only the wire, never the answers" claim.
+    # Managed-dialect legs (s3 = SigV4-signed dialect emulator, gcs =
+    # OAuth-bearer dialect emulator) carry the same trio each: signed
+    # wall time, overhead vs sec_local_fs, and that client's counters.
     "sec_local_fs", "blob_overhead_pct", "blob_ops", "blob_retries",
+    "sec_s3", "s3_overhead_pct", "s3_ops", "s3_retries",
+    "sec_gcs", "gcs_overhead_pct", "gcs_ops", "gcs_retries",
     # Warm-start corpus (BENCH_CORPUS=1 row): the cold wall time next to
     # the warm submission's (`sec`), the cold/warm ratio (acceptance >=
     # 5x), the preloaded-state count, and the corrupted-entry CRC verdict

@@ -1,28 +1,37 @@
 #!/usr/bin/env python
 """blobd: the standalone HTTP object-store emulator.
 
-    python scripts/blobd.py [--address 0.0.0.0:3700]
+    python scripts/blobd.py [--address 0.0.0.0:3700] [--dialect blob|s3|gcs]
 
-Serves the conditional-put/generation-token blob protocol from
-`stateright_tpu/faults/blobstore.py` (PUT /b/<name> with If-None-Match /
-If-Match and server-side `.prev` rotation, GET /b/<name>, DELETE,
-GET /list?prefix=, GET /healthz). Point a fleet at it with
+The default ``blob`` dialect serves the conditional-put/generation-token
+blob protocol from `stateright_tpu/faults/blobstore.py` (PUT /b/<name>
+with If-None-Match / If-Match and server-side `.prev` rotation,
+GET /b/<name>, DELETE, GET /list?prefix=, GET /healthz). Point a fleet
+at it with
 
     ServiceFleet(remote=True, store_root="blob://host:3700/myfleet")
 
 or any `*_dir` knob spelled as a ``blob://`` URI — checkpoint
 generations, lease records, corpus entries, member-discovery records,
 and flush-synced journals then all live here, and the URI is the only
-configuration the fleet's processes share. Storage is in-memory: an
-emulator for development, CI, and chaos runs — the S3/GCS shape without
-the credentials (the managed-store backend is the ROADMAP residue).
+configuration the fleet's processes share.
 
+``--dialect s3`` / ``--dialect gcs`` serve the provider-conformance
+dialects instead (`stateright_tpu/faults/blobdialect.py`): SigV4 /
+OAuth-bearer auth verification, provider error XML/JSON shapes,
+conditional-write preconditions, and a credential plane (IMDSv2 /
+GCE metadata + token grant). The process prints the environment to
+export so ``s3://bucket/...`` or ``gs://bucket/...`` roots resolve
+to it.
+
+Storage is in-memory: an emulator for development, CI, and chaos runs.
 Stdlib-only (no jax import): runs anywhere.
 """
 
 import argparse
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -31,12 +40,24 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--address", default="localhost:3700",
                     help="host:port to bind (default localhost:3700)")
+    ap.add_argument("--dialect", choices=("blob", "s3", "gcs"),
+                    default="blob",
+                    help="wire protocol: native blob (default), or the "
+                         "s3/gcs provider-conformance dialects")
     args = ap.parse_args(argv)
 
     from stateright_tpu.faults.blobstore import serve_blobd
 
-    print(f"blobd serving blob://{args.address}", flush=True)
-    serve_blobd(args.address, block=True)
+    handle = serve_blobd(args.address, block=False, dialect=args.dialect)
+    print(f"blobd[{handle.dialect}] serving {handle.root_uri} "
+          f"on {handle.address}", flush=True)
+    for key, val in sorted(handle.env.items()):
+        print(f"  export {key}={val}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        handle.shutdown()
     return 0
 
 

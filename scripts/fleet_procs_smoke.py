@@ -35,13 +35,21 @@ Backends:
   (`faults/blobstore.py`): checkpoint generations, lease records, and
   member-discovery records live behind HTTP conditional puts; journals
   are local-write and blob-synced at flush boundaries, and the timeline
-  CLI reads them back FROM THE BLOB ROOT (`blob://...` argument).
+  CLI reads them back FROM THE BLOB ROOT (`blob://...` argument);
+- **s3** / **gs** — the managed-dialect emulators
+  (`faults/blobdialect.py`): the same store surfaces behind SigV4 /
+  OAuth-bearer authenticated conditional writes, with the credential
+  chain resolving against the emulator's metadata/token plane through
+  environment the replica subprocesses inherit.
+
+`--backend both` runs (file, blob) — the historical default; `--backend
+all` adds the two managed dialects.
 
 In every phase all jobs complete with counts bit-identical to the
 single-replica goldens and the merged journals reconstruct to ZERO
 anomalies through the timeline CLI (run as a real subprocess).
 
-    JAX_PLATFORMS=cpu python scripts/fleet_procs_smoke.py [--backend file|blob|both]
+    JAX_PLATFORMS=cpu python scripts/fleet_procs_smoke.py [--backend file|blob|s3|gs|both|all]
 
 Exit 0 = fenced, recovered, reconstructed. Anything else is a regression.
 """
@@ -143,29 +151,43 @@ def run_timeline(journal_root):
 
 class _Roots:
     """Per-backend store-root factory: fresh local tempdirs, or fresh
-    prefixes on one in-proc blobd emulator."""
+    prefixes on one in-proc emulator (native blobd, or an s3/gs
+    dialect server whose endpoint + credential-plane environment is
+    installed into os.environ so the replica subprocesses — which
+    inherit it — resolve and sign against the same emulator)."""
 
     def __init__(self, backend):
         self.backend = backend
         self._srv = None
+        self._env_saved = None
         self._n = 0
-        if backend == "blob":
+        if backend != "file":
             from stateright_tpu.faults.blobstore import serve_blobd
 
-            self._srv = serve_blobd()
+            self._srv = serve_blobd(dialect=backend)
+            env = self._srv.env
+            if env:
+                self._env_saved = {k: os.environ.get(k) for k in env}
+                os.environ.update(env)
 
     def fresh(self, tag):
         self._n += 1
-        if self.backend == "blob":
-            return f"{self._srv.root_uri}/{tag}{self._n}"
-        return tempfile.mkdtemp(prefix=f"srtpu-procs-{tag}-")
+        if self.backend == "file":
+            return tempfile.mkdtemp(prefix=f"srtpu-procs-{tag}-")
+        return f"{self._srv.root_uri}/{tag}{self._n}"
 
     def journal_root(self, root):
-        return root + "/journal" if self.backend == "blob" else os.path.join(
-            root, "journal"
-        )
+        if self.backend == "file":
+            return os.path.join(root, "journal")
+        return root + "/journal"
 
     def close(self):
+        if self._env_saved:
+            for key, old in self._env_saved.items():
+                if old is None:
+                    os.environ.pop(key, None)
+                else:
+                    os.environ[key] = old
         if self._srv is not None:
             self._srv.shutdown()
 
@@ -218,8 +240,9 @@ def run_matrix(backend) -> None:
         plan = FaultPlan().rule(
             "fleet.partition", "io", times=-1, match={"replica": victim.idx}
         )
-        if backend == "blob":
-            # Blob-backend chaos rides along: throttle some puts (429 ->
+        if backend != "file":
+            # Wire-backend chaos rides along (blob, s3, and gs all route
+            # through the same blob.* points): throttle some puts (429 ->
             # bounded retry) and tear one (CRC-rejected, .prev serves) —
             # outcomes must stay bit-identical and counted.
             plan.rule("blob.put", "http", times=2)
@@ -312,13 +335,16 @@ def run_matrix(backend) -> None:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--backend", choices=("file", "blob", "both"),
-                    default="both")
-    args = ap.parse_args(argv)
-    backends = (
-        ("file", "blob") if args.backend == "both" else (args.backend,)
+    ap.add_argument(
+        "--backend",
+        choices=("file", "blob", "s3", "gs", "both", "all"),
+        default="both",
+        help="store backend(s); both=(file,blob) is the historical "
+             "default, all adds the s3/gs managed-dialect emulators",
     )
-    for backend in backends:
+    args = ap.parse_args(argv)
+    matrix = {"both": ("file", "blob"), "all": ("file", "blob", "s3", "gs")}
+    for backend in matrix.get(args.backend, (args.backend,)):
         run_matrix(backend)
     print("FLEET PROCS SMOKE PASSED")
     return 0

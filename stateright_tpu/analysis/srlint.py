@@ -119,11 +119,19 @@ FAULT_SCOPE = (
     "stateright_tpu.parallel.sharded",
     "stateright_tpu.store",
     "stateright_tpu.service",
-    # The blob-store backend's failure surfaces (retry exhaustion, HTTP
-    # translation) must sit on the chaos plane like every other store's.
+    # The blob-store backends' failure surfaces (retry exhaustion, HTTP
+    # translation) must sit on the chaos plane like every other store's —
+    # the prefix match covers blobstore_s3/blobstore_gcs too.
     "stateright_tpu.faults.blobstore",
+    # The managed-store credential chain: a chain-exhausted resolve is a
+    # failure surface exactly like retry exhaustion (creds.refresh is its
+    # chaos point).
+    "stateright_tpu.faults.creds",
 )
-FAULT_EXC_NAMES = {"RuntimeError", "OSError", "IOError", "BlobUnavailable"}
+FAULT_EXC_NAMES = {
+    "RuntimeError", "OSError", "IOError", "BlobUnavailable",
+    "CredentialError",
+}
 
 #: knob parameter/variable names -> registry attribute (knobs.py).
 KNOB_UNIVERSES = {
@@ -137,6 +145,12 @@ KNOB_UNIVERSES = {
     # put_along_axis(mode="drop")), so literal-linting it drowns in false
     # positives; the builder validates against the registry tuple instead.
     "dedup": "SIM_DEDUP_KINDS",
+    # Blob-store backend selectors: the smoke's `--backend`, the URI
+    # dispatcher's return, the bench per-backend legs. A literal outside
+    # ("file", "blob", "s3", "gs") — e.g. a scheme string compared
+    # against `backend` — is exactly the drift the r24 dispatcher
+    # generalization must bound.
+    "backend": "BLOB_BACKENDS",
 }
 
 

@@ -1,5 +1,5 @@
 """Pluggable blob-store backend for the checkpoint plane (ROADMAP item 1:
-the true multi-host residue).
+the true multi-host residue; item 3 added the managed-store backends).
 
 Everything durable the fleet shares — checkpoint generations, lease
 records, corpus entries, member-discovery records, synced journals — is
@@ -7,7 +7,8 @@ bytes-at-a-name with one-generation history. On one machine that name is a
 filesystem path and the discipline is tmp+fsync+rename (faults/ckptio.py);
 across machines it is an OBJECT STORE, where the failure modes are
 throttling (429/5xx), latency, partial writes, and stale listings rather
-than torn renames. This module gives the repo ONE backend seam for both:
+than torn renames. This module gives the repo ONE backend seam for all of
+them:
 
 - `LocalFSBlobStore` — today's on-disk layout, bit-identical: files under
   a root directory, `put` staged through a pid-unique tmp + fsync +
@@ -19,25 +20,44 @@ than torn renames. This module gives the repo ONE backend seam for both:
   previous payload to ``<name>.prev`` atomically on PUT — the same
   two-generation contract as the filesystem, so `ckptio.load_latest`'s
   current-then-`.prev` walk is backend-agnostic.
+- `faults/blobstore_s3.py` / `faults/blobstore_gcs.py` — the MANAGED
+  providers behind the same seam: pure-stdlib SigV4 / OAuth2-bearer
+  signing over `faults/creds.py`'s credential chain, provider-native
+  conditional writes (S3 ``If-None-Match: *`` + ETag compare, GCS
+  ``x-goog-if-generation-match``), and the ``.prev`` rotation re-derived
+  per provider (server-side COPY). Loaded lazily — importing this module
+  never costs the managed plumbing.
 
-Backends are chosen by ROOT URI: a plain path or ``file://...`` is the
-filesystem; ``blob://host:port[/prefix]`` is the HTTP store. `faults/
-ckptio.py` (`fenced_savez`/`fenced_load_latest`), `service/lease.py`, and
+Backends are chosen by ROOT URI (`backend_of`, the `knobs.BLOB_BACKENDS`
+universe): a plain path or ``file://...`` is the filesystem;
+``blob://host:port[/prefix]`` is the HTTP emulator store;
+``s3://bucket[/prefix]`` and ``gs://bucket[/prefix]`` are the managed
+providers (endpoint overrides via ``SR_TPU_S3_ENDPOINT`` /
+``SR_TPU_GCS_ENDPOINT`` point them at the dialect conformance emulators
+in `faults/blobdialect.py`). `faults/ckptio.py`
+(`fenced_savez`/`fenced_load_latest`), `service/lease.py`, and
 `store/corpus.py` all route through here when handed a blob URI, so one
 shared root URI is the fleet's whole storage configuration.
 
-**Chaos + retry discipline**: every HTTP op is a chaos boundary
+**Chaos + retry discipline**: every wire op is a chaos boundary
 (``blob.put`` / ``blob.get`` / ``blob.list`` / ``blob.delete`` in
 faults/plan.py) and runs
 under bounded retry with the supervisor's seeded deterministic backoff and
 a per-op deadline. Injected 429/5xx/transport faults are retried and
-counted; a ``torn`` PUT truncates the uploaded payload (CRC-rejected at
-read, ``.prev`` serves — the r13 torn-generation story over the network);
-a ``stale`` LIST serves the previous listing (consumers degrade to a
-bigger directory, never a wrong result); ``slow`` injects latency. Retry
-exhaustion raises `BlobUnavailable` (an OSError), which every caller
-already degrades on: resume-fresh, cold corpus run, counted publish fault.
-Counters are exported through the obs REGISTRY "blob" source.
+counted; a server-supplied ``Retry-After``/``retry-after-ms`` hint is a
+FLOOR under the deterministic backoff (the provider knows its own
+throttle window; ignoring it converts one 503 into five); a ``torn`` PUT
+truncates the uploaded payload (CRC-rejected at read, ``.prev`` serves —
+the r13 torn-generation story over the network); a ``stale`` LIST serves
+the previous listing (consumers degrade to a bigger directory, never a
+wrong result); ``slow`` injects latency; an auth reject (401/403) on a
+managed backend invalidates the credential chain and retries under the
+same bounded budget (`creds.refresh` is its own counted chaos point).
+Retry exhaustion raises `BlobUnavailable` (an OSError), which every
+caller already degrades on: resume-fresh, cold corpus run, counted
+publish fault. Counters are exported through the obs REGISTRY — source
+"blob" for the emulator client, "blob_s3"/"blob_gcs" for the managed
+clients, "creds" for the chains.
 
 The ONE sanctioned write path into a blob store is `faults/ckptio.py`
 (`fenced_savez` / `write_record`) — srlint SR002 flags a bare ``put``
@@ -58,6 +78,7 @@ import urllib.request
 from collections import namedtuple
 from typing import Optional
 
+from ..knobs import BLOB_BACKENDS
 from .plan import (
     FaultError,
     active_plan,
@@ -66,14 +87,17 @@ from .plan import (
 )
 
 __all__ = [
+    "BLOB_BACKENDS",
     "BlobStat",
     "BlobUnavailable",
     "HTTPBlobStore",
     "LocalFSBlobStore",
+    "backend_of",
     "blob_backend",
     "is_blob_uri",
     "normalize_root",
     "serve_blobd",
+    "split_bucket_uri",
 ]
 
 #: One listing row, backend-agnostic: `name` is relative to the store's
@@ -96,18 +120,37 @@ class _Conflict(RuntimeError):
 #: HTTP statuses worth retrying (throttling + transient server failures).
 RETRYABLE_HTTP = (429, 500, 502, 503, 504)
 
+#: Auth rejects: retryable ONLY through the credential-chain invalidate
+#: hook (`_RetryingClient._auth_retry`) — a wrong signature re-signed
+#: with the same creds stays wrong, so the base client treats them as
+#: terminal and the managed clients re-resolve first.
+AUTH_HTTP = (401, 403)
+
 #: Injected-latency sleep for a consumed ``slow`` fault, seconds.
 SLOW_S = 0.05
 
 
+def backend_of(path) -> str:
+    """Which `knobs.BLOB_BACKENDS` member a root/URI selects — the ONE
+    scheme dispatch (``blob://``/``s3://``/``gs://``; anything else,
+    including ``file://``, is the filesystem)."""
+    if isinstance(path, str):
+        for backend in BLOB_BACKENDS[1:]:
+            if path.startswith(backend + "://"):
+                return backend
+    return BLOB_BACKENDS[0]
+
+
 def is_blob_uri(path) -> bool:
-    return isinstance(path, str) and path.startswith("blob://")
+    """True when `path` names a WIRE store (anything but the local
+    filesystem) — the predicate every consumer branches on."""
+    return backend_of(path) != BLOB_BACKENDS[0]
 
 
 def normalize_root(root: Optional[str]) -> Optional[str]:
     """Strip a ``file://`` scheme down to the plain path it names (so
-    everything downstream sees either a filesystem path or a ``blob://``
-    URI — the only two spellings the backend seam dispatches on)."""
+    everything downstream sees either a filesystem path or a wire-store
+    URI — the only spellings the backend seam dispatches on)."""
     if isinstance(root, str) and root.startswith("file://"):
         return root[len("file://"):] or "/"
     return root
@@ -122,19 +165,60 @@ def split_blob_uri(uri: str) -> tuple:
     return f"http://{host}", ("/" + name if slash else "/")
 
 
-# -- the HTTP client (absolute names, shared per server) -----------------------
+def split_bucket_uri(uri: str) -> tuple:
+    """``s3://bucket/some/name`` -> ("s3", "bucket", "/some/name") — the
+    managed-provider URI grammar (same name convention as
+    `split_blob_uri`: absolute, leading slash)."""
+    scheme, sep, rest = uri.partition("://")
+    if not sep:
+        raise ValueError(f"object URI {uri!r} has no scheme")
+    bucket, slash, name = rest.partition("/")
+    if not bucket:
+        raise ValueError(f"object URI {uri!r} has no bucket")
+    return scheme, bucket, ("/" + name if slash else "/")
 
 
-class _BlobClient:
-    """One server's client: retry/backoff/chaos wrapper over the four
-    verbs, counters exported through the obs REGISTRY "blob" source.
-    Cached per base URL (`_client`) so every URI op against one server
-    shares one counter set and one stale-list cache."""
+def _retry_after_s(err) -> float:
+    """The server's retry hint in seconds (0.0 = none): ``retry-after-ms``
+    (the router/HTTP doors' spelling) wins over RFC ``Retry-After``."""
+    headers = getattr(err, "headers", None)
+    if headers is None:
+        return 0.0
+    ms = headers.get("retry-after-ms")
+    if ms:
+        try:
+            return max(float(ms) / 1000.0, 0.0)
+        except ValueError:
+            pass
+    ra = headers.get("Retry-After")
+    if ra:
+        try:
+            return max(float(ra), 0.0)
+        except ValueError:
+            pass
+    return 0.0
+
+
+# -- the retrying wire client (shared by emulator + managed backends) ----------
+
+
+class _RetryingClient:
+    """The backend-agnostic half of every wire client: the chaos points,
+    the bounded deterministic-backoff retry with the server's Retry-After
+    hint as a floor, the torn/stale/slow special handling, the
+    auth-invalidate hook, and the counter set — subclasses implement only
+    the five raw `_do_*` verbs (one provider round trip each, raising
+    `urllib.error.HTTPError` for status failures). Cached per store
+    identity (`_cached_client`) so every URI op against one server shares
+    one counter set and one stale-list cache."""
 
     retry_limit = 4
     op_deadline_s = 30.0
     backoff_base_s = 0.02
     backoff_cap_s = 0.5
+
+    #: obs REGISTRY source the counters export under.
+    metrics_source = "blob"
 
     def __init__(self, base_url: str):
         self.base_url = base_url.rstrip("/")
@@ -149,10 +233,14 @@ class _BlobClient:
             "stale_lists": 0,
             "slow_ops": 0,
             "unavailable": 0,
+            "retry_after_waits": 0,
+            "auth_retries": 0,
         }
         from ..obs import REGISTRY
 
-        self._metrics_name = REGISTRY.register("blob", self.metrics)
+        self._metrics_name = REGISTRY.register(
+            self.metrics_source, self.metrics
+        )
 
     def metrics(self) -> dict:
         with self._lock:
@@ -163,6 +251,12 @@ class _BlobClient:
             self.counters[key] += n
 
     # -- retry/chaos wrapper ---------------------------------------------------
+
+    def _auth_retry(self, err) -> bool:
+        """Hook for a 401/403: return True to treat the reject as
+        retryable (after invalidating whatever credential produced it).
+        The base client has no credentials, so a reject is terminal."""
+        return False
 
     def _op(
         self,
@@ -176,7 +270,11 @@ class _BlobClient:
         deterministic-backoff retry + per-op deadline. 404s and
         conditional-put conflicts pass straight through (they are answers,
         not failures); everything transport-shaped is retried until the
-        budget runs out, then surfaced as `BlobUnavailable`.
+        budget runs out, then surfaced as `BlobUnavailable`. A throttle
+        response carrying ``Retry-After``/``retry-after-ms`` floors the
+        next backoff (counted ``retry_after_waits``); a 401/403 retries
+        only when `_auth_retry` invalidated a credential chain (counted
+        ``auth_retries``).
 
         `chaos=False` skips the injection point (real transport failures
         are still retried): reserved for ops the chaos plane itself can
@@ -196,6 +294,7 @@ class _BlobClient:
         )
         attempt = 0
         last: Optional[BaseException] = None
+        floor_s = 0.0
         while True:
             try:
                 if chaos:
@@ -210,10 +309,18 @@ class _BlobClient:
                     ) from e
                 if e.code == 412:
                     raise _Conflict(str(e)) from e
-                if e.code not in RETRYABLE_HTTP:
+                if e.code in AUTH_HTTP:
+                    if not self._auth_retry(e):
+                        raise BlobUnavailable(
+                            f"blob op {point} rejected with HTTP {e.code}"
+                        ) from e
+                    self._count("auth_retries")
+                elif e.code not in RETRYABLE_HTTP:
                     raise BlobUnavailable(
                         f"blob op {point} failed with HTTP {e.code}"
                     ) from e
+                else:
+                    floor_s = _retry_after_s(e)
                 last = e
             except (
                 FaultError,
@@ -237,19 +344,35 @@ class _BlobClient:
                 seed, f"{point}.backoff", attempt - 1,
                 self.backoff_base_s, self.backoff_cap_s,
             )
+            if floor_s > delay:
+                self._count("retry_after_waits")
+                delay = floor_s
+            floor_s = 0.0
             delay = min(delay, max(deadline - time.monotonic(), 0.0))
             self._count("retries")
             self._count("backoff_ms", int(delay * 1000))
             time.sleep(delay)
 
-    # -- raw verbs -------------------------------------------------------------
+    # -- raw verbs (one round trip; subclasses implement) ----------------------
 
-    def _url(self, name: str) -> str:
-        return self.base_url + "/b" + urllib.parse.quote(name)
+    def _do_put(
+        self, name: str, data: bytes, rotate: bool, if_absent: bool
+    ) -> int:
+        raise NotImplementedError
 
-    def _request(self, req, timeout: float = 10.0):
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return resp.read()
+    def _do_get(self, name: str) -> bytes:
+        raise NotImplementedError
+
+    def _do_delete(self, name: str) -> bool:
+        raise NotImplementedError
+
+    def _do_list(self, prefix: str) -> list:
+        raise NotImplementedError
+
+    def _do_exists(self, name: str) -> bool:
+        raise NotImplementedError
+
+    # -- the chaos-wrapped verb surface ----------------------------------------
 
     def put(
         self,
@@ -260,17 +383,18 @@ class _BlobClient:
         chaos: bool = True,
         deadline_s: Optional[float] = None,
     ) -> Optional[int]:
-        """Upload one blob; the server rotates the previous payload to
+        """Upload one blob; the backend rotates the previous payload to
         ``<name>.prev`` when `rotate` (the two-generation contract).
-        `if_absent=True` is the conditional put (``If-None-Match: *``):
-        None means another writer got there first — the content-addressed
-        idempotence the corpus publish rides. A consumed ``torn`` fault
-        truncates the payload BEFORE upload: the partial PUT the read-side
-        CRC must reject. `chaos=False` (journal mirror only) skips the
-        injection point — see `_op`; `deadline_s` overrides the per-op
-        deadline (best-effort callers cap their stall).
+        `if_absent=True` is the conditional put (``If-None-Match: *`` /
+        ``ifGenerationMatch=0``): None means another writer got there
+        first — the content-addressed idempotence the corpus publish
+        rides. A consumed ``torn`` fault truncates the payload BEFORE
+        upload: the partial PUT the read-side CRC must reject.
+        `chaos=False` (journal mirror only) skips the injection point —
+        see `_op`; `deadline_s` overrides the per-op deadline
+        (best-effort callers cap their stall).
 
-        Returns the server's generation token — NEGATED when the upload
+        Returns the backend's generation token — NEGATED when the upload
         was torn, so the caller KNOWS this write is not trustworthy
         (ckptio must not mark the path written-intact, or a later write
         would rotate the torn generation over the good `.prev`, and a
@@ -281,24 +405,11 @@ class _BlobClient:
             self._count("torn_puts")
             data = data[: max(len(data) // 2, 1)]
             torn = True
-
-        def do():
-            headers = {"Content-Type": "application/octet-stream"}
-            if if_absent:
-                headers["If-None-Match"] = "*"
-            req = urllib.request.Request(
-                self._url(name) + f"?rotate={int(bool(rotate))}",
-                data=data,
-                method="PUT",
-                headers=headers,
-            )
-            out = json.loads(self._request(req) or b"{}")
-            return int(out.get("generation", 0))
-
         try:
             gen = self._op(
-                "blob.put", do, chaos=chaos, deadline_s=deadline_s,
-                name=name[-64:],
+                "blob.put",
+                lambda: self._do_put(name, data, rotate, if_absent),
+                chaos=chaos, deadline_s=deadline_s, name=name[-64:],
             )
         except _Conflict:
             return None
@@ -307,22 +418,17 @@ class _BlobClient:
     def get(self, name: str) -> bytes:
         """One blob's bytes; FileNotFoundError when absent (an answer, not
         a failure — never retried)."""
-
-        def do():
-            return self._request(urllib.request.Request(self._url(name)))
-
-        return self._op("blob.get", do, name=name[-64:])
+        return self._op(
+            "blob.get", lambda: self._do_get(name), name=name[-64:]
+        )
 
     def delete(self, name: str) -> bool:
         # Its own chaos point: deletes riding ``blob.put`` would shift
         # the put hit counter (replayed torn-put plans landing on the
         # wrong upload) and let put-targeted rules fire on GC traffic.
-        def do():
-            req = urllib.request.Request(self._url(name), method="DELETE")
-            out = json.loads(self._request(req) or b"{}")
-            return bool(out.get("deleted"))
-
-        return self._op("blob.delete", do, name=name[-64:])
+        return self._op(
+            "blob.delete", lambda: self._do_delete(name), name=name[-64:]
+        )
 
     def list(self, prefix: str = "/") -> list:
         """Every blob under `prefix` as `BlobStat` rows (absolute names).
@@ -334,64 +440,122 @@ class _BlobClient:
         if plan is not None and plan.consume_special("blob.list", "stale"):
             self._count("stale_lists")
             return list(self._stale_cache.get(prefix, ()))
-
-        def do():
-            req = urllib.request.Request(
-                self.base_url
-                + "/list?prefix="
-                + urllib.parse.quote(prefix)
-            )
-            out = json.loads(self._request(req) or b"{}")
-            return [
-                BlobStat(b["name"], int(b["size"]), float(b["mtime"]))
-                for b in out.get("blobs", ())
-            ]
-
-        out = self._op("blob.list", do, prefix=prefix[-64:])
+        out = self._op(
+            "blob.list", lambda: self._do_list(prefix), prefix=prefix[-64:]
+        )
         self._stale_cache[prefix] = list(out)
         return out
 
     def exists(self, name: str) -> bool:
-        """Existence probe via HEAD — answers without downloading the
-        payload (checkpoint generations are multi-MB; `any_generation`
-        probes two names per corpus lookup). Runs with `chaos=False`:
-        letting HEADs consume ``blob.get`` hits would shift the point's
-        hit numbering and break replayed plans (the same reason deletes
-        got their own point), and the payload GET that always follows a
-        positive probe is the real chaos surface anyway."""
-
-        def do():
-            req = urllib.request.Request(self._url(name), method="HEAD")
-            self._request(req)
-            return True
-
+        """Existence probe — answers without downloading the payload
+        (checkpoint generations are multi-MB; `any_generation` probes two
+        names per corpus lookup). Runs with `chaos=False`: letting probes
+        consume ``blob.get`` hits would shift the point's hit numbering
+        and break replayed plans (the same reason deletes got their own
+        point), and the payload GET that always follows a positive probe
+        is the real chaos surface anyway."""
         try:
             return bool(
-                self._op("blob.get", do, chaos=False, name=name[-64:])
+                self._op(
+                    "blob.get", lambda: self._do_exists(name),
+                    chaos=False, name=name[-64:],
+                )
             )
         except (FileNotFoundError, BlobUnavailable):
             return False
+
+
+class _BlobClient(_RetryingClient):
+    """The ``blob://`` emulator dialect: plain HTTP against `serve_blobd`
+    (``/b/<name>`` + ``/list``), server-side generation tokens, no auth."""
+
+    def _url(self, name: str) -> str:
+        return self.base_url + "/b" + urllib.parse.quote(name)
+
+    def _request(self, req, timeout: float = 10.0):
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.read()
+
+    def _do_put(
+        self, name: str, data: bytes, rotate: bool, if_absent: bool
+    ) -> int:
+        headers = {"Content-Type": "application/octet-stream"}
+        if if_absent:
+            headers["If-None-Match"] = "*"
+        req = urllib.request.Request(
+            self._url(name) + f"?rotate={int(bool(rotate))}",
+            data=data,
+            method="PUT",
+            headers=headers,
+        )
+        out = json.loads(self._request(req) or b"{}")
+        return int(out.get("generation", 0))
+
+    def _do_get(self, name: str) -> bytes:
+        return self._request(urllib.request.Request(self._url(name)))
+
+    def _do_delete(self, name: str) -> bool:
+        req = urllib.request.Request(self._url(name), method="DELETE")
+        out = json.loads(self._request(req) or b"{}")
+        return bool(out.get("deleted"))
+
+    def _do_list(self, prefix: str) -> list:
+        req = urllib.request.Request(
+            self.base_url + "/list?prefix=" + urllib.parse.quote(prefix)
+        )
+        out = json.loads(self._request(req) or b"{}")
+        return [
+            BlobStat(b["name"], int(b["size"]), float(b["mtime"]))
+            for b in out.get("blobs", ())
+        ]
+
+    def _do_exists(self, name: str) -> bool:
+        self._request(urllib.request.Request(self._url(name), method="HEAD"))
+        return True
 
 
 _clients: dict = {}
 _clients_lock = threading.Lock()
 
 
-def _client(base_url: str) -> _BlobClient:
+def _cached_client(key, factory):
+    """One client per store identity (server URL / (provider, endpoint,
+    bucket)) so counters, stale caches, and credential chains are
+    shared across every URI op against that store."""
     with _clients_lock:
-        c = _clients.get(base_url)
+        c = _clients.get(key)
         if c is None:
-            c = _clients[base_url] = _BlobClient(base_url)
+            c = _clients[key] = factory()
         return c
+
+
+def _client(base_url: str) -> _BlobClient:
+    return _cached_client(base_url, lambda: _BlobClient(base_url))
 
 
 # -- URI-level helpers (what ckptio routes through) ----------------------------
 
 
 def uri_client(uri: str) -> tuple:
-    """(client, absolute name) for one ``blob://`` URI."""
-    base, name = split_blob_uri(uri)
-    return _client(base), name
+    """(client, absolute name) for one wire-store URI — the scheme
+    dispatch behind `get_blob`/`put_blob`/`delete_blob`/`blob_exists`.
+    Managed clients import lazily: a fleet on ``blob://`` never pays for
+    the signing plumbing."""
+    backend = backend_of(uri)
+    if backend == "blob":
+        base, name = split_blob_uri(uri)
+        return _client(base), name
+    if backend == "s3":
+        from .blobstore_s3 import s3_client
+
+        _scheme, bucket, name = split_bucket_uri(uri)
+        return s3_client(bucket), name
+    if backend == "gs":
+        from .blobstore_gcs import gcs_client
+
+        _scheme, bucket, name = split_bucket_uri(uri)
+        return gcs_client(bucket), name
+    raise ValueError(f"not a wire-store URI: {uri!r}")
 
 
 def get_blob(uri: str) -> bytes:
@@ -427,6 +591,14 @@ def blob_exists(uri: str) -> bool:
 # -- rooted store views (the corpus-GC / discovery listing seam) ---------------
 
 
+#: LocalFS previous-listing cache for the ``stale`` LIST fault, keyed
+#: (abs root, prefix) — module-level so every rooted view over one
+#: directory shares it, mirroring the wire clients' per-server cache.
+#: This is what lets the stale-degrade invariance tests run the SAME
+#: chaos plan on ``file://`` as on the three wire backends.
+_local_stale: dict = {}
+
+
 class LocalFSBlobStore:
     """The filesystem backend behind the same four-verb surface: files
     under `root`, put through the pid-unique tmp + fsync + `os.replace`
@@ -441,6 +613,10 @@ class LocalFSBlobStore:
         return os.path.join(self.root, name)
 
     def list(self, prefix: str = "") -> list:
+        plan = active_plan()
+        key = (os.path.abspath(self.root or "."), prefix)
+        if plan is not None and plan.consume_special("blob.list", "stale"):
+            return list(_local_stale.get(key, ()))
         try:
             names = os.listdir(self.root)
         except OSError:
@@ -456,6 +632,7 @@ class LocalFSBlobStore:
             if not os.path.isfile(self._path(n)):
                 continue
             out.append(BlobStat(n, int(st.st_size), float(st.st_mtime)))
+        _local_stale[key] = list(out)
         return out
 
     def get(self, name: str) -> bytes:
@@ -503,17 +680,17 @@ class LocalFSBlobStore:
         return os.path.exists(self._path(name))
 
 
-class HTTPBlobStore:
-    """A rooted view over one server's `_BlobClient`: names are relative
-    to the root URI's prefix, so `CorpusStore.gc` / discovery listings run
-    the same code on both backends."""
+class RootedWireStore:
+    """A rooted view over one wire client: names are relative to the root
+    URI's prefix, so `CorpusStore.gc` / discovery listings run the same
+    code on every backend. Subclasses (`HTTPBlobStore`, the managed
+    stores) only choose the client and parse the prefix."""
 
-    def __init__(self, root_uri: str):
-        base, prefix = split_blob_uri(root_uri)
+    def __init__(self, root_uri: str, client, prefix: str):
         if not prefix.endswith("/"):
             prefix += "/"
         self.root = root_uri
-        self._c = _client(base)
+        self._c = client
         self._prefix = prefix
 
     def list(self, prefix: str = "") -> list:
@@ -542,14 +719,32 @@ class HTTPBlobStore:
         return self._c.exists(self._prefix + name)
 
 
+class HTTPBlobStore(RootedWireStore):
+    """The ``blob://`` emulator store, rooted at the URI's prefix."""
+
+    def __init__(self, root_uri: str):
+        base, prefix = split_blob_uri(root_uri)
+        super().__init__(root_uri, _client(base), prefix)
+
+
 def blob_backend(root: str):
     """The rooted store view for one root URI — `HTTPBlobStore` for
-    ``blob://``, `LocalFSBlobStore` for a plain/‌``file://`` path. The ONE
+    ``blob://``, the managed stores for ``s3://``/``gs://`` (lazy
+    import), `LocalFSBlobStore` for a plain/‌``file://`` path. The ONE
     dispatch every backend-agnostic consumer (corpus GC, member
     discovery, journal-root listing) goes through."""
     root = normalize_root(root)
-    if is_blob_uri(root):
+    backend = backend_of(root)
+    if backend == "blob":
         return HTTPBlobStore(root)
+    if backend == "s3":
+        from .blobstore_s3 import S3BlobStore
+
+        return S3BlobStore(root)
+    if backend == "gs":
+        from .blobstore_gcs import GCSBlobStore
+
+        return GCSBlobStore(root)
     return LocalFSBlobStore(root)
 
 
@@ -558,12 +753,17 @@ def blob_backend(root: str):
 
 class _ServerHandle:
     """serve_blobd's return: the bound address, the live store dict (tests
-    reach in to corrupt/inspect payloads), and shutdown."""
+    reach in to corrupt/inspect payloads), the env vars a client process
+    needs to reach this server (empty for the native dialect; endpoint +
+    static credentials for the provider dialects), and shutdown."""
+
+    dialect = "blob"
 
     def __init__(self, httpd, store, thread):
         self.httpd = httpd
         self.store = store
         self.thread = thread
+        self.env: dict = {}
 
     @property
     def address(self) -> str:
@@ -581,9 +781,16 @@ class _ServerHandle:
             self.thread.join(timeout=5.0)
 
 
-def serve_blobd(address: str = "localhost:0", block: bool = False):
+def serve_blobd(
+    address: str = "localhost:0", block: bool = False, dialect: str = "blob"
+):
     """The in-proc HTTP object-store emulator (`scripts/blobd.py` runs it
-    standalone). Protocol — deliberately the S3/GCS-shaped minimum:
+    standalone). `dialect` selects the wire protocol: the native
+    ``blob`` protocol below, or the provider-conformance dialects
+    (``s3``/``gcs`` — SigV4/OAuth verification, provider error shapes,
+    metadata + token planes) served by `faults/blobdialect.py`.
+
+    Native protocol — deliberately the S3/GCS-shaped minimum:
 
     - ``PUT /b/<name>?rotate=0|1`` — store bytes; ``rotate=1`` moves the
       previous payload to ``<name>.prev`` atomically first (the
@@ -598,6 +805,10 @@ def serve_blobd(address: str = "localhost:0", block: bool = False):
     Storage is in-memory (an emulator, not a database): one dict guarded
     by a lock, rotation + conditional checks atomic under it.
     """
+    if dialect != "blob":
+        from .blobdialect import serve_dialect
+
+        return serve_dialect(dialect, address=address, block=block)
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     store: dict = {}  # name -> {"data": bytes, "gen": int, "mtime": float}

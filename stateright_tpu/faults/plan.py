@@ -106,6 +106,17 @@ Injection points (the name is the contract; grep for `maybe_fault(`):
                         never a wrong one) — its own point so delete
                         traffic never shifts ``blob.put`` hit numbering
                         in a replayed plan
+- ``creds.refresh``   — managed-store credential resolve/refresh
+                        (faults/creds.py CredentialChain, ctx
+                        ``provider=s3|gcs``): an injected fault fails ONE
+                        chain resolve — near expiry the stale credentials
+                        keep serving through the grace window (counted
+                        ``grace_served``), past it the chain raises
+                        `CredentialError` (an OSError) and the blob
+                        client's bounded retry absorbs it like any
+                        transport failure: an expiring token
+                        mid-checkpoint degrades to bounded retry, never a
+                        lost generation
 - ``fleet.rejoin``    — replica rejoin entry (service/fleet.py
                         ServiceFleet.rejoin_replica, ctx ``replica=i``),
                         BEFORE the fresh lease grant and the respawn — an

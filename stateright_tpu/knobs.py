@@ -87,6 +87,16 @@ COST_VARIANTS = ("split", "kv", "phased", "capped", "capped-kv", "pallas")
 #: check_registry() pins the per-engine aliases against it.
 WARM_KINDS = ("exact", "near", "partial", "delta")
 
+#: Blob-store backends (`faults/blobstore.py`'s `backend_of` scheme
+#: dispatch, the `--backend` smoke selector, the bench per-backend legs):
+#: "file" is the local filesystem (plain path / ``file://``), "blob" the
+#: in-house HTTP emulator (``blob://host:port``), "s3" and "gs" the
+#: managed providers (``s3://bucket``/``gs://bucket`` — dialect
+#: emulators in `faults/blobdialect.py` serve them hermetically). First
+#: member is the non-wire default; `backend_of` dispatches on the rest,
+#: in order, as URI schemes.
+BLOB_BACKENDS = ("file", "blob", "s3", "gs")
+
 
 def check_registry() -> list:
     """Cross-module drift probe used by `python -m stateright_tpu.analysis`:
@@ -142,6 +152,28 @@ def check_registry() -> list:
             "store.warm.WARM_KINDS is a restated copy, not the "
             "knobs.WARM_KINDS alias"
         )
+
+    # The URI dispatcher (faults/blobstore.py — jax-free like this module)
+    # must dispatch over THE backend tuple: `backend_of` iterates
+    # BLOB_BACKENDS[1:] as URI schemes, so a restated copy there would let
+    # a new scheme land in one place and silently not the other.
+    from .faults import blobstore
+
+    if blobstore.BLOB_BACKENDS is not BLOB_BACKENDS:
+        problems.append(
+            "faults.blobstore.BLOB_BACKENDS is a restated copy, not the "
+            "knobs.BLOB_BACKENDS alias"
+        )
+    for backend in BLOB_BACKENDS:
+        probe = {
+            "file": "/tmp/x", "blob": "blob://h:1/x",
+            "s3": "s3://b/x", "gs": "gs://b/x",
+        }[backend]
+        if blobstore.backend_of(probe) != backend:
+            problems.append(
+                f"blobstore.backend_of does not round-trip backend "
+                f"{backend!r} (probe {probe!r})"
+            )
 
     try:
         from .parallel.sharded import ShardedSearch

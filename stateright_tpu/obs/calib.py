@@ -99,10 +99,10 @@ def default_device_kind() -> str:
     try:  # jax is already resident in every engine process; stay lazy
         import jax
 
-        backend = jax.default_backend()
+        platform = jax.default_backend()
     except Exception:
-        backend = "cpu"
-    return "cpu-1core" if backend == "cpu" else "tpu-v5e"
+        platform = "cpu"
+    return "cpu-1core" if platform == "cpu" else "tpu-v5e"
 
 
 def theta_of(device: DeviceSpec) -> list:

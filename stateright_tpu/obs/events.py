@@ -346,7 +346,9 @@ def read_journal(path: str) -> list:
     Non-final garbage lines are skipped the same way (a forensic reader
     takes what it can prove)."""
     try:
-        if path.startswith("blob://"):
+        from ..faults.blobstore import is_blob_uri
+
+        if is_blob_uri(path):
             from ..faults.blobstore import get_blob
 
             data = get_blob(path).decode("utf-8", errors="replace")

@@ -249,7 +249,15 @@ REGISTRY_SOURCES = {
     "simulation": "device random-simulation engine (tensor/simulation.py — "
                   "walks, restarts, shared-table dedup hits)",
     "blob": "object-store backend client (faults/blobstore.py — ops, "
-            "retries, backoff, torn puts, stale lists, unavailability)",
+            "retries, backoff, torn puts, stale lists, unavailability, "
+            "Retry-After floor waits, auth retries)",
+    "blob_s3": "managed S3 backend client (faults/blobstore_s3.py — same "
+               "counter keys as \"blob\"; SigV4-signed wire ops)",
+    "blob_gcs": "managed GCS backend client (faults/blobstore_gcs.py — "
+                "same counter keys as \"blob\"; bearer-authed JSON API)",
+    "creds": "managed-store credential chain (faults/creds.py — "
+             "resolves, refreshes, refresh failures, grace-window "
+             "serves, SDK-unavailable degrades)",
     "autoscaler": "elastic control plane reconciliation loop "
                   "(service/autoscale.py — AUTOSCALE_COUNTER_KEYS)",
     "calib": "calibration observatory comparator (obs/calib.py — "
@@ -359,6 +367,12 @@ EVENT_TYPES = {
     # the timeline CLI can answer "which job, which engine, which term,
     # when" from the journal alone.
     "calib.drift": ("engine", "term"),
+    # One managed-store credential resolve/refresh attempt (faults/
+    # creds.py CredentialChain._refresh — provider s3|gcs; ok=1 carries
+    # the chain rung that produced the credentials in `source`, ok=0 the
+    # failing exception type). Journaled only while a chaos plan is
+    # recording, like fault.injected.
+    "creds.refresh": ("provider",),
 }
 
 #: Event types that end a job's timeline — obs/timeline.py flags a trace

@@ -62,8 +62,10 @@ def expand_paths(paths) -> list:
     boundaries, so a blob listing may trail the local truth by one flush
     window — the reader's torn-tail discipline covers the ragged edge."""
     out: list = []
+    from ..faults.blobstore import is_blob_uri
+
     for p in paths:
-        if isinstance(p, str) and p.startswith("blob://"):
+        if is_blob_uri(p):
             if p.endswith(".jsonl"):
                 out.append(p)
                 continue

@@ -56,6 +56,13 @@ class MemberDirectory:
     def __init__(self, root: str):
         self.root = normalize_root(root)
         self._dir = os.path.join(self.root, "members")
+        # Member names THIS instance has published or resolved — the
+        # read-your-own-writes floor under `members()`: a stale LIST
+        # (the ``blob.list`` stale window) may omit a record we just
+        # wrote, but it can never make this instance forget it. Names
+        # only (records re-read per call — the listing stays the one
+        # source of record truth; this is membership-of-the-listing).
+        self._seen: set = set()
 
     def path_for(self, member: str) -> str:
         return os.path.join(self._dir, f"member-{_safe(member)}.json")
@@ -81,6 +88,7 @@ class MemberDirectory:
         write_record(
             self.path_for(member), json.dumps(rec).encode(), MEMBER_MAGIC
         )
+        self._seen.add(member)
         return rec
 
     def lookup(self, member: str) -> Optional[dict]:
@@ -96,17 +104,27 @@ class MemberDirectory:
             rec = json.loads(payload)
         except ValueError:
             return None
-        return rec if isinstance(rec, dict) and "member" in rec else None
+        if isinstance(rec, dict) and "member" in rec:
+            self._seen.add(member)
+            return rec
+        return None
 
     def members(self) -> list:
-        """Every member with an intact record, from the root alone (the
-        listing is the ``blob.list`` chaos surface: a stale listing is a
-        stale membership view, converged by the next call)."""
-        out = []
+        """Every member with an intact record: the listing (the
+        ``blob.list`` chaos surface — a stale listing is a stale
+        membership view, converged by the next call) UNIONED with the
+        names this instance already knows, so a member we just published
+        or resolved is never hidden by the stale window —
+        read-your-own-writes via the per-record `read_record_latest`
+        path, which does not route through LIST."""
+        names = set()
         for st in blob_backend(self._dir).list("member-"):
             if st.name.endswith(".prev"):
                 continue
-            name = st.name[len("member-"):].rsplit(".json", 1)[0]
+            names.add(st.name[len("member-"):].rsplit(".json", 1)[0])
+        names.update(_safe(m) for m in self._seen)
+        out = []
+        for name in sorted(names):
             rec = self.lookup(name)
             if rec is not None:
                 out.append(rec)
@@ -115,6 +133,8 @@ class MemberDirectory:
     def retire(self, member: str) -> None:
         """Best-effort record removal (clean shutdown); a crashed member's
         record simply goes stale instead."""
+        self._seen.discard(member)
+        self._seen.discard(_safe(member))
         path = self.path_for(member)
         try:
             if is_blob_uri(self.root):

@@ -52,6 +52,7 @@ import urllib.request
 from typing import Optional
 
 from ..core.discovery import HasDiscoveries
+from ..faults.blobstore import is_blob_uri
 from ..faults.plan import maybe_fault
 from ..obs import as_tracer
 from ..tensor.frontier import SearchResult
@@ -176,6 +177,7 @@ class RemoteReplica:
         self.store_root = store_root
         self.rediscoveries = 0
         self._next_rediscover = 0.0  # throttle: record reads cost retries
+        self._adopted_ts = 0.0  # newest record ts adopted (stale guard)
         self.request_timeout_s = request_timeout_s
         self.probe_timeout_s = probe_timeout_s
         # Router-tick control ops (withdraw) get a SHORT deadline: a
@@ -319,11 +321,21 @@ class RemoteReplica:
             return
         if rec is None:
             return
+        # Stale-record guard: `read_record_latest` serves `.prev` while
+        # the current record is torn mid-rotation, and a stale LIST
+        # window can do the same store-side — so a read here can return
+        # an OLDER record than one we already adopted. Adopting it would
+        # regress the address to a dead incarnation's port; records
+        # carry the publisher's heartbeat `ts`, so only move forward.
+        rec_ts = float(rec.get("ts", 0.0) or 0.0)
+        if rec_ts < self._adopted_ts:
+            return
         addr = str(rec.get("address", "")).rstrip("/")
         if addr and addr != self.base_url:
             with self._lock:
                 self.base_url = addr
                 self.rediscoveries += 1
+                self._adopted_ts = rec_ts
             self._tracer.instant(
                 "fleet.rediscover", cat="fleet", replica=self.idx,
                 address=addr,
@@ -615,7 +627,7 @@ def spawn_replica_proc(
 
     member = lease_member(idx)
     scratch = scratch or root
-    if scratch.startswith("blob://"):
+    if is_blob_uri(scratch):
         raise ValueError(
             "spawn_replica_proc needs a LOCAL scratch dir for child "
             "logs/journals when the store root is a blob URI"

@@ -363,8 +363,12 @@ def test_device_detail_pins_blob_row_keys():
     # must survive into detail.device so the ISSUE-15 "object store
     # costs only the wire, never the answers" claim is auditable in
     # every BENCH_r*.json.
+    # The managed-dialect legs (ISSUE 20) pin the same trio per
+    # provider: signed wall time, overhead vs sec_local_fs, counters.
     for key in (
         "sec_local_fs", "blob_overhead_pct", "blob_ops", "blob_retries",
+        "sec_s3", "s3_overhead_pct", "s3_ops", "s3_retries",
+        "sec_gcs", "gcs_overhead_pct", "gcs_ops", "gcs_retries",
     ):
         assert key in bench.DEVICE_DETAIL_FIELDS
     row = bench.device_detail(
@@ -375,11 +379,23 @@ def test_device_detail_pins_blob_row_keys():
             "blob_overhead_pct": 3.3,
             "blob_ops": 412,
             "blob_retries": 2,
+            "sec_s3": 9.8,
+            "s3_overhead_pct": 7.7,
+            "s3_ops": 415,
+            "s3_retries": 3,
+            "sec_gcs": 9.6,
+            "gcs_overhead_pct": 5.5,
+            "gcs_ops": 414,
+            "gcs_retries": 1,
         }
     )
     assert row["sec_local_fs"] == 9.1
     assert row["blob_overhead_pct"] == 3.3
     assert row["blob_ops"] == 412
+    assert row["sec_s3"] == 9.8
+    assert row["s3_retries"] == 3
+    assert row["sec_gcs"] == 9.6
+    assert row["gcs_ops"] == 414
 
 
 def test_fleet_counter_keys_conform_to_obs_schema():
